@@ -1,0 +1,50 @@
+"""h2o3_tpu — a TPU-native distributed ML platform with H2O-3's capabilities.
+
+Brand-new design (not a port): frames are row-sharded ``jax.Array``s over a
+device mesh, whole-dataset algorithms are jit-compiled SPMD programs with XLA
+collectives in place of the reference's MRTask RPC tree, and tree histograms
+target the MXU/VPU instead of CUDA ``gpu_hist``.  See SURVEY.md for the
+reference analysis and the layer-by-layer mapping.
+
+Module-level API mirrors the ``h2o`` Python package (h2o-py/h2o/h2o.py):
+``init``, ``import_file``, ``upload_string``, ``get_frame``, ``remove`` …
+"""
+
+from .runtime.cluster import init, cluster, shutdown
+from .runtime import dkv
+from .frame.frame import Frame
+from .frame.vec import Vec
+from .frame.parse import import_file, parse_csv, upload_string
+
+__version__ = "0.1.0"
+
+
+def get_frame(key: str) -> Frame:
+    f = dkv.get(key)
+    if f is None:
+        raise KeyError(f"no frame under key {key!r}")
+    return f
+
+
+def get_model(key: str):
+    m = dkv.get(key)
+    if m is None:
+        raise KeyError(f"no model under key {key!r}")
+    return m
+
+
+def ls():
+    """List all DKV keys — analog of h2o.ls()."""
+    return dkv.keys()
+
+
+def remove(key: str) -> None:
+    dkv.remove(key)
+
+
+def remove_all() -> None:
+    dkv.clear()
+
+
+def cluster_status() -> dict:
+    return cluster().describe()

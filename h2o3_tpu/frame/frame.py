@@ -1,0 +1,205 @@
+"""Frame: a named columnar table of Vecs, row-sharded over the mesh.
+
+Reference: ``water/fvec/Frame.java:65`` — a Frame is an ordered set of column
+names + Vec keys, lockable for R/W coherence, living in the DKV.  Columns are
+chunked identically (VectorGroup, Vec.java:1528) so row i of every column is
+on the same node.
+
+TPU-native redesign: every Vec payload is a ``jax.Array`` sharded with the
+same NamedSharding over the mesh "rows" axis, which gives the VectorGroup
+row-alignment property by construction.  There is no lock protocol — Frames
+are functionally immutable (mutation returns a new Frame), which is what XLA
+wants anyway.  ``matrix()`` materializes a [rows, features] design block for
+the algorithms (the hot path feeding the MXU) and caches it on the Frame the
+way the reference caches rollups.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..runtime.cluster import cluster
+from ..runtime import dkv
+from .vec import Vec, T_CAT, T_NUM, T_STR, T_TIME
+
+
+class Frame:
+    def __init__(self, names: Sequence[str], vecs: Sequence[Vec],
+                 key: Optional[str] = None):
+        if len(names) != len(vecs):
+            raise ValueError("names/vecs length mismatch")
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate column names: {list(names)}")
+        nrows = {v.nrows for v in vecs}
+        if len(nrows) > 1:
+            raise ValueError(f"vecs disagree on nrows: {nrows}")
+        self.names: List[str] = list(names)
+        self.vecs: List[Vec] = list(vecs)
+        self.nrows: int = vecs[0].nrows if vecs else 0
+        self.key = key
+        self._matrix_cache: Dict[tuple, jax.Array] = {}
+        if key is not None:
+            dkv.put(key, self)
+
+    # ------------------------------------------------------------- accessors
+    @property
+    def ncols(self) -> int:
+        return len(self.vecs)
+
+    @property
+    def shape(self):
+        return (self.nrows, self.ncols)
+
+    @property
+    def padded_rows(self) -> int:
+        return self.vecs[0].padded_len if self.vecs else 0
+
+    def vec(self, name: str) -> Vec:
+        try:
+            return self.vecs[self.names.index(name)]
+        except ValueError:
+            raise KeyError(f"no column {name!r} in frame (have {self.names})")
+
+    def __getitem__(self, cols) -> "Frame":
+        if isinstance(cols, str):
+            cols = [cols]
+        return Frame(cols, [self.vec(c) for c in cols])
+
+    def types(self) -> Dict[str, str]:
+        return {n: v.type for n, v in zip(self.names, self.vecs)}
+
+    def valid_mask(self) -> jax.Array:
+        return self.vecs[0].valid_mask()
+
+    # ------------------------------------------------------------ construct
+    @staticmethod
+    def from_numpy(arrays: Dict[str, np.ndarray], key: Optional[str] = None,
+                   types: Optional[Dict[str, str]] = None,
+                   domains: Optional[Dict[str, Sequence[str]]] = None) -> "Frame":
+        """Build a Frame from host columns (tests' TestFrameBuilder analog)."""
+        types = types or {}
+        domains = domains or {}
+        names, vecs = [], []
+        for name, arr in arrays.items():
+            arr = np.asarray(arr)
+            vtype = types.get(name)
+            domain = domains.get(name)
+            if vtype is None:
+                if arr.dtype == object or arr.dtype.kind in "US":
+                    labels, codes = np.unique(arr.astype(str), return_inverse=True)
+                    vtype, domain, arr = T_CAT, [str(l) for l in labels], codes
+                else:
+                    vtype = T_NUM
+            names.append(name)
+            vecs.append(Vec.from_numpy(arr, vtype, domain=domain))
+        return Frame(names, vecs, key=key)
+
+    # --------------------------------------------------------------- munging
+    def cbind(self, other: "Frame") -> "Frame":
+        if other.nrows != self.nrows:
+            raise ValueError("cbind: row counts differ")
+        return Frame(self.names + other.names, self.vecs + other.vecs)
+
+    def rename(self, mapping: Dict[str, str]) -> "Frame":
+        return Frame([mapping.get(n, n) for n in self.names], self.vecs)
+
+    def drop(self, cols: Sequence[str]) -> "Frame":
+        cols = set([cols] if isinstance(cols, str) else cols)
+        keep = [(n, v) for n, v in zip(self.names, self.vecs) if n not in cols]
+        return Frame([n for n, _ in keep], [v for _, v in keep])
+
+    def with_vec(self, name: str, vec: Vec) -> "Frame":
+        if name in self.names:
+            vecs = list(self.vecs)
+            vecs[self.names.index(name)] = vec
+            return Frame(self.names, vecs)
+        return Frame(self.names + [name], self.vecs + [vec])
+
+    def rows(self, index: np.ndarray) -> "Frame":
+        """Row subset by integer index (host-driven gather, re-sharded)."""
+        index = np.asarray(index)
+        out = []
+        for v in self.vecs:
+            if v.data is None:
+                out.append(Vec.from_numpy(v.host_data[: v.nrows][index], v.type))
+            else:
+                col = np.asarray(v.data)[: v.nrows][index]
+                out.append(Vec.from_numpy(col, v.type, domain=v.domain,
+                                          time_base=v.time_base))
+        return Frame(self.names, out)
+
+    def filter(self, mask: np.ndarray) -> "Frame":
+        mask = np.asarray(mask, dtype=bool)
+        return self.rows(np.nonzero(mask[: self.nrows])[0])
+
+    def split_frame(self, ratios: Sequence[float], seed: int = 0) -> List["Frame"]:
+        """Random row split — analog of h2o.split_frame (random uniform)."""
+        rng = np.random.default_rng(seed)
+        u = rng.random(self.nrows)
+        bounds = np.cumsum(list(ratios))
+        if len(bounds) == 0 or bounds[-1] < 1.0 - 1e-9:
+            bounds = np.append(bounds, 1.0)
+        bounds[-1] = np.inf  # last piece takes everything remaining
+        pieces, lo = [], 0.0
+        for hi in bounds:
+            pieces.append(self.filter((u >= lo) & (u < hi)))
+            lo = hi
+        return pieces
+
+    # ---------------------------------------------------------- device views
+    def matrix(self, cols: Optional[Sequence[str]] = None,
+               dtype=jnp.float32) -> jax.Array:
+        """[padded_rows, len(cols)] design block; cats as raw codes (-1 NA).
+
+        The MXU feed: column Vec payloads stacked into one row-sharded 2-D
+        array.  Cached per column-set (the reference caches the per-algo
+        DataInfo adaptation similarly, hex/DataInfo.java).
+        """
+        cols = list(cols) if cols is not None else list(self.names)
+        ck = (tuple(cols), str(dtype))
+        hit = self._matrix_cache.get(ck)
+        if hit is not None:
+            return hit
+        cl = cluster()
+        parts = []
+        for c in cols:
+            v = self.vec(c)
+            if v.data is None:
+                raise TypeError(f"column {c!r} of type {v.type} is host-only")
+            parts.append(v.data.astype(dtype))
+        mat = jnp.stack(parts, axis=1)
+        mat = jax.device_put(mat, cl.matrix_sharding)
+        self._matrix_cache[ck] = mat
+        return mat
+
+    # ---------------------------------------------------------------- export
+    def to_pandas(self):
+        import pandas as pd
+        return pd.DataFrame({n: v.decoded() for n, v in zip(self.names, self.vecs)})
+
+    def to_numpy(self) -> np.ndarray:
+        return np.stack([np.asarray(v.to_numpy(), dtype=np.float64)
+                         for v in self.vecs], axis=1)
+
+    def head(self, n: int = 10):
+        return self.to_pandas().head(n)
+
+    def summary(self) -> Dict[str, dict]:
+        out = {}
+        for name, v in zip(self.names, self.vecs):
+            if v.data is None:
+                out[name] = {"type": v.type, "missing": v.rollups().nmissing}
+            else:
+                r = v.rollups()
+                out[name] = {"type": v.type, "min": r.vmin, "max": r.vmax,
+                             "mean": r.mean, "sigma": r.sigma,
+                             "missing": r.nmissing, "zeros": r.nzero,
+                             "cardinality": v.cardinality}
+        return out
+
+    def __repr__(self):
+        return f"<Frame {self.key or ''} {self.nrows}x{self.ncols} {self.names[:8]}>"

@@ -1,0 +1,234 @@
+"""Vec: one column of a distributed Frame.
+
+Reference: ``water/fvec/Vec.java:157`` — a Vec is column metadata + an ESPC
+row layout + per-chunk DKV keys, with logical types T_BAD/T_UUID/T_STR/T_NUM/
+T_CAT/T_TIME (Vec.java:207-212) and lazily computed, cached ``RollupStats``
+(min/max/mean/sigma/histogram; fvec/RollupStats.java:19-30).  Chunks use 20+
+compression codecs chosen at write time (fvec/NewChunk.java:1133).
+
+TPU-native redesign: a Vec's payload is ONE row-sharded ``jax.Array`` padded
+to the cluster row multiple — XLA wants flat dtypes and static shapes, so the
+codec zoo collapses to dtype narrowing (float32 for numeric/time, int32 codes
+for categoricals).  Missing values are NaN (numeric) or code -1 (categorical).
+Strings/UUIDs stay host-side (numpy object arrays) — they never participate in
+device compute (SURVEY.md §7 "keep string columns host-side only").
+Rollups are computed lazily in a single fused XLA pass and cached, exactly
+mirroring the reference's RollupStats contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..runtime.cluster import cluster
+
+# Logical column types — mirrors Vec.java:207-212.
+T_BAD = "bad"
+T_NUM = "num"
+T_CAT = "cat"
+T_TIME = "time"
+T_STR = "str"
+T_UUID = "uuid"
+
+_DEVICE_TYPES = (T_NUM, T_CAT, T_TIME, T_BAD)
+
+
+@dataclasses.dataclass
+class RollupStats:
+    """Lazily computed column statistics (fvec/RollupStats.java:19-30)."""
+
+    nrows: int
+    nmissing: int
+    mean: float
+    sigma: float
+    vmin: float
+    vmax: float
+    nzero: int
+
+    @property
+    def is_constant(self) -> bool:
+        return self.nrows - self.nmissing > 0 and self.vmin == self.vmax
+
+
+@jax.jit
+def _rollup_kernel(data, valid):
+    """One fused pass computing all rollup stats for a numeric column."""
+    present = valid & ~jnp.isnan(data)
+    x = jnp.where(present, data, 0.0)
+    n = jnp.sum(present)
+    nf = jnp.maximum(n, 1).astype(jnp.float32)
+    s = jnp.sum(x, dtype=jnp.float32)
+    ss = jnp.sum(x * x, dtype=jnp.float32)
+    mean = s / nf
+    var = jnp.maximum(ss / nf - mean * mean, 0.0)
+    big = jnp.float32(np.finfo(np.float32).max)
+    vmin = jnp.min(jnp.where(present, data, big))
+    vmax = jnp.max(jnp.where(present, data, -big))
+    nzero = jnp.sum(present & (data == 0.0))
+    return n, mean, var * nf / jnp.maximum(nf - 1.0, 1.0), vmin, vmax, nzero
+
+
+class Vec:
+    """One column: device payload (or host payload for str/uuid) + metadata."""
+
+    def __init__(self, data, vtype: str, nrows: int,
+                 domain: Optional[Sequence[str]] = None,
+                 host_data: Optional[np.ndarray] = None,
+                 time_base: float = 0.0):
+        self.type = vtype
+        self.nrows = int(nrows)
+        self.domain = list(domain) if domain is not None else None
+        self.host_data = host_data          # str/uuid payload (numpy object)
+        self.time_base = time_base          # TIME: ms-since-epoch of code 0
+        self.data = data                    # padded row-sharded jax.Array
+        self._rollups: Optional[RollupStats] = None
+
+    # ------------------------------------------------------------------ ctor
+    @staticmethod
+    def from_numpy(arr: np.ndarray, vtype: str = T_NUM,
+                   domain: Optional[Sequence[str]] = None,
+                   time_base: Optional[float] = None) -> "Vec":
+        """Build a Vec from host data, padding + sharding onto the mesh.
+
+        TIME input is float64 ms-since-epoch.  The device payload is rebased
+        to ``(ms - time_base) / 1000`` seconds in float32 (well-conditioned
+        for modeling; ~seconds resolution over year ranges) while the exact
+        float64 ms stay host-side for round-trips.
+        """
+        cl = cluster()
+        arr = np.asarray(arr)
+        n = len(arr)
+        if vtype in (T_STR, T_UUID):
+            return Vec(None, vtype, n, host_data=np.asarray(arr, dtype=object))
+        padded = cl.pad_rows(n)
+        host_data = None
+        if vtype == T_CAT:
+            if arr.dtype == object or arr.dtype.kind in "US":
+                labels = list(domain) if domain is not None else \
+                    [str(u) for u in np.unique(arr.astype(str))]
+                lookup = {s: i for i, s in enumerate(labels)}
+                arr = np.array([lookup.get(str(v), -1) for v in arr],
+                               dtype=np.int32)
+                domain = labels
+            buf = np.full(padded, -1, dtype=np.int32)
+            buf[:n] = arr.astype(np.int32)
+        else:
+            vals = arr.astype(np.float64)
+            if vtype == T_TIME:
+                host_data = vals
+                if time_base is None:
+                    finite = vals[np.isfinite(vals)]
+                    time_base = float(finite.min()) if len(finite) else 0.0
+                vals = (vals - time_base) / 1000.0
+            buf = np.full(padded, np.nan, dtype=np.float32)
+            buf[:n] = vals.astype(np.float32)
+        data = jax.device_put(buf, cl.row_sharding)
+        return Vec(data, vtype, n, domain=domain, host_data=host_data,
+                   time_base=time_base or 0.0)
+
+    # ----------------------------------------------------------------- props
+    @property
+    def is_numeric(self) -> bool:
+        return self.type in (T_NUM, T_TIME)
+
+    @property
+    def is_categorical(self) -> bool:
+        return self.type == T_CAT
+
+    @property
+    def cardinality(self) -> int:
+        return len(self.domain) if self.domain is not None else -1
+
+    @property
+    def padded_len(self) -> int:
+        return int(self.data.shape[0]) if self.data is not None else self.nrows
+
+    def valid_mask(self) -> jax.Array:
+        """Boolean [padded] mask of real (non-padding) rows."""
+        idx = jnp.arange(self.padded_len)
+        return idx < self.nrows
+
+    # --------------------------------------------------------------- rollups
+    def rollups(self) -> RollupStats:
+        """Lazy cached stats — the RollupStats contract (RollupStats.java:19)."""
+        if self._rollups is None:
+            if self.data is None:
+                miss = int(sum(1 for v in self.host_data[: self.nrows] if v is None))
+                self._rollups = RollupStats(self.nrows, miss, float("nan"),
+                                            float("nan"), float("nan"),
+                                            float("nan"), 0)
+            elif self.type == T_TIME and self.host_data is not None:
+                x = self.host_data[: self.nrows]
+                ok = np.isfinite(x)
+                n = int(ok.sum())
+                self._rollups = RollupStats(
+                    nrows=self.nrows, nmissing=self.nrows - n,
+                    mean=float(np.mean(x[ok])) if n else float("nan"),
+                    sigma=float(np.std(x[ok], ddof=1)) if n > 1 else float("nan"),
+                    vmin=float(np.min(x[ok])) if n else float("nan"),
+                    vmax=float(np.max(x[ok])) if n else float("nan"),
+                    nzero=int((x[ok] == 0).sum()))
+            else:
+                x = self.numeric_data()
+                n, mean, var, vmin, vmax, nzero = _rollup_kernel(x, self.valid_mask())
+                n = int(n)
+                self._rollups = RollupStats(
+                    nrows=self.nrows, nmissing=self.nrows - n,
+                    mean=float(mean) if n else float("nan"),
+                    sigma=float(np.sqrt(max(float(var), 0.0))) if n > 1 else float("nan"),
+                    vmin=float(vmin) if n else float("nan"),
+                    vmax=float(vmax) if n else float("nan"),
+                    nzero=int(nzero))
+        return self._rollups
+
+    def numeric_data(self) -> jax.Array:
+        """Payload as float32 with NaN missing (cat codes -1 -> NaN)."""
+        if self.data is None:
+            raise TypeError(f"Vec of type {self.type} has no device payload")
+        if self.type == T_CAT:
+            return jnp.where(self.data < 0, jnp.nan, self.data.astype(jnp.float32))
+        return self.data
+
+    def mean(self) -> float:
+        return self.rollups().mean
+
+    def sigma(self) -> float:
+        return self.rollups().sigma
+
+    def min(self) -> float:
+        return self.rollups().vmin
+
+    def max(self) -> float:
+        return self.rollups().vmax
+
+    def nmissing(self) -> int:
+        return self.rollups().nmissing
+
+    # ---------------------------------------------------------------- export
+    def to_numpy(self) -> np.ndarray:
+        """Materialize the logical (unpadded) column on host.
+
+        TIME returns the exact float64 ms-since-epoch kept host-side.
+        """
+        if self.type == T_TIME and self.host_data is not None:
+            return self.host_data[: self.nrows]
+        if self.data is None:
+            return self.host_data[: self.nrows]
+        return np.asarray(self.data)[: self.nrows]
+
+    def decoded(self) -> np.ndarray:
+        """Host column with categorical codes mapped back to labels."""
+        arr = self.to_numpy()
+        if self.type == T_CAT and self.domain is not None:
+            dom = np.asarray(self.domain, dtype=object)
+            out = np.empty(len(arr), dtype=object)
+            ok = arr >= 0
+            out[ok] = dom[arr[ok]]
+            out[~ok] = None
+            return out
+        return arr

@@ -1,0 +1,140 @@
+"""Cluster runtime: the TPU-native analog of H2O's "cloud".
+
+The reference (h2o-core/src/main/java/water/H2O.java, water/Paxos.java:27,
+water/HeartBeatThread.java:16) forms a cloud of JVMs via multicast heartbeats
+and a mutual-knowledge consensus, then locks membership at the first job.
+
+On TPU the topology is known at launch: a pod slice is gang-scheduled, so no
+consensus protocol is needed (SURVEY.md §5 "Distributed communication
+backend").  The Cluster here is a thin, explicit object: a
+``jax.sharding.Mesh`` over the available devices plus named shardings used by
+the data plane.  Multi-process operation uses ``jax.distributed.initialize``
+(the analog of flatfile-based clouding); within a process everything is SPMD
+over the mesh and all reductions are XLA collectives over ICI instead of the
+reference's MRTask RPC tree (water/MRTask.java:739-760).
+
+Axis names:
+  * ``"rows"``  — the data axis; Frames are row-sharded over it (the analog of
+    H2O chunk distribution, water/fvec/Vec.java:152 ESPC).
+  * ``"model"`` — optional second axis for feature/model sharding (the TP
+    analog for very wide Gram matrices, SURVEY.md §2.10).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+ROW_AXIS = "rows"
+MODEL_AXIS = "model"
+
+_lock = threading.Lock()
+_cluster: "Cluster | None" = None
+
+
+@dataclasses.dataclass
+class Cluster:
+    """A booted cluster: device mesh + canonical shardings.
+
+    Analog of the reference's ``H2O.CLOUD`` (water/H2O.java) — but instead of
+    a membership list plus a key-homing hash (water/Key.java:175-181), data
+    placement is expressed as JAX shardings over the mesh.
+    """
+
+    mesh: Mesh
+
+    # -- canonical shardings -------------------------------------------------
+    @property
+    def row_sharding(self) -> NamedSharding:
+        """Sharding for 1-D row vectors (one Vec's payload)."""
+        return NamedSharding(self.mesh, P(ROW_AXIS))
+
+    @property
+    def matrix_sharding(self) -> NamedSharding:
+        """Sharding for [rows, features] matrices: rows split, features local."""
+        return NamedSharding(self.mesh, P(ROW_AXIS, None))
+
+    @property
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    # -- geometry ------------------------------------------------------------
+    @property
+    def n_row_shards(self) -> int:
+        return self.mesh.shape[ROW_AXIS]
+
+    @property
+    def n_devices(self) -> int:
+        return int(np.prod(list(self.mesh.shape.values())))
+
+    def row_multiple(self) -> int:
+        """Rows are padded to a multiple of this (shards x 8 sublanes)."""
+        return self.n_row_shards * 8
+
+    def pad_rows(self, n: int) -> int:
+        m = self.row_multiple()
+        return ((max(n, 1) + m - 1) // m) * m
+
+    def describe(self) -> dict:
+        """Cluster status — the `/3/Cloud` analog (water/api/CloudHandler)."""
+        return {
+            "devices": [str(d) for d in self.mesh.devices.flat],
+            "platform": self.mesh.devices.flat[0].platform,
+            "mesh_shape": dict(self.mesh.shape),
+            "process_index": jax.process_index(),
+            "process_count": jax.process_count(),
+        }
+
+
+def init(devices=None, model_axis: int = 1, coordinator: str | None = None,
+         num_processes: int | None = None, process_id: int | None = None) -> Cluster:
+    """Boot (or return) the cluster — analog of ``h2o.init()``.
+
+    Single-host: builds a mesh over the local devices.  Multi-host: pass
+    ``coordinator`` (+ ``num_processes``/``process_id`` or rely on the TPU
+    environment) to run ``jax.distributed.initialize`` first; the mesh then
+    spans all hosts' devices and collectives ride ICI/DCN.
+    """
+    global _cluster
+    with _lock:
+        if _cluster is not None:
+            if (devices is None and model_axis == _cluster.mesh.shape[MODEL_AXIS]
+                    and coordinator is None):
+                return _cluster
+            if model_axis == 1 and devices is None and coordinator is None:
+                return _cluster
+            raise RuntimeError(
+                "cluster already booted with a different configuration; "
+                "call h2o3_tpu.shutdown() first to re-init")
+        if coordinator is not None and jax.process_count() == 1:
+            jax.distributed.initialize(coordinator_address=coordinator,
+                                       num_processes=num_processes,
+                                       process_id=process_id)
+        if devices is None:
+            devices = jax.devices()
+        devices = list(devices)
+        n = len(devices)
+        if model_axis < 1 or n % model_axis:
+            raise ValueError(f"model_axis={model_axis} must divide device count {n}")
+        dev_grid = np.array(devices).reshape(n // model_axis, model_axis)
+        mesh = Mesh(dev_grid, (ROW_AXIS, MODEL_AXIS))
+        _cluster = Cluster(mesh=mesh)
+        return _cluster
+
+
+def cluster() -> Cluster:
+    """The booted cluster, booting a default one on first use."""
+    if _cluster is None:
+        return init()
+    return _cluster
+
+
+def shutdown() -> None:
+    global _cluster
+    with _lock:
+        _cluster = None

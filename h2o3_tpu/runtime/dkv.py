@@ -1,0 +1,57 @@
+"""DKV: the keyed object store for frames, models and jobs.
+
+Reference: ``water/DKV.java:52`` / ``water/Key.java:44`` — a cluster-wide
+distributed hash map where every Frame/Vec/Chunk/Model/Job lives under a Key
+homed to a node, coherent via invalidates, backed by Cliff Click's
+NonBlockingHashMap (water/nbhm/).
+
+TPU-native redesign: bulk payloads (column data) are ``jax.Array``s whose
+placement is already expressed by shardings — the JAX runtime is the
+"distributed" part.  What remains is the *control-plane* index: a name ->
+object map on the coordinator host.  Single-process now; the multi-host
+version replicates this index over the control-plane channel (SURVEY.md §5:
+"DKV stays in TPU-VM host RAM").  The API mirrors DKV.get/put/remove.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+_store: Dict[str, Any] = {}
+_lock = threading.RLock()
+_counter = 0
+
+
+def make_key(prefix: str) -> str:
+    """Fresh unique key — analog of Key.make() (water/Key.java:44)."""
+    global _counter
+    with _lock:
+        _counter += 1
+        return f"{prefix}_{_counter}"
+
+
+def put(key: str, value: Any) -> str:
+    with _lock:
+        _store[key] = value
+    return key
+
+
+def get(key: str) -> Optional[Any]:
+    with _lock:
+        return _store.get(key)
+
+
+def remove(key: str) -> None:
+    with _lock:
+        _store.pop(key, None)
+
+
+def keys(prefix: str = "") -> List[str]:
+    with _lock:
+        return sorted(k for k in _store if k.startswith(prefix))
+
+
+def clear() -> None:
+    with _lock:
+        _store.clear()

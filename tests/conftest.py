@@ -1,0 +1,31 @@
+"""Test harness: simulate an 8-device TPU mesh on CPU.
+
+The reference's pattern (SURVEY.md §4): tests run against a real in-process
+cloud (water.TestUtil.stall_till_cloudsize), with multi-node tests spawning
+real JVMs on localhost (scripts/multiNodeUtils.sh).  Here the analog is a
+virtual 8-device CPU mesh: XLA partitions and executes the very same SPMD
+programs (collectives included) that run on a TPU slice, so sharding bugs
+surface without TPU hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cl():
+    import h2o3_tpu
+    return h2o3_tpu.init()
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,162 @@
+"""Frame/Vec/parse tests — analog of water/fvec tests + parser pyunits."""
+
+import io
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.frame.vec import T_CAT, T_NUM, T_STR, T_TIME
+from h2o3_tpu.runtime.mapreduce import map_partitions, map_reduce
+
+
+CSV = """id,age,city,income,signup,comment
+1,34,ny,55000.5,2021-01-02,hello
+2,28,sf,72000,2021-02-03,world
+3,,ny,NA,2021-03-04,foo
+4,45,la,91000,2021-04-05,bar
+5,51,sf,,2021-05-06,baz
+"""
+
+
+def make_frame(cl):
+    return h2o3_tpu.upload_string(CSV, destination_frame="f1")
+
+
+def test_parse_types(cl):
+    f = make_frame(cl)
+    assert f.shape == (5, 6)
+    t = f.types()
+    assert t["id"] == T_NUM and t["age"] == T_NUM and t["income"] == T_NUM
+    assert t["city"] == T_CAT
+    assert t["signup"] == T_TIME
+    assert sorted(f.vec("city").domain) == ["la", "ny", "sf"]
+
+
+def test_rollups(cl):
+    f = make_frame(cl)
+    age = f.vec("age")
+    r = age.rollups()
+    assert r.nmissing == 1
+    assert r.vmin == 28 and r.vmax == 51
+    np.testing.assert_allclose(r.mean, np.mean([34, 28, 45, 51]), rtol=1e-6)
+    np.testing.assert_allclose(
+        r.sigma, np.std([34, 28, 45, 51], ddof=1), rtol=1e-5)
+
+
+def test_padding_and_sharding(cl):
+    f = make_frame(cl)
+    v = f.vec("age")
+    assert v.padded_len % cl.row_multiple() == 0
+    assert v.data.sharding.spec[0] == "rows"
+    back = v.to_numpy()
+    assert len(back) == 5
+    assert np.isnan(back[2])
+
+
+def test_cat_decode_roundtrip(cl):
+    f = make_frame(cl)
+    city = f.vec("city").decoded()
+    assert list(city) == ["ny", "sf", "ny", "la", "sf"]
+
+
+def test_frame_munging(cl):
+    f = make_frame(cl)
+    g = f[["age", "income"]]
+    assert g.names == ["age", "income"]
+    h = f.drop("comment")
+    assert "comment" not in h.names
+    sub = f.filter(np.array([True, False, True, False, True]))
+    assert sub.nrows == 3
+    assert list(sub.vec("id").to_numpy()) == [1, 3, 5]
+
+
+def test_split_frame(cl):
+    big = h2o3_tpu.Frame.from_numpy(
+        {"x": np.arange(1000, dtype=np.float32)}, key="big")
+    a, b = big.split_frame([0.75], seed=1)
+    assert a.nrows + b.nrows == 1000
+    assert 650 < a.nrows < 850
+
+
+def test_matrix(cl):
+    f = make_frame(cl)
+    m = f.matrix(["age", "income"])
+    assert m.shape == (f.padded_rows, 2)
+    assert m.sharding.spec[0] == "rows"
+
+
+def test_dkv(cl):
+    make_frame(cl)
+    assert "f1" in h2o3_tpu.ls()
+    assert h2o3_tpu.get_frame("f1").nrows == 5
+    h2o3_tpu.remove("f1")
+    with pytest.raises(KeyError):
+        h2o3_tpu.get_frame("f1")
+
+
+def test_map_reduce(cl, rng):
+    x = h2o3_tpu.Vec.from_numpy(rng.normal(size=1000).astype(np.float32))
+    valid = x.valid_mask()
+
+    def msum(data, mask):
+        import jax.numpy as jnp
+        return jnp.sum(jnp.where(mask, data, 0.0))
+
+    total = map_reduce(msum, x.data, valid)
+    np.testing.assert_allclose(float(total), float(np.sum(x.to_numpy())),
+                               rtol=1e-4)
+
+
+def test_map_partitions(cl, rng):
+    x = h2o3_tpu.Vec.from_numpy(np.arange(64, dtype=np.float32))
+    doubled = map_partitions(lambda d: d * 2, x.data)
+    np.testing.assert_allclose(np.asarray(doubled)[:64], np.arange(64) * 2)
+
+
+def test_string_column_host_side(cl):
+    f = make_frame(cl)
+    c = f.vec("comment")
+    assert c.type == T_CAT or c.type == T_STR  # low-card text may be cat
+    vals = list(c.decoded())
+    assert vals == ["hello", "world", "foo", "bar", "baz"]
+
+
+def test_time_precision_roundtrip(cl):
+    # float32 device storage must not destroy sub-minute timestamp resolution
+    f = h2o3_tpu.upload_string(
+        "t\n2021-01-02 00:00:00\n2021-01-02 00:01:00\n2021-01-02 00:01:30\n")
+    t = f.vec("t")
+    assert t.type == T_TIME
+    ms = t.to_numpy()
+    assert ms[1] - ms[0] == 60_000.0 and ms[2] - ms[1] == 30_000.0
+    # device payload is rebased seconds: distinct and well-conditioned
+    dev = np.asarray(t.data)[:3]
+    np.testing.assert_allclose(dev, [0.0, 60.0, 90.0], atol=1e-3)
+
+
+def test_split_frame_ratios_sum_to_one(cl):
+    big = h2o3_tpu.Frame.from_numpy({"x": np.arange(1000, dtype=np.float32)})
+    parts = big.split_frame([0.1] * 10, seed=3)
+    assert len(parts) == 10
+    assert sum(p.nrows for p in parts) == 1000
+
+
+def test_from_numpy_explicit_cat(cl):
+    f = h2o3_tpu.Frame.from_numpy({"c": np.array(["a", "b", "a"])},
+                                  types={"c": T_CAT})
+    assert f.vec("c").domain == ["a", "b"]
+    assert list(f.vec("c").decoded()) == ["a", "b", "a"]
+
+
+def test_all_missing_column_rollups(cl):
+    f = h2o3_tpu.upload_string("x,y\nNA,1\nNA,2\n", col_types={"x": T_NUM})
+    r = f.vec("x").rollups()
+    assert r.nmissing == 2
+    assert np.isnan(r.mean) and np.isnan(r.vmin)
+
+
+def test_reinit_conflict_raises(cl):
+    import pytest as _pytest
+    with _pytest.raises(RuntimeError):
+        h2o3_tpu.init(model_axis=4)
