@@ -1,0 +1,57 @@
+"""Benchmark: DeepLearning MLP training throughput (samples/sec/chip).
+
+The reference logs rows/sec for hex.deeplearning (DeepLearning.java:648,
+DeepLearningModel.java:580 "samples/sec").  H2O's Java Hogwild fprop/bprop on
+a CPU node sustains on the order of 5e4 samples/sec for a 784->200->200->10
+MLP; BASELINE.json's north star is DeepLearning samples/sec/chip.  We report
+vs_baseline against that 5e4 reference-shape number.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+import numpy as np
+
+REFERENCE_SAMPLES_PER_SEC = 5.0e4   # H2O Java DL per-node ballpark (see above)
+
+
+def main():
+    import jax
+    import h2o3_tpu
+    from h2o3_tpu import Frame
+    from h2o3_tpu.models.deeplearning import DeepLearning
+
+    h2o3_tpu.init()
+    rng = np.random.default_rng(0)
+    n, p, k = 200_000, 784, 10
+    X = rng.normal(size=(n, p)).astype(np.float32)
+    w_true = rng.normal(size=(p, k)).astype(np.float32)
+    labels = np.argmax(X @ w_true + rng.normal(size=(n, k)), axis=1)
+    cols = {f"x{j}": X[:, j] for j in range(p)}
+    cols["y"] = labels.astype(str).astype(object)
+    fr = Frame.from_numpy(cols)
+
+    # warmup: compile the training program
+    DeepLearning(response_column="y", hidden=[256, 256], epochs=0.02,
+                 mini_batch_size=512, seed=1, stopping_rounds=0,
+                 standardize=False).train(fr)
+    # timed run
+    t0 = time.time()
+    m = DeepLearning(response_column="y", hidden=[256, 256], epochs=2.0,
+                     mini_batch_size=512, seed=1, stopping_rounds=0,
+                     standardize=False).train(fr)
+    dt = time.time() - t0
+    samples = m.output["samples_trained"]
+    sps = samples / dt
+    print(json.dumps({
+        "metric": "deeplearning_samples_per_sec_per_chip",
+        "value": round(sps, 1),
+        "unit": "samples/sec",
+        "vs_baseline": round(sps / REFERENCE_SAMPLES_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

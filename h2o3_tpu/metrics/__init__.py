@@ -1,0 +1,6 @@
+"""Model metrics (the hex.ModelMetrics* analog)."""
+
+from .core import (ConfusionMatrix, ModelMetricsBinomial,
+                   ModelMetricsMultinomial, ModelMetricsRegression,
+                   binomial_metrics, multinomial_metrics, regression_metrics,
+                   make_metrics)

@@ -1,0 +1,5 @@
+"""Model framework + algorithms (the hex.* analog)."""
+
+from .base import Model, ModelBuilder, Parameters
+from .datainfo import DataInfo
+from .glm import GLM, GLMModel, GLMParameters

@@ -1,0 +1,223 @@
+"""Model / ModelBuilder: the training + scoring contract every algo follows.
+
+Reference: ``hex/ModelBuilder.java:25`` (param validation, train/valid
+adaptation, CV orchestration, Driver running computeImpl) and
+``hex/Model.java`` (Parameters/Output, ``score()`` -> BigScore MRTask ->
+per-row ``score0``, hex/Model.java:1901-1994).
+
+TPU-native redesign: a ModelBuilder validates parameters, fits a DataInfo,
+runs the algorithm's jit-compiled training program under a Job, and returns a
+Model holding small host-side learned state (coefficients, trees, weights).
+Scoring is a single batched SPMD program over the row-sharded design matrix —
+the BigScore-per-row-score0 pattern collapses into one matmul-shaped pass.
+Save/load is plain pickle of the host state (the portable MOJO-analog lives
+in ``h2o3_tpu/export``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pickle
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..frame.frame import Frame
+from ..frame.vec import Vec, T_CAT, T_NUM
+from ..runtime import dkv
+from ..runtime.job import Job
+from .datainfo import DataInfo, MEAN_IMPUTATION
+
+
+@dataclasses.dataclass
+class Parameters:
+    """Common training parameters — analog of hex.Model.Parameters."""
+
+    response_column: Optional[str] = None
+    ignored_columns: Sequence[str] = ()
+    weights_column: Optional[str] = None
+    offset_column: Optional[str] = None
+    seed: int = -1
+    max_iterations: int = 50
+    standardize: bool = True
+    missing_values_handling: str = MEAN_IMPUTATION
+    # early stopping (hex/ScoreKeeper.java:319)
+    stopping_rounds: int = 0
+    stopping_metric: str = "auto"
+    stopping_tolerance: float = 1e-3
+    # checkpointing (hex/Model.java:521,543)
+    checkpoint: Optional[str] = None
+    export_checkpoints_dir: Optional[str] = None
+    # cross-validation
+    nfolds: int = 0
+    fold_column: Optional[str] = None
+    fold_assignment: str = "auto"          # auto|random|modulo|stratified
+    keep_cross_validation_predictions: bool = False
+
+    def effective_seed(self) -> int:
+        return np.random.default_rng().integers(2**31) if self.seed in (-1, None) \
+            else int(self.seed)
+
+
+class Model:
+    """A trained model: params + output + host-side learned state."""
+
+    algo = "model"
+
+    def __init__(self, key: str, params: Parameters, datainfo: DataInfo):
+        self.key = key
+        self.params = params
+        self.datainfo = datainfo
+        self.output: Dict[str, Any] = {}
+        self.training_metrics = None
+        self.validation_metrics = None
+        self.cross_validation_metrics = None
+        self.cv_predictions: Optional[np.ndarray] = None
+        self.scoring_history: List[dict] = []
+        dkv.put(key, self)
+
+    # ---------------------------------------------------------------- scoring
+    def _predict_raw(self, X: jax.Array) -> jax.Array:
+        """[padded, nclasses] class probabilities or [padded] regression preds.
+
+        The score0 analog — subclasses implement this as a pure jittable
+        function of the design matrix.
+        """
+        raise NotImplementedError
+
+    def predict(self, frame: Frame) -> Frame:
+        """Score a frame — returns a Frame shaped like the reference's preds.
+
+        Classification: ``predict`` (label) + one probability column per
+        class.  Regression: single ``predict`` column.
+        """
+        di = self.datainfo
+        X = di.make_matrix(frame)
+        raw = np.asarray(self._predict_raw(X))[: frame.nrows]
+        if di.is_classifier:
+            dom = di.response_domain
+            labels = np.argmax(raw, axis=1)
+            if raw.shape[1] == 2:
+                thr = self.default_threshold()
+                labels = (raw[:, 1] >= thr).astype(np.int64)
+            names = ["predict"] + [str(d) for d in dom]
+            vecs = [Vec.from_numpy(labels.astype(np.int32), T_CAT,
+                                   domain=[str(d) for d in dom])]
+            vecs += [Vec.from_numpy(raw[:, k], T_NUM) for k in range(raw.shape[1])]
+            return Frame(names, vecs)
+        return Frame(["predict"], [Vec.from_numpy(raw.astype(np.float64), T_NUM)])
+
+    def default_threshold(self) -> float:
+        m = self.training_metrics
+        thr = getattr(m, "max_f1_threshold", None) if m is not None else None
+        return float(thr) if thr is not None else 0.5
+
+    def model_performance(self, frame: Optional[Frame] = None):
+        """Compute metrics on a frame (None -> training metrics)."""
+        if frame is None:
+            return self.training_metrics
+        from ..metrics.core import make_metrics
+        di = self.datainfo
+        X = di.make_matrix(frame)
+        raw = self._predict_raw(X)
+        y = di.response(frame)
+        w = di.weights(frame)
+        return make_metrics(di, raw, y, w, distribution=getattr(
+            self.params, "distribution", None))
+
+    # ------------------------------------------------------------ persistence
+    def save(self, path: str) -> str:
+        state = self.__dict__.copy()
+        state = jax.tree.map(
+            lambda v: np.asarray(v) if isinstance(v, jax.Array) else v, state)
+        with open(path, "wb") as f:
+            pickle.dump((type(self), state), f)
+        return path
+
+    @staticmethod
+    def load(path: str) -> "Model":
+        with open(path, "rb") as f:
+            cls, state = pickle.load(f)
+        m = object.__new__(cls)
+        m.__dict__.update(state)
+        dkv.put(m.key, m)
+        return m
+
+    def summary(self) -> dict:
+        return {"key": self.key, "algo": self.algo, **{
+            k: v for k, v in self.output.items()
+            if isinstance(v, (int, float, str, bool, list))}}
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self.key}>"
+
+
+class ModelBuilder:
+    """Base builder — analog of hex.ModelBuilder.trainModel()."""
+
+    algo = "model"
+    model_class = Model
+    supervised = True
+
+    def __init__(self, params: Parameters):
+        self.params = params
+        self.job: Optional[Job] = None
+
+    # -- hooks ---------------------------------------------------------------
+    def _validate(self, frame: Frame) -> None:
+        p = self.params
+        if self.supervised:
+            if not p.response_column:
+                raise ValueError(f"{self.algo}: response_column is required")
+            if p.response_column not in frame.names:
+                raise ValueError(
+                    f"response_column {p.response_column!r} not in frame")
+
+    def _make_datainfo(self, frame: Frame) -> DataInfo:
+        p = self.params
+        return DataInfo.fit(
+            frame,
+            response_column=p.response_column if self.supervised else None,
+            ignored_columns=p.ignored_columns,
+            weights_column=p.weights_column,
+            offset_column=p.offset_column,
+            standardize=p.standardize,
+            missing_values_handling=p.missing_values_handling,
+            force_classification=getattr(self, "_force_classification", False))
+
+    def _fit(self, job: Job, frame: Frame, di: DataInfo,
+             valid: Optional[Frame]) -> Model:
+        raise NotImplementedError
+
+    # -- driver --------------------------------------------------------------
+    def train(self, frame: Frame, valid: Optional[Frame] = None) -> Model:
+        """Blocking train — the trainModel/Driver.computeImpl path."""
+        self._validate(frame)
+        di = self._make_datainfo(frame)
+        self.job = Job(f"{self.algo} train", dest_key=dkv.make_key(self.algo))
+
+        def _driver(job: Job) -> Model:
+            t0 = time.time()
+            if self.params.nfolds and self.params.nfolds > 1:
+                model = self._train_cv(job, frame, di, valid)
+            else:
+                model = self._fit(job, frame, di, valid)
+            model.output.setdefault("run_time_s", time.time() - t0)
+            model.output.setdefault("training_frame_rows", frame.nrows)
+            if self.params.export_checkpoints_dir:
+                import os
+                os.makedirs(self.params.export_checkpoints_dir, exist_ok=True)
+                model.save(os.path.join(self.params.export_checkpoints_dir,
+                                        model.key + ".bin"))
+            return model
+
+        return self.job.run(_driver)
+
+    # -- cross-validation (hex/CVModelBuilder.java:10) -----------------------
+    def _train_cv(self, job: Job, frame: Frame, di: DataInfo,
+                  valid: Optional[Frame]) -> Model:
+        from .cv import cross_validate
+        return cross_validate(self, job, frame, di, valid)

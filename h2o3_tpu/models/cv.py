@@ -1,0 +1,95 @@
+"""Cross-validation orchestration.
+
+Reference: ``hex/CVModelBuilder.java:10`` + ``hex/FoldAssignment.java`` +
+ModelBuilder's CV code — build N fold models (optionally in parallel),
+aggregate the holdout predictions into the main model's CV metrics, then
+train the final model on all data.
+
+TPU-native redesign: fold models are independent compiled programs; holdout
+predictions are gathered host-side into one array and scored with the same
+fused metric kernels.  (Coarse model-parallelism across mesh slices — the
+SegmentModels pattern — can schedule fold models concurrently later.)
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Optional
+
+import numpy as np
+
+from ..frame.frame import Frame
+from ..runtime.job import Job
+from ..metrics.core import make_metrics
+import jax.numpy as jnp
+
+
+def fold_assignment(n: int, nfolds: int, scheme: str, seed: int,
+                    y: Optional[np.ndarray] = None) -> np.ndarray:
+    """Row -> fold index (hex/FoldAssignment.java). Schemes: auto|random|
+    modulo|stratified."""
+    if scheme in ("auto", "random"):
+        rng = np.random.default_rng(seed)
+        return rng.integers(0, nfolds, size=n)
+    if scheme == "modulo":
+        return np.arange(n) % nfolds
+    if scheme == "stratified":
+        if y is None:
+            raise ValueError("stratified fold assignment needs a response")
+        rng = np.random.default_rng(seed)
+        folds = np.zeros(n, dtype=np.int64)
+        for cls in np.unique(y[~np.isnan(y)]):
+            idx = np.nonzero(y == cls)[0]
+            rng.shuffle(idx)
+            folds[idx] = np.arange(len(idx)) % nfolds
+        return folds
+    raise ValueError(f"unknown fold_assignment {scheme!r}")
+
+
+def cross_validate(builder, job: Job, frame: Frame, di, valid):
+    """N-fold CV: fold models -> holdout preds -> CV metrics -> final model."""
+    p = builder.params
+    nfolds = p.nfolds
+    seed = p.effective_seed()
+    if p.fold_column is not None:
+        fc = frame.vec(p.fold_column).to_numpy()
+        _, folds = np.unique(fc, return_inverse=True)
+        nfolds = folds.max() + 1
+    else:
+        y_host = np.asarray(di.response(frame))[: frame.nrows] \
+            if di.response_column else None
+        folds = fold_assignment(frame.nrows, nfolds, p.fold_assignment, seed,
+                                y=y_host)
+
+    nclasses = di.nclasses
+    width = nclasses if di.is_classifier else 1
+    holdout = np.full((frame.nrows, width), np.nan, dtype=np.float64)
+    cv_models = []
+    for f in range(nfolds):
+        train_f = frame.rows(np.nonzero(folds != f)[0])
+        hold_idx = np.nonzero(folds == f)[0]
+        hold_f = frame.rows(hold_idx)
+        fold_builder = type(builder)(copy.copy(p))
+        fold_builder.params.nfolds = 0
+        fold_di = di  # share the training layout: same domains/means
+        fold_job = Job(f"{builder.algo} cv fold {f}")
+        m = fold_job.run(lambda j: fold_builder._fit(j, train_f, fold_di, None))
+        cv_models.append(m)
+        X_h = di.make_matrix(hold_f)
+        raw = np.asarray(m._predict_raw(X_h))[: hold_f.nrows]
+        holdout[hold_idx] = raw.reshape(len(hold_idx), width)
+        job.update(0.8 * (f + 1) / nfolds, f"cv fold {f + 1}/{nfolds}")
+
+    # final model on all data
+    model = builder._fit(job, frame, di, valid)
+    y = di.response(frame)
+    w = di.weights(frame)
+    raw_pad = np.zeros((frame.padded_rows, width))
+    raw_pad[: frame.nrows] = np.nan_to_num(holdout)
+    model.cross_validation_metrics = make_metrics(
+        di, jnp.asarray(raw_pad.squeeze() if width == 1 else raw_pad,
+                        dtype=jnp.float32), y, w)
+    model.output["cv_fold_models"] = [m.key for m in cv_models]
+    if p.keep_cross_validation_predictions:
+        model.cv_predictions = holdout
+    return model

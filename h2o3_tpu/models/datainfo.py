@@ -1,0 +1,233 @@
+"""DataInfo: the shared featurization layer feeding every algorithm.
+
+Reference: ``hex/DataInfo.java`` (h2o-algos, ~1.5k LoC) — converts a Frame
+into the algorithm's numeric view: categorical one-hot/enum expansion,
+standardization, NA imputation, interaction terms; shared by GLM/DL/GAM/
+CoxPH/KMeans.  Test-time adaptation (``Model.adaptTestForTrain``,
+hex/Model.java:1683) aligns incoming frames to the training layout.
+
+TPU-native redesign: featurization is a single fused XLA program per frame —
+categorical codes expand to one-hot via a broadcast compare (an MXU-friendly
+dense [rows, features] block), numerics are imputed/standardized in the same
+pass, and the result is a row-sharded float32 matrix.  The fitted state
+(domains, means, sigmas, layout) is a small host-side dataclass that also
+performs test adaptation, guaranteeing train/test layout agreement.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..frame.frame import Frame
+from ..frame.vec import Vec, T_CAT, T_NUM, T_TIME
+from ..runtime.cluster import cluster
+
+MEAN_IMPUTATION = "mean_imputation"
+SKIP = "skip"
+
+
+@dataclasses.dataclass
+class ColumnSpec:
+    name: str
+    type: str                       # T_NUM / T_TIME / T_CAT
+    domain: Optional[List[str]]     # cat labels (training-time)
+    mean: float                     # imputation value / centering
+    sigma: float                    # scaling (1.0 when not standardizing)
+    time_base: float = 0.0
+    offset: int = 0                 # first output column index
+    width: int = 1                  # number of output columns
+
+
+@dataclasses.dataclass
+class DataInfo:
+    """Fitted featurization: layout + per-column adaptation state."""
+
+    specs: List[ColumnSpec]
+    response_column: Optional[str]
+    response_domain: Optional[List[str]]
+    weights_column: Optional[str]
+    offset_column: Optional[str]
+    standardize: bool
+    use_all_factor_levels: bool
+    missing_values_handling: str
+    add_intercept: bool
+    nfeatures: int
+    response_mean: float = 0.0
+    response_sigma: float = 1.0
+
+    # ------------------------------------------------------------ properties
+    @property
+    def coef_names(self) -> List[str]:
+        names = []
+        for s in self.specs:
+            if s.type == T_CAT:
+                lo = 0 if self.use_all_factor_levels else 1
+                names += [f"{s.name}.{lbl}" for lbl in s.domain[lo:]]
+                names.append(f"{s.name}.missing(NA)")
+            else:
+                names.append(s.name)
+        if self.add_intercept:
+            names.append("Intercept")
+        return names
+
+    @property
+    def nclasses(self) -> int:
+        return len(self.response_domain) if self.response_domain else 1
+
+    @property
+    def is_classifier(self) -> bool:
+        return self.response_domain is not None
+
+    # -------------------------------------------------------------- fitting
+    @staticmethod
+    def fit(frame: Frame, response_column: Optional[str] = None,
+            ignored_columns: Sequence[str] = (),
+            weights_column: Optional[str] = None,
+            offset_column: Optional[str] = None,
+            standardize: bool = True,
+            use_all_factor_levels: bool = False,
+            missing_values_handling: str = MEAN_IMPUTATION,
+            add_intercept: bool = True,
+            force_classification: bool = False) -> "DataInfo":
+        skip = set(ignored_columns) | {response_column, weights_column,
+                                       offset_column, None}
+        specs: List[ColumnSpec] = []
+        offset = 0
+        for name, vec in zip(frame.names, frame.vecs):
+            if name in skip or vec.data is None:   # str/uuid never featurized
+                continue
+            if vec.type == T_CAT:
+                dom = list(vec.domain or [])
+                lo = 0 if use_all_factor_levels else 1
+                width = max(len(dom) - lo, 0) + 1          # +1 NA bucket
+                specs.append(ColumnSpec(name, T_CAT, dom, 0.0, 1.0,
+                                        offset=offset, width=width))
+            else:
+                r = vec.rollups()
+                mean = r.mean if np.isfinite(r.mean) else 0.0
+                sigma = r.sigma if (standardize and np.isfinite(r.sigma)
+                                    and r.sigma > 0) else 1.0
+                specs.append(ColumnSpec(name, vec.type, None, mean, sigma,
+                                        time_base=vec.time_base,
+                                        offset=offset, width=1))
+            offset += specs[-1].width
+        if not specs:
+            raise ValueError("no usable feature columns")
+
+        resp_domain = None
+        rmean, rsigma = 0.0, 1.0
+        if response_column is not None:
+            rv = frame.vec(response_column)
+            if rv.type == T_CAT:
+                resp_domain = list(rv.domain or [])
+            elif force_classification:
+                vals = np.unique(rv.to_numpy())
+                vals = vals[np.isfinite(vals)]
+                resp_domain = [str(int(v)) if v == int(v) else str(v)
+                               for v in vals]
+            else:
+                rr = rv.rollups()
+                rmean = rr.mean if np.isfinite(rr.mean) else 0.0
+                rsigma = rr.sigma if np.isfinite(rr.sigma) and rr.sigma > 0 else 1.0
+        nfeat = offset + (1 if add_intercept else 0)
+        return DataInfo(specs, response_column, resp_domain, weights_column,
+                        offset_column, standardize, use_all_factor_levels,
+                        missing_values_handling, add_intercept, nfeat,
+                        response_mean=rmean, response_sigma=rsigma)
+
+    # ---------------------------------------------------------- application
+    def make_matrix(self, frame: Frame, standardize: Optional[bool] = None) -> jax.Array:
+        """[padded_rows, nfeatures] float32 design matrix, row-sharded.
+
+        One fused XLA pass: numeric impute+standardize, categorical one-hot
+        with NA bucket, optional intercept column.  Unseen test levels map to
+        the NA bucket (the reference's adaptTestForTrain ``skipMissing`` /
+        makeNA path, hex/Model.java:1683).
+        """
+        standardize = self.standardize if standardize is None else standardize
+        cl = cluster()
+        cols = []
+        for s in self.specs:
+            vec = frame.vec(s.name)
+            if s.type == T_CAT:
+                codes = self._aligned_codes(vec, s)
+                lo = 0 if self.use_all_factor_levels else 1
+                width = s.width - 1
+                levels = jnp.arange(lo, lo + width, dtype=jnp.int32)
+                onehot = (codes[:, None] == levels[None, :]).astype(jnp.float32)
+                na = (codes < 0).astype(jnp.float32)[:, None]
+                cols.append(jnp.concatenate([onehot, na], axis=1))
+            else:
+                x = vec.data
+                if s.type == T_TIME and abs(vec.time_base - s.time_base) > 0:
+                    x = x + (vec.time_base - s.time_base) / 1000.0
+                x = jnp.where(jnp.isnan(x), s.mean, x)
+                if standardize:
+                    x = (x - s.mean) / s.sigma
+                cols.append(x[:, None])
+        if self.add_intercept:
+            cols.append(jnp.ones((frame.padded_rows, 1), jnp.float32))
+        mat = jnp.concatenate(cols, axis=1)
+        return jax.device_put(mat, cl.matrix_sharding)
+
+    def _aligned_codes(self, vec: Vec, s: ColumnSpec) -> jax.Array:
+        """Map a (possibly differently-coded) cat Vec onto training codes."""
+        if vec.type != T_CAT:
+            # numeric column where a cat was expected: treat values as codes
+            return jnp.where(jnp.isnan(vec.data), -1,
+                             vec.data).astype(jnp.int32)
+        if vec.domain == s.domain:
+            return vec.data
+        remap = np.full(max(len(vec.domain or []), 1), -1, dtype=np.int32)
+        lookup = {lbl: i for i, lbl in enumerate(s.domain)}
+        for i, lbl in enumerate(vec.domain or []):
+            remap[i] = lookup.get(lbl, -1)
+        remap_dev = jnp.asarray(remap)
+        codes = vec.data
+        return jnp.where(codes < 0, -1, remap_dev[jnp.clip(codes, 0, None)])
+
+    def response(self, frame: Frame) -> jax.Array:
+        """Response as float32 [padded]: cat codes for classifiers else values."""
+        rv = frame.vec(self.response_column)
+        if self.response_domain is not None:
+            if rv.type == T_CAT:
+                spec = ColumnSpec(self.response_column, T_CAT,
+                                  self.response_domain, 0.0, 1.0)
+                return self._aligned_codes(rv, spec).astype(jnp.float32)
+            # numeric response trained as classification (force_classification)
+            vals = np.array([float(v) for v in self.response_domain],
+                            dtype=np.float32)
+            vals_dev = jnp.asarray(vals)
+            x = rv.data
+            code = jnp.argmin(jnp.abs(x[:, None] - vals_dev[None, :]), axis=1)
+            exact = jnp.any(x[:, None] == vals_dev[None, :], axis=1)
+            return jnp.where(exact, code, -1).astype(jnp.float32)
+        return rv.numeric_data()
+
+    def weights(self, frame: Frame) -> jax.Array:
+        """Row weights x validity mask — 0 on padding and (optionally) NA rows."""
+        w = frame.valid_mask().astype(jnp.float32)
+        if self.weights_column is not None:
+            w = w * jnp.nan_to_num(frame.vec(self.weights_column).numeric_data())
+        if self.response_column is not None:
+            y = self.response(frame)
+            w = w * jnp.where(jnp.isnan(y) | (y < -0.5) if self.response_domain
+                              else jnp.isnan(y), 0.0, 1.0)
+        if self.missing_values_handling == SKIP:
+            for s in self.specs:
+                vec = frame.vec(s.name)
+                if s.type == T_CAT:
+                    w = w * (self._aligned_codes(vec, s) >= 0)
+                else:
+                    w = w * ~jnp.isnan(vec.data)
+        return w
+
+    def offsets(self, frame: Frame) -> Optional[jax.Array]:
+        if self.offset_column is None:
+            return None
+        return jnp.nan_to_num(frame.vec(self.offset_column).numeric_data())

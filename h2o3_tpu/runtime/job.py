@@ -1,0 +1,124 @@
+"""Jobs: async work tracking for train/parse/score.
+
+Reference: ``water/Job.java:24`` (565 LoC) — every long-running action is a
+Job living in the DKV with progress, cancellation, and exceptional-completion
+tracking; clients poll ``/3/Jobs``.
+
+TPU-native redesign: the driver process orchestrates compiled SPMD programs,
+so a Job is a host-side record (status, progress, timing, result key) in the
+DKV index.  Work may run inline (blocking train, the common case) or on a
+thread (``start(fn)``) for the async ``h2o.train(..., async)`` pattern.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Any, Callable, Optional
+
+from . import dkv
+
+CREATED = "CREATED"
+RUNNING = "RUNNING"
+DONE = "DONE"
+FAILED = "FAILED"
+CANCELLED = "CANCELLED"
+
+
+class JobCancelled(Exception):
+    pass
+
+
+class Job:
+    """A tracked unit of work — analog of water.Job."""
+
+    def __init__(self, description: str, dest_key: Optional[str] = None):
+        self.key = dkv.make_key("job")
+        self.description = description
+        self.dest_key = dest_key
+        self.status = CREATED
+        self.progress = 0.0
+        self.progress_msg = ""
+        self.exception: Optional[BaseException] = None
+        self.start_time: Optional[float] = None
+        self.end_time: Optional[float] = None
+        self._cancel_requested = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.result: Any = None
+        dkv.put(self.key, self)
+
+    # ------------------------------------------------------------- lifecycle
+    def run(self, fn: Callable[["Job"], Any]) -> Any:
+        """Run ``fn(self)`` inline, tracking status/exceptions (blocking)."""
+        self.status = RUNNING
+        self.start_time = time.time()
+        try:
+            self.result = fn(self)
+            self.status = DONE
+            self.progress = 1.0
+            return self.result
+        except JobCancelled:
+            self.status = CANCELLED
+            raise
+        except BaseException as e:
+            self.status = FAILED
+            self.exception = e
+            self.traceback = traceback.format_exc()
+            raise
+        finally:
+            self.end_time = time.time()
+
+    def start(self, fn: Callable[["Job"], Any]) -> "Job":
+        """Run ``fn(self)`` on a background thread (async job)."""
+        def _runner():
+            try:
+                self.run(fn)
+            except BaseException:
+                pass  # recorded on the job
+        self._thread = threading.Thread(target=_runner, daemon=True)
+        self._thread.start()
+        return self
+
+    def join(self, timeout: Optional[float] = None) -> Any:
+        if self._thread is not None:
+            self._thread.join(timeout)
+        if self.status == FAILED:
+            raise self.exception
+        return self.result
+
+    # -------------------------------------------------------------- progress
+    def update(self, progress: float, msg: str = "") -> None:
+        """Advance progress; raises JobCancelled if a cancel was requested."""
+        self.progress = float(progress)
+        if msg:
+            self.progress_msg = msg
+        if self._cancel_requested.is_set():
+            raise JobCancelled(self.description)
+
+    def cancel(self) -> None:
+        self._cancel_requested.set()
+
+    @property
+    def is_running(self) -> bool:
+        return self.status == RUNNING
+
+    @property
+    def run_time(self) -> float:
+        if self.start_time is None:
+            return 0.0
+        return (self.end_time or time.time()) - self.start_time
+
+    def describe(self) -> dict:
+        return {
+            "key": self.key, "description": self.description,
+            "status": self.status, "progress": self.progress,
+            "msg": self.progress_msg, "dest": self.dest_key,
+            "run_time": self.run_time,
+            "exception": repr(self.exception) if self.exception else None,
+        }
+
+
+def list_jobs() -> list:
+    """All jobs in the DKV — the `/3/Jobs` analog."""
+    return [dkv.get(k) for k in dkv.keys("job_")]

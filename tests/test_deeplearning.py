@@ -1,0 +1,67 @@
+"""DeepLearning tests — mirrors pyunit_deeplearning* coverage."""
+
+import numpy as np
+
+from h2o3_tpu import Frame
+from h2o3_tpu.models.deeplearning import DeepLearning
+
+
+def _spiral(rng, n=1200):
+    """Two-class nonlinear problem an MLP must solve but a GLM can't."""
+    t = rng.random(n) * 3 * np.pi
+    cls = rng.integers(0, 2, n)
+    r = t / (3 * np.pi)
+    x = r * np.cos(t + np.pi * cls) + 0.05 * rng.normal(size=n)
+    y = r * np.sin(t + np.pi * cls) + 0.05 * rng.normal(size=n)
+    return Frame.from_numpy({
+        "x": x, "y": y,
+        "label": np.array(["a", "b"], dtype=object)[cls]}), cls
+
+
+def test_classification_nonlinear(cl, rng):
+    fr, cls = _spiral(rng)
+    m = DeepLearning(response_column="label", hidden=[64, 64], epochs=60,
+                     seed=1, stopping_rounds=0).train(fr)
+    assert m.training_metrics.auc > 0.95, m.training_metrics.describe()
+    preds = m.predict(fr)
+    assert preds.names == ["predict", "a", "b"]
+
+
+def test_regression(cl, rng):
+    n = 2000
+    x = rng.normal(size=(n, 3))
+    y = np.sin(x[:, 0]) + x[:, 1] ** 2 + 0.1 * rng.normal(size=n)
+    fr = Frame.from_numpy({"x0": x[:, 0], "x1": x[:, 1], "x2": x[:, 2],
+                           "y": y})
+    m = DeepLearning(response_column="y", hidden=[32, 32], epochs=40,
+                     seed=2, stopping_rounds=0).train(fr)
+    assert m.training_metrics.r2 > 0.85, m.training_metrics.describe()
+
+
+def test_activations_and_dropout(cl, rng):
+    fr, _ = _spiral(rng, n=600)
+    for act in ["tanh", "maxout", "rectifier_with_dropout"]:
+        m = DeepLearning(response_column="label", hidden=[32], epochs=10,
+                         activation=act, seed=3, stopping_rounds=0).train(fr)
+        assert m.training_metrics.auc > 0.5
+
+
+def test_checkpoint_continues(cl, rng):
+    fr, _ = _spiral(rng, n=800)
+    m1 = DeepLearning(response_column="label", hidden=[32, 32], epochs=5,
+                      seed=4, stopping_rounds=0).train(fr)
+    ll1 = m1.training_metrics.logloss
+    m2 = DeepLearning(response_column="label", hidden=[32, 32], epochs=25,
+                      checkpoint=m1.key, seed=4, stopping_rounds=0).train(fr)
+    assert m2.training_metrics.logloss < ll1
+
+
+def test_autoencoder_anomaly(cl, rng):
+    n = 1000
+    X = rng.normal(size=(n, 4))
+    X[-5:] += 8.0                       # planted outliers
+    fr = Frame.from_numpy({f"x{j}": X[:, j] for j in range(4)})
+    m = DeepLearning(autoencoder=True, hidden=[2], epochs=40, seed=5,
+                     stopping_rounds=0).train(fr)
+    err = m.anomaly(fr).vec("Reconstruction.MSE").to_numpy()
+    assert err[-5:].mean() > 3 * err[:-5].mean()
