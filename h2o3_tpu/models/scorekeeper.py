@@ -53,5 +53,6 @@ METRIC_MAXIMIZE = {
 def metric_direction(name: str, is_classifier: bool) -> tuple:
     """Resolve stopping_metric='auto' -> (metric_name, maximize)."""
     if name in ("auto", "", None):
-        return ("logloss", False) if is_classifier else ("deviance", False)
+        return ("logloss", False) if is_classifier else \
+            ("mean_residual_deviance", False)
     return name, METRIC_MAXIMIZE.get(name, False)

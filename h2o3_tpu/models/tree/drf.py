@@ -1,0 +1,189 @@
+"""DRF: distributed random forest on the tpu_hist kernels.
+
+Reference: ``hex/tree/drf/DRF.java:30`` — the bootstrap+mtries variant of
+SharedTree: each tree trains on a row sample (rate 1-1/e by default) with
+per-split random feature subsets (mtries); predictions are the average of
+per-tree leaf estimates (class probability / mean response).
+
+TPU-native redesign: the "mean response per leaf" fit is expressed through
+the same Newton machinery as GBM by setting grad=-y, hess=1 (leaf value
+= sum(w*y)/sum(w)); mtries is a per-(leaf, feature) random mask pushed into
+the split-search kernel; trees average instead of sum (init 0, divide by T).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...frame.frame import Frame
+from ...runtime import dkv
+from ...runtime.job import Job
+from ..datainfo import DataInfo
+from ..scorekeeper import stop_early, metric_direction
+from ..distributions import Gaussian
+from .binning import fit_bins
+from .shared import (SharedTree, SharedTreeModel, SharedTreeParameters,
+                     build_tree, stack_trees, traverse_jit)
+from ...metrics.core import make_metrics
+
+
+@dataclasses.dataclass
+class DRFParameters(SharedTreeParameters):
+    ntrees: int = 50
+    max_depth: int = 20
+    min_rows: float = 1.0
+    sample_rate: float = 0.632           # DRF.java default (1 - 1/e)
+    mtries: int = -1                     # -1: sqrt(F) cls / F/3 reg
+    learn_rate: float = 1.0              # no shrinkage in a forest
+
+
+class DRFModel(SharedTreeModel):
+    algo = "drf"
+
+    def _predict_raw(self, X: jax.Array) -> jax.Array:
+        K = self.output.get("nclass_trees", 1)
+        T = self.output["ntrees_trained"]
+        F = self._raw_scores(X) / max(T, 1)
+        if self.datainfo.is_classifier and K > 1:
+            probs = jnp.clip(F, 0.0, 1.0)
+            s = jnp.sum(probs, axis=1, keepdims=True)
+            return probs / jnp.maximum(s, 1e-12)
+        if self.datainfo.is_classifier:
+            p1 = jnp.clip(F, 0.0, 1.0)
+            return jnp.stack([1 - p1, p1], axis=1)
+        return F
+
+
+class DRF(SharedTree):
+    algo = "drf"
+    model_class = DRFModel
+
+    def __init__(self, params: Optional[DRFParameters] = None, **kw):
+        super().__init__(params or DRFParameters(**kw))
+
+    def _fit(self, job: Job, frame: Frame, di: DataInfo,
+             valid: Optional[Frame]) -> DRFModel:
+        p: DRFParameters = self.params
+        K = di.nclasses if (di.is_classifier and di.nclasses > 2) else 1
+        binned = fit_bins(frame, [s.name for s in di.specs], nbins=p.nbins,
+                          seed=p.effective_seed())
+        codes = binned.codes
+        Fnum = binned.nfeatures
+        y = di.response(frame)
+        w = di.weights(frame)
+        y = jnp.where(jnp.isnan(y), 0.0, y)
+        N = codes.shape[0]
+        rng = jax.random.PRNGKey(p.effective_seed())
+
+        if p.mtries == -1:
+            m = math.isqrt(Fnum) if di.is_classifier else max(Fnum // 3, 1)
+            col_rate = max(min(m, Fnum), 1) / Fnum
+        elif p.mtries == -2:
+            col_rate = 1.0
+        else:
+            col_rate = max(min(p.mtries, Fnum), 1) / Fnum
+
+        model = DRFModel(job.dest_key or dkv.make_key(self.algo), p, di)
+        model.output["nclass_trees"] = K
+        dist = Gaussian()
+
+        if K > 1:
+            yi = jnp.clip(y.astype(jnp.int32), 0, K - 1)
+            Y1 = jax.nn.one_hot(yi, K, dtype=jnp.float32)
+            targets = [Y1[:, k] for k in range(K)]
+        elif di.is_classifier:
+            targets = [y]
+        else:
+            targets = [y]
+
+        F_sum = jnp.zeros((N, K), jnp.float32) if K > 1 \
+            else jnp.zeros((N,), jnp.float32)
+        valid_state = None
+        if valid is not None:
+            Xv = model._design(valid)
+            y_v, w_v = di.response(valid), di.weights(valid)
+            F_v = jnp.zeros((Xv.shape[0], K), jnp.float32) if K > 1 \
+                else jnp.zeros((Xv.shape[0],), jnp.float32)
+
+        trees, history = [], []
+        metric_name, maximize = metric_direction(p.stopping_metric,
+                                                 di.is_classifier)
+        for t in range(p.ntrees):
+            rng, ks = jax.random.split(rng)
+            w_eff = w * jax.random.bernoulli(ks, p.sample_rate, (N,)) \
+                if p.sample_rate < 1.0 else w
+            if K > 1:
+                ktrees = []
+                for k in range(K):
+                    rng, kk = jax.random.split(rng)
+                    # mean-fit: grad = -y, hess = 1 -> leaf = mean(y)
+                    tree, leaf = build_tree(
+                        codes, -targets[k] * w_eff, w_eff, w_eff,
+                        binned.edges, p.nbins, p.max_depth, p.reg_lambda,
+                        p.min_rows, p.min_split_improvement, 1.0, kk,
+                        col_rate, None)
+                    ktrees.append(tree)
+                    F_sum = F_sum.at[:, k].add(jnp.asarray(tree.values)[leaf])
+                    if valid is not None:
+                        levels, vals = stack_trees([tree])
+                        F_v = F_v.at[:, k].add(traverse_jit(levels, vals, Xv))
+                trees.append(ktrees)
+            else:
+                rng, kk = jax.random.split(rng)
+                tree, leaf = build_tree(
+                    codes, -targets[0] * w_eff, w_eff, w_eff, binned.edges,
+                    p.nbins, p.max_depth, p.reg_lambda, p.min_rows,
+                    p.min_split_improvement, 1.0, kk, col_rate, None)
+                trees.append(tree)
+                F_sum = F_sum + jnp.asarray(tree.values)[leaf]
+                if valid is not None:
+                    levels, vals = stack_trees([tree])
+                    F_v = F_v + traverse_jit(levels, vals, Xv)
+            job.update((t + 1) / p.ntrees, f"tree {t + 1}/{p.ntrees}")
+
+            if ((t + 1) % p.score_tree_interval == 0) or t == p.ntrees - 1:
+                avg = F_sum / (t + 1)
+                raw = self._avg_to_preds(avg, di, K)
+                m = make_metrics(di, raw, y, w)
+                entry = {"iteration": t + 1, **m.describe()}
+                if valid is not None:
+                    mv = make_metrics(
+                        di, self._avg_to_preds(F_v / (t + 1), di, K), y_v, w_v)
+                    entry.update({f"valid_{k2}": v for k2, v
+                                  in mv.describe().items()})
+                history.append(entry)
+                if p.stopping_rounds:
+                    key = (f"valid_{metric_name}" if valid is not None
+                           else metric_name)
+                    series = [hh.get(key) for hh in history
+                              if hh.get(key) is not None]
+                    if series and stop_early(series, p.stopping_rounds,
+                                             p.stopping_tolerance, maximize):
+                        break
+
+        model.output["trees"] = trees
+        model.output["init_score"] = np.zeros(K) if K > 1 else 0.0
+        model.output["ntrees_trained"] = len(trees)
+        model.output["edges"] = binned.edges
+        model.scoring_history = history
+        raw = model._predict_raw(model._design(frame))
+        model.training_metrics = make_metrics(di, raw, di.response(frame), w)
+        if valid is not None:
+            model.validation_metrics = model.model_performance(valid)
+        return model
+
+    @staticmethod
+    def _avg_to_preds(avg, di, K):
+        if di.is_classifier and K > 1:
+            pr = jnp.clip(avg, 0.0, 1.0)
+            return pr / jnp.maximum(jnp.sum(pr, axis=1, keepdims=True), 1e-12)
+        if di.is_classifier:
+            p1 = jnp.clip(avg, 0.0, 1.0)
+            return jnp.stack([1 - p1, p1], axis=1)
+        return avg

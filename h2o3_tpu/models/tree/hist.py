@@ -1,0 +1,182 @@
+"""tpu_hist: the histogram / split-search / partition kernels for tree algos.
+
+Reference hot loop: ``hex/tree/DHistogram.java:48,67-95`` (per-(leaf, column,
+bin) accumulate of w/wY/wYY into one double[]), driven by
+``ScoreBuildHistogram2.java:62,119-235`` (two node-local passes: score rows ->
+leaf assignment, then histogram build parallel over columns x row-ranges),
+reduced across the cluster by elementwise array add (MRTask tree-reduce).
+The XGBoost extension's CUDA ``gpu_hist`` is the performance target
+(BASELINE.json: "gpu_hist via xgboost4j-gpu -> Pallas/XLA tpu_hist").
+
+TPU-native redesign: scatter-adds are serialized on a vector machine, so the
+histogram becomes DENSE MATMULS on the MXU: one-hot(leaf) x (g,h,w) planes
+contracted with one-hot(bin codes) via einsum, blocked over rows to bound
+memory, shard_mapped over the mesh "rows" axis with a single ``psum`` as the
+cross-device reduce (replacing both the LocalMR pass and the MRTask tree).
+Split search and row partition are fused elementwise/gather passes.  All
+shapes static per tree level; one compile per (depth, F, B) geometry.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from ...runtime.cluster import cluster, ROW_AXIS
+
+# target float32 elements for the one-hot block buffer (memory knob)
+_BLOCK_BUDGET = 32 * 1024 * 1024
+
+
+def _block_rows(n_local: int, F: int, B: int) -> int:
+    blk = max(_BLOCK_BUDGET // max(F * B, 1), 256)
+    return int(min(n_local, blk))
+
+
+@functools.lru_cache(maxsize=None)
+def make_hist_fn(L: int, F: int, B: int, n_padded: int):
+    """Compiled histogram: (codes[N,F], leaf[N], g[N], h[N], w[N]) ->
+    H[3, L, F, B] with planes (sum g, sum h, sum w), psum'd over the mesh.
+
+    ``B`` here includes the NA bin (= nbins + 1).
+    """
+    cl = cluster()
+    n_local = n_padded // cl.n_row_shards
+    blk = _block_rows(n_local, F, B)
+    nblk = (n_local + blk - 1) // blk
+    pad_to = nblk * blk
+
+    def local_hist(codes, leaf, g, h, w):
+        # pad local shard to a whole number of blocks (w=0 rows contribute 0)
+        def padr(x, fill=0):
+            return jnp.pad(x, [(0, pad_to - n_local)] + [(0, 0)] * (x.ndim - 1),
+                           constant_values=fill)
+        codes = padr(codes).reshape(nblk, blk, F)
+        leaf = padr(leaf).reshape(nblk, blk)
+        S = jnp.stack([g, h, w], axis=1)          # [n, 3]
+        S = padr(S).reshape(nblk, blk, 3)
+
+        def body(acc, args):
+            c, lf, s = args
+            Pl = jax.nn.one_hot(lf, L, dtype=jnp.float32)       # [blk, L]
+            OH = jax.nn.one_hot(c, B, dtype=jnp.float32)        # [blk, F, B]
+            # [blk,L]x[blk,3] -> contract rows with [blk,F,B]
+            PS = jnp.einsum("rl,rs->rsl", Pl, s)                # [blk,3,L]
+            acc = acc + jnp.einsum("rsl,rfb->slfb", PS, OH)
+            return acc, None
+        H0 = jnp.zeros((3, L, F, B), jnp.float32)
+        # carry becomes device-varying inside shard_map; mark it so upfront
+        H0 = jax.lax.pcast(H0, (ROW_AXIS,), to='varying')
+        H, _ = jax.lax.scan(body, H0, (codes, leaf, S))
+        return jax.lax.psum(H, ROW_AXIS)
+
+    specs_in = (P(ROW_AXIS, None), P(ROW_AXIS), P(ROW_AXIS), P(ROW_AXIS),
+                P(ROW_AXIS))
+    f = shard_map(local_hist, mesh=cl.mesh, in_specs=specs_in, out_specs=P())
+    return jax.jit(f)
+
+
+def _score(G, H, lam):
+    return G * G / (H + lam)
+
+
+@functools.partial(jax.jit, static_argnames=("nbins",))
+def best_splits(Hist, nbins: int, reg_lambda: float, min_rows: float,
+                min_split_improvement: float, feat_mask=None):
+    """Best split per leaf from H[3, L, F, B] (B = nbins regular + 1 NA bin).
+
+    Tries NA-left and NA-right (XGBoost's sparsity-aware default direction;
+    the reference tracks NA in DHistogram the same way).  Returns per-leaf
+    (feat, bin, na_left, gain, valid).  ``feat_mask`` [L, F] (or [F]) disables
+    features per leaf (DRF mtries / column sampling).
+    """
+    G, Hs, C = Hist[0], Hist[1], Hist[2]           # [L, F, B]
+    g_na, h_na, c_na = G[..., -1], Hs[..., -1], C[..., -1]
+    Gr, Hr, Cr = G[..., :-1], Hs[..., :-1], C[..., :-1]
+    cumG = jnp.cumsum(Gr, -1)
+    cumH = jnp.cumsum(Hr, -1)
+    cumC = jnp.cumsum(Cr, -1)
+    totG = cumG[..., -1] + g_na                    # [L, F]
+    totH = cumH[..., -1] + h_na
+    totC = cumC[..., -1] + c_na
+    parent = _score(totG, totH, reg_lambda)        # [L, F]
+
+    # candidate split after bin b (left = bins <= b), b in [0, nbins-2]
+    GL, HL, CL = cumG[..., :-1], cumH[..., :-1], cumC[..., :-1]
+    GR = totG[..., None] - GL - g_na[..., None]
+    HR = totH[..., None] - HL - h_na[..., None]
+    CR = totC[..., None] - CL - c_na[..., None]
+
+    def gain_with_na(gl, hl, cl, gr, hr, cr):
+        g = 0.5 * (_score(gl, hl, reg_lambda) + _score(gr, hr, reg_lambda)
+                   - parent[..., None])
+        ok = (cl >= min_rows) & (cr >= min_rows)
+        return jnp.where(ok, g, -jnp.inf)
+
+    gain_naL = gain_with_na(GL + g_na[..., None], HL + h_na[..., None],
+                            CL + c_na[..., None], GR, HR, CR)
+    gain_naR = gain_with_na(GL, HL, CL, GR + g_na[..., None],
+                            HR + h_na[..., None], CR + c_na[..., None])
+    na_left_better = gain_naL >= gain_naR
+    gain = jnp.maximum(gain_naL, gain_naR)         # [L, F, nbins-1]
+    if feat_mask is not None:
+        m = feat_mask if feat_mask.ndim == 2 else feat_mask[None, :]
+        gain = jnp.where(m[..., None], gain, -jnp.inf)
+
+    L, F = parent.shape
+    flat = gain.reshape(L, -1)
+    best = jnp.argmax(flat, axis=1)
+    best_gain = jnp.take_along_axis(flat, best[:, None], 1)[:, 0]
+    feat = (best // (nbins - 1)).astype(jnp.int32)
+    bin_ = (best % (nbins - 1)).astype(jnp.int32)
+    na_left = jnp.take_along_axis(
+        na_left_better.reshape(L, -1), best[:, None], 1)[:, 0]
+    valid = jnp.isfinite(best_gain) & \
+        (best_gain > min_split_improvement) & (totC >= 2 * min_rows).any(-1)
+    return feat, bin_, na_left, best_gain, valid
+
+
+@jax.jit
+def partition(codes, leaf, feat, bin_, na_left, valid, na_bin: jnp.int32):
+    """Send rows to child leaves: new_leaf = 2*leaf + went_right.
+
+    Terminal (invalid-split) leaves route everything left so descendants stay
+    consistent; the final leaf-value gather resolves them.
+    """
+    f = feat[leaf]                                     # [N] gather
+    c = jnp.take_along_axis(codes, f[:, None], axis=1)[:, 0]
+    is_na = c == na_bin
+    right = jnp.where(is_na, ~na_left[leaf], c > bin_[leaf])
+    right = right & valid[leaf]
+    return (2 * leaf + right.astype(jnp.int32)).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("L",))
+def leaf_values_from_hist(Hist, L: int, reg_lambda: float, learn_rate: float,
+                          max_abs: float = 1e10):
+    """Newton leaf values -G/(H+lambda) x learn_rate (fitBestConstants)."""
+    G = Hist[0].sum(axis=(1, 2)) if Hist[0].ndim == 3 else Hist[0]
+    H = Hist[1].sum(axis=(1, 2)) if Hist[1].ndim == 3 else Hist[1]
+    v = -G / (H + reg_lambda + 1e-12) * learn_rate
+    return jnp.clip(v, -max_abs, max_abs)
+
+
+@functools.lru_cache(maxsize=None)
+def make_leaf_agg_fn(L: int, n_padded: int):
+    """Compiled (leaf, g, h, w) -> [3, L] sums over the mesh (final-level
+    aggregation for leaf values, no per-feature breakdown needed)."""
+    cl = cluster()
+
+    def local(leaf, g, h, w):
+        Pl = jax.nn.one_hot(leaf, L, dtype=jnp.float32)
+        out = jnp.stack([g @ Pl, h @ Pl, w @ Pl])
+        return jax.lax.psum(out, ROW_AXIS)
+
+    f = shard_map(local, mesh=cl.mesh,
+                  in_specs=(P(ROW_AXIS),) * 4, out_specs=P())
+    return jax.jit(f)

@@ -1,0 +1,229 @@
+"""Shared tree infrastructure: level-wise growth driver + ensemble scoring.
+
+Reference: ``hex/tree/SharedTree.java:29`` (Driver:231, scoreAndBuildTrees:483,
+buildLayer:561), ``hex/tree/DTree.java`` (in-progress tree),
+``hex/tree/CompressedTree`` (packed scoring form), ``hex/tree/Score.java``.
+
+TPU-native redesign: a tree level is three fused device programs (histogram ->
+split-search -> partition, see hist.py); a finished tree is a set of per-level
+arrays (feature, threshold, NA-direction, valid) + leaf values — the
+CompressedTree analog, directly gather-traversable on device.  Ensemble
+prediction stacks trees per level and lax.scan's over them: depth gathers per
+tree, all batched over rows on the VPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...frame.frame import Frame
+from ...frame.vec import T_CAT
+from ...runtime import dkv
+from ...runtime.job import Job
+from ..base import Model, ModelBuilder, Parameters
+from ..datainfo import DataInfo, ColumnSpec
+from ..scorekeeper import stop_early, metric_direction
+from ..distributions import make_distribution
+from .binning import BinnedFrame, fit_bins, encode_bins
+from .hist import (make_hist_fn, best_splits, partition, make_leaf_agg_fn)
+
+
+@dataclasses.dataclass
+class SharedTreeParameters(Parameters):
+    ntrees: int = 50
+    max_depth: int = 5
+    min_rows: float = 10.0
+    nbins: int = 64                  # quantile-sketch bins (ref nbins=20)
+    learn_rate: float = 0.1
+    sample_rate: float = 1.0
+    col_sample_rate: float = 1.0         # per split (mtries analog)
+    col_sample_rate_per_tree: float = 1.0
+    min_split_improvement: float = 1e-5
+    reg_lambda: float = 0.0
+    distribution: str = "auto"
+    tweedie_power: float = 1.5
+    quantile_alpha: float = 0.5
+    huber_alpha: float = 0.9
+    score_tree_interval: int = 5
+    stopping_rounds: int = 0
+    standardize: bool = False            # trees never standardize
+
+
+@dataclasses.dataclass
+class Tree:
+    """One grown tree — the CompressedTree analog (host-side)."""
+    feat: List[np.ndarray]       # per level [2^d] int32
+    thr: List[np.ndarray]        # per level [2^d] float32
+    na_left: List[np.ndarray]    # per level [2^d] bool
+    valid: List[np.ndarray]      # per level [2^d] bool
+    values: np.ndarray           # [2^depth] float32
+
+
+def stack_trees(trees: List[Tree]):
+    """[T, ...] per-level stacks for compiled whole-ensemble traversal."""
+    depth = len(trees[0].feat)
+    levels = []
+    for d in range(depth):
+        levels.append((
+            jnp.asarray(np.stack([t.feat[d] for t in trees])),
+            jnp.asarray(np.stack([t.thr[d] for t in trees])),
+            jnp.asarray(np.stack([t.na_left[d] for t in trees])),
+            jnp.asarray(np.stack([t.valid[d] for t in trees]))))
+    values = jnp.asarray(np.stack([t.values for t in trees]))
+    return levels, values
+
+
+def traverse(levels, values, X):
+    """Sum of leaf values over stacked trees for raw feature matrix X.
+
+    scan over trees; per level: gather node params, compare, descend.
+    NaN feature -> NA direction (sparsity-aware default, hist.py).
+    """
+    N = X.shape[0]
+
+    def one_tree(carry, tree_slices):
+        acc = carry
+        node = jnp.zeros(N, jnp.int32)
+        for (feat, thr, na_left, valid) in tree_slices[0]:
+            f = feat[node]
+            x = jnp.take_along_axis(X, f[:, None], axis=1)[:, 0]
+            right = jnp.where(jnp.isnan(x), ~na_left[node], x >= thr[node])
+            right = right & valid[node]
+            node = 2 * node + right.astype(jnp.int32)
+        acc = acc + tree_slices[1][node]
+        return acc, None
+
+    # lax.scan needs uniform pytrees; reorganize levels per tree via index map
+    T = values.shape[0]
+
+    def body(acc, i):
+        slices = tuple((lv[0][i], lv[1][i], lv[2][i], lv[3][i])
+                       for lv in levels)
+        return one_tree(acc, (slices, values[i]))
+
+    acc = jnp.zeros(N, jnp.float32)
+    acc, _ = jax.lax.scan(lambda c, i: body(c, i), acc, jnp.arange(T))
+    return acc
+
+
+traverse_jit = jax.jit(traverse)
+
+
+def build_tree(codes, g, h, w, edges, nbins: int, max_depth: int,
+               reg_lambda: float, min_rows: float, min_split_improvement: float,
+               learn_rate: float, rng_key, col_sample_rate: float = 1.0,
+               tree_col_mask: Optional[np.ndarray] = None):
+    """Grow one tree level-by-level (SharedTree.buildLayer loop).
+
+    Returns (Tree, final_leaf_assignment[N]).
+    """
+    N, F = codes.shape
+    B = nbins + 1
+    leaf = jnp.zeros(N, jnp.int32)
+    feat_l, thr_l, nal_l, val_l = [], [], [], []
+    for d in range(max_depth):
+        L = 2 ** d
+        H = make_hist_fn(L, F, B, N)(codes, leaf, g, h, w)
+        mask = None
+        if tree_col_mask is not None:
+            mask = jnp.asarray(tree_col_mask)
+        if col_sample_rate < 1.0:
+            rng_key, k = jax.random.split(rng_key)
+            per_split = jax.random.uniform(k, (L, F)) < col_sample_rate
+            # always keep at least one feature per leaf
+            per_split = per_split.at[:, 0].set(
+                per_split.any(axis=1) & per_split[:, 0] | ~per_split.any(axis=1))
+            mask = per_split if mask is None else per_split & mask[None, :]
+        feat, bin_, na_left, gain, valid = best_splits(
+            H, nbins, reg_lambda, min_rows, min_split_improvement, mask)
+        leaf = partition(codes, leaf, feat, bin_, na_left, valid,
+                         jnp.int32(nbins))
+        # host copies for the compressed tree
+        feat_h = np.asarray(feat)
+        bin_h = np.asarray(bin_)
+        thr_h = np.zeros(L, np.float32)
+        for i in range(L):
+            e = edges[feat_h[i]]
+            thr_h[i] = e[min(bin_h[i], len(e) - 1)] if len(e) else 0.0
+        feat_l.append(feat_h)
+        thr_l.append(thr_h)
+        nal_l.append(np.asarray(na_left))
+        val_l.append(np.asarray(valid))
+    Lfin = 2 ** max_depth
+    agg = make_leaf_agg_fn(Lfin, N)(leaf, g, h, w)
+    agg = np.asarray(agg, np.float64)
+    vals = np.where(agg[2] > 0,
+                    -agg[0] / (agg[1] + reg_lambda + 1e-12) * learn_rate, 0.0)
+    tree = Tree(feat_l, thr_l, nal_l, val_l, vals.astype(np.float32))
+    return tree, leaf
+
+
+class SharedTreeModel(Model):
+    """Tree-ensemble model: scores via compiled stacked-tree traversal."""
+
+    def _design(self, frame: Frame) -> jax.Array:
+        """Raw-value matrix [padded, F]: numerics as-is, cats as codes."""
+        di = self.datainfo
+        cols = []
+        for s in di.specs:
+            vec = frame.vec(s.name)
+            if s.type == T_CAT:
+                codes = di._aligned_codes(vec, s)
+                cols.append(jnp.where(codes < 0, jnp.nan,
+                                      codes.astype(jnp.float32)))
+            else:
+                cols.append(vec.data)
+        return jnp.stack(cols, axis=1)
+
+    def _raw_scores(self, X: jax.Array):
+        trees = self.output["trees"]
+        init = self.output["init_score"]
+        K = self.output.get("nclass_trees", 1)
+        if K == 1:
+            levels, values = stack_trees(trees)
+            return init + traverse_jit(levels, values, X)
+        outs = []
+        for k in range(K):
+            levels, values = stack_trees([t[k] for t in trees])
+            outs.append(init[k] + traverse_jit(levels, values, X))
+        return jnp.stack(outs, axis=1)
+
+
+class SharedTree(ModelBuilder):
+    """Common driver: binning, main loop, scoring, early stopping."""
+
+    def _make_datainfo(self, frame: Frame) -> DataInfo:
+        p = self.params
+        return DataInfo.fit(
+            frame, response_column=p.response_column if self.supervised else None,
+            ignored_columns=p.ignored_columns, weights_column=p.weights_column,
+            offset_column=p.offset_column, standardize=False,
+            missing_values_handling="mean_imputation",
+            force_classification=getattr(self, "_force_classification", False))
+
+    def _score_and_log(self, model, it, F_train, y, w, di, dist, history,
+                       valid_state):
+        from ...metrics.core import make_metrics
+        raw = self._scores_to_preds(F_train, dist, di)
+        m = make_metrics(di, raw, y, w)
+        entry = {"iteration": it, **m.describe()}
+        if valid_state is not None:
+            F_v, y_v, w_v = valid_state
+            mv = make_metrics(di, self._scores_to_preds(F_v, dist, di),
+                              y_v, w_v)
+            entry.update({f"valid_{k}": v for k, v in mv.describe().items()})
+        history.append(entry)
+        return m
+
+    def _scores_to_preds(self, F, dist, di):
+        if di.is_classifier and di.nclasses > 2:
+            return jax.nn.softmax(F, axis=1)
+        if di.is_classifier:
+            p1 = jnp.clip(dist.linkinv(F), 0.0, 1.0)
+            return jnp.stack([1 - p1, p1], axis=1)
+        return dist.linkinv(F)

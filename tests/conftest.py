@@ -10,11 +10,21 @@ surface without TPU hardware.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force the CPU backend: the test mesh must be 8 virtual CPU devices, never
+# the (single, exclusively-held) real TPU chip — grabbing it from multiple
+# test processes deadlocks in backend init.  The env var alone is NOT enough:
+# this image pre-imports jax from sitecustomize.py with JAX_PLATFORMS=axon
+# baked into the config, so we must update the live config too (before any
+# backend is initialized).
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,145 @@
+"""GBM/DRF tests — mirrors pyunit_gbm*/pyunit_drf* coverage plus golden
+comparisons against sklearn's boosted/forest baselines on synthetic data."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu import Frame
+from h2o3_tpu.models.tree.gbm import GBM
+from h2o3_tpu.models.tree.drf import DRF
+
+
+def _friedman(rng, n=3000):
+    """Friedman #1 regression surface (nonlinear + interactions)."""
+    X = rng.random((n, 5))
+    y = (10 * np.sin(np.pi * X[:, 0] * X[:, 1]) + 20 * (X[:, 2] - 0.5) ** 2
+         + 10 * X[:, 3] + 5 * X[:, 4] + 0.5 * rng.normal(size=n))
+    cols = {f"x{j}": X[:, j] for j in range(5)}
+    cols["y"] = y
+    return Frame.from_numpy(cols)
+
+
+def _binary(rng, n=3000):
+    X = rng.normal(size=(n, 4))
+    logits = 2 * X[:, 0] * X[:, 1] + X[:, 2] ** 2 - 1
+    y = (rng.random(n) < 1 / (1 + np.exp(-logits))).astype(int)
+    cols = {f"x{j}": X[:, j] for j in range(4)}
+    cols["y"] = np.array(["n", "y"], dtype=object)[y]
+    return Frame.from_numpy(cols), y
+
+
+def test_gbm_regression(cl, rng):
+    fr = _friedman(rng)
+    m = GBM(response_column="y", ntrees=40, max_depth=4, learn_rate=0.2,
+            seed=1).train(fr)
+    assert m.training_metrics.r2 > 0.9, m.training_metrics.describe()
+    # prediction roundtrip
+    preds = m.predict(fr)
+    assert preds.nrows == fr.nrows
+
+
+def test_gbm_binomial(cl, rng):
+    fr, y = _binary(rng)
+    m = GBM(response_column="y", ntrees=60, max_depth=5, learn_rate=0.2,
+            seed=2).train(fr)
+    assert m.training_metrics.auc > 0.9, m.training_metrics.describe()
+
+
+def test_gbm_vs_sklearn(cl, rng):
+    from sklearn.ensemble import HistGradientBoostingRegressor
+    from sklearn.metrics import r2_score
+    fr = _friedman(rng, n=4000)
+    Xh = np.stack([fr.vec(f"x{j}").to_numpy() for j in range(5)], axis=1)
+    yh = fr.vec("y").to_numpy()
+    m = GBM(response_column="y", ntrees=60, max_depth=5, learn_rate=0.1,
+            min_rows=5, seed=3).train(fr)
+    ours = m.predict(fr).vec("predict").to_numpy()
+    sk = HistGradientBoostingRegressor(
+        max_iter=60, max_depth=5, learning_rate=0.1).fit(Xh, yh)
+    sk_r2 = r2_score(yh, sk.predict(Xh))
+    our_r2 = r2_score(yh, ours)
+    assert our_r2 > sk_r2 - 0.05, (our_r2, sk_r2)
+
+
+def test_gbm_multinomial(cl, rng):
+    n = 3000
+    centers = np.array([[2, 0], [-2, 1], [0, -2]])
+    labels = rng.integers(0, 3, n)
+    X = centers[labels] + rng.normal(size=(n, 2))
+    fr = Frame.from_numpy({
+        "x0": X[:, 0], "x1": X[:, 1],
+        "y": np.array(["a", "b", "c"], dtype=object)[labels]})
+    m = GBM(response_column="y", ntrees=20, max_depth=3, seed=4).train(fr)
+    assert m.training_metrics.accuracy > 0.85
+    preds = m.predict(fr)
+    probs = np.stack([preds.vec(c).to_numpy() for c in "abc"], axis=1)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-4)
+
+
+def test_gbm_categorical_and_na(cl, rng):
+    n = 2000
+    g = np.array(["a", "b", "c"], dtype=object)[rng.integers(0, 3, n)]
+    x = rng.normal(size=n)
+    x[rng.random(n) < 0.1] = np.nan          # missing values
+    eff = {"a": 0.0, "b": 2.0, "c": -2.0}
+    y = np.where(np.isnan(x), 1.0, x) + np.array([eff[s] for s in g])
+    fr = Frame.from_numpy({"g": g, "x": x, "y": y})
+    m = GBM(response_column="y", ntrees=30, max_depth=4, learn_rate=0.3,
+            seed=5).train(fr)
+    assert m.training_metrics.r2 > 0.85, m.training_metrics.describe()
+
+
+def test_gbm_early_stopping(cl, rng):
+    fr = _friedman(rng, n=1500)
+    train, valid = fr.split_frame([0.8], seed=1)
+    m = GBM(response_column="y", ntrees=200, max_depth=3, learn_rate=0.5,
+            stopping_rounds=2, stopping_tolerance=1e-3,
+            score_tree_interval=5, seed=6).train(train, valid=valid)
+    assert m.output["ntrees_trained"] < 200
+
+
+def test_gbm_poisson(cl, rng):
+    n = 2500
+    x = rng.normal(size=n)
+    y = rng.poisson(np.exp(0.5 * x + 1.0)).astype(float)
+    fr = Frame.from_numpy({"x": x, "y": y})
+    m = GBM(response_column="y", ntrees=30, distribution="poisson",
+            max_depth=3, seed=7).train(fr)
+    preds = m.predict(fr).vec("predict").to_numpy()
+    assert (preds > 0).all()                     # log link respected
+    assert abs(preds.mean() - y.mean()) / y.mean() < 0.1
+
+
+def test_drf_classification(cl, rng):
+    fr, y = _binary(rng)
+    m = DRF(response_column="y", ntrees=30, max_depth=10, seed=8).train(fr)
+    assert m.training_metrics.auc > 0.9, m.training_metrics.describe()
+
+
+def test_drf_regression(cl, rng):
+    fr = _friedman(rng)
+    m = DRF(response_column="y", ntrees=30, max_depth=10, seed=9).train(fr)
+    assert m.training_metrics.r2 > 0.85, m.training_metrics.describe()
+
+
+def test_drf_multinomial(cl, rng):
+    n = 2000
+    centers = np.array([[2, 0], [-2, 1], [0, -2]])
+    labels = rng.integers(0, 3, n)
+    X = centers[labels] + rng.normal(size=(n, 2))
+    fr = Frame.from_numpy({
+        "x0": X[:, 0], "x1": X[:, 1],
+        "y": np.array(["a", "b", "c"], dtype=object)[labels]})
+    m = DRF(response_column="y", ntrees=20, max_depth=8, seed=10).train(fr)
+    assert m.training_metrics.accuracy > 0.85
+
+
+def test_tree_save_load_predict(cl, rng, tmp_path):
+    from h2o3_tpu.models import Model
+    fr, y = _binary(rng, n=1000)
+    m = GBM(response_column="y", ntrees=10, max_depth=3, seed=11).train(fr)
+    p1 = m.predict(fr).vec("y").to_numpy()
+    path = m.save(str(tmp_path / "gbm.bin"))
+    m2 = Model.load(path)
+    p2 = m2.predict(fr).vec("y").to_numpy()
+    np.testing.assert_allclose(p1, p2, rtol=1e-5)
