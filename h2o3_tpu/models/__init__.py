@@ -3,3 +3,12 @@
 from .base import Model, ModelBuilder, Parameters
 from .datainfo import DataInfo
 from .glm import GLM, GLMModel, GLMParameters
+from .deeplearning import DeepLearning, DeepLearningModel, DeepLearningParameters
+from .kmeans import KMeans, KMeansModel, KMeansParameters
+from .pca import PCA, PCAModel, PCAParameters, SVD, SVDModel, SVDParameters
+from .naivebayes import NaiveBayes, NaiveBayesModel, NaiveBayesParameters
+from .quantile import Quantile, QuantileModel, QuantileParameters, quantile
+from .isotonic import (IsotonicRegression, IsotonicRegressionModel,
+                       IsotonicRegressionParameters)
+from .tree.gbm import GBM, GBMModel, GBMParameters
+from .tree.drf import DRF, DRFModel, DRFParameters

@@ -1,0 +1,148 @@
+"""Isotonic regression: device sort + pool-adjacent-violators on thresholds.
+
+Reference: ``hex/isotonic/IsotonicRegression.java`` — distributed PAV: rows
+are aggregated into (x, y, w) triples, pooled until monotone; the model
+stores threshold knots and predicts by linear interpolation with
+``out_of_bounds`` NA/clip handling.
+
+TPU-native redesign: the row-scale work (sort by x, duplicate-x aggregation
+via segment sums) runs on device; the inherently sequential PAV pooling runs
+on host over the *unique-x* knots (≤ cardinality of x, small after
+aggregation), using the O(n) stack algorithm.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..frame.frame import Frame
+from ..frame.vec import Vec, T_NUM
+from ..runtime import dkv
+from ..runtime.job import Job
+from .base import Model, ModelBuilder, Parameters
+from .datainfo import DataInfo
+
+
+@dataclasses.dataclass
+class IsotonicRegressionParameters(Parameters):
+    out_of_bounds: str = "na"     # na | clip
+
+
+@jax.jit
+def _sort_xyw(x, y, w):
+    invalid = jnp.isnan(x) | jnp.isnan(y) | (w <= 0)
+    key = jnp.where(invalid, jnp.inf, x)
+    order = jnp.argsort(key)
+    return key[order], y[order], jnp.where(invalid, 0.0, w)[order]
+
+
+def _pav(y: np.ndarray, w: np.ndarray) -> np.ndarray:
+    """Stack-based pool-adjacent-violators; returns the isotonic fit."""
+    n = len(y)
+    means = np.empty(n)
+    weights = np.empty(n)
+    sizes = np.empty(n, dtype=np.int64)
+    top = -1
+    for i in range(n):
+        top += 1
+        means[top], weights[top], sizes[top] = y[i], w[i], 1
+        while top > 0 and means[top - 1] >= means[top]:
+            tw = weights[top - 1] + weights[top]
+            means[top - 1] = (means[top - 1] * weights[top - 1]
+                              + means[top] * weights[top]) / tw
+            weights[top - 1] = tw
+            sizes[top - 1] += sizes[top]
+            top -= 1
+    return np.repeat(means[: top + 1], sizes[: top + 1])
+
+
+class IsotonicRegressionModel(Model):
+    algo = "isotonicregression"
+
+    def _predict_raw(self, X: jax.Array) -> jax.Array:
+        raise NotImplementedError("isotonic scores via thresholds")
+
+    def predict(self, frame: Frame) -> Frame:
+        x = np.asarray(frame.vec(self.output["feature"]).numeric_data(),
+                       np.float64)[: frame.nrows]
+        tx = self.output["thresholds_x"]
+        ty = self.output["thresholds_y"]
+        pred = np.interp(x, tx, ty)
+        if self.params.out_of_bounds == "na":
+            pred = np.where((x < tx[0]) | (x > tx[-1]), np.nan, pred)
+        pred = np.where(np.isnan(x), np.nan, pred)
+        return Frame(["predict"], [Vec.from_numpy(pred, T_NUM)])
+
+    def model_performance(self, frame: Optional[Frame] = None):
+        from ..metrics.core import regression_metrics
+        if frame is None:
+            return self.training_metrics
+        p = self.predict(frame).vecs[0].to_numpy()
+        y = np.asarray(frame.vec(self.params.response_column).numeric_data(),
+                       np.float64)[: frame.nrows]
+        ok = ~(np.isnan(p) | np.isnan(y))
+        return regression_metrics(jnp.asarray(p[ok], jnp.float32),
+                                  jnp.asarray(y[ok], jnp.float32),
+                                  jnp.ones(int(ok.sum()), jnp.float32))
+
+
+class IsotonicRegression(ModelBuilder):
+    """Isotonic builder — H2OIsotonicRegressionEstimator analog."""
+
+    algo = "isotonicregression"
+    model_class = IsotonicRegressionModel
+
+    def __init__(self, params: Optional[IsotonicRegressionParameters] = None,
+                 **kw):
+        super().__init__(params or IsotonicRegressionParameters(**kw))
+
+    def _validate(self, frame: Frame) -> None:
+        super()._validate(frame)
+        p = self.params
+        feats = [n for n in frame.names
+                 if n not in (p.response_column, p.weights_column)
+                 and n not in p.ignored_columns]
+        if len(feats) != 1:
+            raise ValueError(
+                f"isotonic regression needs exactly 1 feature, got {feats}")
+
+    def _fit(self, job: Job, frame: Frame, di: DataInfo,
+             valid: Optional[Frame]) -> IsotonicRegressionModel:
+        p = self.params
+        feature = di.specs[0].name
+        x = frame.vec(feature).numeric_data()
+        y = frame.vec(p.response_column).numeric_data()
+        w = di.weights(frame)
+        xs, ys, ws = _sort_xyw(x, y, w)
+        xs = np.asarray(xs, np.float64)
+        ys = np.asarray(ys, np.float64)
+        ws = np.asarray(ws, np.float64)
+        n = int((ws > 0).sum())
+        xs, ys, ws = xs[:n], ys[:n], ws[:n]
+        # aggregate duplicate x (weighted mean) so PAV runs on unique knots
+        ux, start = np.unique(xs, return_index=True)
+        wsum = np.add.reduceat(ws, start)
+        ysum = np.add.reduceat(ys * ws, start)
+        ymean = ysum / np.maximum(wsum, 1e-30)
+        fit = _pav(ymean, wsum)
+        # keep only segment-boundary knots (thresholds, as the reference does)
+        keep = np.ones(len(fit), bool)
+        if len(fit) > 2:
+            interior = (fit[1:-1] == fit[:-2]) & (fit[1:-1] == fit[2:])
+            keep[1:-1] = ~interior
+        model = IsotonicRegressionModel(
+            job.dest_key or dkv.make_key(self.algo), p, di)
+        model.output.update({
+            "feature": feature,
+            "thresholds_x": ux[keep], "thresholds_y": fit[keep],
+            "nobs": n,
+        })
+        model.training_metrics = model.model_performance(frame)
+        if valid is not None:
+            model.validation_metrics = model.model_performance(valid)
+        return model

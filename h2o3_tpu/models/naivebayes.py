@@ -1,0 +1,164 @@
+"""Naive Bayes: all per-class sufficient statistics as one-hot matmuls.
+
+Reference: ``hex/naivebayes/NaiveBayes.java`` — an MRTask accumulates
+per-(class, feature-level) counts for categoricals and per-class mean/sdev
+for numerics; Laplace smoothing, ``min_sdev``/``eps_sdev`` floors, apriori
+class probabilities; scoring sums log-likelihoods per row.
+
+TPU-native redesign: the entire sufficient-statistics pass is two MXU
+matmuls — ``Y_onehot.T @ X`` and ``Y_onehot.T @ X**2`` over the row-sharded
+one-hot design matrix (categorical level counts and numeric moment sums fall
+out of the same product); scoring is one ``X @ log_prob_table`` matmul plus a
+small per-class Gaussian term.  The MRTask reduce tree becomes the XLA psum.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..frame.frame import Frame
+from ..frame.vec import T_CAT
+from ..runtime import dkv
+from ..runtime.job import Job
+from .base import Model, ModelBuilder, Parameters
+from .datainfo import DataInfo
+
+
+@dataclasses.dataclass
+class NaiveBayesParameters(Parameters):
+    laplace: float = 0.0
+    min_sdev: float = 1e-3
+    eps_sdev: float = 0.0
+    min_prob: float = 1e-3
+    eps_prob: float = 0.0
+    standardize: bool = False
+    compute_metrics: bool = True
+
+
+@jax.jit
+def _class_moments(X, Y, w):
+    """[K,P] weighted per-class sums of X and X^2, plus class weights."""
+    Yw = Y * w[:, None]
+    M1 = Yw.T @ X
+    M2 = Yw.T @ (X * X)
+    nk = jnp.sum(Yw, axis=0)
+    return M1, M2, nk
+
+
+class NaiveBayesModel(Model):
+    algo = "naivebayes"
+
+    def _predict_raw(self, X: jax.Array) -> jax.Array:
+        out = self.output
+        log_cat = jnp.asarray(out["_log_cat_table"], jnp.float32)   # [P, K]
+        mu = jnp.asarray(out["_num_mu"], jnp.float32)               # [K, Pn]
+        inv2v = jnp.asarray(out["_num_inv2var"], jnp.float32)       # [K, Pn]
+        logsd = jnp.asarray(out["_num_logsd"], jnp.float32)         # [K, Pn]
+        num_idx = jnp.asarray(out["_num_idx"], jnp.int32)
+        logprior = jnp.asarray(out["_log_prior"], jnp.float32)      # [K]
+
+        ll = X @ log_cat + logprior[None, :]
+        if num_idx.shape[0]:
+            Xn = X[:, num_idx]                                       # [N, Pn]
+            diff = Xn[:, None, :] - mu[None, :, :]                   # [N, K, Pn]
+            ll = ll - jnp.sum(diff * diff * inv2v[None] + logsd[None], axis=2)
+        ll = ll - jnp.max(ll, axis=1, keepdims=True)
+        probs = jnp.exp(ll)
+        return probs / jnp.sum(probs, axis=1, keepdims=True)
+
+
+class NaiveBayes(ModelBuilder):
+    """NaiveBayes builder — h2o.naiveBayes / H2ONaiveBayesEstimator analog."""
+
+    algo = "naivebayes"
+    model_class = NaiveBayesModel
+
+    def __init__(self, params: Optional[NaiveBayesParameters] = None, **kw):
+        super().__init__(params or NaiveBayesParameters(**kw))
+
+    def _make_datainfo(self, frame: Frame) -> DataInfo:
+        p = self.params
+        di = DataInfo.fit(
+            frame, response_column=p.response_column,
+            ignored_columns=p.ignored_columns,
+            weights_column=p.weights_column, standardize=False,
+            use_all_factor_levels=True, add_intercept=False,
+            missing_values_handling=p.missing_values_handling)
+        if not di.is_classifier:
+            raise ValueError("naivebayes requires a categorical response")
+        return di
+
+    def _fit(self, job: Job, frame: Frame, di: DataInfo,
+             valid: Optional[Frame]) -> NaiveBayesModel:
+        p: NaiveBayesParameters = self.params
+        X = di.make_matrix(frame)
+        y = di.response(frame)
+        w = di.weights(frame)
+        K = di.nclasses
+        Y = (jnp.clip(y, 0, K - 1).astype(jnp.int32)[:, None]
+             == jnp.arange(K)[None, :]).astype(jnp.float32)
+        M1, M2, nk = _class_moments(X, Y, w)
+        M1 = np.asarray(M1, np.float64)
+        M2 = np.asarray(M2, np.float64)
+        nk = np.asarray(nk, np.float64)
+        n = nk.sum()
+
+        P = di.nfeatures
+        log_cat = np.zeros((P, K))
+        num_idx, num_mu, num_var = [], [], []
+        for s in di.specs:
+            sl = slice(s.offset, s.offset + s.width)
+            if s.type == T_CAT:
+                counts = M1[:, sl].T                        # [W, K] level counts
+                # NA bucket (last level of the block) contributes nothing at
+                # score time (NaiveBayes.java skips NAs); drop it from the
+                # denominator too.
+                denom = counts[:-1].sum(axis=0) + p.laplace * (s.width - 1)
+                probs = (counts + p.laplace) / np.maximum(denom[None, :], 1e-30)
+                # NaiveBayes.java: probability <= eps_prob replaced by min_prob
+                probs = np.where(probs <= max(p.eps_prob, 1e-30),
+                                 p.min_prob, probs)
+                log_cat[sl, :] = np.log(probs)
+                log_cat[s.offset + s.width - 1, :] = 0.0
+            else:
+                mu_k = M1[:, s.offset] / np.maximum(nk, 1e-30)
+                var_k = M2[:, s.offset] / np.maximum(nk, 1e-30) - mu_k**2
+                sd_k = np.sqrt(np.maximum(var_k, 0.0) * nk
+                               / np.maximum(nk - 1.0, 1.0))
+                # NaiveBayes.java: sdev <= eps_sdev replaced by min_sdev
+                sd_k = np.where(sd_k <= max(p.eps_sdev, 1e-30),
+                                p.min_sdev, sd_k)
+                num_idx.append(s.offset)
+                num_mu.append(mu_k)
+                num_var.append(sd_k**2)
+        prior = nk / max(n, 1e-30)
+
+        model = NaiveBayesModel(job.dest_key or dkv.make_key(self.algo), p, di)
+        if num_idx:
+            mu = np.stack(num_mu, axis=1)                   # [K, Pn]
+            var = np.stack(num_var, axis=1)
+        else:
+            mu = np.zeros((K, 0)); var = np.ones((K, 0))
+        model.output.update({
+            "apriori": prior,
+            "levels": list(di.response_domain),
+            "coef_names": di.coef_names,
+            "_log_cat_table": log_cat,
+            "_num_idx": np.asarray(num_idx, np.int64),
+            "_num_mu": mu,
+            "_num_inv2var": 1.0 / (2.0 * var),
+            "_num_logsd": 0.5 * np.log(2 * np.pi * var),
+            "_log_prior": np.log(np.maximum(prior, 1e-30)),
+        })
+        if p.compute_metrics:
+            from ..metrics.core import make_metrics
+            raw = model._predict_raw(X)
+            model.training_metrics = make_metrics(di, raw, y, w)
+            if valid is not None:
+                model.validation_metrics = model.model_performance(valid)
+        return model

@@ -1,0 +1,287 @@
+"""PCA + SVD: Gram/eigen and randomized projections on the MXU.
+
+Reference: ``hex/pca/PCA.java:41`` (methods GramSVD / Power / Randomized /
+GLRM; transform NONE/STANDARDIZE/NORMALIZE/DEMEAN/DESCALE) and
+``hex/svd/SVD.java`` — both accumulate a distributed Gram ``X'X`` via
+``gram/Gram.java:1017`` GramTask MRTasks and eigendecompose on the driver.
+
+TPU-native redesign: the Gram is one ``X.T @ (w * X)`` matmul over the
+row-sharded design matrix (XLA partitioner inserts the psum that replaces the
+GramTask reduce); eigh/svd of the small [P, P] Gram runs on host.  The
+Randomized method is the Halko sketch — two tall-skinny MXU matmuls — which
+is the TPU-preferred path for wide data.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..frame.frame import Frame
+from ..frame.vec import Vec, T_NUM
+from ..runtime import dkv
+from ..runtime.job import Job
+from .base import Model, ModelBuilder, Parameters
+from .datainfo import DataInfo
+
+TRANSFORMS = ("none", "standardize", "normalize", "demean", "descale")
+
+
+@dataclasses.dataclass
+class PCAParameters(Parameters):
+    k: int = 1
+    transform: str = "none"
+    pca_method: str = "gram_s_v_d"      # gram_s_v_d | power | randomized
+    use_all_factor_levels: bool = False
+    compute_metrics: bool = True
+    max_iterations: int = 1000
+
+
+def _transform_flags(transform: str):
+    if transform not in TRANSFORMS:
+        raise ValueError(f"transform must be one of {TRANSFORMS}")
+    demean = transform in ("standardize", "demean")
+    descale = transform in ("standardize", "normalize", "descale")
+    return demean, descale
+
+
+@jax.jit
+def _gram(X, w):
+    Xw = X * w[:, None]
+    return X.T @ Xw, jnp.sum(w)
+
+
+class _ProjectionMixin:
+    """Shared fitted-projection plumbing for PCA/SVD models."""
+
+    def _std_matrix(self, frame: Frame) -> jax.Array:
+        di = self.datainfo
+        X = di.make_matrix(frame, standardize=False)
+        mu = jnp.asarray(self.output["_mu"], jnp.float32)
+        sd = jnp.asarray(self.output["_sd"], jnp.float32)
+        return (X - mu[None, :]) * sd[None, :]
+
+
+class PCAModel(_ProjectionMixin, Model):
+    algo = "pca"
+
+    def _predict_raw(self, X: jax.Array) -> jax.Array:
+        V = jnp.asarray(self.output["eigenvectors"], jnp.float32)
+        return X @ V
+
+    def predict(self, frame: Frame) -> Frame:
+        Z = np.asarray(self._predict_raw(self._std_matrix(frame)))
+        Z = Z[: frame.nrows]
+        names = [f"PC{i+1}" for i in range(Z.shape[1])]
+        return Frame(names, [Vec.from_numpy(Z[:, i].astype(np.float64), T_NUM)
+                             for i in range(Z.shape[1])])
+
+    def model_performance(self, frame: Optional[Frame] = None):
+        if frame is None:
+            return self.training_metrics
+        # reconstruction MSE in the transformed space on the given frame
+        Xt = self._std_matrix(frame)
+        V = jnp.asarray(self.output["eigenvectors"], jnp.float32)
+        R = Xt - (Xt @ V) @ V.T
+        w = self.datainfo.weights(frame)
+        mse = float(jnp.sum(jnp.sum(R * R, axis=1) * w)
+                    / jnp.maximum(jnp.sum(w), 1.0))
+        return {"reconstruction_mse": mse}
+
+
+class PCA(ModelBuilder):
+    """PCA builder — h2o.prcomp / H2OPrincipalComponentAnalysisEstimator analog."""
+
+    algo = "pca"
+    model_class = PCAModel
+    supervised = False
+
+    def __init__(self, params: Optional[PCAParameters] = None, **kw):
+        super().__init__(params or PCAParameters(**kw))
+
+    def _make_datainfo(self, frame: Frame) -> DataInfo:
+        p = self.params
+        return DataInfo.fit(
+            frame, response_column=None, ignored_columns=p.ignored_columns,
+            standardize=False, use_all_factor_levels=p.use_all_factor_levels,
+            add_intercept=False,
+            missing_values_handling=p.missing_values_handling)
+
+    def _centered(self, frame: Frame, di: DataInfo, transform: str):
+        """[N,P] matrix after the PCA transform + (mu, sd) used."""
+        X = di.make_matrix(frame, standardize=False)
+        w = di.weights(frame)
+        n = jnp.maximum(jnp.sum(w), 1.0)
+        mu_all = jnp.sum(X * w[:, None], axis=0) / n
+        var = jnp.sum((X - mu_all[None, :]) ** 2 * w[:, None], axis=0) \
+            / jnp.maximum(n - 1.0, 1.0)
+        demean, descale = _transform_flags(transform)
+        mu = mu_all if demean else jnp.zeros_like(mu_all)
+        sd = jnp.where(var > 0, 1.0 / jnp.sqrt(var), 1.0) if descale \
+            else jnp.ones_like(var)
+        Xt = (X - mu[None, :]) * sd[None, :]
+        return Xt, w, mu, sd, n
+
+    def _fit(self, job: Job, frame: Frame, di: DataInfo,
+             valid: Optional[Frame]) -> PCAModel:
+        p: PCAParameters = self.params
+        k = min(p.k, di.nfeatures)
+        Xt, w, mu, sd, n = self._centered(frame, di, p.transform)
+
+        if p.pca_method == "randomized":
+            eigvec, eigval = self._randomized(Xt, w, k, n, p)
+        elif p.pca_method == "power":
+            eigvec, eigval = self._power(Xt, w, k, n, p)
+        else:
+            G, _ = _gram(Xt, w)
+            G = np.asarray(G, np.float64) / max(float(n) - 1.0, 1.0)
+            vals, vecs = np.linalg.eigh(G)
+            order = np.argsort(vals)[::-1][:k]
+            eigval, eigvec = vals[order], vecs[:, order]
+
+        eigval = np.maximum(np.asarray(eigval, np.float64), 0.0)
+        sdev = np.sqrt(eigval)
+        # sign convention: largest |component| positive (matches prcomp-ish)
+        for j in range(eigvec.shape[1]):
+            i = np.argmax(np.abs(eigvec[:, j]))
+            if eigvec[i, j] < 0:
+                eigvec[:, j] = -eigvec[:, j]
+
+        total_var = float(jnp.sum(
+            jnp.sum(Xt * Xt * w[:, None], axis=0) / jnp.maximum(n - 1.0, 1.0)))
+        pve = sdev**2 / total_var if total_var > 0 else sdev * 0
+
+        model = PCAModel(job.dest_key or dkv.make_key(self.algo), p, di)
+        model.output.update({
+            "eigenvectors": np.asarray(eigvec, np.float64),
+            "std_deviation": sdev,
+            "pct_variance": pve,
+            "cum_pct_variance": np.cumsum(pve),
+            "coef_names": di.coef_names,
+            "k": int(k),
+            "_mu": np.asarray(mu, np.float64),
+            "_sd": np.asarray(sd, np.float64),
+        })
+        if p.compute_metrics:
+            model.training_metrics = {"total_variance": total_var}
+        return model
+
+    # -------------------------------------------------- iterative methods
+    def _power(self, Xt, w, k, n, p):
+        """Power iteration with deflation on the [P,P] Gram (PCA.java Power)."""
+        G, _ = _gram(Xt, w)
+        G = np.asarray(G, np.float64) / max(float(n) - 1.0, 1.0)
+        P = G.shape[0]
+        rng = np.random.default_rng(p.effective_seed())
+        vecs, vals = [], []
+        for _ in range(k):
+            v = rng.normal(size=P)
+            v /= np.linalg.norm(v)
+            for _ in range(p.max_iterations):
+                v2 = G @ v
+                for u in vecs:
+                    v2 -= (u @ v2) * u
+                nv = np.linalg.norm(v2)
+                if nv == 0:
+                    break
+                v2 /= nv
+                if np.abs(v2 @ v) > 1 - 1e-12:
+                    v = v2
+                    break
+                v = v2
+            lam = float(v @ G @ v)
+            vecs.append(v)
+            vals.append(lam)
+        return np.stack(vecs, axis=1), np.array(vals)
+
+    def _randomized(self, Xt, w, k, n, p):
+        """Halko randomized SVD: sketch + 2 power passes, all MXU matmuls."""
+        P = Xt.shape[1]
+        rng = np.random.default_rng(p.effective_seed())
+        ell = min(P, k + 8)
+        Om = jnp.asarray(rng.normal(size=(P, ell)), jnp.float32)
+        Wc = w[:, None]
+        Y = (Xt * Wc) @ Om
+        for _ in range(2):
+            Q, _ = jnp.linalg.qr(Y)
+            Y = (Xt * Wc) @ (Xt.T @ Q)
+        Q, _ = jnp.linalg.qr(Y)
+        B = Q.T @ (Xt * jnp.sqrt(Wc))          # [ell, P]
+        Bh = np.asarray(B, np.float64)
+        _, s, Vt = np.linalg.svd(Bh, full_matrices=False)
+        vals = (s**2) / max(float(n) - 1.0, 1.0)
+        return Vt[:k].T, vals[:k]
+
+
+# ============================================================ SVD builder
+@dataclasses.dataclass
+class SVDParameters(PCAParameters):
+    nv: int = 1
+    svd_method: str = "gram_s_v_d"
+    keep_u: bool = True
+
+
+class SVDModel(_ProjectionMixin, Model):
+    algo = "svd"
+
+    def _predict_raw(self, X: jax.Array) -> jax.Array:
+        V = jnp.asarray(self.output["v"], jnp.float32)
+        d = jnp.asarray(self.output["d"], jnp.float32)
+        return (X @ V) / jnp.maximum(d[None, :], 1e-30)
+
+    def predict(self, frame: Frame) -> Frame:
+        U = np.asarray(self._predict_raw(self._std_matrix(frame)))[: frame.nrows]
+        names = [f"u{i+1}" for i in range(U.shape[1])]
+        return Frame(names, [Vec.from_numpy(U[:, i].astype(np.float64), T_NUM)
+                             for i in range(U.shape[1])])
+
+    def model_performance(self, frame=None):
+        if frame is None:
+            return self.training_metrics
+        Xt = self._std_matrix(frame)
+        V = jnp.asarray(self.output["v"], jnp.float32)
+        R = Xt - (Xt @ V) @ V.T
+        w = self.datainfo.weights(frame)
+        mse = float(jnp.sum(jnp.sum(R * R, axis=1) * w)
+                    / jnp.maximum(jnp.sum(w), 1.0))
+        return {"reconstruction_mse": mse}
+
+
+class SVD(PCA):
+    """SVD builder — hex/svd/SVD.java analog (d, V, optional U)."""
+
+    algo = "svd"
+    model_class = SVDModel
+
+    def __init__(self, params: Optional[SVDParameters] = None, **kw):
+        ModelBuilder.__init__(self, params or SVDParameters(**kw))
+
+    def _fit(self, job: Job, frame: Frame, di: DataInfo,
+             valid: Optional[Frame]) -> SVDModel:
+        p: SVDParameters = self.params
+        k = min(p.nv, di.nfeatures)
+        Xt, w, mu, sd, n = self._centered(frame, di, p.transform)
+        G, _ = _gram(Xt, w)
+        G = np.asarray(G, np.float64)
+        vals, vecs = np.linalg.eigh(G)
+        order = np.argsort(vals)[::-1][:k]
+        vals = np.maximum(vals[order], 0.0)
+        V = vecs[:, order]
+        d = np.sqrt(vals)
+        model = SVDModel(job.dest_key or dkv.make_key(self.algo), p, di)
+        model.output.update({
+            "d": d, "v": V, "coef_names": di.coef_names, "k": int(k),
+            "_mu": np.asarray(mu, np.float64), "_sd": np.asarray(sd, np.float64),
+        })
+        model.training_metrics = {"d": d.tolist()}
+        if p.keep_u:
+            u = model.predict(frame)
+            u_key = dkv.make_key("svd_u")
+            u.key = u_key
+            dkv.put(u_key, u)
+            model.output["u_key"] = u_key
+        return model

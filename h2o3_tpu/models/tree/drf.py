@@ -26,7 +26,6 @@ from ...runtime import dkv
 from ...runtime.job import Job
 from ..datainfo import DataInfo
 from ..scorekeeper import stop_early, metric_direction
-from ..distributions import Gaussian
 from .binning import fit_bins
 from .shared import (SharedTree, SharedTreeModel, SharedTreeParameters,
                      build_tree, stack_trees, traverse_jit)
@@ -91,7 +90,6 @@ class DRF(SharedTree):
 
         model = DRFModel(job.dest_key or dkv.make_key(self.algo), p, di)
         model.output["nclass_trees"] = K
-        dist = Gaussian()
 
         if K > 1:
             yi = jnp.clip(y.astype(jnp.int32), 0, K - 1)
@@ -104,7 +102,6 @@ class DRF(SharedTree):
 
         F_sum = jnp.zeros((N, K), jnp.float32) if K > 1 \
             else jnp.zeros((N,), jnp.float32)
-        valid_state = None
         if valid is not None:
             Xv = model._design(valid)
             y_v, w_v = di.response(valid), di.weights(valid)
@@ -172,8 +169,9 @@ class DRF(SharedTree):
         model.output["ntrees_trained"] = len(trees)
         model.output["edges"] = binned.edges
         model.scoring_history = history
-        raw = model._predict_raw(model._design(frame))
-        model.training_metrics = make_metrics(di, raw, di.response(frame), w)
+        # F_sum already holds the final ensemble scores — no re-traversal
+        model.training_metrics = make_metrics(
+            di, self._avg_to_preds(F_sum / max(len(trees), 1), di, K), y, w)
         if valid is not None:
             model.validation_metrics = model.model_performance(valid)
         return model

@@ -28,7 +28,7 @@ from ...runtime.job import Job
 from ..datainfo import DataInfo
 from ..distributions import make_distribution, Multinomial
 from ..scorekeeper import stop_early, metric_direction
-from .binning import fit_bins, encode_bins
+from .binning import fit_bins
 from .shared import (SharedTree, SharedTreeModel, SharedTreeParameters,
                      Tree, build_tree, stack_trees, traverse_jit)
 from ...metrics.core import make_metrics
@@ -90,9 +90,7 @@ class GBM(SharedTree):
         model.output["binning"] = {"nbins": p.nbins}
         model.output["nclass_trees"] = K
 
-        valid_state = None
         if valid is not None:
-            model.output["trees"] = []
             Xv = model._design(valid)
             y_v, w_v = di.response(valid), di.weights(valid)
 
@@ -186,8 +184,9 @@ class GBM(SharedTree):
         model.output["ntrees_trained"] = len(trees)
         model.output["edges"] = binned.edges
         model.scoring_history = history
-        raw = model._predict_raw(model._design(frame))
-        model.training_metrics = make_metrics(di, raw, di.response(frame), w)
+        # F already holds the final training scores — no tree re-traversal
+        model.training_metrics = make_metrics(
+            di, self._scores_to_preds(F, dist, di), y, w)
         if valid is not None:
             model.validation_metrics = model.model_performance(valid)
         return model

@@ -1,0 +1,195 @@
+"""KMeans / PCA / SVD / NaiveBayes / Quantile / Isotonic tests.
+
+Mirrors the reference's pyunit strategy (h2o-py/tests/testdir_algos/{kmeans,
+pca,naivebayes,isotonicregression}, testdir_misc/pyunit_quantile.py): golden
+comparisons against numpy closed forms on synthetic data.
+"""
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu import Frame
+from h2o3_tpu.models import (KMeans, PCA, SVD, NaiveBayes, Quantile,
+                             IsotonicRegression, quantile)
+
+
+def _blobs(rng, n_per=500, centers=((0, 0), (8, 8), (-8, 8)), scale=0.8):
+    pts, lab = [], []
+    for i, c in enumerate(centers):
+        pts.append(rng.normal(size=(n_per, 2)) * scale + np.asarray(c))
+        lab += [i] * n_per
+    X = np.concatenate(pts)
+    perm = rng.permutation(len(X))
+    return X[perm], np.asarray(lab)[perm]
+
+
+# ------------------------------------------------------------------ KMeans
+def test_kmeans_recovers_blobs(cl, rng):
+    X, lab = _blobs(rng)
+    fr = Frame.from_numpy({"x": X[:, 0], "y": X[:, 1]})
+    m = KMeans(k=3, standardize=False, seed=42, max_iterations=20).train(fr)
+    centers = np.sort(np.round(m.output["centers"]).astype(int), axis=0)
+    assert centers.tolist() == [[-8, 0], [0, 8], [8, 8]]
+    tm = m.training_metrics
+    assert tm.tot_withinss < 0.05 * tm.totss
+    assert abs(tm.totss - (tm.tot_withinss + tm.betweenss)) < 1e-6
+    assert sorted(tm.size) == [500, 500, 500]
+    pred = m.predict(fr)
+    labels = pred.vecs[0].to_numpy()
+    # each true blob maps to exactly one predicted cluster
+    for i in range(3):
+        assert len(np.unique(labels[lab == i])) == 1
+
+
+def test_kmeans_init_methods(cl, rng):
+    X, _ = _blobs(rng, n_per=200)
+    fr = Frame.from_numpy({"x": X[:, 0], "y": X[:, 1]})
+    for init in ("random", "plus_plus", "furthest"):
+        m = KMeans(k=3, init=init, seed=7).train(fr)
+        assert m.output["k"] == 3
+    user = np.array([[0.0, 0.0], [8.0, 8.0], [-8.0, 8.0]])
+    m = KMeans(k=3, init="user", user_points=user, standardize=False).train(fr)
+    assert m.training_metrics.tot_withinss < 0.05 * m.training_metrics.totss
+
+
+def test_kmeans_estimate_k(cl, rng):
+    X, _ = _blobs(rng, n_per=300)
+    fr = Frame.from_numpy({"x": X[:, 0], "y": X[:, 1]})
+    m = KMeans(k=8, estimate_k=True, seed=3, standardize=False).train(fr)
+    assert m.output["k"] == 3
+
+
+# -------------------------------------------------------------------- PCA
+def test_pca_matches_numpy_svd(cl, rng):
+    n, p = 2000, 6
+    base = rng.normal(size=(n, 3))
+    X = np.concatenate([base, base @ rng.normal(size=(3, 3)) * 0.5], axis=1)
+    X += 0.01 * rng.normal(size=X.shape)
+    fr = Frame.from_numpy({f"c{i}": X[:, i] for i in range(p)})
+    m = PCA(k=3, transform="demean", pca_method="gram_s_v_d").train(fr)
+    Xc = X - X.mean(axis=0)
+    _, s, Vt = np.linalg.svd(Xc, full_matrices=False)
+    sd_true = s[:3] / np.sqrt(n - 1)
+    np.testing.assert_allclose(m.output["std_deviation"], sd_true, rtol=1e-3)
+    for j in range(3):
+        dot = abs(np.dot(m.output["eigenvectors"][:, j], Vt[j]))
+        assert dot > 0.999, (j, dot)
+    # projection roundtrip
+    scores = m.predict(fr)
+    Z = np.stack([v.to_numpy() for v in scores.vecs], axis=1)
+    Z_true = Xc @ Vt[:3].T
+    for j in range(3):
+        c = np.corrcoef(Z[:, j], Z_true[:, j])[0, 1]
+        assert abs(c) > 0.999
+
+
+def test_pca_methods_agree(cl, rng):
+    X = rng.normal(size=(1500, 5)) @ np.diag([5, 3, 2, 0.5, 0.1])
+    fr = Frame.from_numpy({f"c{i}": X[:, i] for i in range(5)})
+    ms = {meth: PCA(k=2, transform="demean", pca_method=meth, seed=1).train(fr)
+          for meth in ("gram_s_v_d", "power", "randomized")}
+    ref = ms["gram_s_v_d"].output["std_deviation"]
+    for meth in ("power", "randomized"):
+        np.testing.assert_allclose(ms[meth].output["std_deviation"], ref,
+                                   rtol=1e-2)
+
+
+def test_svd(cl, rng):
+    X = rng.normal(size=(800, 4))
+    fr = Frame.from_numpy({f"c{i}": X[:, i] for i in range(4)})
+    m = SVD(nv=4, transform="none").train(fr)
+    s_true = np.linalg.svd(X, compute_uv=False)
+    np.testing.assert_allclose(m.output["d"], s_true, rtol=1e-3)
+
+
+# ------------------------------------------------------------- NaiveBayes
+def test_naivebayes_gaussian(cl, rng):
+    n = 3000
+    y = rng.integers(0, 2, n)
+    x0 = rng.normal(size=n) + 2.0 * y
+    x1 = rng.normal(size=n) - 1.5 * y
+    cat = np.where(rng.random(n) < 0.2 + 0.6 * y, "a", "b")
+    fr = Frame.from_numpy({
+        "x0": x0, "x1": x1, "cat": cat.astype(object),
+        "y": np.array(["no", "yes"], dtype=object)[y]})
+    m = NaiveBayes(response_column="y", laplace=1.0).train(fr)
+    assert m.training_metrics.auc > 0.9
+    np.testing.assert_allclose(m.output["apriori"],
+                               [np.mean(y == 0), np.mean(y == 1)], atol=0.02)
+    pred = m.predict(fr)
+    acc = np.mean(pred.vecs[0].decoded() == np.where(y, "yes", "no"))
+    assert acc > 0.85
+
+
+# ---------------------------------------------------------------- Quantile
+def test_quantile_matches_numpy(cl, rng):
+    x = rng.normal(size=5000)
+    fr = Frame.from_numpy({"x": x})
+    probs = (0.1, 0.25, 0.5, 0.75, 0.9)
+    q = quantile(fr, probs=probs)["x"]
+    q_true = np.quantile(x, probs)          # linear interpolation == type 7
+    np.testing.assert_allclose(q, q_true, atol=1e-6)
+
+
+def test_quantile_methods_and_nas(cl, rng):
+    x = np.arange(10, dtype=np.float64)
+    x_na = np.concatenate([x, [np.nan] * 5])
+    fr = Frame.from_numpy({"x": x_na})
+    m = Quantile(probs=(0.5,), combine_method="low").train(fr)
+    assert m.output["quantiles"]["x"][0] == 4.0
+    m = Quantile(probs=(0.5,), combine_method="high").train(fr)
+    assert m.output["quantiles"]["x"][0] == 5.0
+    m = Quantile(probs=(0.5,), combine_method="average").train(fr)
+    assert m.output["quantiles"]["x"][0] == 4.5
+
+
+def test_quantile_weighted(cl, rng):
+    x = np.array([1.0, 2.0, 3.0, 4.0])
+    w = np.array([1.0, 1.0, 2.0, 0.0])
+    fr = Frame.from_numpy({"x": x, "w": w})
+    m = Quantile(probs=(0.5,), weights_column="w",
+                 combine_method="low").train(fr)
+    # cumweights [1,2,4]@x=[1,2,3]; target 2 -> boundary at x=2
+    assert m.output["quantiles"]["x"][0] == 2.0
+
+
+# ---------------------------------------------------------------- Isotonic
+def test_isotonic_monotone_and_accurate(cl, rng):
+    n = 4000
+    x = rng.uniform(-3, 3, n)
+    y = np.tanh(x) + 0.3 * rng.normal(size=n)
+    fr = Frame.from_numpy({"x": x, "y": y})
+    m = IsotonicRegression(response_column="y").train(fr)
+    ty = m.output["thresholds_y"]
+    assert np.all(np.diff(ty) >= -1e-12)
+    pred = m.predict(fr).vecs[0].to_numpy()
+    ok = ~np.isnan(pred)
+    rmse = np.sqrt(np.mean((pred[ok] - np.tanh(x[ok])) ** 2))
+    assert rmse < 0.1
+    assert m.training_metrics.rmse < 0.35
+
+
+def test_isotonic_out_of_bounds(cl, rng):
+    x = np.array([0.0, 1.0, 2.0, 3.0])
+    y = np.array([0.0, 1.0, 2.0, 3.0])
+    m = IsotonicRegression(response_column="y").train(
+        Frame.from_numpy({"x": x, "y": y}))
+    test = Frame.from_numpy({"x": np.array([-1.0, 1.5, 9.0])})
+    p_na = m.predict(test).vecs[0].to_numpy()
+    assert np.isnan(p_na[0]) and np.isnan(p_na[2]) and abs(p_na[1] - 1.5) < 1e-9
+    m.params.out_of_bounds = "clip"
+    p_clip = m.predict(test).vecs[0].to_numpy()
+    assert p_clip[0] == 0.0 and p_clip[2] == 3.0
+
+
+def test_model_save_load_kmeans(cl, rng, tmp_path):
+    X, _ = _blobs(rng, n_per=100)
+    fr = Frame.from_numpy({"x": X[:, 0], "y": X[:, 1]})
+    m = KMeans(k=3, seed=1).train(fr)
+    path = m.save(str(tmp_path / "km.bin"))
+    m2 = h2o3_tpu.models.Model.load(path)
+    np.testing.assert_allclose(m2.output["centers"], m.output["centers"])
+    p1 = m.predict(fr).vecs[0].to_numpy()
+    p2 = m2.predict(fr).vecs[0].to_numpy()
+    assert np.array_equal(p1, p2)
