@@ -1,10 +1,11 @@
-"""Benchmark: DeepLearning MLP training throughput (samples/sec/chip).
+"""Benchmark: tpu_hist boosting throughput (trees/sec, Airlines-10M shape).
 
-The reference logs rows/sec for hex.deeplearning (DeepLearning.java:648,
-DeepLearningModel.java:580 "samples/sec").  H2O's Java Hogwild fprop/bprop on
-a CPU node sustains on the order of 5e4 samples/sec for a 784->200->200->10
-MLP; BASELINE.json's north star is DeepLearning samples/sec/chip.  We report
-vs_baseline against that 5e4 reference-shape number.
+North star (BASELINE.json / SURVEY.md §6): the reference's XGBoost gpu_hist
+benchmark gate trains 100 trees on airlines-10m in 22-52s on its GPU node
+(compareBenchmarksStage.groovy:174-177) → ~1.9-4.5 trees/sec.  vs_baseline
+divides our trees/sec by the best end of that interval (4.5), measured on an
+airlines-shaped synthetic set: 10M rows, mixed numeric/categorical, binary
+response, max_depth=6, nbins=256 — the same work shape gpu_hist does.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -14,42 +15,60 @@ import time
 
 import numpy as np
 
-REFERENCE_SAMPLES_PER_SEC = 5.0e4   # H2O Java DL per-node ballpark (see above)
+REFERENCE_TREES_PER_SEC = 4.5     # best of the reference gpu_hist interval
+N_ROWS = 10_000_000
+N_TREES = 50
+
+
+def make_airlines_like(n):
+    rng = np.random.default_rng(0)
+    cols = {
+        "year": rng.integers(1987, 2008, n).astype(np.float32),
+        "month": rng.integers(1, 13, n).astype(np.float32),
+        "day_of_week": rng.integers(1, 8, n).astype(np.float32),
+        "crs_dep_time": rng.integers(0, 2400, n).astype(np.float32),
+        "distance": np.abs(rng.normal(700, 500, n)).astype(np.float32),
+        "carrier": rng.integers(0, 22, n),
+        "origin": rng.integers(0, 300, n),
+        "dest": rng.integers(0, 300, n),
+    }
+    logit = (0.002 * (cols["crs_dep_time"] / 100 - 12) ** 2
+             - 0.0005 * cols["distance"] / 100
+             + 0.2 * np.isin(cols["day_of_week"], (5, 7))
+             + 0.1 * rng.normal(size=n))
+    dep_delayed = rng.random(n) < 1 / (1 + np.exp(-logit))
+    cols["dep_delayed_15min"] = np.where(dep_delayed, "YES", "NO").astype(object)
+    types = {"carrier": "cat", "origin": "cat", "dest": "cat"}
+    domains = {"carrier": [str(i) for i in range(22)],
+               "origin": [str(i) for i in range(300)],
+               "dest": [str(i) for i in range(300)]}
+    return cols, types, domains
 
 
 def main():
-    import jax
     import h2o3_tpu
     from h2o3_tpu import Frame
-    from h2o3_tpu.models.deeplearning import DeepLearning
+    from h2o3_tpu.frame.vec import T_CAT
+    from h2o3_tpu.models import XGBoost
 
     h2o3_tpu.init()
-    rng = np.random.default_rng(0)
-    n, p, k = 200_000, 784, 10
-    X = rng.normal(size=(n, p)).astype(np.float32)
-    w_true = rng.normal(size=(p, k)).astype(np.float32)
-    labels = np.argmax(X @ w_true + rng.normal(size=(n, k)), axis=1)
-    cols = {f"x{j}": X[:, j] for j in range(p)}
-    cols["y"] = labels.astype(str).astype(object)
-    fr = Frame.from_numpy(cols)
+    cols, types, domains = make_airlines_like(N_ROWS)
+    types = {k: (T_CAT if v == "cat" else v) for k, v in types.items()}
+    fr = Frame.from_numpy(cols, types=types, domains=domains)
 
-    # warmup: compile the training program
-    DeepLearning(response_column="y", hidden=[256, 256], epochs=0.02,
-                 mini_batch_size=512, seed=1, stopping_rounds=0,
-                 standardize=False).train(fr)
-    # timed run
+    config = dict(response_column="dep_delayed_15min", max_depth=6,
+                  nbins=256, seed=1, score_tree_interval=10 ** 9)
+    # warmup: compile every tree-level geometry
+    XGBoost(ntrees=2, **config).train(fr)
     t0 = time.time()
-    m = DeepLearning(response_column="y", hidden=[256, 256], epochs=2.0,
-                     mini_batch_size=512, seed=1, stopping_rounds=0,
-                     standardize=False).train(fr)
+    XGBoost(ntrees=N_TREES, **config).train(fr)
     dt = time.time() - t0
-    samples = m.output["samples_trained"]
-    sps = samples / dt
+    tps = N_TREES / dt
     print(json.dumps({
-        "metric": "deeplearning_samples_per_sec_per_chip",
-        "value": round(sps, 1),
-        "unit": "samples/sec",
-        "vs_baseline": round(sps / REFERENCE_SAMPLES_PER_SEC, 3),
+        "metric": "xgboost_trees_per_sec_airlines10m_shape",
+        "value": round(tps, 3),
+        "unit": "trees/sec",
+        "vs_baseline": round(tps / REFERENCE_TREES_PER_SEC, 3),
     }))
 
 

@@ -1,0 +1,219 @@
+"""AutoML: planned modeling steps + leaderboard + stacked ensembles.
+
+Reference: ``h2o-automl`` — ``ai/h2o/automl/AutoML.java:49`` runs a plan of
+``ModelingStep``s from per-algo providers
+(modeling/{GLM,GBM,DRF,DeepLearning,StackedEnsemble,XGBoost}StepsProvider),
+with time/model budgets (WorkAllocations), ranking in
+``hex/leaderboard/Leaderboard.java:34``, and two final stacked ensembles
+(BestOfFamily, AllModels).
+
+TPU-native redesign: the plan is plain host control flow over this package's
+builders; every step trains with common nfolds +
+keep_cross_validation_predictions so the final SEs stack for free.  Budgets
+are wall-clock/model-count checks between steps (model-parallel scheduling
+across mesh slices is the natural extension, SURVEY.md §7 step 8).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..frame.frame import Frame
+from ..models.base import Model
+from ..models.grid import default_sort_metric, model_metric
+
+
+class Leaderboard:
+    """Ranked model container — hex/leaderboard/Leaderboard.java:34 analog."""
+
+    def __init__(self, models: List[Model], sort_metric: Optional[str] = None):
+        self.models = list(models)
+        if models:
+            default, lower = default_sort_metric(models[0])
+            self.sort_metric = sort_metric or default
+            from ..models.scorekeeper import METRIC_MAXIMIZE
+            self.lower_is_better = lower if sort_metric is None else \
+                not METRIC_MAXIMIZE.get(self.sort_metric, False)
+        else:
+            self.sort_metric, self.lower_is_better = "rmse", True
+
+    def sorted_models(self) -> List[Model]:
+        def keyfn(m):
+            v = model_metric(m, self.sort_metric)
+            if v is None:
+                return np.inf if self.lower_is_better else -np.inf
+            return v
+        return sorted(self.models, key=keyfn,
+                      reverse=not self.lower_is_better)
+
+    @property
+    def leader(self) -> Model:
+        return self.sorted_models()[0]
+
+    def as_table(self) -> List[dict]:
+        rows = []
+        for m in self.sorted_models():
+            row = {"model_id": m.key, "algo": m.algo,
+                   self.sort_metric: model_metric(m, self.sort_metric)}
+            for extra in ("auc", "logloss", "rmse", "mae"):
+                if extra != self.sort_metric:
+                    v = model_metric(m, extra)
+                    if v is not None:
+                        row[extra] = v
+            rows.append(row)
+        return rows
+
+    def __repr__(self):
+        lines = [f"Leaderboard (by {self.sort_metric}):"]
+        for r in self.as_table():
+            lines.append(f"  {r['model_id']:<28} "
+                         f"{r[self.sort_metric]}")
+        return "\n".join(lines)
+
+
+@dataclasses.dataclass
+class AutoMLParameters:
+    response_column: str = ""
+    max_models: int = 10
+    max_runtime_secs: float = 0.0            # 0 = no time budget
+    nfolds: int = 5
+    seed: int = -1
+    include_algos: Optional[Sequence[str]] = None
+    exclude_algos: Sequence[str] = ()
+    sort_metric: Optional[str] = None
+    weights_column: Optional[str] = None
+    keep_cross_validation_predictions: bool = True
+
+
+class AutoML:
+    """AutoML driver — H2OAutoML analog (plan of steps + leaderboard + SEs)."""
+
+    def __init__(self, params: Optional[AutoMLParameters] = None, **kw):
+        self.params = params or AutoMLParameters(**kw)
+        self.models: List[Model] = []
+        self.leaderboard: Optional[Leaderboard] = None
+        self.events: List[dict] = []
+
+    # ------------------------------------------------------------ the plan
+    def _plan(self) -> List[dict]:
+        """Ordered steps — the {algo}StepsProvider defaults, trimmed."""
+        p = self.params
+        steps = [
+            {"algo": "glm", "id": "GLM_1", "params": {"lambda_search": True}},
+            {"algo": "gbm", "id": "GBM_1",
+             "params": {"ntrees": 50, "max_depth": 6, "sample_rate": 0.8,
+                        "col_sample_rate": 0.8}},
+            {"algo": "gbm", "id": "GBM_2",
+             "params": {"ntrees": 50, "max_depth": 7, "sample_rate": 0.9,
+                        "col_sample_rate": 0.9}},
+            {"algo": "gbm", "id": "GBM_3",
+             "params": {"ntrees": 50, "max_depth": 8}},
+            {"algo": "drf", "id": "DRF_1", "params": {"ntrees": 50}},
+            {"algo": "drf", "id": "XRT_1",
+             "params": {"ntrees": 50, "sample_rate": 0.632}},
+            {"algo": "xgboost", "id": "XGBoost_1",
+             "params": {"ntrees": 50, "max_depth": 6}},
+            {"algo": "xgboost", "id": "XGBoost_2",
+             "params": {"ntrees": 50, "max_depth": 8, "sample_rate": 0.8}},
+            {"algo": "deeplearning", "id": "DeepLearning_1",
+             "params": {"hidden": [64, 64], "epochs": 10}},
+        ]
+        include = set(a.lower() for a in p.include_algos) \
+            if p.include_algos else None
+        exclude = set(a.lower() for a in p.exclude_algos)
+        out = []
+        for s in steps:
+            if include is not None and s["algo"] not in include:
+                continue
+            if s["algo"] in exclude:
+                continue
+            out.append(s)
+        return out
+
+    def _builder(self, algo: str, params: dict):
+        from ..models import GLM, GBM, DRF, XGBoost, DeepLearning
+        p = self.params
+        common = dict(response_column=p.response_column,
+                      weights_column=p.weights_column,
+                      nfolds=p.nfolds, seed=p.seed,
+                      keep_cross_validation_predictions=
+                      p.keep_cross_validation_predictions)
+        cls = {"glm": GLM, "gbm": GBM, "drf": DRF, "xgboost": XGBoost,
+               "deeplearning": DeepLearning}[algo]
+        return cls(**{**common, **params})
+
+    # --------------------------------------------------------------- train
+    def train(self, frame: Frame, valid: Optional[Frame] = None) -> Model:
+        p = self.params
+        if not p.response_column:
+            raise ValueError("automl requires response_column")
+        t0 = time.time()
+
+        def budget_left(n_planned: int = 0) -> bool:
+            if p.max_models and len(self.models) + n_planned > p.max_models:
+                return False
+            if p.max_runtime_secs and time.time() - t0 > p.max_runtime_secs:
+                return False
+            return True
+
+        for step in self._plan():
+            if not budget_left(1):
+                break
+            try:
+                b = self._builder(step["algo"], step["params"])
+                m = b.train(frame, valid)
+                m.output["automl_step"] = step["id"]
+                self.models.append(m)
+                self.events.append({"step": step["id"], "model": m.key,
+                                    "t": time.time() - t0})
+            except Exception as e:                      # noqa: BLE001
+                self.events.append({"step": step["id"], "error": repr(e),
+                                    "t": time.time() - t0})
+
+        if not self.models:
+            raise RuntimeError(
+                f"automl: every modeling step failed; events: {self.events}")
+
+        # stacked ensembles (BestOfFamily + AllModels), CV stacking
+        se_excluded = any(a.lower().replace("_", "") == "stackedensemble"
+                          for a in p.exclude_algos)
+        if len(self.models) >= 2 and p.nfolds and p.nfolds > 1 \
+                and not se_excluded:
+            lb = Leaderboard(self.models, p.sort_metric)
+            ranked = lb.sorted_models()
+            best_of_family: List[Model] = []
+            seen = set()
+            for m in ranked:
+                if m.algo not in seen:
+                    seen.add(m.algo)
+                    best_of_family.append(m)
+            from ..models.ensemble import StackedEnsemble
+            for name, base in (("SE_BestOfFamily", best_of_family),
+                               ("SE_AllModels", ranked)):
+                if len(base) < 2:
+                    continue
+                try:
+                    se = StackedEnsemble(
+                        response_column=p.response_column,
+                        base_models=[m.key for m in base],
+                        seed=p.seed).train(frame, valid)
+                    se.output["automl_step"] = name
+                    self.models.append(se)
+                    self.events.append({"step": name, "model": se.key,
+                                        "t": time.time() - t0})
+                except Exception as e:                  # noqa: BLE001
+                    self.events.append({"step": name, "error": repr(e),
+                                        "t": time.time() - t0})
+
+        self.leaderboard = Leaderboard(self.models, p.sort_metric)
+        return self.leaderboard.leader
+
+    @property
+    def leader(self) -> Model:
+        if self.leaderboard is None:
+            raise RuntimeError("automl: train() has not been run")
+        return self.leaderboard.leader

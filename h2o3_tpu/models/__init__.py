@@ -12,3 +12,12 @@ from .isotonic import (IsotonicRegression, IsotonicRegressionModel,
                        IsotonicRegressionParameters)
 from .tree.gbm import GBM, GBMModel, GBMParameters
 from .tree.drf import DRF, DRFModel, DRFParameters
+from .tree.xgboost import XGBoost, XGBoostModel, XGBoostParameters
+from .ensemble import (StackedEnsemble, StackedEnsembleModel,
+                       StackedEnsembleParameters)
+from .grid import Grid, GridSearch
+from .tree.isofor import (IsolationForest, IsolationForestModel,
+                          IsolationForestParameters,
+                          ExtendedIsolationForest,
+                          ExtendedIsolationForestModel,
+                          ExtendedIsolationForestParameters)

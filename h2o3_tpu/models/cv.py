@@ -65,19 +65,36 @@ def cross_validate(builder, job: Job, frame: Frame, di, valid):
     width = nclasses if di.is_classifier else 1
     holdout = np.full((frame.nrows, width), np.nan, dtype=np.float64)
     cv_models = []
+
+    # Constant-shape folds: rather than slicing rows per fold (which changes
+    # the padded row count and forces XLA to recompile every program per
+    # fold), train each fold model on the FULL frame with holdout rows'
+    # weights zeroed via a synthetic weight column.  Shapes stay identical
+    # across folds, so every fold reuses the first fold's compilations.
+    from ..frame.vec import Vec, T_NUM
+    base_w = np.ones(frame.nrows)
+    if p.weights_column is not None:
+        base_w = np.nan_to_num(frame.vec(p.weights_column).to_numpy())
+    cv_w_col = "_cv_weights_"
+    import dataclasses as _dc
+    X_full = None
     for f in range(nfolds):
-        train_f = frame.rows(np.nonzero(folds != f)[0])
-        hold_idx = np.nonzero(folds == f)[0]
-        hold_f = frame.rows(hold_idx)
+        w_f = np.where(folds != f, base_w, 0.0)
+        fold_frame = Frame(list(frame.names) + [cv_w_col],
+                           list(frame.vecs) + [Vec.from_numpy(w_f, T_NUM)])
         fold_builder = type(builder)(copy.copy(p))
         fold_builder.params.nfolds = 0
-        fold_di = di  # share the training layout: same domains/means
+        fold_builder.params.weights_column = cv_w_col
+        fold_di = _dc.replace(di, weights_column=cv_w_col)
         fold_job = Job(f"{builder.algo} cv fold {f}")
-        m = fold_job.run(lambda j: fold_builder._fit(j, train_f, fold_di, None))
+        m = fold_job.run(
+            lambda j: fold_builder._fit(j, fold_frame, fold_di, None))
         cv_models.append(m)
-        X_h = di.make_matrix(hold_f)
-        raw = np.asarray(m._predict_raw(X_h))[: hold_f.nrows]
-        holdout[hold_idx] = raw.reshape(len(hold_idx), width)
+        if X_full is None:
+            X_full = di.make_matrix(frame)
+        hold_idx = np.nonzero(folds == f)[0]
+        raw = np.asarray(m._predict_raw(X_full))[: frame.nrows]
+        holdout[hold_idx] = raw.reshape(frame.nrows, width)[hold_idx]
         job.update(0.8 * (f + 1) / nfolds, f"cv fold {f + 1}/{nfolds}")
 
     # final model on all data

@@ -1,0 +1,171 @@
+"""Stacked Ensembles: metalearner over base-model holdout predictions.
+
+Reference: ``hex/ensemble/StackedEnsemble.java:38`` — base models trained
+with common nfolds + keep_cross_validation_predictions supply the level-one
+frame (their CV holdout predictions); a metalearner (GLM default, or
+GBM/DRF/DeepLearning) is trained on it; ``blending_frame`` switches to
+holdout blending instead of CV stacking.
+
+TPU-native redesign: the level-one "frame" is a small dense matrix assembled
+host-side from each base model's holdout predictions; the metalearner is any
+ModelBuilder in this package, trained as usual on the sharded level-one
+design.  Ensemble scoring chains two compiled passes (base batch predict →
+metalearner predict)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..frame.frame import Frame
+from ..runtime import dkv
+from ..runtime.job import Job
+from .base import Model, ModelBuilder, Parameters
+from .datainfo import DataInfo
+
+
+@dataclasses.dataclass
+class StackedEnsembleParameters(Parameters):
+    base_models: Sequence[Union[str, Model]] = ()
+    metalearner_algorithm: str = "auto"     # auto|glm|gbm|drf|deeplearning
+    metalearner_params: Optional[dict] = None
+    metalearner_nfolds: int = 0
+    blending_frame: Optional[Frame] = None
+
+
+def _resolve(m: Union[str, Model]) -> Model:
+    if isinstance(m, Model):
+        return m
+    got = dkv.get(m)
+    if got is None:
+        raise KeyError(f"base model {m!r} not found in DKV")
+    return got
+
+
+def _base_columns(model: Model, raw: np.ndarray) -> List[np.ndarray]:
+    """Level-one columns contributed by one base model's raw predictions."""
+    di = model.datainfo
+    if di.is_classifier and di.nclasses == 2:
+        return [raw[:, 1]]                       # p(positive)
+    if di.is_classifier:
+        return [raw[:, k] for k in range(di.nclasses)]
+    return [raw.reshape(len(raw))]
+
+
+class StackedEnsembleModel(Model):
+    algo = "stackedensemble"
+
+    def _level_one(self, frame: Frame) -> Frame:
+        cols = {}
+        for key in self.output["base_model_keys"]:
+            bm = _resolve(key)
+            X = bm.datainfo.make_matrix(frame)
+            raw = np.asarray(bm._predict_raw(X))[: frame.nrows]
+            raw = raw.reshape(frame.nrows, -1)
+            for i, col in enumerate(_base_columns(bm, raw)):
+                cols[f"{key}_p{i}"] = col
+        lf = Frame.from_numpy(cols)
+        resp = self.params.response_column
+        if resp in frame.names:
+            # carry the response through unchanged (keeps cat identity)
+            lf = Frame(lf.names + [resp], lf.vecs + [frame.vec(resp)])
+        return lf
+
+    def _predict_raw(self, X):
+        raise NotImplementedError("ensemble scores via its base models")
+
+    def predict(self, frame: Frame) -> Frame:
+        meta = _resolve(self.output["metalearner_key"])
+        return meta.predict(self._level_one(frame))
+
+    def model_performance(self, frame: Optional[Frame] = None):
+        if frame is None:
+            return self.training_metrics
+        meta = _resolve(self.output["metalearner_key"])
+        return meta.model_performance(self._level_one(frame))
+
+
+class StackedEnsemble(ModelBuilder):
+    """SE builder — H2OStackedEnsembleEstimator analog."""
+
+    algo = "stackedensemble"
+    model_class = StackedEnsembleModel
+
+    def __init__(self, params: Optional[StackedEnsembleParameters] = None,
+                 **kw):
+        super().__init__(params or StackedEnsembleParameters(**kw))
+
+    def _make_metalearner(self, di: DataInfo) -> ModelBuilder:
+        p: StackedEnsembleParameters = self.params
+        algo = p.metalearner_algorithm
+        mp = dict(p.metalearner_params or {})
+        mp.setdefault("response_column", p.response_column)
+        mp.setdefault("nfolds", p.metalearner_nfolds)
+        mp.setdefault("seed", p.seed)
+        if algo in ("auto", "glm"):
+            from .glm import GLM
+            mp.setdefault("lambda_", 1e-5)
+            return GLM(**mp)
+        if algo == "gbm":
+            from .tree.gbm import GBM
+            return GBM(**mp)
+        if algo == "drf":
+            from .tree.drf import DRF
+            return DRF(**mp)
+        if algo == "deeplearning":
+            from .deeplearning import DeepLearning
+            return DeepLearning(**mp)
+        raise ValueError(f"unknown metalearner_algorithm {algo!r}")
+
+    def _validate(self, frame: Frame) -> None:
+        super()._validate(frame)
+        p: StackedEnsembleParameters = self.params
+        if not p.base_models:
+            raise ValueError("stackedensemble requires base_models")
+        if p.blending_frame is None:
+            for m in p.base_models:
+                bm = _resolve(m)
+                if bm.cv_predictions is None:
+                    raise ValueError(
+                        f"base model {bm.key} has no CV holdout predictions; "
+                        "train with nfolds>1 and "
+                        "keep_cross_validation_predictions=True, or supply "
+                        "a blending_frame")
+
+    def _fit(self, job: Job, frame: Frame, di: DataInfo,
+             valid: Optional[Frame]) -> StackedEnsembleModel:
+        p: StackedEnsembleParameters = self.params
+        base = [_resolve(m) for m in p.base_models]
+        model = StackedEnsembleModel(
+            job.dest_key or dkv.make_key(self.algo), p, di)
+        model.output["base_model_keys"] = [m.key for m in base]
+
+        # level-one training matrix
+        lf_frame = p.blending_frame if p.blending_frame is not None else frame
+        cols = {}
+        for bm in base:
+            if p.blending_frame is not None:
+                X = bm.datainfo.make_matrix(lf_frame)
+                raw = np.asarray(bm._predict_raw(X))[: lf_frame.nrows]
+            else:
+                raw = np.asarray(bm.cv_predictions)
+            raw = raw.reshape(lf_frame.nrows, -1)
+            for i, col in enumerate(_base_columns(bm, raw)):
+                cols[f"{bm.key}_p{i}"] = col
+        rv = lf_frame.vec(p.response_column)
+        lone = Frame.from_numpy(cols)
+        names = list(lone.names) + [p.response_column]
+        vecs = list(lone.vecs) + [rv]
+        lone = Frame(names, vecs)
+
+        job.update(0.3, "training metalearner")
+        meta_builder = self._make_metalearner(di)
+        meta = meta_builder.train(lone)
+        model.output["metalearner_key"] = meta.key
+        model.output["metalearner_algo"] = meta.algo
+        model.training_metrics = meta.training_metrics
+        if valid is not None:
+            model.validation_metrics = model.model_performance(valid)
+        return model

@@ -1,0 +1,160 @@
+"""Grid search: Cartesian + RandomDiscrete hyperparameter walkers.
+
+Reference: ``hex/grid/GridSearch.java`` + ``HyperSpaceWalker.java:213-216``
+(Cartesian and RandomDiscrete walkers with max_models / max_runtime_secs
+budgets and early stopping over the model sequence) + ``hex/grid/Grid.java``
+(the model container, sorted metric table, resumable).
+
+TPU-native redesign: each grid entry is an independent compiled training
+program; the walker is plain host control flow.  (Coarse model-parallel
+scheduling across mesh slices is the multi-slice AutoML pattern from
+SURVEY.md §7 — entries are embarrassingly parallel.)
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..frame.frame import Frame
+from ..runtime import dkv
+from .base import Model, ModelBuilder
+from .scorekeeper import stop_early
+
+
+def default_sort_metric(model: Model) -> (str, bool):
+    """(metric, lower_is_better) by model category (Leaderboard defaults)."""
+    di = model.datainfo
+    if di.is_classifier and di.nclasses == 2:
+        return "auc", False
+    if di.is_classifier:
+        return "logloss", True
+    return "rmse", True
+
+
+def model_metric(model: Model, metric: str,
+                 prefer: str = "cv") -> Optional[float]:
+    """Pull a metric off CV metrics when present, else training metrics."""
+    for m in ((model.cross_validation_metrics, model.validation_metrics,
+               model.training_metrics) if prefer == "cv" else
+              (model.validation_metrics, model.cross_validation_metrics,
+               model.training_metrics)):
+        if m is None:
+            continue
+        v = getattr(m, metric, None)
+        if v is None and isinstance(m, dict):
+            v = m.get(metric)
+        if v is not None:
+            return float(v)
+    return None
+
+
+class Grid:
+    """Trained-grid container — hex/grid/Grid.java analog."""
+
+    def __init__(self, key: str, models: List[Model],
+                 hyper_names: Sequence[str], entries: List[dict],
+                 sort_metric: str, decreasing: bool):
+        self.key = key
+        self.models = models
+        self.hyper_names = list(hyper_names)
+        self.entries = entries
+        self.sort_metric = sort_metric
+        self.decreasing = decreasing
+        dkv.put(key, self)
+
+    def _order(self) -> List[int]:
+        vals = [model_metric(m, self.sort_metric) for m in self.models]
+        keyed = [(v if v is not None else np.inf * (1 if not self.decreasing
+                                                    else -1), i)
+                 for i, v in enumerate(vals)]
+        return [i for _, i in sorted(keyed, reverse=self.decreasing)]
+
+    @property
+    def best_model(self) -> Model:
+        return self.models[self._order()[0]]
+
+    def sorted_metric_table(self) -> List[dict]:
+        rows = []
+        for i in self._order():
+            rows.append({**self.entries[i],
+                         "model_id": self.models[i].key,
+                         self.sort_metric: model_metric(
+                             self.models[i], self.sort_metric)})
+        return rows
+
+    def __repr__(self):
+        return (f"<Grid {self.key}: {len(self.models)} models by "
+                f"{self.sort_metric}>")
+
+
+class GridSearch:
+    """Grid driver — h2o.grid / H2OGridSearch analog.
+
+    ``search_criteria``: {"strategy": "Cartesian"} (default) or
+    {"strategy": "RandomDiscrete", "max_models": N, "max_runtime_secs": S,
+    "seed": K, "stopping_rounds": R, "stopping_tolerance": T}.
+    """
+
+    def __init__(self, builder_cls, hyper_params: Dict[str, Sequence],
+                 search_criteria: Optional[dict] = None, **base_params):
+        self.builder_cls = builder_cls
+        self.hyper_params = {k: list(v) for k, v in hyper_params.items()}
+        self.search_criteria = dict(search_criteria or
+                                    {"strategy": "Cartesian"})
+        self.base_params = base_params
+
+    def _combos(self) -> List[dict]:
+        names = list(self.hyper_params)
+        all_combos = [dict(zip(names, vals)) for vals in
+                      itertools.product(*(self.hyper_params[n]
+                                          for n in names))]
+        sc = self.search_criteria
+        if sc.get("strategy", "Cartesian").lower() in (
+                "randomdiscrete", "random_discrete"):
+            rng = np.random.default_rng(sc.get("seed", 0))
+            rng.shuffle(all_combos)
+        return all_combos
+
+    def train(self, frame: Frame, valid: Optional[Frame] = None,
+              sort_metric: Optional[str] = None) -> Grid:
+        sc = self.search_criteria
+        max_models = sc.get("max_models", None)
+        max_secs = sc.get("max_runtime_secs", None)
+        stop_rounds = sc.get("stopping_rounds", 0)
+        stop_tol = sc.get("stopping_tolerance", 1e-3)
+        t0 = time.time()
+        models, entries = [], []
+        metric, decreasing = None, None
+        series: List[float] = []
+        for combo in self._combos():
+            if max_models and len(models) >= max_models:
+                break
+            if max_secs and time.time() - t0 > max_secs:
+                break
+            builder = self.builder_cls(**{**self.base_params, **combo})
+            m = builder.train(frame, valid)
+            models.append(m)
+            entries.append(combo)
+            if metric is None:
+                if sort_metric is None:
+                    metric, lower = default_sort_metric(m)
+                else:
+                    from .scorekeeper import METRIC_MAXIMIZE
+                    metric = sort_metric
+                    lower = not METRIC_MAXIMIZE.get(sort_metric, False)
+                decreasing = not lower
+            v = model_metric(m, metric)
+            if v is not None:
+                series.append(v)
+                # early stop over the *sequence of best-so-far* models
+                if stop_rounds and stop_early(
+                        series, stop_rounds, stop_tol, maximize=decreasing):
+                    break
+        if not models:
+            raise ValueError("grid search trained no models")
+        return Grid(dkv.make_key("grid"), models, list(self.hyper_params),
+                    entries, metric, decreasing)

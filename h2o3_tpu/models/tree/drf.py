@@ -26,7 +26,7 @@ from ...runtime import dkv
 from ...runtime.job import Job
 from ..datainfo import DataInfo
 from ..scorekeeper import stop_early, metric_direction
-from .binning import fit_bins
+from .binning import fit_bins, edges_matrix
 from .shared import (SharedTree, SharedTreeModel, SharedTreeParameters,
                      build_tree, stack_trees, traverse_jit)
 from ...metrics.core import make_metrics
@@ -70,14 +70,17 @@ class DRF(SharedTree):
              valid: Optional[Frame]) -> DRFModel:
         p: DRFParameters = self.params
         K = di.nclasses if (di.is_classifier and di.nclasses > 2) else 1
-        binned = fit_bins(frame, [s.name for s in di.specs], nbins=p.nbins,
-                          seed=p.effective_seed())
-        codes = binned.codes
-        Fnum = binned.nfeatures
         y = di.response(frame)
         w = di.weights(frame)
+        binned = fit_bins(frame, [s.name for s in di.specs], nbins=p.nbins,
+                          seed=p.effective_seed(),
+                          weights=w if p.weights_column else None)
+        codes = binned.codes
+        edges_mat = jnp.asarray(
+            edges_matrix(binned.edges, p.nbins), jnp.float32)
+        Fnum = binned.nfeatures
         y = jnp.where(jnp.isnan(y), 0.0, y)
-        N = codes.shape[0]
+        N = codes.shape[1]
         rng = jax.random.PRNGKey(p.effective_seed())
 
         if p.mtries == -1:
@@ -122,9 +125,11 @@ class DRF(SharedTree):
                     # mean-fit: grad = -y, hess = 1 -> leaf = mean(y)
                     tree, leaf = build_tree(
                         codes, -targets[k] * w_eff, w_eff, w_eff,
-                        binned.edges, p.nbins, p.max_depth, p.reg_lambda,
+                        edges_mat, p.nbins, p.max_depth, p.reg_lambda,
                         p.min_rows, p.min_split_improvement, 1.0, kk,
-                        col_rate, None)
+                        col_rate, None, p.reg_alpha, p.gamma,
+                        p.min_child_weight,
+                        hist_precision=p.hist_precision)
                     ktrees.append(tree)
                     F_sum = F_sum.at[:, k].add(jnp.asarray(tree.values)[leaf])
                     if valid is not None:
@@ -134,9 +139,11 @@ class DRF(SharedTree):
             else:
                 rng, kk = jax.random.split(rng)
                 tree, leaf = build_tree(
-                    codes, -targets[0] * w_eff, w_eff, w_eff, binned.edges,
+                    codes, -targets[0] * w_eff, w_eff, w_eff, edges_mat,
                     p.nbins, p.max_depth, p.reg_lambda, p.min_rows,
-                    p.min_split_improvement, 1.0, kk, col_rate, None)
+                    p.min_split_improvement, 1.0, kk, col_rate, None,
+                    p.reg_alpha, p.gamma, p.min_child_weight,
+                    hist_precision=p.hist_precision)
                 trees.append(tree)
                 F_sum = F_sum + jnp.asarray(tree.values)[leaf]
                 if valid is not None:

@@ -26,74 +26,237 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 from jax import shard_map
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from ...runtime.cluster import cluster, ROW_AXIS
 
-# target float32 elements for the one-hot block buffer (memory knob)
-_BLOCK_BUDGET = 32 * 1024 * 1024
+def _make_pallas_hist(L: int, F: int, B: int, n_local: int,
+                      interpret: bool = False, precision: str = "bf16"):
+    """tpu_hist kernel: histogram as an in-VMEM one-hot matmul.
 
-
-def _block_rows(n_local: int, F: int, B: int) -> int:
-    blk = max(_BLOCK_BUDGET // max(F * B, 1), 256)
-    return int(min(n_local, blk))
-
-
-@functools.lru_cache(maxsize=None)
-def make_hist_fn(L: int, F: int, B: int, n_padded: int):
-    """Compiled histogram: (codes[N,F], leaf[N], g[N], h[N], w[N]) ->
-    H[3, L, F, B] with planes (sum g, sum h, sum w), psum'd over the mesh.
-
-    ``B`` here includes the NA bin (= nbins + 1).
+    The XLA einsum path materializes the [rows, F*B] one-hot in HBM every
+    level (~N*F*B*4 bytes of traffic — bandwidth-bound); here the one-hot
+    tile lives only in VMEM and feeds the MXU directly, so HBM traffic per
+    level is just codes + (leaf,g,h,w).  Grid: (bin tiles, row blocks) —
+    row blocks innermost so each [F*TB, 3L] output tile stays resident
+    while rows stream through (replacing DHistogram's per-node scatter-adds
+    and gpu_hist's shared-memory atomics).
     """
-    cl = cluster()
-    n_local = n_padded // cl.n_row_shards
-    blk = _block_rows(n_local, F, B)
+    R = int(min(4096, max(256, ((n_local + 255) // 256) * 256)))
+    nblk = (n_local + R - 1) // R
+    pad_to = nblk * R
+    L3 = 3 * L
+    TB = max(1, 512 // F)          # bins per tile -> [F*TB, R] one-hot tile
+    FBT = F * TB
+    n_fb = (B + TB - 1) // TB
+
+    dt = jnp.bfloat16 if precision == "bf16" else jnp.float32
+
+    def kernel(codes_ref, ls_ref, out_ref, a_scratch):
+        i = pl.program_id(0)                       # row block (outer)
+        j = pl.program_id(1)                       # bin tile (inner)
+
+        @pl.when(j == 0)
+        def _():
+            # A[r, 3l+s] = S[r, s] where leaf[r] == l, else 0 — built once
+            # per row block, reused across all bin tiles
+            LS = ls_ref[:]                         # [4, R] f32 (leaf,g,h,w)
+            leaf = LS[0].astype(jnp.int32)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (R, L3), 1)
+            l_of, s_of = cols // 3, cols % 3
+            match = leaf[:, None] == l_of
+            sv = jnp.where(s_of == 0, LS[1][:, None],
+                           jnp.where(s_of == 1, LS[2][:, None],
+                                     LS[3][:, None]))
+            a_scratch[:] = jnp.where(match, sv, 0.0).astype(dt)
+
+        @pl.when((i == 0) & (j == 0))
+        def _():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        # OHT[b*F+f, r] = (codes[f, r] == j*TB + b) via broadcast compare —
+        # no materialized int32 repeat, one VPU pass straight to bf16
+        # (bf16/int16 compares are not supported by the target's VPU)
+        b_of = jax.lax.broadcasted_iota(jnp.int32, (TB, 1, 1), 0) + j * TB
+        OHT = (codes_ref[:][None] == b_of).astype(dt).reshape(FBT, R)
+        # the WHOLE histogram is one output block (index map is constant),
+        # so every grid step revisits it consecutively — the accumulation
+        # is safe under Pallas TPU's revisiting rule, and the block never
+        # round-trips through HBM
+        out_ref[pl.ds(j * FBT, FBT), :] += jnp.dot(
+            OHT, a_scratch[:], preferred_element_type=jnp.float32)
+
+    def kernel_deep(codes_ref, ls_ref, out_ref):
+        # fallback for deep trees where the whole histogram exceeds VMEM:
+        # out tile [FBT, L3] is stationary across the inner row loop
+        # (consecutive revisits — safe), A rebuilt per step
+        j = pl.program_id(0)                       # bin tile (outer)
+        i = pl.program_id(1)                       # row block (inner)
+
+        @pl.when(i == 0)
+        def _():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        LS = ls_ref[:]
+        leaf = LS[0].astype(jnp.int32)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (R, L3), 1)
+        l_of, s_of = cols // 3, cols % 3
+        match = leaf[:, None] == l_of
+        sv = jnp.where(s_of == 0, LS[1][:, None],
+                       jnp.where(s_of == 1, LS[2][:, None],
+                                 LS[3][:, None]))
+        A = jnp.where(match, sv, 0.0).astype(dt)
+        b_of = jax.lax.broadcasted_iota(jnp.int32, (TB, 1, 1), 0) + j * TB
+        OHT = (codes_ref[:][None] == b_of).astype(dt).reshape(FBT, R)
+        out_ref[:] += jnp.dot(OHT, A, preferred_element_type=jnp.float32)
+
+    out_bytes = n_fb * FBT * L3 * 4
+    a_bytes = R * L3 * (2 if precision == "bf16" else 4)
+    if out_bytes + a_bytes <= 8 * 1024 * 1024:
+        call = pl.pallas_call(
+            kernel,
+            grid=(nblk, n_fb),
+            in_specs=[
+                pl.BlockSpec((F, R), lambda i, j: (0, i),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((4, R), lambda i, j: (0, i),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((n_fb * FBT, L3), lambda i, j: (0, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((n_fb * FBT, L3), jnp.float32,
+                                           vma=frozenset({ROW_AXIS})),
+            scratch_shapes=[pltpu.VMEM((R, L3), dt)],
+            interpret=interpret,
+        )
+    else:
+        call = pl.pallas_call(
+            kernel_deep,
+            grid=(n_fb, nblk),
+            in_specs=[
+                pl.BlockSpec((F, R), lambda j, i: (0, i),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((4, R), lambda j, i: (0, i),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((FBT, L3), lambda j, i: (j, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((n_fb * FBT, L3), jnp.float32,
+                                           vma=frozenset({ROW_AXIS})),
+            interpret=interpret,
+        )
+
+    def local(codes, leaf, g, h, w):
+        pad = pad_to - n_local
+
+        def padr(x):
+            if pad == 0:
+                return x
+            return jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad)])
+        LS = jnp.stack([leaf.astype(jnp.float32), g, h, w], axis=0)
+        out = call(padr(codes), padr(LS))[: B * F]
+        # [B*F, 3L] rows ordered (b*F + f), cols (l*3 + s) -> [3, L, F, B]
+        return out.reshape(B, F, L, 3).transpose(3, 2, 1, 0)
+
+    return local
+
+
+def _make_einsum_hist(L: int, F: int, B: int, n_local: int):
+    """Portable XLA path (CPU mesh tests, non-TPU backends)."""
+    blk = max((4 * 1024 * 1024) // max(F * B, 1), 256)
+    blk = min(n_local, blk)
     nblk = (n_local + blk - 1) // blk
     pad_to = nblk * blk
 
-    def local_hist(codes, leaf, g, h, w):
-        # pad local shard to a whole number of blocks (w=0 rows contribute 0)
+    def local(codes, leaf, g, h, w):
         def padr(x, fill=0):
-            return jnp.pad(x, [(0, pad_to - n_local)] + [(0, 0)] * (x.ndim - 1),
-                           constant_values=fill)
-        codes = padr(codes).reshape(nblk, blk, F)
+            return jnp.pad(x, [(0, 0)] * (x.ndim - 1)
+                           + [(0, pad_to - n_local)], constant_values=fill)
+        codes = padr(codes).reshape(F, nblk, blk).transpose(1, 0, 2)
         leaf = padr(leaf).reshape(nblk, blk)
         S = jnp.stack([g, h, w], axis=1)          # [n, 3]
-        S = padr(S).reshape(nblk, blk, 3)
+        S = jnp.pad(S, [(0, pad_to - n_local), (0, 0)]).reshape(nblk, blk, 3)
 
         def body(acc, args):
             c, lf, s = args
             Pl = jax.nn.one_hot(lf, L, dtype=jnp.float32)       # [blk, L]
-            OH = jax.nn.one_hot(c, B, dtype=jnp.float32)        # [blk, F, B]
-            # [blk,L]x[blk,3] -> contract rows with [blk,F,B]
+            OH = jax.nn.one_hot(c, B, dtype=jnp.float32)        # [F, blk, B]
             PS = jnp.einsum("rl,rs->rsl", Pl, s)                # [blk,3,L]
-            acc = acc + jnp.einsum("rsl,rfb->slfb", PS, OH)
+            acc = acc + jnp.einsum("rsl,frb->slfb", PS, OH)
             return acc, None
         H0 = jnp.zeros((3, L, F, B), jnp.float32)
-        # carry becomes device-varying inside shard_map; mark it so upfront
         H0 = jax.lax.pcast(H0, (ROW_AXIS,), to='varying')
         H, _ = jax.lax.scan(body, H0, (codes, leaf, S))
-        return jax.lax.psum(H, ROW_AXIS)
+        return H
 
-    specs_in = (P(ROW_AXIS, None), P(ROW_AXIS), P(ROW_AXIS), P(ROW_AXIS),
+    return local
+
+
+@functools.lru_cache(maxsize=None)
+def make_hist_fn(L: int, F: int, B: int, n_padded: int,
+                 force_impl: str = "", precision: str = "bf16"):
+    """Compiled histogram: (codes[N,F], leaf[N], g[N], h[N], w[N]) ->
+    H[3, L, F, B] with planes (sum g, sum h, sum w), psum'd over the mesh.
+
+    ``B`` here includes the NA bin (= nbins + 1).  On TPU the local pass is
+    the Pallas tpu_hist kernel; elsewhere (CPU test mesh) an equivalent
+    einsum program.  ``force_impl`` ("pallas_interpret" | "einsum") pins the
+    implementation for cross-checking.
+    """
+    cl = cluster()
+    n_local = n_padded // cl.n_row_shards
+    platform = cl.mesh.devices.flat[0].platform
+    # very deep levels: the [F*B, 3L] result exceeds what XLA will stage
+    # through VMEM for the custom call — take the portable path there
+    hist_bytes = F * B * 3 * L * 4
+    if force_impl == "pallas_interpret":
+        inner = _make_pallas_hist(L, F, B, n_local, interpret=True,
+                                  precision=precision)
+    elif force_impl == "einsum" or platform != "tpu" \
+            or hist_bytes > 12 * 1024 * 1024:
+        inner = _make_einsum_hist(L, F, B, n_local)
+    else:
+        inner = _make_pallas_hist(L, F, B, n_local, precision=precision)
+
+    def local_hist(codes, leaf, g, h, w):
+        return jax.lax.psum(inner(codes, leaf, g, h, w), ROW_AXIS)
+
+    specs_in = (P(None, ROW_AXIS), P(ROW_AXIS), P(ROW_AXIS), P(ROW_AXIS),
                 P(ROW_AXIS))
-    f = shard_map(local_hist, mesh=cl.mesh, in_specs=specs_in, out_specs=P())
+    # check_vma=False: the kernel mixes varying refs with grid-constant
+    # iotas, which the vma checker can't see through pallas_call
+    f = shard_map(local_hist, mesh=cl.mesh, in_specs=specs_in, out_specs=P(),
+                  check_vma=False)
     return jax.jit(f)
 
 
-def _score(G, H, lam):
-    return G * G / (H + lam)
+def _soft_threshold(G, alpha):
+    return jnp.sign(G) * jnp.maximum(jnp.abs(G) - alpha, 0.0)
+
+
+def _score(G, H, lam, alpha=0.0):
+    Gt = _soft_threshold(G, alpha)
+    return Gt * Gt / (H + lam)
 
 
 @functools.partial(jax.jit, static_argnames=("nbins",))
 def best_splits(Hist, nbins: int, reg_lambda: float, min_rows: float,
-                min_split_improvement: float, feat_mask=None):
+                min_split_improvement: float, feat_mask=None,
+                reg_alpha: float = 0.0, gamma: float = 0.0,
+                min_child_weight: float = 0.0):
     """Best split per leaf from H[3, L, F, B] (B = nbins regular + 1 NA bin).
 
     Tries NA-left and NA-right (XGBoost's sparsity-aware default direction;
     the reference tracks NA in DHistogram the same way).  Returns per-leaf
     (feat, bin, na_left, gain, valid).  ``feat_mask`` [L, F] (or [F]) disables
     features per leaf (DRF mtries / column sampling).
+
+    ``reg_alpha`` / ``gamma`` / ``min_child_weight`` give the exact XGBoost
+    objective: gain = 1/2(scoreL + scoreR - parent) - gamma with L1
+    soft-thresholded numerators and a hessian-sum child constraint
+    (libxgboost split_evaluator; h2o drives it via
+    hex/tree/xgboost/XGBoostModel.java:260-298 tree_method=hist params).
     """
     G, Hs, C = Hist[0], Hist[1], Hist[2]           # [L, F, B]
     g_na, h_na, c_na = G[..., -1], Hs[..., -1], C[..., -1]
@@ -104,7 +267,7 @@ def best_splits(Hist, nbins: int, reg_lambda: float, min_rows: float,
     totG = cumG[..., -1] + g_na                    # [L, F]
     totH = cumH[..., -1] + h_na
     totC = cumC[..., -1] + c_na
-    parent = _score(totG, totH, reg_lambda)        # [L, F]
+    parent = _score(totG, totH, reg_lambda, reg_alpha)   # [L, F]
 
     # candidate split after bin b (left = bins <= b), b in [0, nbins-2]
     GL, HL, CL = cumG[..., :-1], cumH[..., :-1], cumC[..., :-1]
@@ -113,9 +276,11 @@ def best_splits(Hist, nbins: int, reg_lambda: float, min_rows: float,
     CR = totC[..., None] - CL - c_na[..., None]
 
     def gain_with_na(gl, hl, cl, gr, hr, cr):
-        g = 0.5 * (_score(gl, hl, reg_lambda) + _score(gr, hr, reg_lambda)
-                   - parent[..., None])
-        ok = (cl >= min_rows) & (cr >= min_rows)
+        g = 0.5 * (_score(gl, hl, reg_lambda, reg_alpha)
+                   + _score(gr, hr, reg_lambda, reg_alpha)
+                   - parent[..., None]) - gamma
+        ok = (cl >= min_rows) & (cr >= min_rows) & \
+            (hl >= min_child_weight) & (hr >= min_child_weight)
         return jnp.where(ok, g, -jnp.inf)
 
     gain_naL = gain_with_na(GL + g_na[..., None], HL + h_na[..., None],
@@ -138,45 +303,54 @@ def best_splits(Hist, nbins: int, reg_lambda: float, min_rows: float,
         na_left_better.reshape(L, -1), best[:, None], 1)[:, 0]
     valid = jnp.isfinite(best_gain) & \
         (best_gain > min_split_improvement) & (totC >= 2 * min_rows).any(-1)
-    return feat, bin_, na_left, best_gain, valid
+
+    # child sufficient statistics at the chosen split (G, H, C per side) —
+    # lets the final level derive Newton leaf values with no extra data pass
+    def pick(a):
+        return jnp.take_along_axis(a.reshape(L, -1), best[:, None], 1)[:, 0]
+    gl, hl, cl = pick(GL), pick(HL), pick(CL)
+    gr, hr, cr = pick(GR), pick(HR), pick(CR)
+    gna, hna, cna = pick(jnp.broadcast_to(g_na[..., None], GL.shape)), \
+        pick(jnp.broadcast_to(h_na[..., None], HL.shape)), \
+        pick(jnp.broadcast_to(c_na[..., None], CL.shape))
+    gl = jnp.where(na_left, gl + gna, gl)
+    hl = jnp.where(na_left, hl + hna, hl)
+    cl = jnp.where(na_left, cl + cna, cl)
+    gr = jnp.where(na_left, gr, gr + gna)
+    hr = jnp.where(na_left, hr, hr + hna)
+    cr = jnp.where(na_left, cr, cr + cna)
+    # terminal (invalid) nodes: everything routes to the left child
+    ftot = jnp.take_along_axis(totG, feat[:, None], 1)[:, 0]
+    htot = jnp.take_along_axis(totH, feat[:, None], 1)[:, 0]
+    ctot = jnp.take_along_axis(totC, feat[:, None], 1)[:, 0]
+    gl = jnp.where(valid, gl, ftot)
+    hl = jnp.where(valid, hl, htot)
+    cl = jnp.where(valid, cl, ctot)
+    gr = jnp.where(valid, gr, 0.0)
+    hr = jnp.where(valid, hr, 0.0)
+    cr = jnp.where(valid, cr, 0.0)
+    children = jnp.stack([gl, hl, cl, gr, hr, cr], axis=1)   # [L, 6]
+    return feat, bin_, na_left, best_gain, valid, children
 
 
 @jax.jit
 def partition(codes, leaf, feat, bin_, na_left, valid, na_bin: jnp.int32):
     """Send rows to child leaves: new_leaf = 2*leaf + went_right.
 
-    Terminal (invalid-split) leaves route everything left so descendants stay
-    consistent; the final leaf-value gather resolves them.
+    ``codes`` is feature-major [F, N]; the per-row chosen-feature value is a
+    select-chain over the (small) feature dim — a cross-sublane dynamic
+    gather here would make XLA materialize a row-major transpose, whose
+    lane padding costs 16x the array's HBM footprint.  Terminal
+    (invalid-split) leaves route everything left so descendants stay
+    consistent; the leaf-value gather resolves them.
     """
-    f = feat[leaf]                                     # [N] gather
-    c = jnp.take_along_axis(codes, f[:, None], axis=1)[:, 0]
+    f = feat[leaf]                                     # [N] gather from [L]
+    Fdim = codes.shape[0]
+    fiota = jax.lax.broadcasted_iota(jnp.int32, (Fdim, 1), 0)
+    c = jnp.sum(jnp.where(f[None, :] == fiota, codes, 0), axis=0)
     is_na = c == na_bin
     right = jnp.where(is_na, ~na_left[leaf], c > bin_[leaf])
     right = right & valid[leaf]
     return (2 * leaf + right.astype(jnp.int32)).astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnames=("L",))
-def leaf_values_from_hist(Hist, L: int, reg_lambda: float, learn_rate: float,
-                          max_abs: float = 1e10):
-    """Newton leaf values -G/(H+lambda) x learn_rate (fitBestConstants)."""
-    G = Hist[0].sum(axis=(1, 2)) if Hist[0].ndim == 3 else Hist[0]
-    H = Hist[1].sum(axis=(1, 2)) if Hist[1].ndim == 3 else Hist[1]
-    v = -G / (H + reg_lambda + 1e-12) * learn_rate
-    return jnp.clip(v, -max_abs, max_abs)
-
-
-@functools.lru_cache(maxsize=None)
-def make_leaf_agg_fn(L: int, n_padded: int):
-    """Compiled (leaf, g, h, w) -> [3, L] sums over the mesh (final-level
-    aggregation for leaf values, no per-feature breakdown needed)."""
-    cl = cluster()
-
-    def local(leaf, g, h, w):
-        Pl = jax.nn.one_hot(leaf, L, dtype=jnp.float32)
-        out = jnp.stack([g @ Pl, h @ Pl, w @ Pl])
-        return jax.lax.psum(out, ROW_AXIS)
-
-    f = shard_map(local, mesh=cl.mesh,
-                  in_specs=(P(ROW_AXIS),) * 4, out_specs=P())
-    return jax.jit(f)

@@ -1,0 +1,327 @@
+"""Isolation Forest + Extended Isolation Forest: random isolation trees.
+
+Reference: ``hex/tree/isofor/IsolationForest.java:33`` (random-split trees on
+row subsamples, anomaly score from average isolation depth) and
+``hex/tree/isoforextended/ExtendedIsolationForest.java`` (random-hyperplane
+splits, ``extension_level``).
+
+TPU-native redesign: a level of an isolation tree needs only per-leaf
+min/max/count of the currently-routed rows — ``jax.ops.segment_min/max/sum``
+over the row-sharded matrix (no histograms, no gradients).  Split choices
+(random feature, uniform threshold, random hyperplane) are host RNG draws;
+routing is the same gather-compare partition the other trees use.  Scoring
+reuses the stacked-tree traversal: each leaf's "value" is its isolation path
+length, so the ensemble sum is one compiled pass and the anomaly score
+``2^(-E[h]/c(n))`` is a scalar epilogue.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...frame.frame import Frame
+from ...frame.vec import Vec, T_NUM
+from ...runtime import dkv
+from ...runtime.job import Job
+from ..base import Model, ModelBuilder
+from ..datainfo import DataInfo
+from .shared import (SharedTreeModel, SharedTreeParameters, Tree, stack_trees,
+                     traverse_jit)
+
+
+def _avg_path_length(n) -> float:
+    """c(n): expected path length of an unsuccessful BST search (iForest eq.1)."""
+    if n <= 1:
+        return 0.0
+    if n == 2:
+        return 1.0
+    h = math.log(n - 1) + 0.5772156649015329
+    return 2.0 * h - 2.0 * (n - 1) / n
+
+
+@dataclasses.dataclass
+class IsolationForestParameters(SharedTreeParameters):
+    ntrees: int = 50
+    sample_size: int = 256
+    max_depth: int = 8
+    contamination: float = -1.0          # optional threshold quantile
+
+
+@dataclasses.dataclass
+class ExtendedIsolationForestParameters(IsolationForestParameters):
+    extension_level: int = 0             # 0 == standard iForest
+
+
+@functools.partial(jax.jit, static_argnames=("L",))
+def _leaf_stats(x, leaf, active, L: int):
+    """Per-leaf (min, max, count) of feature values over active rows."""
+    big = jnp.float32(3.4e38)
+    xa = jnp.where(active, x, big)
+    xb = jnp.where(active, x, -big)
+    mn = jax.ops.segment_min(xa, leaf, num_segments=L)
+    mx = jax.ops.segment_max(xb, leaf, num_segments=L)
+    cnt = jax.ops.segment_sum(active.astype(jnp.float32), leaf, num_segments=L)
+    return mn, mx, cnt
+
+
+def _termination_depths(valid_levels: List[np.ndarray],
+                        max_depth: int) -> np.ndarray:
+    """Per final leaf: number of valid splits along its ancestor path."""
+    Lfin = 2 ** max_depth
+    depths = np.zeros(Lfin, np.int64)
+    for d, v in enumerate(valid_levels):
+        anc = np.arange(Lfin) >> (max_depth - d)
+        depths += v[anc].astype(np.int64)
+    return depths
+
+
+class IsolationForestModel(SharedTreeModel):
+    algo = "isolationforest"
+
+    def _path_lengths(self, X: jax.Array) -> jax.Array:
+        levels, values = stack_trees(self.output["trees"])
+        return traverse_jit(levels, values, X) / len(self.output["trees"])
+
+    def _predict_raw(self, X: jax.Array) -> jax.Array:
+        mean_len = self._path_lengths(X)
+        c = self.output["c_norm"]
+        return jnp.exp2(-mean_len / max(c, 1e-9))
+
+    def predict(self, frame: Frame) -> Frame:
+        X = self._design(frame)
+        mean_len = np.asarray(self._path_lengths(X), np.float64)[: frame.nrows]
+        c = self.output["c_norm"]
+        score = np.exp2(-mean_len / max(c, 1e-9))
+        names = ["predict", "mean_length"]
+        vecs = [Vec.from_numpy(score, T_NUM), Vec.from_numpy(mean_len, T_NUM)]
+        return Frame(names, vecs)
+
+    def model_performance(self, frame: Optional[Frame] = None):
+        if frame is None:
+            return self.training_metrics
+        score = self.predict(frame).vecs[0].to_numpy()
+        return {"mean_score": float(np.mean(score)),
+                "max_score": float(np.max(score))}
+
+
+class IsolationForest(ModelBuilder):
+    """Isolation Forest builder — H2OIsolationForestEstimator analog."""
+
+    algo = "isolationforest"
+    model_class = IsolationForestModel
+    supervised = False
+
+    def __init__(self, params: Optional[IsolationForestParameters] = None,
+                 **kw):
+        super().__init__(params or IsolationForestParameters(**kw))
+
+    def _make_datainfo(self, frame: Frame) -> DataInfo:
+        p = self.params
+        return DataInfo.fit(
+            frame, response_column=None, ignored_columns=p.ignored_columns,
+            standardize=False, add_intercept=False,
+            missing_values_handling=p.missing_values_handling)
+
+    def _sample_mask(self, N: int, nrows: int, size: int,
+                     rng: np.random.Generator):
+        size = min(size, nrows)
+        idx = rng.choice(nrows, size=size, replace=False)
+        m = np.zeros(N, np.float32)
+        m[idx] = 1.0
+        return jnp.asarray(m), size
+
+    def _fit(self, job: Job, frame: Frame, di: DataInfo,
+             valid: Optional[Frame]) -> IsolationForestModel:
+        p: IsolationForestParameters = self.params
+        rng = np.random.default_rng(p.effective_seed())
+        model = IsolationForestModel(
+            job.dest_key or dkv.make_key(self.algo), p, di)
+        X = model._design(frame)
+        N, Fn = X.shape
+        depth = p.max_depth
+        trees: List[Tree] = []
+        for t in range(p.ntrees):
+            mask, size = self._sample_mask(N, frame.nrows, p.sample_size, rng)
+            leaf = jnp.zeros(N, jnp.int32)
+            feat_l, thr_l, nal_l, val_l = [], [], [], []
+            for d in range(depth):
+                L = 2 ** d
+                f = rng.integers(0, Fn, size=L).astype(np.int32)
+                fj = jnp.asarray(f)
+                x = jnp.take_along_axis(X, fj[leaf][:, None], axis=1)[:, 0]
+                active = (mask > 0) & ~jnp.isnan(x)
+                mn, mx, cnt = _leaf_stats(x, leaf, active, L)
+                mn_h = np.asarray(mn, np.float64)
+                mx_h = np.asarray(mx, np.float64)
+                cnt_h = np.asarray(cnt, np.float64)
+                valid = (cnt_h > 1) & (mx_h > mn_h)
+                u = rng.random(L)
+                mn_h = np.where(valid, mn_h, 0.0)   # empty leaves hold ±big
+                mx_h = np.where(valid, mx_h, 0.0)
+                thr = (mn_h + u * (mx_h - mn_h)).astype(np.float32)
+                vj = jnp.asarray(valid)
+                tj = jnp.asarray(thr)
+                right = jnp.where(jnp.isnan(x), False, x >= tj[leaf])
+                leaf = (2 * leaf + (right & vj[leaf]).astype(jnp.int32))
+                feat_l.append(f)
+                thr_l.append(thr)
+                nal_l.append(np.ones(L, bool))      # NaN goes left
+                val_l.append(valid)
+            # per-leaf path length = termination depth + c(final count)
+            Lfin = 2 ** depth
+            cnt = jax.ops.segment_sum(mask, leaf, num_segments=Lfin)
+            cnt_h = np.asarray(cnt, np.float64)
+            depths = _termination_depths(val_l, depth)
+            pl = depths + np.array([_avg_path_length(int(c)) for c in cnt_h])
+            trees.append(Tree(feat_l, thr_l, nal_l, val_l,
+                              pl.astype(np.float32)))
+            job.update((t + 1) / p.ntrees, f"itree {t + 1}/{p.ntrees}")
+
+        model.output.update({
+            "trees": trees, "ntrees_trained": len(trees),
+            "c_norm": _avg_path_length(min(p.sample_size, frame.nrows)),
+            "nclass_trees": 1, "init_score": 0.0,
+        })
+        score = model.predict(frame).vecs[0].to_numpy()
+        model.training_metrics = {
+            "mean_score": float(np.mean(score)),
+            "max_score": float(np.max(score)),
+        }
+        if p.contamination > 0:
+            model.output["threshold"] = float(
+                np.quantile(score, 1.0 - p.contamination))
+        return model
+
+
+# ===================================================== extended isolation
+@dataclasses.dataclass
+class _EITree:
+    normals: List[np.ndarray]     # per level [L, F]
+    offsets: List[np.ndarray]     # per level [L]
+    valid: List[np.ndarray]       # per level [L]
+    values: np.ndarray            # [2^depth] path lengths
+
+
+class ExtendedIsolationForestModel(SharedTreeModel):
+    algo = "extendedisolationforest"
+
+    def _path_lengths(self, X: jax.Array) -> jax.Array:
+        total = jnp.zeros(X.shape[0], jnp.float32)
+        Xz = jnp.nan_to_num(X)
+        for t in self.output["trees"]:
+            node = jnp.zeros(X.shape[0], jnp.int32)
+            for nm, off, vd in zip(t.normals, t.offsets, t.valid):
+                nmj = jnp.asarray(nm)[node]            # [N, F]
+                proj = jnp.sum(Xz * nmj, axis=1)
+                right = (proj >= jnp.asarray(off)[node]) & jnp.asarray(vd)[node]
+                node = 2 * node + right.astype(jnp.int32)
+            total = total + jnp.asarray(t.values)[node]
+        return total / len(self.output["trees"])
+
+    def _predict_raw(self, X: jax.Array) -> jax.Array:
+        c = self.output["c_norm"]
+        return jnp.exp2(-self._path_lengths(X) / max(c, 1e-9))
+
+    def predict(self, frame: Frame) -> Frame:
+        X = self._design(frame)
+        mean_len = np.asarray(self._path_lengths(X), np.float64)[: frame.nrows]
+        c = self.output["c_norm"]
+        score = np.exp2(-mean_len / max(c, 1e-9))
+        return Frame(["anomaly_score", "mean_length"],
+                     [Vec.from_numpy(score, T_NUM),
+                      Vec.from_numpy(mean_len, T_NUM)])
+
+    def model_performance(self, frame: Optional[Frame] = None):
+        if frame is None:
+            return self.training_metrics
+        score = self.predict(frame).vecs[0].to_numpy()
+        return {"mean_score": float(np.mean(score))}
+
+
+class ExtendedIsolationForest(IsolationForest):
+    """Extended IF builder — H2OExtendedIsolationForestEstimator analog."""
+
+    algo = "extendedisolationforest"
+    model_class = ExtendedIsolationForestModel
+
+    def __init__(self, params: Optional[ExtendedIsolationForestParameters]
+                 = None, **kw):
+        ModelBuilder.__init__(
+            self, params or ExtendedIsolationForestParameters(**kw))
+
+    def _fit(self, job: Job, frame: Frame, di: DataInfo,
+             valid: Optional[Frame]) -> ExtendedIsolationForestModel:
+        p: ExtendedIsolationForestParameters = self.params
+        rng = np.random.default_rng(p.effective_seed())
+        model = ExtendedIsolationForestModel(
+            job.dest_key or dkv.make_key(self.algo), p, di)
+        X = model._design(frame)
+        N, Fn = X.shape
+        ext = min(p.extension_level, Fn - 1)
+        depth = p.max_depth
+        trees: List[_EITree] = []
+        for t in range(p.ntrees):
+            mask, size = self._sample_mask(N, frame.nrows, p.sample_size, rng)
+            leaf = jnp.zeros(N, jnp.int32)
+            Xz = jnp.nan_to_num(X)
+            norm_l, off_l, val_l = [], [], []
+            for d in range(depth):
+                L = 2 ** d
+                # bounding box per (leaf, feature) for intercept sampling
+                active = (mask > 0)
+                big = jnp.float32(3.4e38)
+                Xa = jnp.where(active[:, None], Xz, big)
+                Xb = jnp.where(active[:, None], Xz, -big)
+                mn = np.asarray(jax.ops.segment_min(Xa, leaf, num_segments=L),
+                                np.float64)
+                mx = np.asarray(jax.ops.segment_max(Xb, leaf, num_segments=L),
+                                np.float64)
+                cnt = np.asarray(jax.ops.segment_sum(
+                    mask, leaf, num_segments=L), np.float64)
+                valid = (cnt > 1) & (mx > mn).any(axis=1)
+                occupied = cnt[:, None] > 0          # empty leaves hold ±big
+                mn = np.where(occupied, mn, 0.0)
+                mx = np.where(occupied, np.maximum(mx, mn), 0.0)
+                # random hyperplane with ext+1 nonzero components
+                nm = rng.normal(size=(L, Fn))
+                if ext + 1 < Fn:
+                    for i in range(L):
+                        keep = rng.choice(Fn, size=ext + 1, replace=False)
+                        z = np.ones(Fn, bool)
+                        z[keep] = False
+                        nm[i, z] = 0.0
+                nm /= np.maximum(np.linalg.norm(nm, axis=1, keepdims=True),
+                                 1e-12)
+                pt = mn + rng.random((L, Fn)) * np.maximum(mx - mn, 0.0)
+                off = np.sum(nm * pt, axis=1)
+                nmj = jnp.asarray(nm, jnp.float32)
+                offj = jnp.asarray(off, jnp.float32)
+                vj = jnp.asarray(valid)
+                proj = jnp.sum(Xz * nmj[leaf], axis=1)
+                right = (proj >= offj[leaf]) & vj[leaf]
+                leaf = 2 * leaf + right.astype(jnp.int32)
+                norm_l.append(nm.astype(np.float32))
+                off_l.append(off.astype(np.float32))
+                val_l.append(valid)
+            Lfin = 2 ** depth
+            cnt = np.asarray(jax.ops.segment_sum(mask, leaf,
+                                                 num_segments=Lfin), np.float64)
+            depths = _termination_depths(val_l, depth)
+            pl = depths + np.array([_avg_path_length(int(c)) for c in cnt])
+            trees.append(_EITree(norm_l, off_l, val_l, pl.astype(np.float32)))
+            job.update((t + 1) / p.ntrees, f"eitree {t + 1}/{p.ntrees}")
+
+        model.output.update({
+            "trees": trees, "ntrees_trained": len(trees),
+            "c_norm": _avg_path_length(min(p.sample_size, frame.nrows)),
+        })
+        score = model.predict(frame).vecs[0].to_numpy()
+        model.training_metrics = {"mean_score": float(np.mean(score))}
+        return model

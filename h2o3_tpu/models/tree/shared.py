@@ -15,6 +15,7 @@ tree, all batched over rows on the VPU.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import List, Optional, Sequence
 
 import jax
@@ -30,7 +31,7 @@ from ..datainfo import DataInfo, ColumnSpec
 from ..scorekeeper import stop_early, metric_direction
 from ..distributions import make_distribution
 from .binning import BinnedFrame, fit_bins, encode_bins
-from .hist import (make_hist_fn, best_splits, partition, make_leaf_agg_fn)
+from .hist import make_hist_fn, best_splits, partition
 
 
 @dataclasses.dataclass
@@ -45,6 +46,9 @@ class SharedTreeParameters(Parameters):
     col_sample_rate_per_tree: float = 1.0
     min_split_improvement: float = 1e-5
     reg_lambda: float = 0.0
+    reg_alpha: float = 0.0               # L1 on leaf values (XGBoost alpha)
+    gamma: float = 0.0                   # min loss reduction (XGBoost gamma)
+    min_child_weight: float = 0.0        # min child hessian sum (XGBoost)
     distribution: str = "auto"
     tweedie_power: float = 1.5
     quantile_alpha: float = 0.5
@@ -52,6 +56,7 @@ class SharedTreeParameters(Parameters):
     score_tree_interval: int = 5
     stopping_rounds: int = 0
     standardize: bool = False            # trees never standardize
+    hist_precision: str = "bf16"         # f32 for exact reproducibility
 
 
 @dataclasses.dataclass
@@ -68,13 +73,15 @@ def stack_trees(trees: List[Tree]):
     """[T, ...] per-level stacks for compiled whole-ensemble traversal."""
     depth = len(trees[0].feat)
     levels = []
+    # jnp.stack keeps device-resident per-level arrays on device — no
+    # host round-trip per tree (matters for per-tree valid scoring)
     for d in range(depth):
         levels.append((
-            jnp.asarray(np.stack([t.feat[d] for t in trees])),
-            jnp.asarray(np.stack([t.thr[d] for t in trees])),
-            jnp.asarray(np.stack([t.na_left[d] for t in trees])),
-            jnp.asarray(np.stack([t.valid[d] for t in trees]))))
-    values = jnp.asarray(np.stack([t.values for t in trees]))
+            jnp.stack([jnp.asarray(t.feat[d]) for t in trees]),
+            jnp.stack([jnp.asarray(t.thr[d]) for t in trees]),
+            jnp.stack([jnp.asarray(t.na_left[d]) for t in trees]),
+            jnp.stack([jnp.asarray(t.valid[d]) for t in trees])))
+    values = jnp.stack([jnp.asarray(t.values) for t in trees])
     return levels, values
 
 
@@ -114,52 +121,101 @@ def traverse(levels, values, X):
 traverse_jit = jax.jit(traverse)
 
 
+@functools.lru_cache(maxsize=None)
+def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
+                       hist_precision: str = "bf16"):
+    """One compiled program that grows a whole tree on device.
+
+    The level loop (SharedTree.buildLayer) is unrolled inside a single jit:
+    histogram -> split-search -> threshold lookup -> partition per level,
+    then final-leaf Newton values — zero host syncs per tree, which is what
+    the driver-loop latency budget demands on a remote TPU.  Returns
+    (per-level (feat, thr, na_left, valid) tuples, leaf values, final leaf
+    assignment), all device-resident.
+    """
+    B = nbins + 1
+    hist_fns = [make_hist_fn(2 ** max(d - 1, 0), F, B, n_padded,
+                             precision=hist_precision)
+                for d in range(max_depth)]
+
+    def build(codes, g, h, w, edges_mat, rng_key, reg_lambda, min_rows,
+              min_split_improvement, learn_rate, col_sample_rate, tree_mask,
+              reg_alpha, gamma, min_child_weight):
+        N = codes.shape[1]
+        leaf = jnp.zeros(N, jnp.int32)
+        levels = []
+        keys = jax.random.split(rng_key, max_depth)
+        H_prev = None
+        for d in range(max_depth):
+            L = 2 ** d
+            if d == 0:
+                H = hist_fns[0](codes, leaf, g, h, w)
+            else:
+                # parent-sibling subtraction (gpu_hist's trick): build only
+                # the left children's histograms; the right child is
+                # parent - left.  Halves the histogram work per level.
+                em = ((leaf & 1) == 0).astype(jnp.float32)
+                Hl = hist_fns[d](codes, leaf >> 1, g * em, h * em, w * em)
+                Hr = H_prev - Hl
+                H = jnp.stack([Hl, Hr], axis=2).reshape(3, L, F, B)
+            H_prev = H
+            per_split = jax.random.uniform(keys[d], (L, F)) < col_sample_rate
+            # always keep at least one feature per leaf
+            per_split = per_split.at[:, 0].set(
+                (per_split.any(axis=1) & per_split[:, 0])
+                | ~per_split.any(axis=1))
+            mask = per_split & tree_mask[None, :]
+            feat, bin_, na_left, gain, valid, children = best_splits(
+                H, nbins, reg_lambda, min_rows, min_split_improvement, mask,
+                reg_alpha, gamma, min_child_weight)
+            thr = edges_mat[feat, jnp.clip(bin_, 0, nbins - 1)]
+            leaf = partition(codes, leaf, feat, bin_, na_left, valid,
+                             jnp.int32(nbins))
+            levels.append((feat, thr, na_left, valid))
+        # Newton leaf values from the last level's child sums — no extra
+        # data pass (fitBestConstants from the histograms themselves)
+        gl, hl, cl = children[:, 0], children[:, 1], children[:, 2]
+        gr, hr, cr = children[:, 3], children[:, 4], children[:, 5]
+
+        def newton(gc, hc, cc):
+            num = jnp.sign(gc) * jnp.maximum(jnp.abs(gc) - reg_alpha, 0.0)
+            return jnp.where(cc > 0,
+                             -num / (hc + reg_lambda + 1e-12) * learn_rate,
+                             0.0)
+        vals = jnp.stack([newton(gl, hl, cl), newton(gr, hr, cr)],
+                         axis=1).reshape(-1).astype(jnp.float32)
+        return levels, vals, leaf
+
+    return jax.jit(build)
+
+
 def build_tree(codes, g, h, w, edges, nbins: int, max_depth: int,
                reg_lambda: float, min_rows: float, min_split_improvement: float,
                learn_rate: float, rng_key, col_sample_rate: float = 1.0,
-               tree_col_mask: Optional[np.ndarray] = None):
-    """Grow one tree level-by-level (SharedTree.buildLayer loop).
+               tree_col_mask: Optional[np.ndarray] = None,
+               reg_alpha: float = 0.0, gamma: float = 0.0,
+               min_child_weight: float = 0.0, hist_precision: str = "bf16"):
+    """Grow one tree — convenience wrapper around make_build_tree_fn.
 
-    Returns (Tree, final_leaf_assignment[N]).
+    ``edges`` may be the per-feature edge list (converted to the dense
+    lookup table here) or an already-built [F, nbins] matrix.
+    Returns (Tree, final_leaf_assignment[N]); Tree fields stay on device
+    until something materializes them.
     """
-    N, F = codes.shape
-    B = nbins + 1
-    leaf = jnp.zeros(N, jnp.int32)
-    feat_l, thr_l, nal_l, val_l = [], [], [], []
-    for d in range(max_depth):
-        L = 2 ** d
-        H = make_hist_fn(L, F, B, N)(codes, leaf, g, h, w)
-        mask = None
-        if tree_col_mask is not None:
-            mask = jnp.asarray(tree_col_mask)
-        if col_sample_rate < 1.0:
-            rng_key, k = jax.random.split(rng_key)
-            per_split = jax.random.uniform(k, (L, F)) < col_sample_rate
-            # always keep at least one feature per leaf
-            per_split = per_split.at[:, 0].set(
-                per_split.any(axis=1) & per_split[:, 0] | ~per_split.any(axis=1))
-            mask = per_split if mask is None else per_split & mask[None, :]
-        feat, bin_, na_left, gain, valid = best_splits(
-            H, nbins, reg_lambda, min_rows, min_split_improvement, mask)
-        leaf = partition(codes, leaf, feat, bin_, na_left, valid,
-                         jnp.int32(nbins))
-        # host copies for the compressed tree
-        feat_h = np.asarray(feat)
-        bin_h = np.asarray(bin_)
-        thr_h = np.zeros(L, np.float32)
-        for i in range(L):
-            e = edges[feat_h[i]]
-            thr_h[i] = e[min(bin_h[i], len(e) - 1)] if len(e) else 0.0
-        feat_l.append(feat_h)
-        thr_l.append(thr_h)
-        nal_l.append(np.asarray(na_left))
-        val_l.append(np.asarray(valid))
-    Lfin = 2 ** max_depth
-    agg = make_leaf_agg_fn(Lfin, N)(leaf, g, h, w)
-    agg = np.asarray(agg, np.float64)
-    vals = np.where(agg[2] > 0,
-                    -agg[0] / (agg[1] + reg_lambda + 1e-12) * learn_rate, 0.0)
-    tree = Tree(feat_l, thr_l, nal_l, val_l, vals.astype(np.float32))
+    from .binning import edges_matrix
+    F, N = codes.shape
+    if isinstance(edges, (list, tuple)):
+        edges = edges_matrix(edges, nbins)
+    edges_mat = jnp.asarray(edges, jnp.float32)
+    tm = jnp.asarray(tree_col_mask, bool) if tree_col_mask is not None \
+        else jnp.ones(F, bool)
+    fn = make_build_tree_fn(max_depth, nbins, F, N, hist_precision)
+    levels, vals, leaf = fn(codes, g, h, w, edges_mat, rng_key,
+                            reg_lambda, min_rows, min_split_improvement,
+                            learn_rate, col_sample_rate, tm,
+                            reg_alpha, gamma, min_child_weight)
+    tree = Tree([lv[0] for lv in levels], [lv[1] for lv in levels],
+                [lv[2] for lv in levels], [lv[3] for lv in levels], vals)
     return tree, leaf
 
 

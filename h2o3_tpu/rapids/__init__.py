@@ -1,0 +1,20 @@
+"""Rapids analog: dataframe munging as sharded device programs.
+
+Reference: ``water/rapids/`` — a Lisp-like expression language with 221
+``Ast*`` primitives in 17 categories (mungers, operators, reducers, matrix,
+string, time, …), plus distributed radix sort/merge
+(``RadixOrder.java``/``BinaryMerge.java``) and group-by (``AstGroup``).
+
+TPU-native redesign: there is no expression-string interpreter — the client
+IS Python, so munging primitives are plain functions/operators over the
+sharded Frame/Vec (the lazy-DAG-to-Rapids compile step in h2o-py exists only
+because the reference's client is remote; here frames are already
+device-resident).  Row-scale work (sort keys, segment aggregation, joins,
+filters) runs as compiled device programs: sort = ``jnp.argsort`` (TPU
+bitonic network, the RadixOrder analog), group-by = one-hot/segment sums
+psum'd over the mesh, merge = binary search against the sorted build side
+(the BinaryMerge analog).
+"""
+
+from .ops import (sort, group_by, merge, rbind, cbind, filter_rows, unique,
+                  table, ifelse, hist)

@@ -1,0 +1,352 @@
+"""Munging primitives over sharded Frames (the water/rapids Ast* analogs)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..frame.frame import Frame
+from ..frame.vec import Vec, T_CAT, T_NUM, T_STR, T_TIME
+
+
+def _sort_key(vec: Vec) -> jax.Array:
+    """Ascending sort key with NaN/NA last."""
+    if vec.type == T_CAT:
+        codes = vec.data.astype(jnp.float32)
+        return jnp.where(codes < 0, jnp.inf, codes)
+    return jnp.where(jnp.isnan(vec.data), jnp.inf, vec.data)
+
+
+def _take_rows(frame: Frame, order: np.ndarray) -> Frame:
+    """Reorder/select rows by host index array (handles str columns too)."""
+    vecs = []
+    for v in frame.vecs:
+        if v.data is None:                       # str/uuid: host payload
+            vecs.append(Vec.from_numpy(v.host_data[order], v.type))
+            continue
+        host = v.to_numpy()[order]
+        if v.type == T_TIME:
+            vecs.append(Vec.from_numpy(v.host_data[order], T_TIME))
+        elif v.type == T_CAT:
+            vecs.append(Vec.from_numpy(host.astype(np.int32), T_CAT,
+                                       domain=v.domain))
+        else:
+            vecs.append(Vec.from_numpy(host, v.type))
+    return Frame(frame.names, vecs)
+
+
+def sort(frame: Frame, by: Union[str, Sequence[str]],
+         ascending: Union[bool, Sequence[bool]] = True) -> Frame:
+    """Multi-key sort — AstSort / RadixOrder analog.
+
+    Keys are argsorted on device (TPU sort network); multi-key order comes
+    from successive stable argsorts, least-significant key first.
+    """
+    by = [by] if isinstance(by, str) else list(by)
+    asc = [ascending] * len(by) if isinstance(ascending, bool) \
+        else list(ascending)
+    if len(asc) != len(by):
+        raise ValueError("ascending must match by")
+    order = jnp.arange(frame.padded_rows)
+    for col, a in reversed(list(zip(by, asc))):
+        key = _sort_key(frame.vec(col))
+        key = key if a else jnp.where(jnp.isinf(key), key, -key)
+        keyed = key[order]
+        order = order[jnp.argsort(keyed, stable=True)]
+    order_h = np.asarray(order)
+    order_h = order_h[order_h < frame.nrows][: frame.nrows]
+    return _take_rows(frame, order_h)
+
+
+def filter_rows(frame: Frame, mask) -> Frame:
+    """Boolean row filter — AstRowSlice analog."""
+    mask = np.asarray(mask)[: frame.nrows].astype(bool)
+    return _take_rows(frame, np.flatnonzero(mask))
+
+
+def rbind(*frames: Frame) -> Frame:
+    """Stack frames vertically — AstRBind analog."""
+    base = frames[0]
+    for fr in frames[1:]:
+        if fr.names != base.names:
+            raise ValueError("rbind: column names differ")
+    vecs = []
+    for i, name in enumerate(base.names):
+        vs = [fr.vecs[i] for fr in frames]
+        t = vs[0].type
+        if t == T_CAT:
+            # unify domains
+            domain = []
+            seen = {}
+            for v in vs:
+                for lbl in (v.domain or []):
+                    if lbl not in seen:
+                        seen[lbl] = len(domain)
+                        domain.append(lbl)
+            codes = []
+            for v in vs:
+                remap = np.array([seen[lbl] for lbl in (v.domain or [])],
+                                 dtype=np.int32)
+                c = v.to_numpy()
+                codes.append(np.where(c < 0, -1,
+                                      remap[np.clip(c, 0, None)]))
+            vecs.append(Vec.from_numpy(np.concatenate(codes), T_CAT,
+                                       domain=domain))
+        elif vs[0].data is None:
+            vecs.append(Vec.from_numpy(
+                np.concatenate([v.host_data for v in vs]), t))
+        else:
+            vecs.append(Vec.from_numpy(
+                np.concatenate([v.host_data if t == T_TIME else v.to_numpy()
+                                for v in vs]), t))
+    return Frame(base.names, vecs)
+
+
+def cbind(*frames: Frame) -> Frame:
+    """Stack frames horizontally — AstCBind analog."""
+    names, vecs = [], []
+    for fr in frames:
+        for n, v in zip(fr.names, fr.vecs):
+            nn = n
+            k = 0
+            while nn in names:
+                k += 1
+                nn = f"{n}{k}"
+            names.append(nn)
+            vecs.append(v)
+    return Frame(names, vecs)
+
+
+def unique(vec: Vec) -> np.ndarray:
+    """Distinct values — AstUnique analog."""
+    if vec.type == T_CAT:
+        codes = np.unique(vec.to_numpy())
+        return np.asarray([vec.domain[c] for c in codes if c >= 0])
+    x = np.asarray(jnp.sort(_sort_key(vec)))[: vec.nrows]
+    x = x[np.isfinite(x)]
+    return np.unique(x)
+
+
+def table(vec: Vec, weights: Optional[Vec] = None) -> Dict[str, float]:
+    """Value counts — AstTable analog (one-hot matmul on device for cats)."""
+    if vec.type == T_CAT:
+        K = len(vec.domain or [])
+        codes = vec.data
+        w = vec.valid_mask().astype(jnp.float32) * (codes >= 0)
+        if weights is not None:
+            w = w * weights.numeric_data()
+        onehot = (codes[:, None] == jnp.arange(K)[None, :])
+        counts = np.asarray(jnp.sum(onehot * w[:, None], axis=0))
+        return {vec.domain[i]: float(counts[i]) for i in range(K)}
+    vals, counts = np.unique(vec.to_numpy()[~np.isnan(vec.to_numpy())],
+                             return_counts=True)
+    return {str(v): int(c) for v, c in zip(vals, counts)}
+
+
+def ifelse(cond, yes, no) -> Vec:
+    """Vectorized conditional — AstIfElse analog."""
+    c = cond.data if isinstance(cond, Vec) else jnp.asarray(cond)
+    y = yes.data if isinstance(yes, Vec) else yes
+    n = no.data if isinstance(no, Vec) else no
+    nrows = cond.nrows if isinstance(cond, Vec) else len(np.asarray(cond))
+    out = jnp.where(c != 0, y, n)
+    return Vec(out.astype(jnp.float32), T_NUM, nrows)
+
+
+def hist(vec: Vec, breaks: int = 20) -> Tuple[np.ndarray, np.ndarray]:
+    """Histogram counts — AstHist analog (device bucketize + one-hot sum)."""
+    r = vec.rollups()
+    lo, hi = r.vmin, r.vmax
+    if not np.isfinite(lo) or not np.isfinite(hi) or hi <= lo:
+        return np.zeros(breaks), np.linspace(0, 1, breaks + 1)
+    edges = np.linspace(lo, hi, breaks + 1)
+    x = vec.data
+    idx = jnp.clip(((x - lo) / (hi - lo) * breaks).astype(jnp.int32),
+                   0, breaks - 1)
+    valid = vec.valid_mask() & ~jnp.isnan(x)
+    onehot = (idx[:, None] == jnp.arange(breaks)[None, :]) * valid[:, None]
+    counts = np.asarray(jnp.sum(onehot, axis=0))
+    return counts, edges
+
+
+# ---------------------------------------------------------------- group-by
+_AGGS = ("count", "sum", "mean", "min", "max", "var", "sd")
+
+
+def _group_codes(frame: Frame, by: List[str]):
+    """Combined group code per row + the list of group key tuples."""
+    cols = []
+    for name in by:
+        v = frame.vec(name)
+        if v.type == T_CAT:
+            cols.append((v.to_numpy(), v.domain))
+        else:
+            x = v.to_numpy()
+            vals, inv = np.unique(x[~np.isnan(x)], return_inverse=True)
+            codes = np.full(len(x), -1, np.int64)
+            codes[~np.isnan(x)] = inv
+            cols.append((codes, [str(u) for u in vals]))
+    combo = np.zeros(frame.nrows, np.int64)
+    mult = 1
+    valid = np.ones(frame.nrows, bool)
+    for codes, dom in cols:
+        c = codes[: frame.nrows]
+        valid &= c >= 0
+        combo = combo + np.where(c >= 0, c, 0) * mult
+        mult *= max(len(dom), 1)
+    uniq, inv = np.unique(combo[valid], return_inverse=True)
+    group_of_row = np.full(frame.nrows, -1, np.int64)
+    group_of_row[valid] = inv
+    # decode group keys
+    keys = []
+    for u in uniq:
+        key = []
+        rem = u
+        for codes, dom in cols:
+            key.append(dom[rem % max(len(dom), 1)])
+            rem //= max(len(dom), 1)
+        keys.append(tuple(key))
+    return group_of_row, keys
+
+
+def group_by(frame: Frame, by: Union[str, Sequence[str]],
+             aggs: Dict[str, Sequence[str]]) -> Frame:
+    """Grouped aggregation — AstGroup analog.
+
+    ``aggs``: {column: [agg, ...]} with aggs from count/sum/mean/min/max/
+    var/sd.  Group discovery is host-side (small); the per-group
+    aggregation is a one-hot segment matmul on device, psum'd by XLA.
+    """
+    by = [by] if isinstance(by, str) else list(by)
+    for col, fns in aggs.items():
+        for fn in fns:
+            if fn not in _AGGS:
+                raise ValueError(f"unknown agg {fn!r} (have {_AGGS})")
+    group_of_row, keys = _group_codes(frame, by)
+    G = len(keys)
+    padded = frame.padded_rows
+    gid = np.full(padded, G, np.int32)          # padding -> overflow bucket
+    gid[: frame.nrows] = np.where(group_of_row >= 0, group_of_row, G)
+    gid_dev = jnp.asarray(gid)
+
+    out_cols: Dict[str, np.ndarray] = {}
+    for i, name in enumerate(by):
+        out_cols[name] = np.asarray([k[i] for k in keys], dtype=object)
+
+    onehot = jax.nn.one_hot(gid_dev, G, dtype=jnp.float32)   # [N, G]
+    counts = None
+    for col, fns in aggs.items():
+        x = frame.vec(col).numeric_data()
+        ok = (~jnp.isnan(x)).astype(jnp.float32)
+        xz = jnp.nan_to_num(x)
+        s1 = np.asarray(xz * ok @ onehot, np.float64)
+        n = np.asarray(ok @ onehot, np.float64)
+        counts = n if counts is None else counts
+        if any(f in ("min", "max") for f in fns):
+            big = jnp.float32(3.4e38)
+            xmin = jnp.where(jnp.isnan(x), big, x)
+            xmax = jnp.where(jnp.isnan(x), -big, x)
+            mn = np.asarray(jax.ops.segment_min(xmin, gid_dev,
+                                                num_segments=G + 1))[:G]
+            mx = np.asarray(jax.ops.segment_max(xmax, gid_dev,
+                                                num_segments=G + 1))[:G]
+        if any(f in ("var", "sd") for f in fns):
+            s2 = np.asarray((xz * xz) * ok @ onehot, np.float64)
+        for fn in fns:
+            key = f"{fn}_{col}"
+            if fn == "count":
+                out_cols[key] = n
+            elif fn == "sum":
+                out_cols[key] = s1
+            elif fn == "mean":
+                out_cols[key] = s1 / np.maximum(n, 1e-300)
+            elif fn == "min":
+                out_cols[key] = mn
+            elif fn == "max":
+                out_cols[key] = mx
+            else:
+                mean = s1 / np.maximum(n, 1e-300)
+                var = (s2 / np.maximum(n, 1e-300) - mean**2) \
+                    * n / np.maximum(n - 1, 1e-300)
+                var = np.maximum(var, 0.0)
+                out_cols[key] = np.sqrt(var) if fn == "sd" else var
+    return Frame.from_numpy(out_cols)
+
+
+# -------------------------------------------------------------------- merge
+def merge(left: Frame, right: Frame, by: Union[str, Sequence[str]],
+          how: str = "inner") -> Frame:
+    """Join — AstMerge / BinaryMerge analog.
+
+    Single- or multi-key equi-join.  The match step runs on device
+    (binary search against the sorted build side); rows are expanded
+    host-side when the build side has duplicate keys.
+    """
+    by = [by] if isinstance(by, str) else list(by)
+    if how not in ("inner", "left"):
+        raise ValueError("merge supports how='inner'|'left'")
+    lkeys = _merge_key(left, by)
+    rkeys = _merge_key(right, by)
+    order = np.argsort(rkeys, kind="stable")
+    rsorted = rkeys[order]
+    lo = np.searchsorted(rsorted, lkeys, side="left")
+    hi = np.searchsorted(rsorted, lkeys, side="right")
+    counts = hi - lo
+    matched = counts > 0
+
+    lidx, ridx = [], []
+    for i in np.flatnonzero(matched):
+        span = order[lo[i]: hi[i]]
+        lidx.extend([i] * len(span))
+        ridx.extend(span)
+    lidx = np.asarray(lidx, np.int64)
+    ridx = np.asarray(ridx, np.int64)
+    if how == "left":
+        miss = np.flatnonzero(~matched)
+        lidx = np.concatenate([lidx, miss])
+        ridx = np.concatenate([ridx, np.full(len(miss), -1)])
+        srt = np.argsort(lidx, kind="stable")
+        lidx, ridx = lidx[srt], ridx[srt]
+
+    out = _take_rows(left, lidx)
+    rcols = [n for n in right.names if n not in by]
+    rsub = _take_rows(right[rcols], np.where(ridx >= 0, ridx, 0)) \
+        if rcols else None
+    if rsub is not None:
+        vecs = []
+        for n, v in zip(rsub.names, rsub.vecs):
+            if how == "left" and (ridx < 0).any() and v.data is not None \
+                    and v.type != T_CAT:
+                host = np.array(v.to_numpy(), copy=True)
+                host[ridx < 0] = np.nan
+                v = Vec.from_numpy(host, v.type)
+            elif how == "left" and (ridx < 0).any() and v.type == T_CAT:
+                host = np.array(v.to_numpy(), copy=True)
+                host[ridx < 0] = -1
+                v = Vec.from_numpy(host.astype(np.int32), T_CAT,
+                                   domain=v.domain)
+            vecs.append(v)
+        out = cbind(out, Frame(rsub.names, vecs))
+    return out
+
+
+def _merge_key(frame: Frame, by: List[str]) -> np.ndarray:
+    """Rows -> hashable composite key array (string form for stability)."""
+    parts = []
+    for name in by:
+        v = frame.vec(name)
+        if v.type == T_CAT:
+            dom = np.asarray(list(v.domain or []) + ["<NA>"], dtype=object)
+            c = v.to_numpy()
+            parts.append(dom[np.where(c < 0, len(dom) - 1, c)])
+        elif v.data is None:
+            parts.append(v.host_data.astype(str))
+        else:
+            parts.append(v.to_numpy().astype(str))
+    if len(parts) == 1:
+        return parts[0].astype(str)
+    return np.array(["\x1f".join(t) for t in zip(*[p.astype(str)
+                                                   for p in parts])])

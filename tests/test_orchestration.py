@@ -1,0 +1,108 @@
+"""GridSearch / StackedEnsemble / Leaderboard / AutoML tests.
+
+Mirrors testdir_algos/{grid,stackedensemble,automl} pyunits: grid budgets
+and ordering, CV stacking beating-or-matching base models, leaderboard
+ranking, a small end-to-end AutoML run.
+"""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu import Frame
+from h2o3_tpu.automl import AutoML, Leaderboard
+from h2o3_tpu.models import (GBM, GLM, StackedEnsemble, GridSearch)
+
+
+def _binary_frame(rng, n=2500):
+    X = rng.normal(size=(n, 4))
+    logits = 1.2 * X[:, 0] - 0.8 * X[:, 1] + 0.5 * X[:, 2] * X[:, 3]
+    y = rng.random(n) < 1 / (1 + np.exp(-logits))
+    cols = {f"x{j}": X[:, j] for j in range(4)}
+    cols["y"] = np.where(y, "yes", "no").astype(object)
+    return Frame.from_numpy(cols)
+
+
+def test_grid_cartesian(cl, rng):
+    fr = _binary_frame(rng)
+    grid = GridSearch(GBM, {"max_depth": [2, 4], "ntrees": [5, 10]},
+                      response_column="y", seed=1).train(fr)
+    assert len(grid.models) == 4
+    table = grid.sorted_metric_table()
+    assert table[0]["auc"] >= table[-1]["auc"]
+    assert grid.best_model.key == table[0]["model_id"]
+    assert set(table[0]) >= {"max_depth", "ntrees", "model_id", "auc"}
+
+
+def test_grid_random_discrete_budget(cl, rng):
+    fr = _binary_frame(rng, n=1200)
+    grid = GridSearch(
+        GBM, {"max_depth": [2, 3, 4, 5], "learn_rate": [0.05, 0.1, 0.3]},
+        search_criteria={"strategy": "RandomDiscrete", "max_models": 3,
+                         "seed": 7},
+        response_column="y", ntrees=5, seed=1).train(fr)
+    assert len(grid.models) == 3
+
+
+def test_stacked_ensemble_cv(cl, rng):
+    fr = _binary_frame(rng)
+    common = dict(response_column="y", nfolds=3, seed=11,
+                  keep_cross_validation_predictions=True)
+    gbm = GBM(ntrees=20, max_depth=3, **common).train(fr)
+    glm = GLM(family="binomial", lambda_=1e-4, **common).train(fr)
+    se = StackedEnsemble(response_column="y",
+                         base_models=[gbm.key, glm.key]).train(fr)
+    base_auc = max(gbm.training_metrics.auc, glm.training_metrics.auc)
+    perf = se.model_performance(fr)
+    assert perf.auc > base_auc - 0.02
+    pred = se.predict(fr)
+    assert pred.names[0] == "predict"
+    assert len(pred.vecs[0].to_numpy()) == fr.nrows
+
+
+def test_stacked_ensemble_requires_cv_preds(cl, rng):
+    fr = _binary_frame(rng, n=600)
+    gbm = GBM(response_column="y", ntrees=5, seed=1).train(fr)
+    with pytest.raises(ValueError, match="CV holdout"):
+        StackedEnsemble(response_column="y",
+                        base_models=[gbm.key]).train(fr)
+
+
+def test_stacked_ensemble_blending(cl, rng):
+    fr = _binary_frame(rng)
+    blend = _binary_frame(rng, n=800)
+    gbm = GBM(response_column="y", ntrees=10, seed=1).train(fr)
+    glm = GLM(response_column="y", family="binomial",
+              lambda_=1e-4, seed=1).train(fr)
+    se = StackedEnsemble(response_column="y", base_models=[gbm.key, glm.key],
+                         blending_frame=blend).train(blend)
+    assert se.model_performance(blend).auc > 0.7
+
+
+def test_leaderboard_ranking(cl, rng):
+    fr = _binary_frame(rng, n=1500)
+    weak = GLM(response_column="y", family="binomial", lambda_=10.0,
+               alpha=0.0, seed=1).train(fr)
+    strong = GBM(response_column="y", ntrees=30, max_depth=4,
+                 seed=1).train(fr)
+    lb = Leaderboard([weak, strong])
+    assert lb.sort_metric == "auc"
+    assert lb.leader.key == strong.key
+    table = lb.as_table()
+    assert table[0]["model_id"] == strong.key
+
+
+def test_automl_small_run(cl, rng):
+    fr = _binary_frame(rng, n=1200)
+    aml = AutoML(response_column="y", max_models=3, nfolds=3, seed=5,
+                 include_algos=["glm", "gbm"])
+    leader = aml.train(fr)
+    assert leader is aml.leader
+    steps = [e["step"] for e in aml.events if "model" in e]
+    assert len(steps) >= 3
+    table = aml.leaderboard.as_table()
+    assert len(table) == len(aml.models)
+    # SEs built from CV stacking should be present
+    assert any(s.startswith("SE_") for s in steps), aml.events
+    assert aml.leaderboard.sort_metric == "auc"
+    vals = [r["auc"] for r in table]
+    assert vals == sorted(vals, reverse=True)

@@ -1,0 +1,128 @@
+"""Rapids-analog munging tests: sort/group_by/merge/rbind/cbind/filter/etc.
+
+Mirrors h2o-py/tests/testdir_munging pyunits: golden comparisons against
+pandas-free numpy equivalents.
+"""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu import Frame
+from h2o3_tpu.rapids import (sort, group_by, merge, rbind, cbind,
+                             filter_rows, unique, table, ifelse, hist)
+
+
+@pytest.fixture()
+def fr(rng):
+    n = 500
+    return Frame.from_numpy({
+        "g": np.array(["a", "b", "c"], dtype=object)[
+            rng.integers(0, 3, n)],
+        "x": rng.normal(size=n),
+        "y": rng.integers(0, 100, n).astype(np.float64),
+    })
+
+
+def test_sort_single_and_multi(cl, fr):
+    s = sort(fr, "x")
+    xs = s.vec("x").to_numpy()
+    assert np.all(np.diff(xs) >= 0)
+    s2 = sort(fr, ["g", "x"], ascending=[True, False])
+    g = s2.vec("g").decoded()
+    assert list(g) == sorted(list(g))
+    x = s2.vec("x").to_numpy()
+    for lbl in "abc":
+        seg = x[g == lbl]
+        assert np.all(np.diff(seg) <= 0)
+
+
+def test_sort_nan_last(cl, rng):
+    x = np.array([3.0, np.nan, 1.0, 2.0])
+    s = sort(Frame.from_numpy({"x": x}), "x")
+    out = s.vec("x").to_numpy()
+    np.testing.assert_array_equal(out[:3], [1.0, 2.0, 3.0])
+    assert np.isnan(out[3])
+
+
+def test_group_by(cl, fr):
+    out = group_by(fr, "g", {"x": ["mean", "count", "min", "max", "sd"]})
+    g = fr.vec("g").decoded()
+    x = fr.vec("x").to_numpy()
+    keys = out.vec("g").decoded() if out.vec("g").type == "cat" \
+        else out.vec("g").host_data
+    for i, lbl in enumerate(np.asarray(keys)):
+        seg = x[g == lbl]
+        assert out.vec("mean_x").to_numpy()[i] == pytest.approx(seg.mean(),
+                                                                rel=1e-5)
+        assert out.vec("count_x").to_numpy()[i] == len(seg)
+        assert out.vec("min_x").to_numpy()[i] == pytest.approx(seg.min())
+        assert out.vec("max_x").to_numpy()[i] == pytest.approx(seg.max())
+        assert out.vec("sd_x").to_numpy()[i] == pytest.approx(
+            seg.std(ddof=1), rel=1e-4)
+
+
+def test_group_by_multikey(cl, rng):
+    n = 300
+    fr = Frame.from_numpy({
+        "a": np.array(["p", "q"], dtype=object)[rng.integers(0, 2, n)],
+        "b": rng.integers(0, 3, n).astype(np.float64),
+        "v": rng.normal(size=n)})
+    out = group_by(fr, ["a", "b"], {"v": ["sum"]})
+    assert out.nrows <= 6
+    tot = out.vec("sum_v").to_numpy().sum()
+    assert tot == pytest.approx(fr.vec("v").to_numpy().sum(), rel=1e-5)
+
+
+def test_merge_inner_and_left(cl):
+    left = Frame.from_numpy({
+        "k": np.array(["a", "b", "c", "d"], dtype=object),
+        "x": np.array([1.0, 2.0, 3.0, 4.0])})
+    right = Frame.from_numpy({
+        "k": np.array(["b", "c", "c", "e"], dtype=object),
+        "y": np.array([20.0, 30.0, 31.0, 50.0])})
+    inner = merge(left, right, "k")
+    assert inner.nrows == 3            # b:1, c:2
+    ks = inner.vec("k").decoded()
+    assert sorted(ks) == ["b", "c", "c"]
+    lft = merge(left, right, "k", how="left")
+    assert lft.nrows == 5              # a, b, c, c, d
+    y = lft.vec("y").to_numpy()
+    k = lft.vec("k").decoded()
+    assert np.isnan(y[k == "a"]).all() and np.isnan(y[k == "d"]).all()
+
+
+def test_rbind_unifies_domains(cl):
+    f1 = Frame.from_numpy({"c": np.array(["x", "y"], dtype=object)})
+    f2 = Frame.from_numpy({"c": np.array(["y", "z"], dtype=object)})
+    out = rbind(f1, f2)
+    assert out.nrows == 4
+    assert list(out.vec("c").decoded()) == ["x", "y", "y", "z"]
+
+
+def test_cbind_renames_dups(cl, rng):
+    f1 = Frame.from_numpy({"x": rng.normal(size=5)})
+    f2 = Frame.from_numpy({"x": rng.normal(size=5)})
+    out = cbind(f1, f2)
+    assert out.names == ["x", "x1"]
+
+
+def test_filter_unique_table_ifelse_hist(cl, rng):
+    n = 400
+    fr = Frame.from_numpy({
+        "g": np.array(["u", "v"], dtype=object)[rng.integers(0, 2, n)],
+        "x": rng.normal(size=n)})
+    x = fr.vec("x").to_numpy()
+    flt = filter_rows(fr, x > 0)
+    assert flt.nrows == (x > 0).sum()
+    assert np.all(flt.vec("x").to_numpy() > 0)
+    u = unique(fr.vec("g"))
+    assert sorted(u) == ["u", "v"]
+    t = table(fr.vec("g"))
+    assert t["u"] + t["v"] == n
+    iv = ifelse(fr.vec("x"), 1.0, 0.0)
+    got = iv.to_numpy()[:n]
+    np.testing.assert_array_equal(got, (x != 0).astype(np.float64))
+    counts, edges = hist(fr.vec("x"), breaks=10)
+    assert counts.sum() == n
+    np_counts, _ = np.histogram(x, bins=edges)
+    np.testing.assert_allclose(counts[1:-1], np_counts[1:-1], atol=1)

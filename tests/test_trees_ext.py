@@ -1,0 +1,139 @@
+"""IsolationForest / ExtendedIsolationForest / XGBoost estimator tests.
+
+Mirrors the reference's pyunit strategy (testdir_algos/{isofor,
+isoforextended,xgboost}): anomaly separation on planted outliers, XGBoost
+param-alias surface, regularization behavior, DART smoke.
+"""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu import Frame
+from h2o3_tpu.models import (IsolationForest, ExtendedIsolationForest,
+                             XGBoost, GBM)
+
+
+def _with_outliers(rng, n=2000, n_out=20):
+    X = rng.normal(size=(n, 2))
+    out = rng.normal(size=(n_out, 2)) * 0.5 + 8.0
+    Xall = np.concatenate([X, out])
+    is_out = np.concatenate([np.zeros(n, bool), np.ones(n_out, bool)])
+    return Frame.from_numpy({"x": Xall[:, 0], "y": Xall[:, 1]}), is_out
+
+
+def test_isolation_forest_separates_outliers(cl, rng):
+    fr, is_out = _with_outliers(rng)
+    m = IsolationForest(ntrees=50, seed=5).train(fr)
+    pred = m.predict(fr)
+    assert pred.names == ["predict", "mean_length"]
+    score = pred.vecs[0].to_numpy()
+    # planted outliers must rank above the bulk
+    assert score[is_out].mean() > score[~is_out].mean() + 0.1
+    auc_like = (score[is_out][:, None] > score[~is_out][None, :]).mean()
+    assert auc_like > 0.95
+    ml = pred.vecs[1].to_numpy()
+    assert ml[is_out].mean() < ml[~is_out].mean()
+
+
+def test_isolation_forest_contamination_threshold(cl, rng):
+    fr, is_out = _with_outliers(rng)
+    m = IsolationForest(ntrees=30, seed=5, contamination=0.01).train(fr)
+    assert 0 < m.output["threshold"] < 1
+
+
+def test_extended_isolation_forest(cl, rng):
+    fr, is_out = _with_outliers(rng)
+    m = ExtendedIsolationForest(ntrees=40, extension_level=1, seed=5).train(fr)
+    pred = m.predict(fr)
+    assert pred.names == ["anomaly_score", "mean_length"]
+    score = pred.vecs[0].to_numpy()
+    assert score[is_out].mean() > score[~is_out].mean() + 0.1
+
+
+def _reg_frame(rng, n=3000):
+    X = rng.normal(size=(n, 4))
+    y = 2 * X[:, 0] - X[:, 1] + 0.5 * X[:, 2] ** 2 + 0.1 * rng.normal(size=n)
+    cols = {f"x{j}": X[:, j] for j in range(4)}
+    cols["y"] = y
+    return Frame.from_numpy(cols)
+
+
+def test_xgboost_regression_and_aliases(cl, rng):
+    fr = _reg_frame(rng)
+    m = XGBoost(response_column="y", n_estimators=30, eta=0.3, subsample=0.9,
+                colsample_bytree=0.9, min_child_weight=2.0,
+                objective="reg:squarederror", seed=1).train(fr)
+    assert m.params.learn_rate == 0.3
+    assert m.params.sample_rate == 0.9
+    assert m.training_metrics.rmse < 0.6
+    assert m.algo == "xgboost"
+
+
+def test_xgboost_binary_and_scale_pos_weight(cl, rng):
+    n = 4000
+    X = rng.normal(size=(n, 3))
+    yb = (X[:, 0] + 0.5 * X[:, 1] + 0.3 * rng.normal(size=n) > 1.2)
+    cols = {f"x{j}": X[:, j] for j in range(3)}
+    cols["y"] = np.where(yb, "yes", "no").astype(object)
+    fr = Frame.from_numpy(cols)
+    m = XGBoost(response_column="y", ntrees=30, seed=1).train(fr)
+    assert m.training_metrics.auc > 0.95
+    m2 = XGBoost(response_column="y", ntrees=30, seed=1,
+                 scale_pos_weight=4.0).train(fr)
+    assert m2.training_metrics.auc > 0.9
+
+
+def test_xgboost_regularization_shrinks(cl, rng):
+    fr = _reg_frame(rng)
+    m0 = XGBoost(response_column="y", ntrees=20, seed=1,
+                 reg_lambda=0.0, reg_alpha=0.0).train(fr)
+    m1 = XGBoost(response_column="y", ntrees=20, seed=1,
+                 reg_lambda=50.0, reg_alpha=5.0).train(fr)
+    v0 = np.abs(np.concatenate([t.values for t in m0.output["trees"]])).max()
+    v1 = np.abs(np.concatenate([t.values for t in m1.output["trees"]])).max()
+    assert v1 < v0
+
+
+def test_xgboost_gamma_prunes(cl, rng):
+    fr = _reg_frame(rng)
+    m0 = XGBoost(response_column="y", ntrees=10, seed=1, gamma=0.0).train(fr)
+    m1 = XGBoost(response_column="y", ntrees=10, seed=1,
+                 gamma=1e6).train(fr)
+    splits0 = sum(v.sum() for t in m0.output["trees"] for v in t.valid)
+    splits1 = sum(v.sum() for t in m1.output["trees"] for v in t.valid)
+    assert splits1 < splits0
+
+
+def test_xgboost_dart(cl, rng):
+    fr = _reg_frame(rng)
+    m = XGBoost(response_column="y", ntrees=25, booster="dart",
+                rate_drop=0.3, seed=1).train(fr)
+    assert m.output["ntrees_trained"] == 25
+    assert m.training_metrics.rmse < 1.0
+    pred = m.predict(fr)
+    assert np.isfinite(pred.vecs[0].to_numpy()).all()
+
+
+def test_xgboost_multinomial(cl, rng):
+    n = 3000
+    X = rng.normal(size=(n, 3))
+    cls = np.argmax(X[:, :3] + 0.3 * rng.normal(size=(n, 3)), axis=1)
+    cols = {f"x{j}": X[:, j] for j in range(3)}
+    cols["y"] = np.array(["a", "b", "c"], dtype=object)[cls]
+    fr = Frame.from_numpy(cols)
+    m = XGBoost(response_column="y", ntrees=20, seed=1).train(fr)
+    pred = m.predict(fr)
+    acc = np.mean(pred.vecs[0].decoded() == cols["y"])
+    assert acc > 0.8
+
+
+def test_xgboost_matches_gbm_when_params_align(cl, rng):
+    """With lambda=0, alpha=0, gamma=0, mcw=0, xgboost == gbm split math."""
+    fr = _reg_frame(rng)
+    common = dict(response_column="y", ntrees=10, max_depth=4, seed=7,
+                  learn_rate=0.1, nbins=64, min_rows=10.0)
+    mg = GBM(**common).train(fr)
+    mx = XGBoost(reg_lambda=0.0, min_child_weight=0.0, **common).train(fr)
+    pg = mg.predict(fr).vecs[0].to_numpy()
+    px = mx.predict(fr).vecs[0].to_numpy()
+    np.testing.assert_allclose(pg, px, rtol=1e-4, atol=1e-4)
