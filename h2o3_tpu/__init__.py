@@ -15,6 +15,7 @@ from .runtime import dkv
 from .frame.frame import Frame
 from .frame.vec import Vec
 from .frame.parse import import_file, parse_csv, upload_string
+from .export.mojo import import_mojo
 
 __version__ = "0.1.0"
 

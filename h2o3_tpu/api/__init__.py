@@ -1,0 +1,3 @@
+"""REST API layer (the water/api analog)."""
+
+from .server import H2OServer, start_server

@@ -1,0 +1,298 @@
+"""REST server: versioned JSON routes over the runtime — water/api analog.
+
+Reference: ``water/api/RequestServer.java:56,75-80`` (~150 routes, versioned
+schemas under ``water/api/schemas3``), served by Jetty adapters
+(h2o-webserver-iface).  Clients (h2o-py/h2o-r/Flow) drive everything through
+these routes.
+
+TPU-native redesign: a stdlib ThreadingHTTPServer (no Jetty analog needed —
+the control plane is a single coordinator process; the data plane never
+touches HTTP).  Routes keep the reference's shapes/paths so an h2o-py-style
+client maps 1:1: /3/Cloud, /3/Jobs, /3/Frames, /3/Parse, /3/ModelBuilders/
+{algo}, /3/Models, /3/Predictions/models/{m}/frames/{f}, /3/DKV.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+ALGOS = ("glm", "gbm", "drf", "xgboost", "deeplearning", "kmeans", "pca",
+         "svd", "naivebayes", "isolationforest", "extendedisolationforest",
+         "isotonicregression", "quantile", "stackedensemble", "adaboost",
+         "targetencoder", "glrm", "coxph", "word2vec", "rulefit",
+         "aggregator", "gam")
+
+
+def _builder(algo: str):
+    from .. import models as M
+    return {
+        "glm": M.GLM, "gbm": M.GBM, "drf": M.DRF, "xgboost": M.XGBoost,
+        "deeplearning": M.DeepLearning, "kmeans": M.KMeans, "pca": M.PCA,
+        "svd": M.SVD, "naivebayes": M.NaiveBayes,
+        "isolationforest": M.IsolationForest,
+        "extendedisolationforest": M.ExtendedIsolationForest,
+        "isotonicregression": M.IsotonicRegression,
+        "quantile": M.Quantile, "stackedensemble": M.StackedEnsemble,
+        "adaboost": M.AdaBoost, "targetencoder": M.TargetEncoder,
+        "glrm": M.GLRM, "coxph": M.CoxPH, "word2vec": M.Word2Vec,
+        "rulefit": M.RuleFit, "aggregator": M.Aggregator, "gam": M.GAM,
+    }[algo]
+
+
+def _frame_schema(key: str, fr) -> dict:
+    return {
+        "frame_id": {"name": key},
+        "rows": fr.nrows, "columns": [
+            {"label": n, "type": v.type,
+             "domain": v.domain,
+             "missing_count": int(v.nmissing()) if v.data is not None else 0}
+            for n, v in zip(fr.names, fr.vecs)],
+    }
+
+
+def _model_schema(key: str, m) -> dict:
+    def metr(x):
+        if x is None:
+            return None
+        if isinstance(x, dict):
+            return x
+        d = x.describe() if hasattr(x, "describe") else {}
+        return {k: v for k, v in d.items()
+                if isinstance(v, (int, float, str, bool))}
+    return {
+        "model_id": {"name": key},
+        "algo": m.algo,
+        "response_column": m.params.response_column,
+        "training_metrics": metr(m.training_metrics),
+        "validation_metrics": metr(m.validation_metrics),
+        "cross_validation_metrics": metr(m.cross_validation_metrics),
+        "output": {k: v for k, v in m.output.items()
+                   if isinstance(v, (int, float, str, bool))},
+    }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    routes_get: Dict[str, Callable] = {}
+    routes_post: Dict[str, Callable] = {}
+    routes_delete: Dict[str, Callable] = {}
+
+    def log_message(self, fmt, *args):          # quiet
+        pass
+
+    def _reply(self, code: int, payload: dict):
+        body = json.dumps(payload, default=_json_default).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _dispatch(self, table):
+        parsed = urlparse(self.path)
+        params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+        length = int(self.headers.get("Content-Length") or 0)
+        if length:
+            raw = self.rfile.read(length)
+            try:
+                params.update(json.loads(raw))
+            except Exception:
+                params.update({k: v[0] for k, v
+                               in parse_qs(raw.decode()).items()})
+        for pattern, fn in table.items():
+            m = re.fullmatch(pattern, parsed.path)
+            if m:
+                try:
+                    return self._reply(200, fn(self.server.api,
+                                               *m.groups(), **params))
+                except KeyError as e:
+                    return self._reply(404, {"error": str(e)})
+                except Exception as e:      # noqa: BLE001
+                    return self._reply(400, {
+                        "error": repr(e),
+                        "stacktrace": traceback.format_exc().splitlines()})
+        self._reply(404, {"error": f"no route {parsed.path}"})
+
+    def do_GET(self):
+        self._dispatch(self.routes_get)
+
+    def do_POST(self):
+        self._dispatch(self.routes_post)
+
+    def do_DELETE(self):
+        self._dispatch(self.routes_delete)
+
+
+def _json_default(o):
+    if isinstance(o, (np.integer,)):
+        return int(o)
+    if isinstance(o, (np.floating,)):
+        v = float(o)
+        return v if np.isfinite(v) else None
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    return str(o)
+
+
+class Api:
+    """Route implementations bound to the in-process runtime."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.jobs: Dict[str, dict] = {}
+
+    # ---------------------------------------------------------------- cloud
+    def cloud(self) -> dict:
+        from ..runtime.cluster import cluster
+        c = cluster().describe()
+        return {"version": "h2o3_tpu", "cloud_healthy": True,
+                "cloud_size": c["process_count"], **c}
+
+    # ---------------------------------------------------------------- frames
+    def frames(self) -> dict:
+        from ..runtime import dkv
+        from ..frame.frame import Frame
+        out = []
+        for k in dkv.keys():
+            v = dkv.get(k)
+            if isinstance(v, Frame):
+                out.append(_frame_schema(k, v))
+        return {"frames": out}
+
+    def frame(self, key: str) -> dict:
+        from ..runtime import dkv
+        fr = dkv.get(key)
+        if fr is None:
+            raise KeyError(f"no frame {key!r}")
+        return {"frames": [_frame_schema(key, fr)]}
+
+    def parse(self, source_frames=None, destination_frame=None, path=None,
+              **kw) -> dict:
+        from .. import import_file
+        src = path or source_frames
+        fr = import_file(src, destination_frame=destination_frame)
+        return {"job": {"status": "DONE"},
+                "destination_frame": {"name": fr.key}}
+
+    # ---------------------------------------------------------------- models
+    def train(self, algo: str, **params) -> dict:
+        from ..runtime import dkv
+        algo = algo.lower()
+        if algo not in ALGOS:
+            raise KeyError(f"unknown algo {algo!r}")
+        training = params.pop("training_frame")
+        valid_key = params.pop("validation_frame", None)
+        frame = dkv.get(training)
+        if frame is None:
+            raise KeyError(f"no frame {training!r}")
+        valid = dkv.get(valid_key) if valid_key else None
+        # coerce numeric strings (query-string transport)
+        clean = {}
+        for k, v in params.items():
+            if isinstance(v, str):
+                try:
+                    v = json.loads(v)
+                except Exception:
+                    pass
+            clean[k] = v
+        model = _builder(algo)(**clean).train(frame, valid)
+        return {"job": {"status": "DONE",
+                        "dest": {"name": model.key}},
+                "model": _model_schema(model.key, model)}
+
+    def models(self) -> dict:
+        from ..runtime import dkv
+        from ..models.base import Model
+        out = []
+        for k in dkv.keys():
+            v = dkv.get(k)
+            if isinstance(v, Model):
+                out.append(_model_schema(k, v))
+        return {"models": out}
+
+    def model(self, key: str) -> dict:
+        from ..runtime import dkv
+        m = dkv.get(key)
+        if m is None:
+            raise KeyError(f"no model {key!r}")
+        return {"models": [_model_schema(key, m)]}
+
+    def predict(self, model_key: str, frame_key: str, **kw) -> dict:
+        from ..runtime import dkv
+        m = dkv.get(model_key)
+        fr = dkv.get(frame_key)
+        if m is None or fr is None:
+            raise KeyError(f"missing {model_key!r} or {frame_key!r}")
+        pred = m.predict(fr)
+        dest = kw.get("predictions_frame") or f"{model_key}_preds"
+        pred.key = dest
+        from ..runtime import dkv as _dkv
+        _dkv.put(dest, pred)
+        return {"predictions_frame": {"name": dest},
+                "frames": [_frame_schema(dest, pred)]}
+
+    # ------------------------------------------------------------------ jobs
+    def jobs_list(self) -> dict:
+        from ..runtime.job import list_jobs
+        return {"jobs": [j.describe() for j in list_jobs()]}
+
+    # ------------------------------------------------------------------- dkv
+    def remove(self, key: str) -> dict:
+        from ..runtime import dkv
+        dkv.remove(key)
+        return {"removed": key}
+
+
+class H2OServer:
+    """In-process REST server — H2OApp/Jetty boot analog."""
+
+    def __init__(self, port: int = 54321):
+        self.api = Api()
+        _Handler.routes_get = {
+            r"/3/Cloud": lambda a: a.cloud(),
+            r"/3/Frames": lambda a: a.frames(),
+            r"/3/Frames/([^/]+)": lambda a, k: a.frame(k),
+            r"/3/Models": lambda a: a.models(),
+            r"/3/Models/([^/]+)": lambda a, k: a.model(k),
+            r"/3/Jobs": lambda a: a.jobs_list(),
+        }
+        _Handler.routes_post = {
+            r"/3/Parse": lambda a, **kw: a.parse(**kw),
+            r"/3/ModelBuilders/([^/]+)": lambda a, algo, **kw:
+                a.train(algo, **kw),
+            r"/3/Predictions/models/([^/]+)/frames/([^/]+)":
+                lambda a, m, f, **kw: a.predict(m, f, **kw),
+        }
+        _Handler.routes_delete = {
+            r"/3/DKV/([^/]+)": lambda a, k: a.remove(k),
+        }
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        self.httpd.api = self.api
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "H2OServer":
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+
+def start_server(port: int = 0) -> H2OServer:
+    """Boot the REST layer on an in-process runtime (port 0 = ephemeral)."""
+    return H2OServer(port=port).start()

@@ -1,0 +1,4 @@
+"""Portable model artifacts + standalone scoring (h2o-genmodel analog)."""
+
+from .mojo import export_mojo, import_mojo
+from .scoring import ScoringModel

@@ -1,0 +1,160 @@
+"""Portable model archive writer/reader — the MOJO analog.
+
+Reference: ``hex/genmodel/MojoModel.java:12`` + ``ModelMojoReader.java:25``:
+a MOJO is a zip of binary blobs + metadata that the dependency-free genmodel
+library scores offline.  Here the archive is a zip holding ``model.json``
+(algo, featurization layout, link/metadata) and ``arrays.npz`` (all learned
+tensors); ``scoring.py`` (numpy-only) is the genmodel analog that loads and
+scores it with no jax and no cluster.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from typing import Dict
+
+import numpy as np
+
+from .scoring import ScoringModel
+
+FORMAT_VERSION = 1
+
+
+def _datainfo_meta(di) -> dict:
+    return {
+        "specs": [{
+            "name": s.name, "type": s.type, "domain": s.domain,
+            "mean": float(s.mean), "sigma": float(s.sigma),
+            "offset": s.offset, "width": s.width,
+        } for s in di.specs],
+        "response_column": di.response_column,
+        "response_domain": di.response_domain,
+        "use_all_factor_levels": di.use_all_factor_levels,
+        "standardize": di.standardize,
+        "add_intercept": di.add_intercept,
+        "nfeatures": di.nfeatures,
+    }
+
+
+def _tree_arrays(trees, depth: int, prefix: str = "") -> Dict[str, np.ndarray]:
+    out = {}
+    for d in range(depth):
+        out[f"{prefix}feat_{d}"] = np.stack(
+            [np.asarray(t.feat[d]) for t in trees]).astype(np.int32)
+        out[f"{prefix}thr_{d}"] = np.stack(
+            [np.asarray(t.thr[d]) for t in trees]).astype(np.float32)
+        out[f"{prefix}na_left_{d}"] = np.stack(
+            [np.asarray(t.na_left[d]) for t in trees]).astype(bool)
+        out[f"{prefix}valid_{d}"] = np.stack(
+            [np.asarray(t.valid[d]) for t in trees]).astype(bool)
+    out[f"{prefix}values"] = np.stack(
+        [np.asarray(t.values) for t in trees]).astype(np.float32)
+    return out
+
+
+def _extract(model) -> (dict, Dict[str, np.ndarray]):
+    """(meta, arrays) for the algo families with standalone scorers."""
+    algo = model.algo
+    o = model.output
+    meta = {
+        "algo": algo,
+        "format_version": FORMAT_VERSION,
+        "datainfo": _datainfo_meta(model.datainfo),
+        "default_threshold": float(model.default_threshold())
+        if model.datainfo.is_classifier else 0.5,
+    }
+    arrays: Dict[str, np.ndarray] = {}
+
+    if algo == "glm":
+        meta["family"] = "glm"
+        fam = o.get("family", "gaussian")
+        meta["link"] = {"binomial": "logit", "quasibinomial": "logit",
+                        "poisson": "log", "gamma": "log", "tweedie": "log",
+                        "negativebinomial": "log"}.get(fam, "identity")
+        arrays["beta"] = np.asarray(o["beta_std"], np.float64)
+    elif algo in ("gbm", "xgboost", "drf"):
+        meta["family"] = "tree"
+        meta["tree_average"] = algo == "drf"
+        trees = o["trees"]
+        K = o.get("nclass_trees", 1)
+        meta["nclass_trees"] = K
+        meta["depth"] = model.params.max_depth
+        meta["ntrees"] = len(trees)
+        dist = o.get("distribution", "gaussian")
+        meta["link"] = "log" if dist in ("poisson", "gamma", "tweedie") \
+            else "identity"
+        if K > 1:
+            meta["init_score"] = [float(v) for v in np.asarray(
+                o["init_score"])]
+            for k in range(K):
+                arrays.update(_tree_arrays([t[k] for t in trees],
+                                           model.params.max_depth,
+                                           prefix=f"k{k}_"))
+        else:
+            meta["init_score"] = float(np.asarray(o["init_score"]))
+            arrays.update(_tree_arrays(trees, model.params.max_depth))
+    elif algo == "isolationforest":
+        meta["family"] = "isolation"
+        meta["depth"] = model.params.max_depth
+        meta["ntrees"] = len(o["trees"])
+        meta["c_norm"] = float(o["c_norm"])
+        arrays.update(_tree_arrays(o["trees"], model.params.max_depth))
+    elif algo == "deeplearning":
+        meta["family"] = "deeplearning"
+        act = getattr(model.params, "activation", "rectifier")
+        if act.startswith("maxout"):
+            raise ValueError("portable export does not support maxout")
+        meta["activation"] = "tanh" if act.startswith("tanh") else "rectifier"
+        meta["response_mean"] = float(model.datainfo.response_mean)
+        meta["response_sigma"] = float(model.datainfo.response_sigma)
+        for i, (W, b) in enumerate(o["weights"]):
+            arrays[f"W_{i}"] = np.asarray(W, np.float32)
+            arrays[f"b_{i}"] = np.asarray(b, np.float32)
+    elif algo == "kmeans":
+        meta["family"] = "kmeans"
+        arrays["centers_std"] = np.asarray(o["centers_std"], np.float64)
+    elif algo in ("pca", "svd"):
+        meta["family"] = "pca"
+        arrays["eigenvectors"] = np.asarray(
+            o.get("eigenvectors", o.get("v")), np.float64)
+        arrays["mu"] = np.asarray(o["_mu"], np.float64)
+        arrays["sd"] = np.asarray(o["_sd"], np.float64)
+    elif algo == "naivebayes":
+        meta["family"] = "naivebayes"
+        arrays["log_cat_table"] = np.asarray(o["_log_cat_table"])
+        arrays["log_prior"] = np.asarray(o["_log_prior"])
+        arrays["num_idx"] = np.asarray(o["_num_idx"])
+        arrays["num_mu"] = np.asarray(o["_num_mu"])
+        arrays["num_inv2var"] = np.asarray(o["_num_inv2var"])
+        arrays["num_logsd"] = np.asarray(o["_num_logsd"])
+    elif algo == "isotonicregression":
+        meta["family"] = "isotonic"
+        meta["feature"] = o["feature"]
+        meta["out_of_bounds"] = model.params.out_of_bounds
+        arrays["thresholds_x"] = np.asarray(o["thresholds_x"])
+        arrays["thresholds_y"] = np.asarray(o["thresholds_y"])
+    else:
+        raise ValueError(f"no portable export for algo {algo!r}")
+    return meta, arrays
+
+
+def export_mojo(model, path: str) -> str:
+    """Write the portable artifact — Model.download_mojo analog."""
+    meta, arrays = _extract(model)
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("model.json", json.dumps(meta, indent=1))
+        z.writestr("arrays.npz", buf.getvalue())
+    return path
+
+
+def import_mojo(path: str) -> ScoringModel:
+    """Load a portable artifact for offline scoring — MojoModel.load."""
+    with zipfile.ZipFile(path) as z:
+        meta = json.loads(z.read("model.json"))
+        npz = np.load(io.BytesIO(z.read("arrays.npz")))
+        arrays = {k: npz[k] for k in npz.files}
+    return ScoringModel(meta, arrays)

@@ -16,6 +16,15 @@ from .tree.xgboost import XGBoost, XGBoostModel, XGBoostParameters
 from .ensemble import (StackedEnsemble, StackedEnsembleModel,
                        StackedEnsembleParameters)
 from .grid import Grid, GridSearch
+from .adaboost import AdaBoost, AdaBoostModel, AdaBoostParameters
+from .targetencoder import (TargetEncoder, TargetEncoderModel,
+                            TargetEncoderParameters)
+from .glrm import GLRM, GLRMModel, GLRMParameters
+from .coxph import CoxPH, CoxPHModel, CoxPHParameters
+from .word2vec import Word2Vec, Word2VecModel, Word2VecParameters
+from .rulefit import RuleFit, RuleFitModel, RuleFitParameters
+from .aggregator import Aggregator, AggregatorModel, AggregatorParameters
+from .gam import GAM, GAMModel, GAMParameters
 from .tree.isofor import (IsolationForest, IsolationForestModel,
                           IsolationForestParameters,
                           ExtendedIsolationForest,

@@ -1,0 +1,110 @@
+"""AdaBoost: SAMME boosting of shallow tpu_hist trees.
+
+Reference: ``hex/adaboost/AdaBoost.java`` (h2o-algos) — binary AdaBoost with
+weak tree learners; per-iteration alpha from the weighted error, row weights
+multiplied by exp(+-alpha).
+
+TPU-native redesign: the weak learner is one shallow regression tree on the
+signed target fit through the same single-dispatch device build as GBM; the
+weight update / error reduction is one fused elementwise pass.  Scoring is
+the margin of the alpha-weighted stacked-tree traversal.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..frame.frame import Frame
+from ..runtime import dkv
+from ..runtime.job import Job
+from .base import ModelBuilder
+from .datainfo import DataInfo
+from .tree.binning import fit_bins, edges_matrix
+from .tree.shared import (SharedTree, SharedTreeModel, SharedTreeParameters,
+                          build_tree, stack_trees, traverse_jit)
+from ..metrics.core import make_metrics
+
+
+@dataclasses.dataclass
+class AdaBoostParameters(SharedTreeParameters):
+    nlearners: int = 50
+    max_depth: int = 3
+    learn_rate: float = 0.5          # shrinkage on alphas
+    min_rows: float = 5.0
+
+
+class AdaBoostModel(SharedTreeModel):
+    algo = "adaboost"
+
+    def _predict_raw(self, X: jax.Array) -> jax.Array:
+        levels, values = stack_trees(self.output["trees"])
+        margin = traverse_jit(levels, values, X)     # alphas folded in values
+        p1 = 1.0 / (1.0 + jnp.exp(-2.0 * margin))
+        return jnp.stack([1 - p1, p1], axis=1)
+
+
+class AdaBoost(SharedTree):
+    """AdaBoost builder — H2OAdaBoostEstimator analog (binary)."""
+
+    algo = "adaboost"
+    model_class = AdaBoostModel
+    _force_classification = True
+
+    def __init__(self, params: Optional[AdaBoostParameters] = None, **kw):
+        ModelBuilder.__init__(self, params or AdaBoostParameters(**kw))
+
+    def _fit(self, job: Job, frame: Frame, di: DataInfo,
+             valid: Optional[Frame]) -> AdaBoostModel:
+        p: AdaBoostParameters = self.params
+        if not di.is_classifier or di.nclasses != 2:
+            raise ValueError("adaboost requires a binary response")
+        y = di.response(frame)
+        w0 = di.weights(frame)
+        binned = fit_bins(frame, [s.name for s in di.specs], nbins=p.nbins,
+                          seed=p.effective_seed())
+        codes = binned.codes
+        edges_mat = jnp.asarray(edges_matrix(binned.edges, p.nbins),
+                                jnp.float32)
+        ysign = jnp.where(y > 0.5, 1.0, -1.0) * (w0 > 0)
+        rng = jax.random.PRNGKey(p.effective_seed())
+        D = w0 / jnp.maximum(jnp.sum(w0), 1e-12)
+
+        model = AdaBoostModel(job.dest_key or dkv.make_key(self.algo), p, di)
+        trees: List = []
+        for t in range(p.nlearners):
+            rng, k = jax.random.split(rng)
+            # regression weak learner on the signed target, weights D
+            tree, leaf = build_tree(
+                codes, -ysign * D, D, D, edges_mat, p.nbins, p.max_depth,
+                p.reg_lambda, p.min_rows / max(frame.nrows, 1),
+                p.min_split_improvement, 1.0, k, p.col_sample_rate, None,
+                hist_precision=p.hist_precision)
+            h = jnp.sign(jnp.asarray(tree.values)[leaf])
+            h = jnp.where(h == 0, 1.0, h)
+            err = jnp.sum(D * (h != ysign) * (w0 > 0))
+            err = jnp.clip(err, 1e-10, 1 - 1e-10)
+            alpha = 0.5 * jnp.log((1 - err) / err) * p.learn_rate
+            alpha_h = float(alpha)
+            if alpha_h <= 0:
+                break
+            # fold alpha into leaf signs so scoring is plain traversal
+            tree.values = np.sign(np.asarray(tree.values)) * alpha_h
+            tree.values[tree.values == 0] = alpha_h
+            trees.append(tree)
+            D = D * jnp.exp(-alpha * ysign * h)
+            D = D / jnp.maximum(jnp.sum(D), 1e-12)
+            job.update((t + 1) / p.nlearners,
+                       f"learner {t+1} err={float(err):.4f}")
+
+        model.output.update({"trees": trees, "ntrees_trained": len(trees),
+                             "nclass_trees": 1, "init_score": 0.0})
+        raw = model._predict_raw(model._design(frame))
+        model.training_metrics = make_metrics(di, raw, y, w0)
+        if valid is not None:
+            model.validation_metrics = model.model_performance(valid)
+        return model

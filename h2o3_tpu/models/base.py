@@ -88,6 +88,11 @@ class Model:
         """
         raise NotImplementedError
 
+    def _score_matrix(self, frame: Frame) -> jax.Array:
+        """The matrix ``_predict_raw`` expects.  Default: the standardized
+        one-hot design; tree models override with the raw-value design."""
+        return self.datainfo.make_matrix(frame)
+
     def predict(self, frame: Frame) -> Frame:
         """Score a frame — returns a Frame shaped like the reference's preds.
 
@@ -95,8 +100,8 @@ class Model:
         class.  Regression: single ``predict`` column.
         """
         di = self.datainfo
-        X = di.make_matrix(frame)
-        raw = np.asarray(self._predict_raw(X))[: frame.nrows]
+        raw = np.asarray(self._predict_raw(self._score_matrix(frame)))
+        raw = raw[: frame.nrows]
         if di.is_classifier:
             dom = di.response_domain
             labels = np.argmax(raw, axis=1)
@@ -121,8 +126,7 @@ class Model:
             return self.training_metrics
         from ..metrics.core import make_metrics
         di = self.datainfo
-        X = di.make_matrix(frame)
-        raw = self._predict_raw(X)
+        raw = self._predict_raw(self._score_matrix(frame))
         y = di.response(frame)
         w = di.weights(frame)
         return make_metrics(di, raw, y, w, distribution=getattr(
@@ -136,6 +140,11 @@ class Model:
         with open(path, "wb") as f:
             pickle.dump((type(self), state), f)
         return path
+
+    def download_mojo(self, path: str) -> str:
+        """Export the portable scoring artifact (MOJO analog)."""
+        from ..export.mojo import export_mojo
+        return export_mojo(self, path)
 
     @staticmethod
     def load(path: str) -> "Model":

@@ -91,7 +91,7 @@ def cross_validate(builder, job: Job, frame: Frame, di, valid):
             lambda j: fold_builder._fit(j, fold_frame, fold_di, None))
         cv_models.append(m)
         if X_full is None:
-            X_full = di.make_matrix(frame)
+            X_full = m._score_matrix(frame)
         hold_idx = np.nonzero(folds == f)[0]
         raw = np.asarray(m._predict_raw(X_full))[: frame.nrows]
         holdout[hold_idx] = raw.reshape(frame.nrows, width)[hold_idx]

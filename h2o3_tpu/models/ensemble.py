@@ -61,7 +61,7 @@ class StackedEnsembleModel(Model):
         cols = {}
         for key in self.output["base_model_keys"]:
             bm = _resolve(key)
-            X = bm.datainfo.make_matrix(frame)
+            X = bm._score_matrix(frame)
             raw = np.asarray(bm._predict_raw(X))[: frame.nrows]
             raw = raw.reshape(frame.nrows, -1)
             for i, col in enumerate(_base_columns(bm, raw)):
@@ -147,7 +147,7 @@ class StackedEnsemble(ModelBuilder):
         cols = {}
         for bm in base:
             if p.blending_frame is not None:
-                X = bm.datainfo.make_matrix(lf_frame)
+                X = bm._score_matrix(lf_frame)
                 raw = np.asarray(bm._predict_raw(X))[: lf_frame.nrows]
             else:
                 raw = np.asarray(bm.cv_predictions)

@@ -1,0 +1,170 @@
+"""GLRM: generalized low-rank model via alternating least squares on MXU.
+
+Reference: ``hex/glrm/GLRM.java:52`` — alternating minimization of
+loss(A, XY) + gamma_x rx(X) + gamma_y ry(Y), X held as extra vecs across the
+cluster; quadratic and many other losses/regularizers.
+
+TPU-native redesign: quadratic loss + ridge regularizers have closed-form
+alternating solves — each iteration is two tall-skinny matmuls plus a [k,k]
+host Cholesky (X update row-parallel over the mesh, Y update feature-
+parallel).  Missing cells are mean-imputed into the standardized design
+before factorization (the reference's em-style impute start).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..frame.frame import Frame
+from ..frame.vec import Vec, T_NUM
+from ..runtime import dkv
+from ..runtime.job import Job
+from .base import Model, ModelBuilder, Parameters
+from .datainfo import DataInfo
+from .pca import _transform_flags
+
+
+@dataclasses.dataclass
+class GLRMParameters(Parameters):
+    k: int = 1
+    gamma_x: float = 0.0
+    gamma_y: float = 0.0
+    transform: str = "none"
+    max_iterations: int = 100
+    init: str = "svd"                  # svd | random
+    recover_svd: bool = False
+
+
+class GLRMModel(Model):
+    algo = "glrm"
+
+    def _predict_raw(self, X):
+        raise NotImplementedError("glrm reconstructs via transform()")
+
+    def transform(self, frame: Frame) -> Frame:
+        """Project new rows onto the archetypes -> X factor frame."""
+        Xt = self._std(frame)
+        Y = jnp.asarray(self.output["archetypes"], jnp.float32)
+        G = Y @ Y.T + self.params.gamma_x * jnp.eye(Y.shape[0])
+        Xf = np.asarray(Xt @ Y.T @ jnp.linalg.inv(G))[: frame.nrows]
+        return Frame([f"Arch{i+1}" for i in range(Xf.shape[1])],
+                     [Vec.from_numpy(Xf[:, i].astype(np.float64), T_NUM)
+                      for i in range(Xf.shape[1])])
+
+    def reconstruct(self, frame: Frame) -> Frame:
+        Xf = self.transform(frame)
+        Xm = np.stack([v.to_numpy() for v in Xf.vecs], axis=1)
+        Y = np.asarray(self.output["archetypes"])
+        R = Xm @ Y
+        mu = np.asarray(self.output["_mu"])
+        sd = np.asarray(self.output["_sd"])
+        R = R / np.where(sd == 0, 1, sd)[None, :] + mu[None, :]
+        names = self.output["feature_names"]
+        return Frame([f"reconstr_{n}" for n in names],
+                     [Vec.from_numpy(R[:, i], T_NUM)
+                      for i in range(R.shape[1])])
+
+    def _std(self, frame: Frame) -> jax.Array:
+        di = self.datainfo
+        X = di.make_matrix(frame, standardize=False)
+        mu = jnp.asarray(self.output["_mu"], jnp.float32)
+        sd = jnp.asarray(self.output["_sd"], jnp.float32)
+        return (X - mu[None, :]) * sd[None, :]
+
+    def model_performance(self, frame: Optional[Frame] = None):
+        if frame is None:
+            return self.training_metrics
+        Xt = self._std(frame)
+        Y = jnp.asarray(self.output["archetypes"], jnp.float32)
+        G = Y @ Y.T + self.params.gamma_x * jnp.eye(Y.shape[0])
+        Xf = Xt @ Y.T @ jnp.linalg.inv(G)
+        R = Xt - Xf @ Y
+        w = self.datainfo.weights(frame)
+        return {"objective": float(jnp.sum(jnp.sum(R * R, axis=1) * w))}
+
+
+class GLRM(ModelBuilder):
+    """GLRM builder — H2OGeneralizedLowRankEstimator analog (quadratic)."""
+
+    algo = "glrm"
+    model_class = GLRMModel
+    supervised = False
+
+    def __init__(self, params: Optional[GLRMParameters] = None, **kw):
+        super().__init__(params or GLRMParameters(**kw))
+
+    def _make_datainfo(self, frame: Frame) -> DataInfo:
+        p = self.params
+        return DataInfo.fit(
+            frame, response_column=None, ignored_columns=p.ignored_columns,
+            standardize=False, use_all_factor_levels=True,
+            add_intercept=False,
+            missing_values_handling=p.missing_values_handling)
+
+    def _fit(self, job: Job, frame: Frame, di: DataInfo,
+             valid: Optional[Frame]) -> GLRMModel:
+        p: GLRMParameters = self.params
+        k = min(p.k, di.nfeatures)
+        X0 = di.make_matrix(frame, standardize=False)
+        w = di.weights(frame)
+        n = jnp.maximum(jnp.sum(w), 1.0)
+        mu = jnp.sum(X0 * w[:, None], axis=0) / n
+        var = jnp.sum((X0 - mu[None, :]) ** 2 * w[:, None], axis=0) \
+            / jnp.maximum(n - 1.0, 1.0)
+        demean, descale = _transform_flags(p.transform)
+        mu_t = mu if demean else jnp.zeros_like(mu)
+        sd_t = jnp.where(var > 0, 1.0 / jnp.sqrt(var), 1.0) if descale \
+            else jnp.ones_like(var)
+        A = (X0 - mu_t[None, :]) * sd_t[None, :] * (w[:, None] > 0)
+
+        rng = np.random.default_rng(p.effective_seed())
+        if p.init == "svd":
+            G = np.asarray(A.T @ A, np.float64)
+            vals, vecs = np.linalg.eigh(G)
+            Y = vecs[:, np.argsort(vals)[::-1][:k]].T
+        else:
+            Y = rng.normal(size=(k, di.nfeatures)) / np.sqrt(k)
+        Y = jnp.asarray(Y, jnp.float32)
+
+        Ik = jnp.eye(k, dtype=jnp.float32)
+
+        @jax.jit
+        def step(Y):
+            Gx = Y @ Y.T + p.gamma_x * Ik
+            X = A @ Y.T @ jnp.linalg.inv(Gx)
+            Gy = X.T @ X + p.gamma_y * Ik
+            Y2 = jnp.linalg.inv(Gy) @ (X.T @ A)
+            R = A - X @ Y2
+            obj = jnp.sum(R * R) + p.gamma_x * jnp.sum(X * X) \
+                + p.gamma_y * jnp.sum(Y2 * Y2)
+            return X, Y2, obj
+
+        prev = np.inf
+        for it in range(p.max_iterations):
+            X, Y, obj = step(Y)
+            obj = float(obj)
+            job.update(it / p.max_iterations, f"iter={it} obj={obj:.5g}")
+            if prev - obj < 1e-7 * max(abs(prev), 1.0):
+                break
+            prev = obj
+
+        model = GLRMModel(job.dest_key or dkv.make_key(self.algo), p, di)
+        model.output.update({
+            "archetypes": np.asarray(Y, np.float64),
+            "objective": obj,
+            "iterations": it + 1,
+            "feature_names": di.coef_names,
+            "_mu": np.asarray(mu_t, np.float64),
+            "_sd": np.asarray(sd_t, np.float64),
+        })
+        if p.recover_svd:
+            Xh = np.asarray(X, np.float64)
+            u, s, vt = np.linalg.svd(Xh @ np.asarray(Y), full_matrices=False)
+            model.output["singular_values"] = s[:k]
+        model.training_metrics = {"objective": obj}
+        return model

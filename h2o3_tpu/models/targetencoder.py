@@ -1,0 +1,165 @@
+"""Target encoding: CV-aware categorical mean-target transform.
+
+Reference: ``h2o-extensions/target-encoder`` —
+``ai/h2o/targetencoding/TargetEncoder.java:23``: per-level response means
+with blending (k/f smoothing toward the prior), leave-one-out / k-fold
+holdout strategies to avoid leakage, optional noise; both a ModelBuilder
+and an AutoML preprocessor.
+
+TPU-native redesign: per-level sums are one one-hot matmul per column
+(level counts and response sums from the same product); holdout corrections
+are elementwise.  The fitted state is a small host-side table per column.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..frame.frame import Frame
+from ..frame.vec import Vec, T_CAT, T_NUM
+from ..runtime import dkv
+from ..runtime.job import Job
+from .base import Model, ModelBuilder, Parameters
+from .datainfo import DataInfo
+
+
+@dataclasses.dataclass
+class TargetEncoderParameters(Parameters):
+    columns: Optional[List[str]] = None        # None -> all cat features
+    data_leakage_handling: str = "none"        # none | leave_one_out | k_fold
+    blending: bool = True
+    inflection_point: float = 10.0             # k in k/f smoothing
+    smoothing: float = 20.0                    # f
+    noise: float = 0.0
+    fold_column: Optional[str] = None
+
+
+class TargetEncoderModel(Model):
+    algo = "targetencoder"
+
+    def transform(self, frame: Frame, as_training: bool = False) -> Frame:
+        """Append ``<col>_te`` columns (training mode applies holdout)."""
+        p: TargetEncoderParameters = self.params
+        tables = self.output["encoding_tables"]
+        prior = self.output["prior_mean"]
+        names = list(frame.names)
+        vecs = list(frame.vecs)
+        rng = np.random.default_rng(self.params.effective_seed())
+        y = wrow = folds = None
+        if as_training and p.data_leakage_handling == "leave_one_out":
+            y = np.asarray(self.datainfo.response(frame))[: frame.nrows]
+            wrow = np.ones(frame.nrows)
+            if p.weights_column and p.weights_column in frame.names:
+                wrow = np.nan_to_num(
+                    frame.vec(p.weights_column).to_numpy())
+        if as_training and p.data_leakage_handling == "k_fold":
+            if p.fold_column is None or p.fold_column not in frame.names:
+                raise ValueError(
+                    "k_fold leakage handling requires fold_column")
+            fc = frame.vec(p.fold_column).to_numpy()
+            fold_ids = self.output["fold_ids"]
+            lookup = {f: i for i, f in enumerate(fold_ids)}
+            folds = np.asarray([lookup.get(f, -1) for f in fc])
+        for col, tbl in tables.items():
+            if col not in frame.names:
+                continue
+            v = frame.vec(col)
+            codes = v.to_numpy() if v.type == T_CAT else \
+                v.to_numpy().astype(np.int64)
+            sums = tbl["sums"]
+            counts = tbl["counts"]
+            s = np.where((codes >= 0) & (codes < len(sums)),
+                         sums[np.clip(codes, 0, len(sums) - 1)], 0.0)
+            c = np.where((codes >= 0) & (codes < len(counts)),
+                         counts[np.clip(codes, 0, len(counts) - 1)], 0.0)
+            if y is not None:               # leave-one-out (weight-aware)
+                s = s - np.nan_to_num(y) * wrow
+                c = np.maximum(c - wrow, 0)
+            if folds is not None:           # k_fold: drop own fold's stats
+                fs = tbl["fold_sums"]       # [nfolds, K]
+                fcnt = tbl["fold_counts"]
+                cc = np.clip(codes, 0, len(sums) - 1)
+                ff = np.clip(folds, 0, len(fs) - 1)
+                own_s = np.where((codes >= 0) & (folds >= 0),
+                                 fs[ff, cc], 0.0)
+                own_c = np.where((codes >= 0) & (folds >= 0),
+                                 fcnt[ff, cc], 0.0)
+                s = s - own_s
+                c = np.maximum(c - own_c, 0)
+            mean = np.where(c > 0, s / np.maximum(c, 1e-12), prior)
+            if p.blending:
+                lam = 1.0 / (1.0 + np.exp(-(c - p.inflection_point)
+                                          / max(p.smoothing, 1e-6)))
+                mean = lam * mean + (1 - lam) * prior
+            if as_training and p.noise > 0:
+                mean = mean + rng.uniform(-p.noise, p.noise, len(mean))
+            names.append(f"{col}_te")
+            vecs.append(Vec.from_numpy(mean, T_NUM))
+        return Frame(names, vecs)
+
+    def _predict_raw(self, X):
+        raise NotImplementedError("targetencoder transforms, not predicts")
+
+    def model_performance(self, frame=None):
+        return self.training_metrics
+
+
+class TargetEncoder(ModelBuilder):
+    """TE builder — H2OTargetEncoderEstimator analog."""
+
+    algo = "targetencoder"
+    model_class = TargetEncoderModel
+
+    def __init__(self, params: Optional[TargetEncoderParameters] = None,
+                 **kw):
+        super().__init__(params or TargetEncoderParameters(**kw))
+
+    def _fit(self, job: Job, frame: Frame, di: DataInfo,
+             valid: Optional[Frame]) -> TargetEncoderModel:
+        p: TargetEncoderParameters = self.params
+        y = di.response(frame)
+        w = di.weights(frame)
+        yz = jnp.nan_to_num(y)
+        cols = p.columns or [s.name for s in di.specs if s.type == T_CAT]
+        fold_ids = []
+        fold_mask = None
+        if p.data_leakage_handling == "k_fold" and p.fold_column:
+            fc = frame.vec(p.fold_column).to_numpy()
+            fold_ids = sorted(set(fc.tolist()))
+            pad = frame.padded_rows - frame.nrows
+            fm = np.stack([(fc == f) for f in fold_ids]).astype(np.float32)
+            fold_mask = jnp.asarray(np.pad(fm, [(0, 0), (0, pad)]))
+        tables: Dict[str, dict] = {}
+        for i, col in enumerate(cols):
+            v = frame.vec(col)
+            if v.type != T_CAT:
+                continue
+            K = len(v.domain or [])
+            codes = v.data
+            ok = (codes >= 0).astype(jnp.float32) * w
+            onehot = jax.nn.one_hot(jnp.clip(codes, 0, K - 1), K,
+                                    dtype=jnp.float32) * ok[:, None]
+            sums = np.asarray(yz @ onehot, np.float64)
+            counts = np.asarray(jnp.sum(onehot, axis=0), np.float64)
+            tables[col] = {"sums": sums, "counts": counts,
+                           "domain": list(v.domain or [])}
+            if fold_mask is not None:
+                tables[col]["fold_sums"] = np.asarray(
+                    (fold_mask * yz[None, :]) @ onehot, np.float64)
+                tables[col]["fold_counts"] = np.asarray(
+                    fold_mask @ onehot, np.float64)
+            job.update((i + 1) / max(len(cols), 1), f"encoding {col}")
+        n = float(jnp.sum(w))
+        prior = float(jnp.sum(yz * w)) / max(n, 1e-12)
+        model = TargetEncoderModel(job.dest_key or dkv.make_key(self.algo),
+                                   p, di)
+        model.output.update({"encoding_tables": tables, "prior_mean": prior,
+                             "fold_ids": fold_ids})
+        model.training_metrics = {"columns": list(tables),
+                                  "prior_mean": prior}
+        return model

@@ -222,6 +222,9 @@ def build_tree(codes, g, h, w, edges, nbins: int, max_depth: int,
 class SharedTreeModel(Model):
     """Tree-ensemble model: scores via compiled stacked-tree traversal."""
 
+    def _score_matrix(self, frame: Frame) -> jax.Array:
+        return self._design(frame)
+
     def _design(self, frame: Frame) -> jax.Array:
         """Raw-value matrix [padded, F]: numerics as-is, cats as codes."""
         di = self.datainfo

@@ -1,0 +1,217 @@
+"""Word2Vec: skip-gram with negative sampling as embedding matmuls.
+
+Reference: ``hex/word2vec/Word2Vec.java:15`` — distributed skip-gram with
+per-node training and model averaging (the DL Hogwild pattern); input is a
+string column of words, sentences delimited by NA rows.
+
+TPU-native redesign: pair generation (windows, vocabulary, unigram^0.75
+negative table) is host-side; training is minibatched SGNS on device — each
+step gathers [B, D] center/context/negative embeddings, computes the
+sigmoid losses, and scatter-adds the updates (jnp .at[].add), all in one
+jit.  Synchronous minibatch SGD replaces Hogwild (SURVEY.md §2.10).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..frame.frame import Frame
+from ..frame.vec import Vec, T_NUM, T_STR
+from ..runtime import dkv
+from ..runtime.job import Job
+from .base import Model, ModelBuilder, Parameters
+from .datainfo import DataInfo
+
+
+@dataclasses.dataclass
+class Word2VecParameters(Parameters):
+    vec_size: int = 100
+    window_size: int = 5
+    min_word_freq: int = 5
+    epochs: int = 5
+    learn_rate: float = 0.025       # init_learning_rate
+    negative_samples: int = 5
+    sent_sample_rate: float = 1e-3  # frequent-word subsampling
+    batch_size: int = 8192
+
+
+@jax.jit
+def _sgns_step(U, V, center, context, neg, lr):
+    """One SGNS minibatch: returns updated (U, V)."""
+    u = U[center]                                  # [B, D]
+    vpos = V[context]                              # [B, D]
+    vneg = V[neg]                                  # [B, k, D]
+    spos = jax.nn.sigmoid(jnp.sum(u * vpos, axis=1))         # [B]
+    sneg = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", u, vneg))  # [B, k]
+    gpos = (spos - 1.0)[:, None]                   # dL/d(u.vpos)
+    gneg = sneg[:, :, None]                        # dL/d(u.vneg)
+    du = gpos * vpos + jnp.einsum("bk,bkd->bd", sneg, vneg)
+    U = U.at[center].add(-lr * du)
+    V = V.at[context].add(-lr * gpos * u)
+    V = V.at[neg].add(-lr * gneg * u[:, None, :])
+    return U, V
+
+
+class Word2VecModel(Model):
+    algo = "word2vec"
+
+    def find_synonyms(self, word: str, count: int = 10) -> Dict[str, float]:
+        vocab: Dict[str, int] = self.output["vocab"]
+        if word not in vocab:
+            return {}
+        E = self.output["embeddings"]
+        v = E[vocab[word]]
+        sims = E @ v / (np.linalg.norm(E, axis=1) * np.linalg.norm(v) + 1e-12)
+        order = np.argsort(sims)[::-1]
+        words = self.output["words"]
+        out = {}
+        for i in order:
+            if words[i] != word:
+                out[words[i]] = float(sims[i])
+            if len(out) >= count:
+                break
+        return out
+
+    def transform(self, frame: Frame, aggregate_method: str = "none"):
+        """Word -> embedding frame; 'average' pools NA-delimited sequences."""
+        vocab = self.output["vocab"]
+        E = self.output["embeddings"]
+        col = frame.vecs[0]
+        words = col.host_data if col.data is None else col.decoded()
+        D = E.shape[1]
+        if aggregate_method == "none":
+            M = np.zeros((frame.nrows, D))
+            for i, wd in enumerate(words):
+                j = vocab.get(str(wd), -1)
+                M[i] = E[j] if j >= 0 else np.nan
+        else:
+            seqs, cur = [], []
+            for wd in words:
+                if wd is None or (isinstance(wd, float) and np.isnan(wd)):
+                    seqs.append(cur)
+                    cur = []
+                else:
+                    cur.append(str(wd))
+            seqs.append(cur)
+            seqs = [s for s in seqs if s]
+            M = np.zeros((len(seqs), D))
+            for i, s in enumerate(seqs):
+                vs = [E[vocab[wd]] for wd in s if wd in vocab]
+                M[i] = np.mean(vs, axis=0) if vs else np.nan
+        return Frame([f"C{i+1}" for i in range(D)],
+                     [Vec.from_numpy(M[:, i], T_NUM) for i in range(D)])
+
+    def _predict_raw(self, X):
+        raise NotImplementedError("word2vec transforms, not predicts")
+
+    def model_performance(self, frame=None):
+        return self.training_metrics
+
+
+class Word2Vec(ModelBuilder):
+    """Word2Vec builder — H2OWord2vecEstimator analog."""
+
+    algo = "word2vec"
+    model_class = Word2VecModel
+    supervised = False
+
+    def __init__(self, params: Optional[Word2VecParameters] = None, **kw):
+        super().__init__(params or Word2VecParameters(**kw))
+
+    def _validate(self, frame: Frame) -> None:
+        if frame.ncols != 1:
+            raise ValueError("word2vec expects a single words column")
+
+    def _make_datainfo(self, frame: Frame) -> DataInfo:
+        return None                      # no tabular featurization
+
+    def _fit(self, job: Job, frame: Frame, di, valid) -> Word2VecModel:
+        p: Word2VecParameters = self.params
+        col = frame.vecs[0]
+        raw = col.host_data if col.data is None else col.decoded()
+        rng = np.random.default_rng(p.effective_seed())
+
+        # vocabulary (NA rows delimit sentences)
+        sents: List[List[str]] = []
+        cur: List[str] = []
+        for wd in raw:
+            if wd is None or (isinstance(wd, float) and np.isnan(wd)):
+                if cur:
+                    sents.append(cur)
+                cur = []
+            else:
+                cur.append(str(wd))
+        if cur:
+            sents.append(cur)
+        freq: Dict[str, int] = {}
+        for s in sents:
+            for wd in s:
+                freq[wd] = freq.get(wd, 0) + 1
+        words = sorted([w for w, c in freq.items() if c >= p.min_word_freq])
+        vocab = {w: i for i, w in enumerate(words)}
+        V = len(words)
+        if V < 2:
+            raise ValueError("word2vec: vocabulary too small "
+                             f"(min_word_freq={p.min_word_freq})")
+        counts = np.array([freq[w] for w in words], np.float64)
+        total = counts.sum()
+        # subsample frequent words (word2vec's t-threshold)
+        keep_p = np.minimum(
+            1.0, np.sqrt(p.sent_sample_rate / (counts / total))
+            + p.sent_sample_rate / (counts / total))
+        neg_table = counts ** 0.75
+        neg_table /= neg_table.sum()
+
+        # generate skip-gram pairs host-side
+        centers, contexts = [], []
+        for s in sents:
+            ids = [vocab[wd] for wd in s if wd in vocab
+                   and rng.random() < keep_p[vocab[wd]]]
+            for i, c in enumerate(ids):
+                win = rng.integers(1, p.window_size + 1)
+                for j in range(max(0, i - win), min(len(ids), i + win + 1)):
+                    if j != i:
+                        centers.append(c)
+                        contexts.append(ids[j])
+        if not centers:
+            raise ValueError("word2vec: no training pairs generated")
+        centers = np.asarray(centers, np.int32)
+        contexts = np.asarray(contexts, np.int32)
+
+        D = p.vec_size
+        U = jnp.asarray(rng.uniform(-0.5 / D, 0.5 / D, (V, D)), jnp.float32)
+        Vc = jnp.zeros((V, D), jnp.float32)
+        B = min(p.batch_size, len(centers))
+        npairs = len(centers)
+        steps_per_epoch = max(npairs // B, 1)
+        total_steps = int(p.epochs) * steps_per_epoch
+        step_i = 0
+        for epoch in range(int(p.epochs)):
+            perm = rng.permutation(npairs)
+            for b in range(steps_per_epoch):
+                sl = perm[b * B:(b + 1) * B]
+                if len(sl) < B:
+                    sl = np.concatenate([sl, perm[: B - len(sl)]])
+                neg = rng.choice(V, size=(B, p.negative_samples),
+                                 p=neg_table).astype(np.int32)
+                lr = p.learn_rate * max(
+                    1e-4, 1.0 - step_i / max(total_steps, 1))
+                U, Vc = _sgns_step(U, Vc, jnp.asarray(centers[sl]),
+                                   jnp.asarray(contexts[sl]),
+                                   jnp.asarray(neg), lr)
+                step_i += 1
+            job.update((epoch + 1) / p.epochs, f"epoch {epoch + 1}")
+
+        model = Word2VecModel(job.dest_key or dkv.make_key(self.algo), p, di)
+        model.output.update({
+            "embeddings": np.asarray(U, np.float64),
+            "vocab": vocab, "words": words, "vocab_size": V,
+            "pairs_trained": npairs * int(p.epochs),
+        })
+        model.training_metrics = {"vocab_size": V, "pairs": npairs}
+        return model

@@ -1,0 +1,172 @@
+"""Wave-2 algorithm tests: AdaBoost, TargetEncoder, GLRM, CoxPH, Word2Vec,
+RuleFit, Aggregator, GAM — golden checks against closed forms / known
+structure (testdir_algos pyunit strategy)."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu import Frame
+from h2o3_tpu.models import (AdaBoost, TargetEncoder, GLRM, CoxPH, Word2Vec,
+                             RuleFit, Aggregator, GAM)
+
+
+def test_adaboost_binary(cl, rng):
+    n = 2000
+    X = rng.normal(size=(n, 4))
+    y = (X[:, 0] + 0.5 * X[:, 1] ** 2 - 0.5 > 0)
+    cols = {f"x{j}": X[:, j] for j in range(4)}
+    cols["y"] = np.where(y, "yes", "no").astype(object)
+    fr = Frame.from_numpy(cols)
+    m = AdaBoost(response_column="y", nlearners=30, seed=1).train(fr)
+    assert m.training_metrics.auc > 0.9
+    pred = m.predict(fr)
+    assert set(np.unique(pred.vecs[0].decoded())) <= {"yes", "no"}
+
+
+def test_target_encoder(cl, rng):
+    n = 3000
+    g = rng.integers(0, 8, n)
+    noise = 0.1 * rng.normal(size=n)
+    y = g * 0.5 + noise
+    fr = Frame.from_numpy({
+        "c": np.array([f"lv{i}" for i in range(8)], dtype=object)[g],
+        "y": y})
+    te = TargetEncoder(response_column="y", blending=False).train(fr)
+    out = te.transform(fr)
+    assert "c_te" in out.names
+    enc = out.vec("c_te").to_numpy()
+    for lvl in range(8):
+        seg = enc[g == lvl]
+        assert np.allclose(seg, y[g == lvl].mean(), atol=1e-5)
+    # blending pulls rare levels toward the prior
+    te_b = TargetEncoder(response_column="y", blending=True,
+                         inflection_point=10000).train(fr)
+    enc_b = te_b.transform(fr).vec("c_te").to_numpy()
+    prior = y.mean()
+    assert np.all(np.abs(enc_b - prior) < np.abs(enc - prior) + 1e-9)
+
+
+def test_target_encoder_holdout_modes(cl, rng):
+    n = 1200
+    g = rng.integers(0, 4, n)
+    y = g * 1.0 + 0.1 * rng.normal(size=n)
+    folds = rng.integers(0, 3, n).astype(np.float64)
+    fr = Frame.from_numpy({
+        "c": np.array([f"l{i}" for i in range(4)], dtype=object)[g],
+        "fold": folds, "y": y})
+    # leave-one-out: row's own y must not contribute
+    te = TargetEncoder(response_column="y", blending=False,
+                       data_leakage_handling="leave_one_out",
+                       ignored_columns=["fold"]).train(fr)
+    enc = te.transform(fr, as_training=True).vec("c_te").to_numpy()
+    for i in range(30):
+        seg = y[(g == g[i])]
+        loo = (seg.sum() - y[i]) / (len(seg) - 1)
+        assert enc[i] == pytest.approx(loo, rel=1e-6)
+    # k_fold: encoding excludes the row's own fold entirely
+    te2 = TargetEncoder(response_column="y", blending=False,
+                        data_leakage_handling="k_fold", fold_column="fold",
+                        ignored_columns=["fold"]).train(fr)
+    enc2 = te2.transform(fr, as_training=True).vec("c_te").to_numpy()
+    for i in range(30):
+        mask = (g == g[i]) & (folds != folds[i])
+        expect = y[mask].mean()
+        assert enc2[i] == pytest.approx(expect, rel=1e-6)
+
+
+def test_glrm_low_rank_recovery(cl, rng):
+    n, p, k = 800, 8, 3
+    A = rng.normal(size=(n, k)) @ rng.normal(size=(k, p))
+    fr = Frame.from_numpy({f"c{i}": A[:, i] for i in range(p)})
+    m = GLRM(k=k, max_iterations=50, seed=1).train(fr)
+    assert m.output["objective"] < 1e-4 * (A ** 2).sum()
+    rec = m.reconstruct(fr)
+    R = np.stack([v.to_numpy() for v in rec.vecs], axis=1)
+    assert np.abs(R - A).max() < 0.05 * np.abs(A).max() + 1e-3
+
+
+def test_coxph_recovers_hazard_ratio(cl, rng):
+    n = 3000
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    beta_true = np.array([0.8, -0.5])
+    lam = np.exp(x1 * beta_true[0] + x2 * beta_true[1])
+    t = rng.exponential(1.0 / lam)
+    cens = rng.exponential(2.0, n)
+    time = np.minimum(t, cens)
+    event = (t <= cens).astype(np.float64)
+    fr = Frame.from_numpy({"x1": x1, "x2": x2, "time": time,
+                           "event": event})
+    m = CoxPH(stop_column="time", event_column="event",
+              standardize=False).train(fr)
+    coef = m.output["coef"]
+    assert abs(coef["x1"] - 0.8) < 0.1
+    assert abs(coef["x2"] + 0.5) < 0.1
+    assert m.training_metrics["concordance"] > 0.6
+
+
+def test_word2vec_synonyms(cl, rng):
+    # two topic clusters of co-occurring words
+    topics = [["cat", "dog", "pet", "animal"],
+              ["car", "road", "drive", "wheel"]]
+    words = []
+    for _ in range(400):
+        topic = topics[rng.integers(0, 2)]
+        sent = [topic[i] for i in rng.integers(0, 4, 6)]
+        words.extend(sent)
+        words.append(None)
+    fr = Frame.from_numpy({"words": np.array(words, dtype=object)},
+                          types={"words": "str"})
+    m = Word2Vec(vec_size=16, epochs=15, min_word_freq=2, seed=3,
+                 window_size=3, sent_sample_rate=1.0).train(fr)
+    assert m.output["vocab_size"] == 8
+    syn = m.find_synonyms("cat", 3)
+    assert set(syn) <= {"dog", "pet", "animal"}, syn
+    emb = m.transform(fr, aggregate_method="none")
+    assert emb.ncols == 16
+
+
+def test_rulefit(cl, rng):
+    n = 2000
+    X = rng.normal(size=(n, 3))
+    y = np.where((X[:, 0] > 0) & (X[:, 1] > 0), 2.0, 0.0) \
+        + 0.05 * rng.normal(size=n)
+    cols = {f"x{j}": X[:, j] for j in range(3)}
+    cols["y"] = y
+    fr = Frame.from_numpy(cols)
+    m = RuleFit(response_column="y", rule_generation_ntrees=10,
+                max_rule_length=2, seed=1).train(fr)
+    assert m.training_metrics.rmse < 0.5
+    imp = m.rule_importance()
+    assert len(imp) > 0
+    assert "rule" in imp[0] or imp[0]["variable"].startswith("linear")
+    pred = m.predict(fr).vecs[0].to_numpy()
+    assert np.corrcoef(pred, y)[0, 1] > 0.9
+
+
+def test_aggregator(cl, rng):
+    n = 5000
+    fr = Frame.from_numpy({"x": rng.normal(size=n),
+                           "y": rng.normal(size=n)})
+    m = Aggregator(target_num_exemplars=50, seed=1).train(fr)
+    agg = m.aggregated_frame
+    assert 1 < agg.nrows <= 50
+    counts = agg.vec("counts").to_numpy()
+    assert counts.sum() == pytest.approx(n)
+
+
+def test_gam_fits_nonlinear(cl, rng):
+    n = 3000
+    x = rng.uniform(-3, 3, n)
+    z = rng.normal(size=n)
+    y = np.sin(x) * 2 + 0.5 * z + 0.1 * rng.normal(size=n)
+    fr = Frame.from_numpy({"x": x, "z": z, "y": y})
+    glm_rmse = None
+    from h2o3_tpu.models import GLM
+    glm = GLM(response_column="y", lambda_=1e-6).train(fr)
+    glm_rmse = glm.training_metrics.rmse
+    m = GAM(response_column="y", gam_columns=["x"], num_knots=8,
+            seed=1).train(fr)
+    assert m.training_metrics.rmse < 0.5 * glm_rmse
+    pred = m.predict(fr).vecs[0].to_numpy()
+    assert np.corrcoef(pred, y)[0, 1] > 0.95

@@ -1,0 +1,133 @@
+"""Portable-artifact round-trip tests: in-cluster predict == offline scorer.
+
+Mirrors the reference's testdir_javapredict strategy: train in the cluster,
+export the artifact, score with the standalone (numpy-only) library, compare.
+"""
+
+import sys
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu import Frame
+from h2o3_tpu.models import (GLM, GBM, DRF, XGBoost, DeepLearning, KMeans,
+                             NaiveBayes, PCA, IsotonicRegression,
+                             IsolationForest)
+
+
+def _frames(rng, n=800):
+    X = rng.normal(size=(n, 3))
+    cat = np.array(["u", "v", "w"], dtype=object)[rng.integers(0, 3, n)]
+    y_num = X @ [1.0, -2.0, 0.5] + (cat == "v") * 1.5 + 0.1 * rng.normal(size=n)
+    y_bin = np.where(y_num > 0, "yes", "no").astype(object)
+    cols = {"x0": X[:, 0], "x1": X[:, 1], "x2": X[:, 2], "c": cat}
+    data = dict(cols)
+    return (Frame.from_numpy({**cols, "y": y_num}),
+            Frame.from_numpy({**cols, "y": y_bin}), data)
+
+
+def _roundtrip(model, frame, data, tmp_path, atol=2e-4):
+    path = model.download_mojo(str(tmp_path / f"{model.algo}.zip"))
+    sm = h2o3_tpu.import_mojo(path)
+    assert "jax" not in type(sm).__module__
+    out = sm.predict(data)
+    pred = model.predict(frame)
+    if model.datainfo.is_classifier:
+        probs = np.stack([v.to_numpy() for v in pred.vecs[1:]], axis=1)
+        np.testing.assert_allclose(out["probabilities"], probs, atol=atol)
+        assert (out["predict"] == pred.vecs[0].decoded()).mean() > 0.999
+    else:
+        np.testing.assert_allclose(out["predict"],
+                                   pred.vecs[0].to_numpy(), atol=atol,
+                                   rtol=1e-4)
+    return sm
+
+
+def test_glm_mojo(cl, rng, tmp_path):
+    fr_num, fr_bin, data = _frames(rng)
+    _roundtrip(GLM(response_column="y", lambda_=1e-4).train(fr_num),
+               fr_num, data, tmp_path)
+    _roundtrip(GLM(response_column="y", family="binomial",
+                   lambda_=1e-4).train(fr_bin), fr_bin, data, tmp_path)
+
+
+def test_tree_mojos(cl, rng, tmp_path):
+    fr_num, fr_bin, data = _frames(rng)
+    _roundtrip(GBM(response_column="y", ntrees=10, seed=1).train(fr_num),
+               fr_num, data, tmp_path)
+    _roundtrip(XGBoost(response_column="y", ntrees=10, seed=1).train(fr_bin),
+               fr_bin, data, tmp_path)
+    _roundtrip(DRF(response_column="y", ntrees=10, seed=1,
+                   max_depth=6).train(fr_bin), fr_bin, data, tmp_path)
+
+
+def test_tree_mojo_multinomial(cl, rng, tmp_path):
+    n = 600
+    X = rng.normal(size=(n, 3))
+    cls = np.argmax(X + 0.2 * rng.normal(size=(n, 3)), axis=1)
+    cols = {f"x{j}": X[:, j] for j in range(3)}
+    fr = Frame.from_numpy({**cols,
+                           "y": np.array(["a", "b", "c"],
+                                         dtype=object)[cls]})
+    m = GBM(response_column="y", ntrees=8, seed=1).train(fr)
+    _roundtrip(m, fr, cols, tmp_path)
+
+
+def test_deeplearning_kmeans_nb_pca_mojo(cl, rng, tmp_path):
+    fr_num, fr_bin, data = _frames(rng)
+    _roundtrip(DeepLearning(response_column="y", hidden=[16], epochs=3,
+                            seed=1).train(fr_bin), fr_bin, data, tmp_path,
+               atol=1e-3)
+    km = KMeans(k=3, seed=1).train(fr_num["x0", "x1"] if False else
+                                   Frame.from_numpy({"x0": data["x0"],
+                                                     "x1": data["x1"]}))
+    path = km.download_mojo(str(tmp_path / "km.zip"))
+    sm = h2o3_tpu.import_mojo(path)
+    out = sm.predict({"x0": data["x0"], "x1": data["x1"]})
+    pred = km.predict(Frame.from_numpy({"x0": data["x0"],
+                                        "x1": data["x1"]}))
+    assert (out["predict"].astype(int)
+            == pred.vecs[0].to_numpy().astype(int)).mean() > 0.999
+    _roundtrip(NaiveBayes(response_column="y").train(fr_bin), fr_bin, data,
+               tmp_path, atol=1e-3)
+    pca = PCA(k=2, transform="demean").train(
+        Frame.from_numpy({k: data[k] for k in ("x0", "x1", "x2")}))
+    sm = h2o3_tpu.import_mojo(pca.download_mojo(str(tmp_path / "p.zip")))
+    Z = sm._score(
+        {k: np.asarray(data[k]) for k in ("x0", "x1", "x2")}, len(data["x0"]))
+    Zm = np.stack([v.to_numpy() for v in pca.predict(Frame.from_numpy(
+        {k: data[k] for k in ("x0", "x1", "x2")})).vecs], axis=1)
+    np.testing.assert_allclose(Z, Zm, atol=1e-3)
+
+
+def test_isotonic_isofor_mojo(cl, rng, tmp_path):
+    n = 500
+    x = np.sort(rng.uniform(-2, 2, n))
+    y = x + 0.2 * rng.normal(size=n)
+    iso = IsotonicRegression(response_column="y").train(
+        Frame.from_numpy({"x": x, "y": y}))
+    sm = h2o3_tpu.import_mojo(iso.download_mojo(str(tmp_path / "i.zip")))
+    out = sm.predict({"x": x})
+    np.testing.assert_allclose(
+        out["predict"], iso.predict(Frame.from_numpy({"x": x}))
+        .vecs[0].to_numpy(), atol=5e-4)
+
+    fr = Frame.from_numpy({"a": rng.normal(size=n), "b": rng.normal(size=n)})
+    anom = IsolationForest(ntrees=15, seed=2).train(fr)
+    sm = h2o3_tpu.import_mojo(anom.download_mojo(str(tmp_path / "a.zip")))
+    out = sm.predict({"a": fr.vec("a").to_numpy(),
+                      "b": fr.vec("b").to_numpy()})
+    np.testing.assert_allclose(out["predict"],
+                               anom.predict(fr).vecs[0].to_numpy(),
+                               atol=1e-4)
+
+
+def test_single_row_dict(cl, rng, tmp_path):
+    fr_num, fr_bin, data = _frames(rng)
+    m = GBM(response_column="y", ntrees=5, seed=1).train(fr_bin)
+    sm = h2o3_tpu.import_mojo(m.download_mojo(str(tmp_path / "g.zip")))
+    row = {"x0": 0.5, "x1": -1.0, "x2": 0.2, "c": "v"}
+    out = sm.predict(row)
+    assert out["predict"] in ("yes", "no")
+    assert out["probabilities"].shape == (2,)

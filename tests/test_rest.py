@@ -1,0 +1,97 @@
+"""REST API tests: drive the server over real HTTP (rest-smoke analog)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu import Frame
+from h2o3_tpu.api import start_server
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = start_server(port=0)
+    yield s
+    s.stop()
+
+
+def _get(server, path):
+    with urllib.request.urlopen(server.url + path) as r:
+        return json.loads(r.read())
+
+
+def _post(server, path, payload):
+    req = urllib.request.Request(
+        server.url + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req) as r:
+            return json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        raise AssertionError(f"{path} -> {e.code}: "
+                             f"{e.read().decode()[:1500]}")
+
+
+def test_cloud_route(cl, server):
+    out = _get(server, "/3/Cloud")
+    assert out["cloud_healthy"] is True
+    assert out["platform"] in ("cpu", "tpu")
+
+
+def test_parse_train_predict_flow(cl, server, rng, tmp_path):
+    n = 500
+    X = rng.normal(size=(n, 3))
+    y = (X[:, 0] - X[:, 1] > 0).astype(int)
+    csv = tmp_path / "data.csv"
+    with open(csv, "w") as f:
+        f.write("a,b,c,y\n")
+        for i in range(n):
+            f.write(f"{X[i,0]},{X[i,1]},{X[i,2]},"
+                    f"{'yes' if y[i] else 'no'}\n")
+
+    out = _post(server, "/3/Parse",
+                {"path": str(csv), "destination_frame": "rest_train"})
+    assert out["destination_frame"]["name"] == "rest_train"
+
+    frames = _get(server, "/3/Frames")["frames"]
+    assert any(f["frame_id"]["name"] == "rest_train" for f in frames)
+    fr = _get(server, "/3/Frames/rest_train")["frames"][0]
+    assert fr["rows"] == n
+    assert {c["label"] for c in fr["columns"]} == {"a", "b", "c", "y"}
+
+    out = _post(server, "/3/ModelBuilders/gbm",
+                {"training_frame": "rest_train", "response_column": "y",
+                 "ntrees": 5, "seed": 1})
+    model_key = out["job"]["dest"]["name"]
+    assert out["model"]["algo"] == "gbm"
+    assert out["model"]["training_metrics"]["auc"] > 0.8
+
+    models = _get(server, "/3/Models")["models"]
+    assert any(m["model_id"]["name"] == model_key for m in models)
+
+    out = _post(server,
+                f"/3/Predictions/models/{model_key}/frames/rest_train", {})
+    pred_key = out["predictions_frame"]["name"]
+    pf = _get(server, f"/3/Frames/{pred_key}")["frames"][0]
+    assert pf["rows"] == n
+    assert pf["columns"][0]["label"] == "predict"
+
+    jobs = _get(server, "/3/Jobs")["jobs"]
+    assert any(j["status"] == "DONE" for j in jobs)
+
+    req = urllib.request.Request(server.url + f"/3/DKV/{pred_key}",
+                                 method="DELETE")
+    with urllib.request.urlopen(req) as r:
+        assert json.loads(r.read())["removed"] == pred_key
+
+
+def test_unknown_routes_404(cl, server):
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(server, "/3/Nope")
+    assert e.value.code == 404
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(server, "/3/Frames/not_a_frame")
+    assert e.value.code == 404
