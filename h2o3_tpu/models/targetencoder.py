@@ -135,24 +135,34 @@ class TargetEncoder(ModelBuilder):
             fm = np.stack([(fc == f) for f in fold_ids]).astype(np.float32)
             fold_mask = jnp.asarray(np.pad(fm, [(0, 0), (0, pad)]))
         tables: Dict[str, dict] = {}
+        # encoding tables accumulate in float64 on host (bincount): the
+        # tables are tiny but the transform subtracts near-equal quantities
+        # (LOO / fold corrections), which loses precision in f32 matmuls
+        yz64 = np.asarray(yz, np.float64)
+        w64 = np.asarray(w, np.float64)
+        fold_mask_np = np.asarray(fold_mask, np.float64) \
+            if fold_mask is not None else None
         for i, col in enumerate(cols):
             v = frame.vec(col)
             if v.type != T_CAT:
                 continue
             K = len(v.domain or [])
-            codes = v.data
-            ok = (codes >= 0).astype(jnp.float32) * w
-            onehot = jax.nn.one_hot(jnp.clip(codes, 0, K - 1), K,
-                                    dtype=jnp.float32) * ok[:, None]
-            sums = np.asarray(yz @ onehot, np.float64)
-            counts = np.asarray(jnp.sum(onehot, axis=0), np.float64)
+            if K == 0:
+                continue
+            codes = np.asarray(v.data)
+            ok = (codes >= 0) * w64
+            cc = np.clip(codes, 0, K - 1)
+            sums = np.bincount(cc, weights=yz64 * ok, minlength=K)[:K]
+            counts = np.bincount(cc, weights=ok, minlength=K)[:K]
             tables[col] = {"sums": sums, "counts": counts,
                            "domain": list(v.domain or [])}
-            if fold_mask is not None:
-                tables[col]["fold_sums"] = np.asarray(
-                    (fold_mask * yz[None, :]) @ onehot, np.float64)
-                tables[col]["fold_counts"] = np.asarray(
-                    fold_mask @ onehot, np.float64)
+            if fold_mask_np is not None:
+                tables[col]["fold_sums"] = np.stack(
+                    [np.bincount(cc, weights=yz64 * ok * fm,
+                                 minlength=K)[:K] for fm in fold_mask_np])
+                tables[col]["fold_counts"] = np.stack(
+                    [np.bincount(cc, weights=ok * fm,
+                                 minlength=K)[:K] for fm in fold_mask_np])
             job.update((i + 1) / max(len(cols), 1), f"encoding {col}")
         n = float(jnp.sum(w))
         prior = float(jnp.sum(yz * w)) / max(n, 1e-12)

@@ -47,7 +47,11 @@ def _make_pallas_hist(L: int, F: int, B: int, n_local: int,
     nblk = (n_local + R - 1) // R
     pad_to = nblk * R
     L3 = 3 * L
-    TB = max(1, 512 // F)          # bins per tile -> [F*TB, R] one-hot tile
+    # bins per tile -> [F*TB, R] one-hot tile.  The [TB, F, R] compare
+    # intermediate is laid out with F in the sublane dim, which pads to a
+    # multiple of 8 — size TB against the PADDED F or small-F geometries
+    # blow the 16M scoped-VMEM stack (observed: F=3 -> 22M alloc).
+    TB = max(1, 512 // ((F + 7) // 8 * 8))
     FBT = F * TB
     n_fb = (B + TB - 1) // TB
 
