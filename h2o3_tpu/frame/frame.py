@@ -172,7 +172,8 @@ class Frame:
                 raise TypeError(f"column {c!r} of type {v.type} is host-only")
             parts.append(v.data.astype(dtype))
         mat = jnp.stack(parts, axis=1)
-        mat = jax.device_put(mat, cl.matrix_sharding)
+        from ..runtime.cluster import put_sharded
+        mat = put_sharded(mat, cl.matrix_sharding)
         self._matrix_cache[ck] = mat
         return mat
 

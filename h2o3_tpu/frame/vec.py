@@ -127,7 +127,8 @@ class Vec:
                 vals = (vals - time_base) / 1000.0
             buf = np.full(padded, np.nan, dtype=np.float32)
             buf[:n] = vals.astype(np.float32)
-        data = jax.device_put(buf, cl.row_sharding)
+        from ..runtime.cluster import put_sharded
+        data = put_sharded(buf, cl.row_sharding)
         return Vec(data, vtype, n, domain=domain, host_data=host_data,
                    time_base=time_base or 0.0)
 
@@ -219,7 +220,8 @@ class Vec:
             return self.host_data[: self.nrows]
         if self.data is None:
             return self.host_data[: self.nrows]
-        return np.asarray(self.data)[: self.nrows]
+        from ..runtime.cluster import fetch
+        return fetch(self.data)[: self.nrows]
 
     def decoded(self) -> np.ndarray:
         """Host column with categorical codes mapped back to labels."""

@@ -173,7 +173,8 @@ class DataInfo:
         if self.add_intercept:
             cols.append(jnp.ones((frame.padded_rows, 1), jnp.float32))
         mat = jnp.concatenate(cols, axis=1)
-        return jax.device_put(mat, cl.matrix_sharding)
+        from ..runtime.cluster import put_sharded
+        return put_sharded(mat, cl.matrix_sharding)
 
     def _aligned_codes(self, vec: Vec, s: ColumnSpec) -> jax.Array:
         """Map a (possibly differently-coded) cat Vec onto training codes."""

@@ -60,15 +60,20 @@ def fit_bins(frame: Frame, features: List[str], nbins: int = 64,
     ``weights`` (host or device [>=nrows]) restricts the sketch to rows with
     weight > 0 — keeps CV's zero-weight holdout rows out of the bin edges.
     """
+    from ...runtime.cluster import fetch
     rng = np.random.default_rng(seed)
     n = frame.nrows
     idx = None
+    stride = 1
     if weights is not None:
-        live = np.flatnonzero(np.asarray(weights)[:n] > 0)
+        live = np.flatnonzero(fetch(weights)[:n] > 0)
         idx = live if len(live) <= sample \
             else rng.choice(live, size=sample, replace=False)
     elif n > sample:
-        idx = rng.choice(n, size=sample, replace=False)
+        # strided device slice: rows are unordered, so a stride is as good a
+        # sketch sample as rng.choice — and it fetches `sample` elements to
+        # host instead of the whole 40MB+ column over the device link
+        stride = -(-n // sample)
     edges_list, is_cat, domains = [], [], []
     for name in features:
         vec = frame.vec(name)
@@ -80,9 +85,12 @@ def fit_bins(frame: Frame, features: List[str], nbins: int = 64,
             is_cat.append(True)
             domains.append(vec.domain)
         else:
-            col = np.asarray(vec.data)[: n]
-            if idx is not None:
-                col = col[idx]
+            if stride > 1:
+                col = fetch(vec.data[:n:stride])
+            else:
+                col = fetch(vec.data)[: n]
+                if idx is not None:
+                    col = col[idx]
             col = col[np.isfinite(col)]
             if len(col) == 0:
                 edges = np.zeros(0, dtype=np.float32)

@@ -28,7 +28,8 @@ from ..datainfo import DataInfo
 from ..scorekeeper import stop_early, metric_direction
 from .binning import fit_bins, edges_matrix
 from .shared import (SharedTree, SharedTreeModel, SharedTreeParameters,
-                     build_tree, stack_trees, traverse_jit)
+                     StackedTrees, TreeList, chunk_schedule,
+                     make_tree_scan_fn, traverse_jit)
 from ...metrics.core import make_metrics
 
 
@@ -111,74 +112,79 @@ class DRF(SharedTree):
             F_v = jnp.zeros((Xv.shape[0], K), jnp.float32) if K > 1 \
                 else jnp.zeros((Xv.shape[0],), jnp.float32)
 
-        trees, history = [], []
+        history = []
         metric_name, maximize = metric_direction(p.stopping_metric,
                                                  di.is_classifier)
-        for t in range(p.ntrees):
-            rng, ks = jax.random.split(rng)
-            w_eff = w * jax.random.bernoulli(ks, p.sample_rate, (N,)) \
-                if p.sample_rate < 1.0 else w
-            if K > 1:
-                ktrees = []
-                for k in range(K):
-                    rng, kk = jax.random.split(rng)
-                    # mean-fit: grad = -y, hess = 1 -> leaf = mean(y)
-                    tree, leaf = build_tree(
-                        codes, -targets[k] * w_eff, w_eff, w_eff,
-                        edges_mat, p.nbins, p.max_depth, p.reg_lambda,
-                        p.min_rows, p.min_split_improvement, 1.0, kk,
-                        col_rate, None, p.reg_alpha, p.gamma,
-                        p.min_child_weight,
-                        hist_precision=p.hist_precision)
-                    ktrees.append(tree)
-                    F_sum = F_sum.at[:, k].add(jnp.asarray(tree.values)[leaf])
+        # mean-fit via the scan driver: grad = -y, hess = 1 -> leaf = mean(y);
+        # a whole scoring interval of trees is one device dispatch.  The same
+        # per-tree keys are reused across classes so every class sees the
+        # same bootstrap sample per iteration (DRF.java samples once/tree).
+        scan_fn = make_tree_scan_fn(
+            "drf", 0.0, 0.0, 0.0, p.max_depth, p.nbins, Fnum, N,
+            p.hist_precision, p.sample_rate, 1.0)
+        scalars = (p.reg_lambda, p.min_rows, p.min_split_improvement, 1.0,
+                   col_rate, p.reg_alpha, p.gamma, p.min_child_weight)
+        chunks = [[] for _ in range(K)]
+        for c, t_done, score_now in chunk_schedule(
+                p.ntrees, p.score_tree_interval):
+            rng, kc = jax.random.split(rng)
+            keys = jax.random.split(kc, c)
+            for k in range(K):
+                Fk0 = F_sum[:, k] if K > 1 else F_sum
+                # same keys across classes -> same bootstrap per iteration
+                # (DRF.java samples once per tree); the salt decorrelates
+                # each class tree's per-split feature subsets
+                Fk, lv, vals = scan_fn(codes, targets[k], w, Fk0,
+                                       edges_mat, keys, *scalars, k)
+                chunks[k].append(StackedTrees(lv, vals))
+                if K > 1:
+                    F_sum = F_sum.at[:, k].set(Fk)
                     if valid is not None:
-                        levels, vals = stack_trees([tree])
-                        F_v = F_v.at[:, k].add(traverse_jit(levels, vals, Xv))
-                trees.append(ktrees)
-            else:
-                rng, kk = jax.random.split(rng)
-                tree, leaf = build_tree(
-                    codes, -targets[0] * w_eff, w_eff, w_eff, edges_mat,
-                    p.nbins, p.max_depth, p.reg_lambda, p.min_rows,
-                    p.min_split_improvement, 1.0, kk, col_rate, None,
-                    p.reg_alpha, p.gamma, p.min_child_weight,
-                    hist_precision=p.hist_precision)
-                trees.append(tree)
-                F_sum = F_sum + jnp.asarray(tree.values)[leaf]
-                if valid is not None:
-                    levels, vals = stack_trees([tree])
-                    F_v = F_v + traverse_jit(levels, vals, Xv)
-            job.update((t + 1) / p.ntrees, f"tree {t + 1}/{p.ntrees}")
+                        F_v = F_v.at[:, k].add(traverse_jit(lv, vals, Xv))
+                else:
+                    F_sum = Fk
+                    if valid is not None:
+                        F_v = F_v + traverse_jit(lv, vals, Xv)
+            job.update(t_done / p.ntrees, f"tree {t_done}/{p.ntrees}")
+            if not score_now:
+                continue
 
-            if ((t + 1) % p.score_tree_interval == 0) or t == p.ntrees - 1:
-                avg = F_sum / (t + 1)
-                raw = self._avg_to_preds(avg, di, K)
-                m = make_metrics(di, raw, y, w)
-                entry = {"iteration": t + 1, **m.describe()}
-                if valid is not None:
-                    mv = make_metrics(
-                        di, self._avg_to_preds(F_v / (t + 1), di, K), y_v, w_v)
-                    entry.update({f"valid_{k2}": v for k2, v
-                                  in mv.describe().items()})
-                history.append(entry)
-                if p.stopping_rounds:
-                    key = (f"valid_{metric_name}" if valid is not None
-                           else metric_name)
-                    series = [hh.get(key) for hh in history
-                              if hh.get(key) is not None]
-                    if series and stop_early(series, p.stopping_rounds,
-                                             p.stopping_tolerance, maximize):
-                        break
+            avg = F_sum / t_done
+            raw = self._avg_to_preds(avg, di, K)
+            m = make_metrics(di, raw, y, w)
+            entry = {"iteration": t_done, **m.describe()}
+            if valid is not None:
+                mv = make_metrics(
+                    di, self._avg_to_preds(F_v / t_done, di, K), y_v, w_v)
+                entry.update({f"valid_{k2}": v for k2, v
+                              in mv.describe().items()})
+            history.append(entry)
+            if p.stopping_rounds:
+                key = (f"valid_{metric_name}" if valid is not None
+                       else metric_name)
+                series = [hh.get(key) for hh in history
+                          if hh.get(key) is not None]
+                if series and stop_early(series, p.stopping_rounds,
+                                         p.stopping_tolerance, maximize):
+                    break
 
-        model.output["trees"] = trees
+        stacks = [StackedTrees.concat(ch) for ch in chunks]
+        ntrees_trained = stacks[0].ntrees
+        if K > 1:
+            model.output["stacked"] = stacks
+            per_class = [s.to_tree_list() for s in stacks]
+            model.output["trees"] = [list(t) for t in zip(*per_class)]
+        else:
+            model.output["stacked"] = stacks[0]
+            model.output["trees"] = TreeList(stacks[0])
         model.output["init_score"] = np.zeros(K) if K > 1 else 0.0
-        model.output["ntrees_trained"] = len(trees)
+        model.output["ntrees_trained"] = ntrees_trained
         model.output["edges"] = binned.edges
         model.scoring_history = history
         # F_sum already holds the final ensemble scores — no re-traversal
         model.training_metrics = make_metrics(
-            di, self._avg_to_preds(F_sum / max(len(trees), 1), di, K), y, w)
+            di, self._avg_to_preds(F_sum / max(ntrees_trained, 1), di, K),
+            y, w)
         if valid is not None:
             model.validation_metrics = model.model_performance(valid)
         return model

@@ -31,48 +31,15 @@ from ..distributions import make_distribution, Multinomial
 from ..scorekeeper import stop_early, metric_direction
 from .binning import fit_bins, edges_matrix
 from .shared import (SharedTree, SharedTreeModel, SharedTreeParameters,
-                     Tree, build_tree, stack_trees, traverse_jit)
+                     StackedTrees, Tree, TreeList, build_tree,
+                     chunk_schedule, make_tree_scan_fn, stack_trees,
+                     traverse_jit)
 from ...metrics.core import make_metrics
 
 
 @dataclasses.dataclass
 class GBMParameters(SharedTreeParameters):
     pass
-
-
-@functools.lru_cache(maxsize=None)
-def make_tree_step_fn(dist_name: str, tweedie_power: float,
-                      quantile_alpha: float, huber_alpha: float,
-                      max_depth: int, nbins: int, F: int, n_padded: int,
-                      hist_precision: str, sample_rate: float):
-    """Fused per-tree step: gradients -> row sample -> build -> F update.
-
-    One device dispatch per tree (vs 3-4), cached at module level so repeat
-    trainings with the same geometry reuse the compilation.
-    """
-    from .shared import make_build_tree_fn
-    dist = make_distribution(dist_name, nclasses=2 if dist_name == "bernoulli"
-                             else 1, tweedie_power=tweedie_power,
-                             quantile_alpha=quantile_alpha,
-                             huber_alpha=huber_alpha)
-    bt_fn = make_build_tree_fn(max_depth, nbins, F, n_padded, hist_precision)
-
-    @jax.jit
-    def tree_step(codes_, y_, w_, F_, edges_, key_, tm_, reg_lambda,
-                  min_rows, min_split_improvement, learn_rate,
-                  col_sample_rate, reg_alpha, gamma, min_child_weight):
-        g_, h_ = dist.grad_hess(y_, F_)
-        key_s, key_b = jax.random.split(key_)
-        wv = w_
-        if sample_rate < 1.0:
-            wv = w_ * jax.random.bernoulli(key_s, sample_rate, w_.shape)
-        levels_, vals_, leaf_ = bt_fn(
-            codes_, g_ * wv, h_ * wv, wv, edges_, key_b,
-            reg_lambda, min_rows, min_split_improvement, learn_rate,
-            col_sample_rate, tm_, reg_alpha, gamma, min_child_weight)
-        return levels_, vals_, F_ + vals_[leaf_]
-
-    return tree_step
 
 
 class GBMModel(SharedTreeModel):
@@ -165,15 +132,6 @@ class GBM(SharedTree):
         X_tr = model._design(frame) if dart else None
         lr_build = 1.0 if dart else p.learn_rate
 
-        tree_step = make_tree_step_fn(
-            dist.name, p.tweedie_power, p.quantile_alpha, p.huber_alpha,
-            p.max_depth, p.nbins, binned.nfeatures, N, p.hist_precision,
-            p.sample_rate)
-        tree_mask_all = jnp.ones(binned.nfeatures, bool)
-        scalars = (p.reg_lambda, p.min_rows, p.min_split_improvement,
-                   lr_build, p.col_sample_rate, p.reg_alpha, p.gamma,
-                   p.min_child_weight)
-
         def drop_sum(idx):
             if multinomial:
                 outs = []
@@ -189,11 +147,58 @@ class GBM(SharedTree):
         metric_name, maximize = metric_direction(
             p.stopping_metric, di.is_classifier)
         fused = not multinomial and not dart
+
+        if fused:
+            # fast path: scan a whole scoring interval of trees per dispatch
+            scan_fn = make_tree_scan_fn(
+                dist.name, p.tweedie_power, p.quantile_alpha, p.huber_alpha,
+                p.max_depth, p.nbins, binned.nfeatures, N, p.hist_precision,
+                p.sample_rate, p.col_sample_rate_per_tree)
+            scalars = (p.reg_lambda, p.min_rows, p.min_split_improvement,
+                       p.learn_rate, p.col_sample_rate, p.reg_alpha, p.gamma,
+                       p.min_child_weight)
+            chunks = []
+            for c, t_done, score_now in chunk_schedule(
+                    p.ntrees, p.score_tree_interval):
+                rng, kc = jax.random.split(rng)
+                keys = jax.random.split(kc, c)
+                F, lv, vals = scan_fn(codes, y, w, F, edges_mat, keys,
+                                      *scalars, 0)
+                chunk = StackedTrees(lv, vals)
+                chunks.append(chunk)
+                job.update(t_done / p.ntrees, f"tree {t_done}/{p.ntrees}")
+                if valid is not None:
+                    F_v = F_v + traverse_jit(chunk.levels, chunk.values, Xv)
+                if not score_now:
+                    continue
+                vstate = (F_v, y_v, w_v) if valid is not None else None
+                self._score_and_log(model, t_done, F, y, w, di, dist,
+                                    history, vstate)
+                if p.stopping_rounds:
+                    key = (f"valid_{metric_name}" if valid is not None
+                           else metric_name)
+                    series = [hh.get(key) for hh in history
+                              if hh.get(key) is not None]
+                    if series and stop_early(series, p.stopping_rounds,
+                                             p.stopping_tolerance, maximize):
+                        break
+            stacked = StackedTrees.concat(chunks)
+            model.output["stacked"] = stacked
+            model.output["trees"] = TreeList(stacked)
+            model.output["init_score"] = init_host
+            model.output["ntrees_trained"] = stacked.ntrees
+            model.output["edges"] = binned.edges
+            model.scoring_history = history
+            model.training_metrics = make_metrics(
+                di, self._scores_to_preds(F, dist, di), y, w)
+            if valid is not None:
+                model.validation_metrics = model.model_performance(valid)
+            return model
+
         for t in range(p.ntrees):
             rng, ks, kc = jax.random.split(rng, 3)
             w_eff = w
-            if p.sample_rate < 1.0 and not fused:
-                # the fused tree_step samples internally from its own key
+            if p.sample_rate < 1.0:
                 w_eff = w * jax.random.bernoulli(ks, p.sample_rate, (N,))
             tree_mask = None
             if p.col_sample_rate_per_tree < 1.0:
@@ -240,7 +245,10 @@ class GBM(SharedTree):
                     if dart:
                         tree.values = tree.values * b_scale
                     ktrees.append(tree)
-                    F = F.at[:, k].add(jnp.asarray(tree.values)[leaf])
+                    from .hist import table_lookup
+                    dF = table_lookup(jnp.asarray(tree.values)[None, :],
+                                      leaf, len(tree.values))[0]
+                    F = F.at[:, k].add(dF)
                 trees.append(ktrees)
                 if dart and drop_idx:
                     for i in drop_idx:
@@ -251,20 +259,6 @@ class GBM(SharedTree):
                     for k in range(K):
                         levels, vals = stack_trees([ktrees[k]])
                         F_v = F_v.at[:, k].add(traverse_jit(levels, vals, Xv))
-            elif not dart:
-                # fused fast path: one dispatch per tree
-                tm = jnp.asarray(tree_mask, bool) if tree_mask is not None \
-                    else tree_mask_all
-                levels, vals, F = tree_step(codes, y, w, F, edges_mat,
-                                            kc, tm, *scalars)
-                tree = Tree([lv[0] for lv in levels],
-                            [lv[1] for lv in levels],
-                            [lv[2] for lv in levels],
-                            [lv[3] for lv in levels], vals)
-                trees.append(tree)
-                if valid is not None:
-                    s_levels, s_vals = stack_trees([tree])
-                    F_v = F_v + traverse_jit(s_levels, s_vals, Xv)
             else:
                 g, h = grads_single(y, F_eff)
                 tree, leaf = build_tree(
@@ -276,7 +270,9 @@ class GBM(SharedTree):
                     hist_precision=p.hist_precision)
                 tree.values = tree.values * b_scale
                 trees.append(tree)
-                F = F + jnp.asarray(tree.values)[leaf]
+                from .hist import table_lookup
+                F = F + table_lookup(jnp.asarray(tree.values)[None, :],
+                                     leaf, len(tree.values))[0]
                 if drop_idx:
                     for i in drop_idx:
                         trees[i].values = trees[i].values * a_scale

@@ -85,24 +85,122 @@ def stack_trees(trees: List[Tree]):
     return levels, values
 
 
+@dataclasses.dataclass
+class StackedTrees:
+    """Device-resident whole-ensemble form: per-level [T, 2^d] stacks.
+
+    This is the canonical trained-tree storage — trees never round-trip
+    through host during training (the driver loop appends whole chunks of
+    scanned trees), and traversal consumes it directly.  ``to_tree_list``
+    materializes per-tree host ``Tree`` objects only when something needs
+    them (MOJO export, SHAP, tests).
+    """
+
+    levels: List[tuple]          # per depth: (feat, thr, na_left, valid)
+    values: jax.Array            # [T, 2^depth]
+
+    @property
+    def ntrees(self) -> int:
+        return int(self.values.shape[0])
+
+    @property
+    def depth(self) -> int:
+        return len(self.levels)
+
+    @staticmethod
+    def from_trees(trees: List[Tree]) -> "StackedTrees":
+        levels, values = stack_trees(trees)
+        return StackedTrees(levels, values)
+
+    @staticmethod
+    def concat(chunks: Sequence["StackedTrees"]) -> "StackedTrees":
+        if len(chunks) == 1:
+            return chunks[0]
+        levels = []
+        for d in range(chunks[0].depth):
+            levels.append(tuple(
+                jnp.concatenate([c.levels[d][i] for c in chunks], axis=0)
+                for i in range(4)))
+        values = jnp.concatenate([c.values for c in chunks], axis=0)
+        return StackedTrees(levels, values)
+
+    def to_tree_list(self) -> List[Tree]:
+        """Host materialization — one fetch per level array, then slices."""
+        host_levels = [tuple(np.asarray(a) for a in lv) for lv in self.levels]
+        values = np.asarray(self.values)
+        out = []
+        for t in range(values.shape[0]):
+            out.append(Tree(
+                feat=[lv[0][t] for lv in host_levels],
+                thr=[lv[1][t] for lv in host_levels],
+                na_left=[lv[2][t] for lv in host_levels],
+                valid=[lv[3][t] for lv in host_levels],
+                values=values[t]))
+        return out
+
+
+class TreeList:
+    """Lazy list-of-``Tree`` view over a ``StackedTrees``.
+
+    Keeps ``model.output["trees"]`` available to export/inspection code
+    without pulling the ensemble to host unless someone actually indexes it.
+    """
+
+    def __init__(self, stacked: StackedTrees):
+        self._stacked = stacked
+        self._cache: Optional[List[Tree]] = None
+
+    def _mat(self) -> List[Tree]:
+        if self._cache is None:
+            self._cache = self._stacked.to_tree_list()
+        return self._cache
+
+    def __len__(self):
+        return self._stacked.ntrees
+
+    def __getitem__(self, i):
+        return self._mat()[i]
+
+    def __iter__(self):
+        return iter(self._mat())
+
+    def __getstate__(self):
+        return {"trees": self._mat()}
+
+    def __setstate__(self, state):
+        self._cache = state["trees"]
+        self._stacked = StackedTrees.from_trees(self._cache)
+
+
 def traverse(levels, values, X):
     """Sum of leaf values over stacked trees for raw feature matrix X.
 
-    scan over trees; per level: gather node params, compare, descend.
-    NaN feature -> NA direction (sparsity-aware default, hist.py).
+    scan over trees; per level: look up node params, compare, descend.
+    NaN feature -> NA direction (sparsity-aware default, hist.py).  All
+    per-row lookups go through one-hot matmuls (hist.table_lookup) — TPU
+    per-row gathers are ~2 orders of magnitude slower.
     """
-    N = X.shape[0]
+    from .hist import table_lookup
+    N, Fdim = X.shape
 
     def one_tree(carry, tree_slices):
         acc = carry
         node = jnp.zeros(N, jnp.int32)
         for (feat, thr, na_left, valid) in tree_slices[0]:
-            f = feat[node]
-            x = jnp.take_along_axis(X, f[:, None], axis=1)[:, 0]
-            right = jnp.where(jnp.isnan(x), ~na_left[node], x >= thr[node])
-            right = right & valid[node]
+            L = feat.shape[0]
+            tbl = jnp.stack([feat.astype(jnp.float32), thr,
+                             na_left.astype(jnp.float32),
+                             valid.astype(jnp.float32)], axis=0)
+            t = table_lookup(tbl, node, L)
+            f = t[0].astype(jnp.int32)
+            x = jnp.zeros(N, X.dtype)
+            for fi in range(Fdim):
+                x = jnp.where(f == fi, X[:, fi], x)
+            right = jnp.where(jnp.isnan(x), t[2] <= 0.5, x >= t[1])
+            right = right & (t[3] > 0.5)
             node = 2 * node + right.astype(jnp.int32)
-        acc = acc + tree_slices[1][node]
+        V = tree_slices[1].shape[0]
+        acc = acc + table_lookup(tree_slices[1][None, :], node, V)[0]
         return acc, None
 
     # lax.scan needs uniform pytrees; reorganize levels per tree via index map
@@ -189,6 +287,83 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
     return jax.jit(build)
 
 
+@functools.lru_cache(maxsize=None)
+def make_tree_scan_fn(mode: str, tweedie_power: float, quantile_alpha: float,
+                      huber_alpha: float, max_depth: int, nbins: int, F: int,
+                      n_padded: int, hist_precision: str, sample_rate: float,
+                      col_sample_rate_per_tree: float):
+    """Scan a CHUNK of boosting/bagging rounds in ONE device dispatch.
+
+    The per-tree driver loop (gradients -> row/column sample -> grow ->
+    F update) becomes the body of a ``lax.scan`` over per-tree PRNG keys, so
+    a whole scoring interval of trees costs one dispatch instead of
+    one-plus per tree — on a remote TPU the per-dispatch round trip is the
+    dominant driver-side cost.  ``mode`` is a distribution name for boosting
+    or ``"drf"`` for the forest mean-fit (grad=-y, hess=1).  Returns
+    (F_final, levels, values) with levels/values carrying a leading [T] dim —
+    exactly the ``StackedTrees`` layout.
+    """
+    from ..distributions import make_distribution
+    dist = None
+    if mode != "drf":
+        dist = make_distribution(
+            mode, nclasses=2 if mode == "bernoulli" else 1,
+            tweedie_power=tweedie_power, quantile_alpha=quantile_alpha,
+            huber_alpha=huber_alpha)
+    bt_fn = make_build_tree_fn(max_depth, nbins, F, n_padded, hist_precision)
+
+    def scan_fn(codes, y, w, F0, edges_mat, keys, reg_lambda, min_rows,
+                min_split_improvement, learn_rate, col_sample_rate,
+                reg_alpha, gamma, min_child_weight, salt=0):
+        # ``salt`` decorrelates column/build randomness between callers that
+        # share ``keys`` (DRF class trees share the bootstrap via ks but
+        # must draw independent per-split feature subsets).
+        def body(Fc, key_t):
+            ks, km, kb = jax.random.split(key_t, 3)
+            km = jax.random.fold_in(km, salt)
+            kb = jax.random.fold_in(kb, salt)
+            if mode == "drf":
+                g0, h0 = -y, jnp.ones_like(y)
+            else:
+                g0, h0 = dist.grad_hess(y, Fc)
+            wv = w
+            if sample_rate < 1.0:
+                wv = w * jax.random.bernoulli(ks, sample_rate, w.shape)
+            tm = jnp.ones((F,), bool)
+            if col_sample_rate_per_tree < 1.0:
+                m = jax.random.uniform(km, (F,)) < col_sample_rate_per_tree
+                tm = m.at[0].set(m[0] | ~m.any())
+            levels, vals, leaf = bt_fn(
+                codes, g0 * wv, h0 * wv, wv, edges_mat, kb, reg_lambda,
+                min_rows, min_split_improvement, learn_rate, col_sample_rate,
+                tm, reg_alpha, gamma, min_child_weight)
+            from .hist import table_lookup
+            dF = table_lookup(vals[None, :], leaf, vals.shape[0])[0]
+            return Fc + dF, (tuple(levels), vals)
+
+        Ff, (lv, vals) = jax.lax.scan(body, F0, keys)
+        return Ff, list(lv), vals
+
+    return jax.jit(scan_fn, donate_argnums=(3,))
+
+
+def chunk_schedule(ntrees: int, score_tree_interval: int,
+                   chunk_cap: int = 10):
+    """Yield (chunk_len, trees_done, score_now) for the scan driver loop.
+
+    Chunks have a fixed length (``chunk_cap``) so every chunk reuses one
+    compiled scan program; chunk boundaries land exactly on scoring
+    intervals so early-stopping semantics match the per-tree loop.
+    """
+    interval = max(1, min(score_tree_interval, ntrees))
+    cap = min(chunk_cap, interval)
+    t = 0
+    while t < ntrees:
+        c = min(cap, ntrees - t, interval - (t % interval))
+        t += c
+        yield c, t, (t % interval == 0 or t >= ntrees)
+
+
 def build_tree(codes, g, h, w, edges, nbins: int, max_depth: int,
                reg_lambda: float, min_rows: float, min_split_improvement: float,
                learn_rate: float, rng_key, col_sample_rate: float = 1.0,
@@ -240,16 +415,23 @@ class SharedTreeModel(Model):
         return jnp.stack(cols, axis=1)
 
     def _raw_scores(self, X: jax.Array):
-        trees = self.output["trees"]
         init = self.output["init_score"]
         K = self.output.get("nclass_trees", 1)
+        stacked = self.output.get("stacked")
         if K == 1:
-            levels, values = stack_trees(trees)
-            return init + traverse_jit(levels, values, X)
+            if stacked is None:
+                stacked = StackedTrees.from_trees(self.output["trees"])
+                self.output["stacked"] = stacked
+            return init + traverse_jit(stacked.levels, stacked.values, X)
+        if stacked is None:
+            trees = self.output["trees"]
+            stacked = [StackedTrees.from_trees([t[k] for t in trees])
+                       for k in range(K)]
+            self.output["stacked"] = stacked
         outs = []
         for k in range(K):
-            levels, values = stack_trees([t[k] for t in trees])
-            outs.append(init[k] + traverse_jit(levels, values, X))
+            outs.append(init[k]
+                        + traverse_jit(stacked[k].levels, stacked[k].values, X))
         return jnp.stack(outs, axis=1)
 
 

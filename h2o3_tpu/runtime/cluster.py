@@ -111,10 +111,22 @@ def init(devices=None, model_axis: int = 1, coordinator: str | None = None,
             raise RuntimeError(
                 "cluster already booted with a different configuration; "
                 "call h2o3_tpu.shutdown() first to re-init")
-        if coordinator is not None and jax.process_count() == 1:
-            jax.distributed.initialize(coordinator_address=coordinator,
-                                       num_processes=num_processes,
-                                       process_id=process_id)
+        if coordinator is not None:
+            if jax.process_count() == 1:
+                jax.distributed.initialize(coordinator_address=coordinator,
+                                           num_processes=num_processes,
+                                           process_id=process_id)
+            # control plane (SURVEY §5): coordinator hosts the DKV service
+            # one port above the jax.distributed rendezvous; workers attach.
+            from . import dkv
+            host, _, port = coordinator.rpartition(":")
+            dkv_port = int(port) + 1
+            if jax.process_index() == 0:
+                dkv.serve(host="0.0.0.0" if host not in
+                          ("127.0.0.1", "localhost") else host,
+                          port=dkv_port)
+            else:
+                dkv.attach(host, dkv_port)
         if devices is None:
             devices = jax.devices()
         devices = list(devices)
@@ -125,6 +137,40 @@ def init(devices=None, model_axis: int = 1, coordinator: str | None = None,
         mesh = Mesh(dev_grid, (ROW_AXIS, MODEL_AXIS))
         _cluster = Cluster(mesh=mesh)
         return _cluster
+
+
+def put_sharded(buf: "np.ndarray", sharding) -> "jax.Array":
+    """Place a host buffer onto the mesh under ``sharding``.
+
+    Single-process: plain ``device_put``.  Multi-process SPMD: every process
+    holds the same full buffer, so build the global array from per-shard
+    callbacks — ``device_put``'s cross-process equality check rejects NaN
+    padding (NaN != NaN) and non-addressable shards.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(buf, sharding)
+    if isinstance(buf, jax.Array) and not isinstance(buf, np.ndarray):
+        # already a (possibly global) device array: reshard collectively
+        if buf.sharding == sharding:
+            return buf
+        return jax.jit(lambda x: x, out_shardings=sharding)(buf)
+    buf = np.asarray(buf)
+    return jax.make_array_from_callback(buf.shape, sharding,
+                                        lambda idx: buf[idx])
+
+
+def fetch(x) -> np.ndarray:
+    """Host numpy copy of a (possibly multi-process global) array.
+
+    Row-sharded arrays span non-addressable devices under multi-process
+    SPMD; ``process_allgather`` rides the collective plane to reassemble
+    them on every host.
+    """
+    if not hasattr(x, "sharding") or jax.process_count() == 1 \
+            or x.is_fully_addressable or x.sharding.is_fully_replicated:
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
 
 
 def cluster() -> Cluster:

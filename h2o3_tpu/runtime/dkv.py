@@ -8,23 +8,60 @@ NonBlockingHashMap (water/nbhm/).
 TPU-native redesign: bulk payloads (column data) are ``jax.Array``s whose
 placement is already expressed by shardings — the JAX runtime is the
 "distributed" part.  What remains is the *control-plane* index: a name ->
-object map on the coordinator host.  Single-process now; the multi-host
-version replicates this index over the control-plane channel (SURVEY.md §5:
-"DKV stays in TPU-VM host RAM").  The API mirrors DKV.get/put/remove.
+object map, served over DCN by a small TCP service on the coordinator host
+(SURVEY.md §5 two-plane design: XLA collectives on ICI for compute, host
+TCP for control; this replaces the reference's UDP/RPC + Paxos).  In the
+multi-process SPMD world every process executes the same program, so
+device-backed objects (frames, models) exist everywhere by construction;
+the coordinator service carries the *metadata* plane — key listings, job
+status, small host objects — and gives non-zero processes and external
+clients (REST) a consistent view.  The API mirrors DKV.get/put/remove.
 """
 
 from __future__ import annotations
 
+import pickle
+import socket
+import socketserver
+import struct
 import threading
-from typing import Any, Dict, List, Optional
+import time
+from typing import Any, Dict, List, Optional, Tuple
 
 _store: Dict[str, Any] = {}
 _lock = threading.RLock()
 _counter = 0
 
+# coordinator service state
+_remote: Optional[Tuple[str, int]] = None     # set on non-coordinator procs
+_server: Optional["_DKVServer"] = None
+
+
+def _is_plain(value: Any, depth: int = 0) -> bool:
+    """True when value is safely picklable host data (no device arrays)."""
+    import numpy as np
+    if depth > 6:
+        return False
+    if value is None or isinstance(value, (str, bytes, int, float, bool,
+                                           np.generic, np.ndarray)):
+        return True
+    if isinstance(value, (list, tuple, set)):
+        return all(_is_plain(v, depth + 1) for v in value)
+    if isinstance(value, dict):
+        return all(isinstance(k, str) and _is_plain(v, depth + 1)
+                   for k, v in value.items())
+    return False
+
 
 def make_key(prefix: str) -> str:
-    """Fresh unique key — analog of Key.make() (water/Key.java:44)."""
+    """Fresh unique key — analog of Key.make() (water/Key.java:44).
+
+    Always the LOCAL counter, even when attached to a coordinator: SPMD
+    processes execute the same program line-for-line, so local counters
+    stay in lock-step and every process derives the SAME name for the same
+    logical object — a coordinator counter would hand each process a
+    different key for one model.
+    """
     global _counter
     with _lock:
         _counter += 1
@@ -34,24 +71,152 @@ def make_key(prefix: str) -> str:
 def put(key: str, value: Any) -> str:
     with _lock:
         _store[key] = value
+    if _remote is not None and _is_plain(value):
+        _rpc("put", key=key, value=value)
     return key
 
 
 def get(key: str) -> Optional[Any]:
     with _lock:
-        return _store.get(key)
+        v = _store.get(key)
+    if v is None and _remote is not None:
+        v = _rpc("get", key=key)
+    return v
 
 
 def remove(key: str) -> None:
     with _lock:
         _store.pop(key, None)
+    if _remote is not None:
+        _rpc("remove", key=key)
 
 
 def keys(prefix: str = "") -> List[str]:
     with _lock:
-        return sorted(k for k in _store if k.startswith(prefix))
+        local = {k for k in _store if k.startswith(prefix)}
+    if _remote is not None:
+        local.update(_rpc("keys", prefix=prefix))
+    return sorted(local)
 
 
 def clear() -> None:
     with _lock:
         _store.clear()
+
+
+# --------------------------------------------------------------------------
+# Coordinator service: length-prefixed pickle RPC over TCP (the control
+# plane of SURVEY.md §5 — DCN traffic, never device payloads).
+#
+# Coherence contract: SPMD processes stay coherent BY CONSTRUCTION (every
+# process executes the same put/remove at the same program point); the
+# coordinator index is the authoritative view for EXTERNAL readers (REST
+# clients, tooling).  There is deliberately no cross-process invalidation
+# push — a coordinator-side mutation by an external writer is visible to a
+# worker only for keys the worker never stored locally (its get() falls
+# through to the coordinator).  This mirrors the reference's stance that
+# clients are coordinators of record, not peers (water/DKV.java caching is
+# likewise only coherent among cluster members).
+# --------------------------------------------------------------------------
+
+def _recvall(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("DKV peer closed connection")
+        buf += chunk
+    return buf
+
+
+def _rpc(op: str, **kw) -> Any:
+    payload = pickle.dumps({"op": op, **kw},
+                           protocol=pickle.HIGHEST_PROTOCOL)
+    with socket.create_connection(_remote, timeout=60) as s:
+        s.sendall(struct.pack("<Q", len(payload)) + payload)
+        n = struct.unpack("<Q", _recvall(s, 8))[0]
+        resp = pickle.loads(_recvall(s, n))
+    if resp.get("err"):
+        raise RuntimeError(f"DKV coordinator error: {resp['err']}")
+    return resp.get("value")
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        global _counter
+        try:
+            n = struct.unpack("<Q", _recvall(self.request, 8))[0]
+            req = pickle.loads(_recvall(self.request, n))
+            op = req["op"]
+            if op == "put":
+                with _lock:
+                    _store[req["key"]] = req["value"]
+                value = req["key"]
+            elif op == "get":
+                with _lock:
+                    value = _store.get(req["key"])
+            elif op == "remove":
+                with _lock:
+                    _store.pop(req["key"], None)
+                value = None
+            elif op == "keys":
+                with _lock:
+                    value = sorted(k for k in _store
+                                   if k.startswith(req["prefix"]))
+            elif op == "make_key":
+                with _lock:
+                    _counter += 1
+                    value = f"{req['prefix']}_{_counter}"
+            elif op == "ping":
+                value = "pong"
+            else:
+                raise ValueError(f"unknown DKV op {op!r}")
+            resp = {"value": value}
+        except Exception as e:          # noqa: BLE001 — reported to client
+            resp = {"err": repr(e)}
+        payload = pickle.dumps(resp, protocol=pickle.HIGHEST_PROTOCOL)
+        try:
+            self.request.sendall(struct.pack("<Q", len(payload)) + payload)
+        except OSError:
+            pass
+
+
+class _DKVServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def serve(host: str = "127.0.0.1", port: int = 0) -> int:
+    """Start the coordinator DKV service; returns the bound port."""
+    global _server
+    if _server is not None:
+        return _server.server_address[1]
+    _server = _DKVServer((host, port), _Handler)
+    t = threading.Thread(target=_server.serve_forever, daemon=True,
+                         name="dkv-coordinator")
+    t.start()
+    return _server.server_address[1]
+
+
+def attach(host: str, port: int, timeout: float = 60.0) -> None:
+    """Point this process's DKV at the coordinator service (with retry)."""
+    global _remote
+    _remote = (host, port)
+    deadline = time.time() + timeout
+    while True:
+        try:
+            _rpc("ping")
+            return
+        except (ConnectionError, OSError):
+            if time.time() > deadline:
+                _remote = None
+                raise
+            time.sleep(0.2)
+
+
+def detach() -> None:
+    global _remote, _server
+    _remote = None
+    if _server is not None:
+        _server.shutdown()
+        _server = None

@@ -1,0 +1,147 @@
+"""Multi-process distributed runtime test — the multiNodeUtils.sh analog.
+
+The reference's core distributed test pattern (SURVEY.md §4,
+``scripts/multiNodeUtils.sh:21-26``) spawns real JVMs on localhost and runs
+jobs across them.  Here: N real Python processes each with 4 virtual CPU
+devices run ``jax.distributed.initialize`` against a localhost coordinator,
+boot one 8-device global mesh, and execute the same SPMD training programs —
+XLA collectives cross the process boundary exactly as they would cross
+ICI/DCN on a TPU pod, and the coordinator DKV service carries the control
+plane.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r"""
+import json, os, sys
+
+pid = int(sys.argv[1])
+nproc = int(sys.argv[2])
+coord = sys.argv[3]
+out_path = sys.argv[4]
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+# initialize BEFORE anything can touch the XLA backend
+jax.distributed.initialize(coordinator_address=coord, num_processes=nproc,
+                           process_id=pid)
+
+import numpy as np
+import h2o3_tpu
+from h2o3_tpu import Frame
+from h2o3_tpu.frame.vec import T_CAT
+from h2o3_tpu.models import GBM, GLM
+from h2o3_tpu.runtime import dkv
+
+cl = h2o3_tpu.init(coordinator=coord, num_processes=nproc, process_id=pid)
+assert jax.process_count() == nproc, jax.process_count()
+assert cl.n_devices == 4 * nproc, cl.n_devices
+
+# identical data everywhere — SPMD: every process executes the same program
+rng = np.random.default_rng(0)
+n = 4000
+x1 = rng.normal(size=n).astype(np.float32)
+x2 = rng.normal(size=n).astype(np.float32)
+c1 = rng.integers(0, 4, n)
+logit = 1.2 * x1 - 0.8 * x2 + 0.5 * (c1 == 2)
+y = rng.random(n) < 1 / (1 + np.exp(-logit))
+fr = Frame.from_numpy(
+    {"x1": x1, "x2": x2, "c1": c1,
+     "y": np.where(y, "YES", "NO").astype(object)},
+    types={"c1": T_CAT}, domains={"c1": [str(i) for i in range(4)]})
+
+# rollups ride a cross-process psum
+mean_x1 = fr.vec("x1").mean()
+
+glm = GLM(response_column="y", family="binomial", lambda_=0.0,
+          seed=1).train(fr)
+glm_auc = glm.training_metrics.describe()["auc"]
+
+gbm = GBM(response_column="y", ntrees=4, max_depth=3, nbins=16,
+          seed=1).train(fr)
+gbm_auc = gbm.training_metrics.describe()["auc"]
+
+# control plane: each process publishes a result; all read each other's
+dkv.put(f"mp_result_{pid}", {"auc": float(glm_auc)})
+from jax.experimental import multihost_utils
+multihost_utils.sync_global_devices("dkv_published")
+peers = {}
+for other in range(nproc):
+    v = dkv.get(f"mp_result_{other}")
+    peers[other] = None if v is None else v["auc"]
+
+with open(out_path, "w") as f:
+    json.dump({"pid": pid, "mean_x1": float(mean_x1),
+               "glm_auc": float(glm_auc), "gbm_auc": float(gbm_auc),
+               "peers": peers}, f)
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_cluster(tmp_path):
+    nproc = 2
+    port = _free_port()
+    coord = f"127.0.0.1:{port}"
+    worker_py = tmp_path / "worker.py"
+    worker_py.write_text(WORKER)
+    procs = []
+    outs = []
+    for pid in range(nproc):
+        out = tmp_path / f"out_{pid}.json"
+        outs.append(out)
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "host_platform_device_count" not in f]
+        flags.append("--xla_force_host_platform_device_count=4")
+        env["XLA_FLAGS"] = " ".join(flags)
+        # CPU-only workers: drop the axon TPU plugin from the path — its
+        # sitecustomize probes the backend, which must not happen before
+        # jax.distributed.initialize
+        ambient = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                   if p and "axon" not in p]
+        env["PYTHONPATH"] = os.pathsep.join([ROOT] + ambient)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(worker_py), str(pid), str(nproc), coord,
+             str(out)],
+            env=env, cwd=ROOT, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
+    logs = []
+    for p in procs:
+        try:
+            stdout, _ = p.communicate(timeout=540)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        logs.append(stdout)
+    for pid, p in enumerate(procs):
+        assert p.returncode == 0, (
+            f"worker {pid} failed:\n{logs[pid][-4000:]}")
+    results = [json.loads(o.read_text()) for o in outs]
+    # SPMD: every process computed the same global result
+    assert abs(results[0]["mean_x1"] - results[1]["mean_x1"]) < 1e-6
+    assert abs(results[0]["glm_auc"] - results[1]["glm_auc"]) < 1e-6
+    assert abs(results[0]["gbm_auc"] - results[1]["gbm_auc"]) < 1e-6
+    assert results[0]["glm_auc"] > 0.7
+    assert results[0]["gbm_auc"] > 0.7
+    # control plane: cross-process DKV resolution
+    for r in results:
+        assert r["peers"]["0"] is not None or r["peers"].get(0) is not None
+        vals = list(r["peers"].values())
+        assert all(v is not None for v in vals), r["peers"]
