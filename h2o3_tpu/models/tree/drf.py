@@ -119,9 +119,11 @@ class DRF(SharedTree):
         # a whole scoring interval of trees is one device dispatch.  The same
         # per-tree keys are reused across classes so every class sees the
         # same bootstrap sample per iteration (DRF.java samples once/tree).
+        from .shared import use_hier_split_search
         scan_fn = make_tree_scan_fn(
             "drf", 0.0, 0.0, 0.0, p.max_depth, p.nbins, Fnum, N,
-            p.hist_precision, p.sample_rate, 1.0)
+            p.hist_precision, p.sample_rate, 1.0,
+            hier=use_hier_split_search(p, N))
         scalars = (p.reg_lambda, p.min_rows, p.min_split_improvement, 1.0,
                    col_rate, p.reg_alpha, p.gamma, p.min_child_weight)
         chunks = [[] for _ in range(K)]

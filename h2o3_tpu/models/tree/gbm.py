@@ -33,7 +33,7 @@ from .binning import fit_bins, edges_matrix
 from .shared import (SharedTree, SharedTreeModel, SharedTreeParameters,
                      StackedTrees, Tree, TreeList, build_tree,
                      chunk_schedule, make_tree_scan_fn, stack_trees,
-                     traverse_jit)
+                     traverse_jit, use_hier_split_search)
 from ...metrics.core import make_metrics
 
 
@@ -153,7 +153,8 @@ class GBM(SharedTree):
             scan_fn = make_tree_scan_fn(
                 dist.name, p.tweedie_power, p.quantile_alpha, p.huber_alpha,
                 p.max_depth, p.nbins, binned.nfeatures, N, p.hist_precision,
-                p.sample_rate, p.col_sample_rate_per_tree)
+                p.sample_rate, p.col_sample_rate_per_tree,
+                hier=use_hier_split_search(p, N))
             scalars = (p.reg_lambda, p.min_rows, p.min_split_improvement,
                        p.learn_rate, p.col_sample_rate, p.reg_alpha, p.gamma,
                        p.min_child_weight)
@@ -241,7 +242,8 @@ class GBM(SharedTree):
                         p.min_split_improvement, lr_build, kk,
                         p.col_sample_rate, tree_mask,
                         p.reg_alpha, p.gamma, p.min_child_weight,
-                    hist_precision=p.hist_precision)
+                    hist_precision=p.hist_precision,
+                        hier=use_hier_split_search(p, N))
                     if dart:
                         tree.values = tree.values * b_scale
                     ktrees.append(tree)
@@ -267,7 +269,8 @@ class GBM(SharedTree):
                     p.min_split_improvement, lr_build, kc,
                     p.col_sample_rate, tree_mask,
                     p.reg_alpha, p.gamma, p.min_child_weight,
-                    hist_precision=p.hist_precision)
+                    hist_precision=p.hist_precision,
+                    hier=use_hier_split_search(p, N))
                 tree.values = tree.values * b_scale
                 trees.append(tree)
                 from .hist import table_lookup

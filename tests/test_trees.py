@@ -45,6 +45,20 @@ def test_gbm_binomial(cl, rng):
     assert m.training_metrics.auc > 0.9, m.training_metrics.describe()
 
 
+def test_gbm_hier_split_search_quality(cl, rng):
+    """The hierarchical (benchmark-scale) split search trains through the
+    scan driver and lands within noise of the exact path's fit."""
+    fr = _friedman(rng)
+    kw = dict(response_column="y", ntrees=20, max_depth=4, learn_rate=0.2,
+              nbins=64, reg_lambda=1.0, seed=1)
+    m_exact = GBM(split_search="exact", **kw).train(fr)
+    m_hier = GBM(split_search="hier", **kw).train(fr)
+    r2_e = m_exact.training_metrics.r2
+    r2_h = m_hier.training_metrics.r2
+    assert r2_h > 0.85, (r2_e, r2_h)
+    assert abs(r2_e - r2_h) < 0.05, (r2_e, r2_h)
+
+
 def test_gbm_vs_sklearn(cl, rng):
     from sklearn.ensemble import HistGradientBoostingRegressor
     from sklearn.metrics import r2_score
