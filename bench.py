@@ -1,4 +1,4 @@
-"""Benchmark: tpu_hist boosting throughput (trees/sec, Airlines-10M shape).
+"""Benchmark suite: tpu_hist boosting (headline), DeepLearning, Rapids.
 
 North star (BASELINE.json / SURVEY.md §6): the reference's XGBoost gpu_hist
 benchmark gate trains 100 trees on airlines-10m in 22-52s on its GPU node
@@ -7,7 +7,15 @@ divides our trees/sec by the best end of that interval (4.5), measured on an
 airlines-shaped synthetic set: 10M rows, mixed numeric/categorical, binary
 response, max_depth=6, nbins=256 — the same work shape gpu_hist does.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Secondary metrics (BASELINE.md):
+ - DeepLearning samples/sec, MNIST shape (DeepLearning.java:648 rows/sec
+   hook; no published reference value → no vs_baseline).
+ - Rapids sort / merge wall-clock at 10M x 2 cols (reference Jenkins gate:
+   sort 2-7 s, merge 4-10 s; vs_baseline divides the reference BEST time by
+   ours, so >1 means faster than the reference's best).
+
+Prints ONE JSON line: the headline record with an "extra" dict carrying the
+secondary metrics.
 """
 
 import json
@@ -16,6 +24,8 @@ import time
 import numpy as np
 
 REFERENCE_TREES_PER_SEC = 4.5     # best of the reference gpu_hist interval
+REFERENCE_SORT_10M_S = 2.0        # best of Jenkins sort interval (10M rows)
+REFERENCE_MERGE_10M_S = 4.0       # best of Jenkins merge interval (10M rows)
 N_ROWS = 10_000_000
 N_TREES = 50
 
@@ -45,17 +55,10 @@ def make_airlines_like(n):
     return cols, types, domains
 
 
-def main():
-    import h2o3_tpu
-    from h2o3_tpu import Frame
-    from h2o3_tpu.frame.vec import T_CAT
-    from h2o3_tpu.models import XGBoost
-
-    h2o3_tpu.init()
+def bench_trees(Frame, T_CAT, XGBoost):
     cols, types, domains = make_airlines_like(N_ROWS)
     types = {k: (T_CAT if v == "cat" else v) for k, v in types.items()}
     fr = Frame.from_numpy(cols, types=types, domains=domains)
-
     config = dict(response_column="dep_delayed_15min", max_depth=6,
                   nbins=256, seed=1, score_tree_interval=10 ** 9)
     # warmup: two full scan chunks — the first compiles the exact program the
@@ -65,12 +68,94 @@ def main():
     t0 = time.time()
     XGBoost(ntrees=N_TREES, **config).train(fr)
     dt = time.time() - t0
-    tps = N_TREES / dt
+    del fr
+    return N_TREES / dt
+
+
+def bench_deeplearning(Frame, DeepLearning):
+    """MNIST-shape MLP throughput (samples/sec/chip)."""
+    n, d = 60_000, 784
+    rng = np.random.default_rng(1)
+    X = (rng.random((n, d)) * 255).astype(np.float32)
+    y = rng.integers(0, 10, n)
+    cols = {f"p{j}": X[:, j] for j in range(d)}
+    cols["label"] = np.array([str(v) for v in y], dtype=object)
+    fr = Frame.from_numpy(cols)
+    kw = dict(response_column="label", hidden=(200, 200),
+              mini_batch_size=512, score_interval=1e9, stopping_rounds=0,
+              seed=1)
+    DeepLearning(epochs=0.2, **kw).train(fr)          # compile warmup
+    epochs = 3.0
+    t0 = time.time()
+    DeepLearning(epochs=epochs, **kw).train(fr)
+    dt = time.time() - t0
+    del fr
+    return epochs * n / dt
+
+
+def _sync(frame):
+    """Force completion of a frame's device work (async dispatch barrier).
+
+    A one-element fetch of each output column blocks until its whole buffer
+    exists; block_until_ready does NOT synchronize over the axon tunnel
+    (PROFILE.md), so a tiny real fetch is the reliable sync point.
+    """
+    for v in frame.vecs:
+        if v.data is not None:
+            np.asarray(v.data[:1])
+
+
+def bench_rapids(Frame, sort, merge):
+    n = N_ROWS
+    rng = np.random.default_rng(2)
+    big = Frame.from_numpy({
+        "KEY": rng.integers(0, n, n).astype(np.float64),
+        "X2": rng.random(n)})
+    small = Frame.from_numpy({
+        "KEY": rng.integers(0, n, n // 10).astype(np.float64),
+        "Y2": rng.random(n // 10)})
+    _sync(sort(big, "KEY"))                           # warmup/compile
+    t0 = time.time()
+    _sync(sort(big, "KEY"))
+    dt_sort = time.time() - t0
+    _sync(merge(big, small, "KEY", how="inner"))      # warmup/compile
+    t0 = time.time()
+    _sync(merge(big, small, "KEY", how="inner"))
+    dt_merge = time.time() - t0
+    return dt_sort, dt_merge
+
+
+def main():
+    import h2o3_tpu
+    from h2o3_tpu import Frame
+    from h2o3_tpu.frame.vec import T_CAT
+    from h2o3_tpu.models import XGBoost, DeepLearning
+    from h2o3_tpu.rapids import sort, merge
+
+    h2o3_tpu.init()
+    extra = {}
+    tps = bench_trees(Frame, T_CAT, XGBoost)
+    try:
+        sps = bench_deeplearning(Frame, DeepLearning)
+        extra["deeplearning_samples_per_sec_mnist_shape"] = round(sps, 1)
+    except Exception as e:                            # secondary: never fatal
+        extra["deeplearning_error"] = repr(e)[:200]
+    try:
+        dt_sort, dt_merge = bench_rapids(Frame, sort, merge)
+        extra["rapids_sort_10m_sec"] = round(dt_sort, 3)
+        extra["rapids_sort_vs_baseline"] = round(REFERENCE_SORT_10M_S
+                                                 / dt_sort, 3)
+        extra["rapids_merge_10m_sec"] = round(dt_merge, 3)
+        extra["rapids_merge_vs_baseline"] = round(REFERENCE_MERGE_10M_S
+                                                  / dt_merge, 3)
+    except Exception as e:
+        extra["rapids_error"] = repr(e)[:200]
     print(json.dumps({
         "metric": "xgboost_trees_per_sec_airlines10m_shape",
         "value": round(tps, 3),
         "unit": "trees/sec",
         "vs_baseline": round(tps / REFERENCE_TREES_PER_SEC, 3),
+        "extra": extra,
     }))
 
 

@@ -12,10 +12,24 @@ Module-level API mirrors the ``h2o`` Python package (h2o-py/h2o/h2o.py):
 
 from .runtime.cluster import init, cluster, shutdown
 from .runtime import dkv
+from . import persist
 from .frame.frame import Frame
 from .frame.vec import Vec
-from .frame.parse import import_file, parse_csv, upload_string
+from .frame.parse import (import_file, parse_csv, parse_files,
+                          parse_svmlight, parse_arff, export_file,
+                          upload_string)
 from .export.mojo import import_mojo
+
+
+def save_model(model, path: str) -> str:
+    """h2o.save_model analog — any persist URI works."""
+    return model.save(path)
+
+
+def load_model(path: str):
+    """h2o.load_model analog."""
+    from .models.base import Model
+    return Model.load(path)
 
 __version__ = "0.1.0"
 

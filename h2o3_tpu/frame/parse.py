@@ -164,12 +164,282 @@ def _parse_csv_stdlib(path_or_buf, header, sep, col_names):
     return names, cols
 
 
-def import_file(path: str, destination_frame: Optional[str] = None,
+def _open_decompressed(uri: str) -> io.TextIOBase:
+    """Open a (possibly remote, possibly compressed) source as text.
+
+    Compression by extension — gzip/zip/bz2/xz; zip reads the first entry
+    (ZipUtil.java behavior).  Remote schemes route through the Persist SPI.
+    """
+    from .. import persist
+    raw = persist.open_read(uri)
+    base = uri.lower()
+    if base.endswith(".gz"):
+        import gzip
+        return io.TextIOWrapper(gzip.GzipFile(fileobj=raw), newline="")
+    if base.endswith(".zip"):
+        import zipfile
+        zf = zipfile.ZipFile(raw)
+        names = [n for n in zf.namelist() if not n.endswith("/")]
+        if not names:
+            raise ValueError(f"{uri}: empty zip archive")
+        return io.TextIOWrapper(zf.open(names[0]), newline="")
+    if base.endswith(".bz2"):
+        import bz2
+        return io.TextIOWrapper(bz2.BZ2File(raw), newline="")
+    if base.endswith(".xz"):
+        import lzma
+        return io.TextIOWrapper(lzma.LZMAFile(raw), newline="")
+    return io.TextIOWrapper(raw, newline="")
+
+
+def _expand_paths(path) -> List[str]:
+    """Expand a path / glob / directory / URI / list into source URIs."""
+    from .. import persist
+    paths = path if isinstance(path, (list, tuple)) else [path]
+    out: List[str] = []
+    for p in paths:
+        matches = persist.list_uris(p)
+        if matches:
+            out.extend(matches)
+        elif persist.exists(p):
+            out.append(p)
+        else:
+            raise FileNotFoundError(p)
+    return out
+
+
+def parse_files(paths: Sequence[str],
+                destination_frame: Optional[str] = None,
+                header: Optional[bool] = None, sep: Optional[str] = None,
+                col_types: Optional[Dict[str, str]] = None,
+                col_names: Optional[List[str]] = None,
+                chunksize: int = 1_000_000) -> Frame:
+    """Parse many CSV shards into ONE Frame — MultiFileParseTask analog.
+
+    Each shard streams through pandas in ``chunksize``-row chunks.  Numeric
+    chunks are ``device_put`` immediately and the host copy dropped, so host
+    RSS stays bounded by ~chunksize rows for numeric data (the reference
+    keeps raw chunks in the DKV and parses in place —
+    ParseDataset.java:688).  Text/categorical columns accumulate host-side:
+    their global domain must be built before codes exist, mirroring the
+    reference's cluster-wide categorical domain merge
+    (ParseDataset.java:501-600).
+    """
+    import jax.numpy as jnp
+    col_types = col_types or {}
+    try:
+        import pandas as pd
+    except ImportError:
+        pd = None
+    dev_chunks: Dict[str, list] = {}
+    host_chunks: Dict[str, list] = {}
+    names: Optional[List[str]] = None
+
+    def eat(df_names, df_cols):
+        nonlocal names
+        if names is None:
+            names = list(df_names)
+            for n in names:
+                dev_chunks[n] = []
+                host_chunks[n] = []
+        elif list(df_names) != names:
+            raise ValueError(
+                f"shard schema mismatch: {df_names} vs {names}")
+        for n in names:
+            arr = np.asarray(df_cols[n])
+            want = col_types.get(n)
+            if arr.dtype.kind in "if" and want in (None, T_NUM) \
+                    and not host_chunks[n]:
+                dev_chunks[n].append(jnp.asarray(arr, jnp.float32))
+            else:
+                if dev_chunks[n]:      # late type widening: pull back
+                    host_chunks[n] = [np.asarray(c) for c in dev_chunks[n]]
+                    dev_chunks[n] = []
+                host_chunks[n].append(arr)
+
+    for uri in paths:
+        fh = _open_decompressed(uri)
+        if pd is not None:
+            reader = pd.read_csv(
+                fh, sep=sep if sep is not None else ",",
+                header=0 if header in (None, True) else None,
+                na_values=sorted(_NA), keep_default_na=True, engine="c",
+                chunksize=chunksize)
+            for df in reader:
+                if col_names:
+                    df.columns = col_names
+                eat([str(c) for c in df.columns],
+                    {str(c): df[c].to_numpy() for c in df.columns})
+        else:
+            snames, scols = _parse_csv_stdlib(fh, header, sep, col_names)
+            eat(snames, scols)
+        fh.close()
+    if names is None:
+        raise ValueError("no data parsed")
+    vecs = []
+    for n in names:
+        if dev_chunks[n]:
+            data = jnp.concatenate(dev_chunks[n]) if len(dev_chunks[n]) > 1 \
+                else dev_chunks[n][0]
+            vecs.append(_device_numeric_vec(data))
+        else:
+            col = np.concatenate(host_chunks[n]) if len(host_chunks[n]) > 1 \
+                else host_chunks[n][0]
+            vecs.append(_column_to_vec(col, n, col_types.get(n)))
+    key = destination_frame or dkv.make_key(
+        os.path.basename(str(paths[0])) or "frame")
+    return Frame(names, vecs, key=key)
+
+
+def _device_numeric_vec(data) -> Vec:
+    """Vec from an already-on-device f32 column (pads + row-shards)."""
+    import jax.numpy as jnp
+    from ..runtime.cluster import cluster, put_sharded
+    cl = cluster()
+    n = int(data.shape[0])
+    padded = cl.pad_rows(n)
+    if padded > n:
+        data = jnp.concatenate(
+            [data, jnp.full(padded - n, jnp.nan, jnp.float32)])
+    return Vec(put_sharded(data, cl.row_sharding), T_NUM, n)
+
+
+def parse_svmlight(path: str,
+                   destination_frame: Optional[str] = None) -> Frame:
+    """SVMLight sparse format -> dense Frame (parser/SVMLightParser analog).
+
+    Lines: ``<target> <idx>:<val> ...`` (1-based indices per the format).
+    """
+    targets, rows, max_idx = [], [], 0
+    fh = _open_decompressed(path)
+    for line in fh:
+        line = line.split("#", 1)[0].strip()
+        if not line:
+            continue
+        parts = line.split()
+        targets.append(float(parts[0]))
+        pairs = []
+        for tok in parts[1:]:
+            i, _, v = tok.partition(":")
+            idx = int(i)
+            pairs.append((idx, float(v)))
+            max_idx = max(max_idx, idx)
+        rows.append(pairs)
+    fh.close()
+    # index base detection: the format spec is 1-based, but 0-based files
+    # are common (sklearn dump_svmlight_file defaults to zero_based=True)
+    min_idx = min((i for pairs in rows for i, _ in pairs), default=1)
+    base = 0 if min_idx == 0 else 1
+    n, d = len(rows), max_idx + 1 - base
+    X = np.zeros((n, d), np.float32)
+    for r, pairs in enumerate(rows):
+        for idx, v in pairs:
+            X[r, idx - base] = v
+    names = ["target"] + [f"C{j+1}" for j in range(d)]
+    vecs = [Vec.from_numpy(np.asarray(targets, np.float64), T_NUM)]
+    vecs += [Vec.from_numpy(X[:, j], T_NUM) for j in range(d)]
+    return Frame(names, vecs, key=destination_frame or dkv.make_key("svm"))
+
+
+def parse_arff(path: str, destination_frame: Optional[str] = None) -> Frame:
+    """ARFF -> Frame (parser/ARFFParser analog): @attribute-driven types."""
+    names, types, domains = [], [], []
+    data_lines = []
+    in_data = False
+    fh = _open_decompressed(path)
+    for line in fh:
+        s = line.strip()
+        if not s or s.startswith("%"):
+            continue
+        low = s.lower()
+        if in_data:
+            data_lines.append(s)
+        elif low.startswith("@attribute"):
+            rest = s.split(None, 1)[1]
+            if rest.startswith('"') or rest.startswith("'"):
+                q = rest[0]
+                name = rest[1:rest.index(q, 1)]
+                spec = rest[rest.index(q, 1) + 1:].strip()
+            else:
+                name, _, spec = rest.partition(" ")
+                spec = spec.strip()
+            names.append(name)
+            if spec.startswith("{"):
+                types.append(T_CAT)
+                domains.append([v.strip().strip("'\"")
+                                for v in spec.strip("{}").split(",")])
+            elif spec.lower() in ("numeric", "real", "integer"):
+                types.append(T_NUM)
+                domains.append(None)
+            elif spec.lower().startswith("date"):
+                types.append(T_TIME)
+                domains.append(None)
+            else:
+                types.append(T_STR)
+                domains.append(None)
+        elif low.startswith("@data"):
+            in_data = True
+    fh.close()
+    rows = list(csv.reader(data_lines))
+    cols = {}
+    for i, n in enumerate(names):
+        cols[n] = np.array([r[i].strip() if i < len(r) else ""
+                            for r in rows], dtype=object)
+    vecs = []
+    for n, t, dom in zip(names, types, domains):
+        if t == T_CAT:
+            lookup = {s: i for i, s in enumerate(dom)}
+            codes = np.array([lookup.get(v, -1) for v in cols[n]], np.int32)
+            vecs.append(Vec.from_numpy(codes, T_CAT, domain=dom))
+        elif t == T_NUM:
+            vals = np.array([np.nan if v in _NA else float(v)
+                             for v in cols[n]], np.float64)
+            vecs.append(Vec.from_numpy(vals, T_NUM))
+        else:
+            vecs.append(_column_to_vec(cols[n], n, t))
+    return Frame(names, vecs, key=destination_frame or dkv.make_key("arff"))
+
+
+def import_file(path, destination_frame: Optional[str] = None,
                 **kw) -> Frame:
-    """h2o.import_file analog (h2o-py/h2o/h2o.py import_file -> /3/Parse)."""
-    if not os.path.exists(path):
-        raise FileNotFoundError(path)
-    return parse_csv(path, destination_frame=destination_frame, **kw)
+    """h2o.import_file analog (h2o-py/h2o/h2o.py import_file -> /3/Parse).
+
+    Accepts a single path, a glob pattern, a directory, a list of paths, or
+    a persist URI (``gcs://…``, ``file://…``); gzip/zip/bz2/xz shards
+    decompress transparently; ``.svm``/``.svmlight`` and ``.arff`` route to
+    the format-specific parsers.
+    """
+    paths = _expand_paths(path)
+    low = paths[0].lower()
+    for ext, fn in ((".svm", parse_svmlight), (".svmlight", parse_svmlight),
+                    (".arff", parse_arff)):
+        if low.endswith(ext) or low.endswith(ext + ".gz"):
+            if len(paths) > 1:
+                raise ValueError(f"multi-file {ext} import not supported")
+            return fn(paths[0], destination_frame=destination_frame)
+    if len(paths) == 1 and "://" not in paths[0] \
+            and not any(paths[0].lower().endswith(e)
+                        for e in (".gz", ".zip", ".bz2", ".xz")):
+        return parse_csv(paths[0], destination_frame=destination_frame, **kw)
+    return parse_files(paths, destination_frame=destination_frame, **kw)
+
+
+def export_file(frame: Frame, uri: str, header: bool = True) -> str:
+    """Write a Frame as CSV to any persist URI — h2o.export_file analog."""
+    from .. import persist
+    cols = [v.decoded() for v in frame.vecs]
+    fh = persist.open_write(uri)
+    out = io.TextIOWrapper(fh, newline="")
+    wr = csv.writer(out)
+    if header:
+        wr.writerow(frame.names)
+    for i in range(frame.nrows):
+        wr.writerow(["" if (c[i] is None or (isinstance(c[i], float)
+                                             and np.isnan(c[i]))) else c[i]
+                     for c in cols])
+    out.flush()
+    out.close()
+    return uri
 
 
 def upload_string(text: str, **kw) -> Frame:

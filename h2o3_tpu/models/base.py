@@ -134,10 +134,17 @@ class Model:
 
     # ------------------------------------------------------------ persistence
     def save(self, path: str) -> str:
+        """Save the model to any persist URI (local, gcs://, s3://, …)."""
+        from .. import persist
         state = self.__dict__.copy()
+        if isinstance(state.get("output"), dict):
+            # "stacked" duplicates output["trees"] as raw device arrays;
+            # it is rebuilt lazily on first scoring after load
+            state["output"] = {k: v for k, v in state["output"].items()
+                               if k != "stacked"}
         state = jax.tree.map(
             lambda v: np.asarray(v) if isinstance(v, jax.Array) else v, state)
-        with open(path, "wb") as f:
+        with persist.open_write(path) as f:
             pickle.dump((type(self), state), f)
         return path
 
@@ -148,7 +155,8 @@ class Model:
 
     @staticmethod
     def load(path: str) -> "Model":
-        with open(path, "rb") as f:
+        from .. import persist
+        with persist.open_read(path) as f:
             cls, state = pickle.load(f)
         m = object.__new__(cls)
         m.__dict__.update(state)

@@ -1,0 +1,246 @@
+"""Persist SPI: pluggable storage backends behind URI schemes.
+
+Reference: ``water/persist/PersistManager.java`` routes every import/export
+through a scheme-keyed registry of Persist implementations (PersistFS,
+PersistGcs in h2o-persist-gcs, PersistS3, PersistHdfs, PersistHTTP); the
+data plane reads raw byte ranges, the control plane lists/globs keys.
+
+TPU-native redesign: the storage layer has no device concerns at all, so
+the SPI is a small host-side protocol (open_read/open_write/list/exists/
+delete).  The GCS backend is first (TPU-VMs live next to GCS, SURVEY.md §7
+step 9): it uses ``google.cloud.storage`` when installed and otherwise a
+"mock root" mapping (``gcs://bucket/key`` -> ``$H2O3_TPU_GCS_ROOT/bucket/
+key``) so the full import/export surface stays testable offline.  S3/HDFS
+get the same mock treatment; HTTP is read-only via urllib.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import io
+import os
+import shutil
+import urllib.request
+from typing import BinaryIO, Dict, List, Optional, Tuple
+
+__all__ = ["get_backend", "register", "split_uri", "open_read",
+           "open_write", "list_uris", "exists", "delete", "PersistBackend"]
+
+
+class PersistBackend:
+    """One storage scheme — the water.persist.Persist analog."""
+
+    scheme: str = ""
+
+    def open_read(self, path: str) -> BinaryIO:
+        raise NotImplementedError
+
+    def open_write(self, path: str) -> BinaryIO:
+        raise NotImplementedError
+
+    def list(self, pattern: str) -> List[str]:
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def delete(self, path: str) -> None:
+        raise NotImplementedError
+
+    def _uri(self, path: str) -> str:
+        return f"{self.scheme}://{path}" if self.scheme else path
+
+
+class LocalPersist(PersistBackend):
+    """Plain filesystem (PersistFS analog); also handles file:// URIs."""
+
+    scheme = ""
+
+    def open_read(self, path: str) -> BinaryIO:
+        return open(path, "rb")
+
+    def open_write(self, path: str) -> BinaryIO:
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        return open(path, "wb")
+
+    def list(self, pattern: str) -> List[str]:
+        if os.path.isdir(pattern):
+            pattern = os.path.join(pattern, "*")
+        return sorted(p for p in _glob.glob(pattern) if os.path.isfile(p))
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def delete(self, path: str) -> None:
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        elif os.path.exists(path):
+            os.remove(path)
+
+
+class MockableCloudPersist(PersistBackend):
+    """Cloud object store backend with an offline mock root.
+
+    Real client libraries are used when importable; otherwise paths map
+    onto ``$H2O3_TPU_{SCHEME}_ROOT`` (default /tmp/h2o3_tpu_{scheme}) so
+    integration flows run without cloud credentials — the reference's
+    PersistGcs tests use the same trick with a fake GCS server.
+    """
+
+    def __init__(self, scheme: str):
+        self.scheme = scheme
+        self._local = LocalPersist()
+
+    @property
+    def _root(self) -> Optional[str]:
+        """Mock root dir; set H2O3_TPU_{SCHEME}_ROOT to activate the mock."""
+        return os.environ.get(f"H2O3_TPU_{self.scheme.upper()}_ROOT")
+
+    def _client_open(self, path: str, mode: str):
+        if self.scheme in ("gcs", "gs"):
+            from google.cloud import storage  # needs creds at call time
+            bucket_name, _, key = path.partition("/")
+            blob = storage.Client().bucket(bucket_name).blob(key)
+            if mode == "rb":
+                return io.BytesIO(blob.download_as_bytes())
+            return _BlobWriter(blob)
+        raise NotImplementedError(
+            f"scheme {self.scheme!r} has no live client in this build; "
+            f"set H2O3_TPU_{self.scheme.upper()}_ROOT to use the offline "
+            f"mock mapping")
+
+    def _map(self, path: str) -> str:
+        return os.path.join(self._root, path)
+
+    def open_read(self, path: str) -> BinaryIO:
+        if self._root is not None:
+            return self._local.open_read(self._map(path))
+        return self._client_open(path, "rb")
+
+    def open_write(self, path: str) -> BinaryIO:
+        if self._root is not None:
+            return self._local.open_write(self._map(path))
+        return self._client_open(path, "wb")
+
+    def list(self, pattern: str) -> List[str]:
+        if self._root is not None:
+            root = self._root
+            out = self._local.list(self._map(pattern))
+            return [f"{self.scheme}://{os.path.relpath(p, root)}"
+                    for p in out]
+        if self.scheme in ("gcs", "gs"):  # pragma: no cover - needs creds
+            from google.cloud import storage
+            bucket_name, _, prefix = pattern.partition("/")
+            prefix = prefix.split("*", 1)[0]
+            blobs = storage.Client().list_blobs(bucket_name, prefix=prefix)
+            return [f"{self.scheme}://{bucket_name}/{b.name}" for b in blobs]
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        if self._root is not None:
+            return self._local.exists(self._map(path))
+        try:
+            self.open_read(path).close()
+            return True
+        except Exception:
+            return False
+
+    def delete(self, path: str) -> None:
+        if self._root is not None:
+            self._local.delete(self._map(path))
+        else:  # pragma: no cover - needs creds
+            from google.cloud import storage
+            bucket_name, _, key = path.partition("/")
+            storage.Client().bucket(bucket_name).blob(key).delete()
+
+
+class _BlobWriter(io.BytesIO):  # pragma: no cover - needs real GCS
+    def __init__(self, blob):
+        super().__init__()
+        self._blob = blob
+
+    def close(self):
+        self._blob.upload_from_string(self.getvalue())
+        super().close()
+
+
+class HTTPPersist(PersistBackend):
+    """Read-only HTTP(S) source (PersistHTTP analog)."""
+
+    def __init__(self, scheme: str):
+        self.scheme = scheme
+
+    def open_read(self, path: str) -> BinaryIO:
+        return io.BytesIO(
+            urllib.request.urlopen(f"{self.scheme}://{path}").read())
+
+    def list(self, pattern: str) -> List[str]:
+        return [f"{self.scheme}://{pattern}"]
+
+    def exists(self, path: str) -> bool:
+        try:
+            self.open_read(path).close()
+            return True
+        except Exception:
+            return False
+
+
+_REGISTRY: Dict[str, PersistBackend] = {
+    "": LocalPersist(),
+    "file": LocalPersist(),
+    "gcs": MockableCloudPersist("gcs"),
+    "gs": MockableCloudPersist("gs"),
+    "s3": MockableCloudPersist("s3"),
+    "hdfs": MockableCloudPersist("hdfs"),
+    "http": HTTPPersist("http"),
+    "https": HTTPPersist("https"),
+}
+
+
+def register(scheme: str, backend: PersistBackend) -> None:
+    """Install a custom backend — the PersistManager extension point."""
+    _REGISTRY[scheme] = backend
+
+
+def split_uri(uri: str) -> Tuple[PersistBackend, str]:
+    scheme, sep, rest = uri.partition("://")
+    if not sep:
+        return _REGISTRY[""], uri
+    if scheme == "file":
+        return _REGISTRY[""], rest if rest.startswith("/") else "/" + rest
+    be = _REGISTRY.get(scheme)
+    if be is None:
+        raise ValueError(f"no persist backend for scheme {scheme!r} "
+                         f"(have {sorted(k for k in _REGISTRY if k)})")
+    return be, rest
+
+
+def get_backend(uri: str) -> PersistBackend:
+    return split_uri(uri)[0]
+
+
+def open_read(uri: str) -> BinaryIO:
+    be, path = split_uri(uri)
+    return be.open_read(path)
+
+
+def open_write(uri: str) -> BinaryIO:
+    be, path = split_uri(uri)
+    return be.open_write(path)
+
+
+def list_uris(pattern: str) -> List[str]:
+    be, path = split_uri(pattern)
+    return be.list(path)
+
+
+def exists(uri: str) -> bool:
+    be, path = split_uri(uri)
+    return be.exists(path)
+
+
+def delete(uri: str) -> None:
+    be, path = split_uri(uri)
+    be.delete(path)

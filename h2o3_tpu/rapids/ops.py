@@ -1,4 +1,9 @@
-"""Munging primitives over sharded Frames (the water/rapids Ast* analogs)."""
+"""Munging primitives over sharded Frames (the water/rapids Ast* analogs).
+
+sort/merge/group_by/filter run device-side (see device.py for the
+RadixOrder/BinaryMerge redesign); host round-trips are limited to O(1)
+scalars, group-count-sized arrays, and string-typed payloads.
+"""
 
 from __future__ import annotations
 
@@ -10,61 +15,37 @@ import numpy as np
 
 from ..frame.frame import Frame
 from ..frame.vec import Vec, T_CAT, T_NUM, T_STR, T_TIME
-
-
-def _sort_key(vec: Vec) -> jax.Array:
-    """Ascending sort key with NaN/NA last."""
-    if vec.type == T_CAT:
-        codes = vec.data.astype(jnp.float32)
-        return jnp.where(codes < 0, jnp.inf, codes)
-    return jnp.where(jnp.isnan(vec.data), jnp.inf, vec.data)
-
-
-def _take_rows(frame: Frame, order: np.ndarray) -> Frame:
-    """Reorder/select rows by host index array (handles str columns too)."""
-    vecs = []
-    for v in frame.vecs:
-        if v.data is None:                       # str/uuid: host payload
-            vecs.append(Vec.from_numpy(v.host_data[order], v.type))
-            continue
-        host = v.to_numpy()[order]
-        if v.type == T_TIME:
-            vecs.append(Vec.from_numpy(v.host_data[order], T_TIME))
-        elif v.type == T_CAT:
-            vecs.append(Vec.from_numpy(host.astype(np.int32), T_CAT,
-                                       domain=v.domain))
-        else:
-            vecs.append(Vec.from_numpy(host, v.type))
-    return Frame(frame.names, vecs)
+from ..runtime.cluster import cluster, fetch
+from . import device as dev
 
 
 def sort(frame: Frame, by: Union[str, Sequence[str]],
          ascending: Union[bool, Sequence[bool]] = True) -> Frame:
-    """Multi-key sort — AstSort / RadixOrder analog.
-
-    Keys are argsorted on device (TPU sort network); multi-key order comes
-    from successive stable argsorts, least-significant key first.
-    """
+    """Multi-key sort — AstSort / RadixOrder analog, fully on device."""
     by = [by] if isinstance(by, str) else list(by)
     asc = [ascending] * len(by) if isinstance(ascending, bool) \
         else list(ascending)
     if len(asc) != len(by):
         raise ValueError("ascending must match by")
-    order = jnp.arange(frame.padded_rows)
-    for col, a in reversed(list(zip(by, asc))):
-        key = _sort_key(frame.vec(col))
-        key = key if a else jnp.where(jnp.isinf(key), key, -key)
-        keyed = key[order]
-        order = order[jnp.argsort(keyed, stable=True)]
-    order_h = np.asarray(order)
-    order_h = order_h[order_h < frame.nrows][: frame.nrows]
-    return _take_rows(frame, order_h)
+    keys = [dev.sort_key(frame.vec(c)) for c in by]
+    order = dev.lex_order(keys, asc)
+    return dev.gather_rows(frame, order, frame.nrows)
 
 
 def filter_rows(frame: Frame, mask) -> Frame:
-    """Boolean row filter — AstRowSlice analog."""
-    mask = np.asarray(mask)[: frame.nrows].astype(bool)
-    return _take_rows(frame, np.flatnonzero(mask))
+    """Boolean row filter — AstRowSlice analog (device compaction)."""
+    if isinstance(mask, Vec):
+        m = (mask.data != 0) & mask.valid_mask()
+        if mask.type != T_CAT:
+            m = m & ~jnp.isnan(mask.data)
+    else:
+        host = np.zeros(frame.padded_rows, bool)
+        host[: frame.nrows] = np.asarray(mask)[: frame.nrows].astype(bool)
+        m = jnp.asarray(host)
+    m = m & (jnp.arange(frame.padded_rows) < frame.nrows)
+    n_out = int(jnp.sum(m))
+    order = jnp.argsort(~m, stable=True)          # kept rows first, in order
+    return dev.gather_rows(frame, order, n_out)
 
 
 def rbind(*frames: Frame) -> Frame:
@@ -125,21 +106,22 @@ def unique(vec: Vec) -> np.ndarray:
     if vec.type == T_CAT:
         codes = np.unique(vec.to_numpy())
         return np.asarray([vec.domain[c] for c in codes if c >= 0])
-    x = np.asarray(jnp.sort(_sort_key(vec)))[: vec.nrows]
+    x = np.asarray(jnp.sort(dev.sort_key(vec)))[: vec.nrows]
     x = x[np.isfinite(x)]
     return np.unique(x)
 
 
 def table(vec: Vec, weights: Optional[Vec] = None) -> Dict[str, float]:
-    """Value counts — AstTable analog (one-hot matmul on device for cats)."""
+    """Value counts — AstTable analog (device segment-sum for cats)."""
     if vec.type == T_CAT:
         K = len(vec.domain or [])
         codes = vec.data
-        w = vec.valid_mask().astype(jnp.float32) * (codes >= 0)
+        w = (vec.valid_mask() & (codes >= 0)).astype(jnp.float32)
         if weights is not None:
             w = w * weights.numeric_data()
-        onehot = (codes[:, None] == jnp.arange(K)[None, :])
-        counts = np.asarray(jnp.sum(onehot * w[:, None], axis=0))
+        gid = jnp.where(codes >= 0, codes, K)
+        counts = np.asarray(jax.ops.segment_sum(
+            w, gid, num_segments=K + 1))[:K]
         return {vec.domain[i]: float(counts[i]) for i in range(K)}
     vals, counts = np.unique(vec.to_numpy()[~np.isnan(vec.to_numpy())],
                              return_counts=True)
@@ -157,7 +139,7 @@ def ifelse(cond, yes, no) -> Vec:
 
 
 def hist(vec: Vec, breaks: int = 20) -> Tuple[np.ndarray, np.ndarray]:
-    """Histogram counts — AstHist analog (device bucketize + one-hot sum)."""
+    """Histogram counts — AstHist analog (device bucketize + segment-sum)."""
     r = vec.rollups()
     lo, hi = r.vmin, r.vmax
     if not np.isfinite(lo) or not np.isfinite(hi) or hi <= lo:
@@ -167,8 +149,9 @@ def hist(vec: Vec, breaks: int = 20) -> Tuple[np.ndarray, np.ndarray]:
     idx = jnp.clip(((x - lo) / (hi - lo) * breaks).astype(jnp.int32),
                    0, breaks - 1)
     valid = vec.valid_mask() & ~jnp.isnan(x)
-    onehot = (idx[:, None] == jnp.arange(breaks)[None, :]) * valid[:, None]
-    counts = np.asarray(jnp.sum(onehot, axis=0))
+    gid = jnp.where(valid, idx, breaks)
+    counts = np.asarray(jax.ops.segment_sum(
+        jnp.ones_like(x), gid, num_segments=breaks + 1))[:breaks]
     return counts, edges
 
 
@@ -176,177 +159,201 @@ def hist(vec: Vec, breaks: int = 20) -> Tuple[np.ndarray, np.ndarray]:
 _AGGS = ("count", "sum", "mean", "min", "max", "var", "sd")
 
 
-def _group_codes(frame: Frame, by: List[str]):
-    """Combined group code per row + the list of group key tuples."""
-    cols = []
+def _device_keys(frame: Frame, by: List[str],
+                 cat_remap: Optional[Dict[str, Dict[str, int]]] = None
+                 ) -> List[jax.Array]:
+    """Key columns as float32 device arrays; NA and padding -> +inf."""
+    keys = []
     for name in by:
         v = frame.vec(name)
         if v.type == T_CAT:
-            cols.append((v.to_numpy(), v.domain))
+            if cat_remap is not None and name in cat_remap:
+                remap = cat_remap[name]
+                tbl = jnp.asarray(np.array(
+                    [remap[lbl] for lbl in (v.domain or [])] or [0],
+                    np.float32))
+                k = tbl[jnp.clip(v.data, 0, None)]
+                k = jnp.where(v.data < 0, jnp.inf, k)
+            else:
+                k = dev.sort_key(v)
+        elif v.data is None:
+            raise TypeError(f"column {name!r} is host-only (string key)")
         else:
-            x = v.to_numpy()
-            vals, inv = np.unique(x[~np.isnan(x)], return_inverse=True)
-            codes = np.full(len(x), -1, np.int64)
-            codes[~np.isnan(x)] = inv
-            cols.append((codes, [str(u) for u in vals]))
-    combo = np.zeros(frame.nrows, np.int64)
-    mult = 1
-    valid = np.ones(frame.nrows, bool)
-    for codes, dom in cols:
-        c = codes[: frame.nrows]
-        valid &= c >= 0
-        combo = combo + np.where(c >= 0, c, 0) * mult
-        mult *= max(len(dom), 1)
-    uniq, inv = np.unique(combo[valid], return_inverse=True)
-    group_of_row = np.full(frame.nrows, -1, np.int64)
-    group_of_row[valid] = inv
-    # decode group keys
-    keys = []
-    for u in uniq:
-        key = []
-        rem = u
-        for codes, dom in cols:
-            key.append(dom[rem % max(len(dom), 1)])
-            rem //= max(len(dom), 1)
-        keys.append(tuple(key))
-    return group_of_row, keys
+            k = jnp.where(jnp.isnan(v.data), jnp.inf, v.data)
+        pad = jnp.arange(frame.padded_rows) >= frame.nrows
+        keys.append(jnp.where(pad, jnp.inf, k))
+    return keys
 
 
 def group_by(frame: Frame, by: Union[str, Sequence[str]],
              aggs: Dict[str, Sequence[str]]) -> Frame:
-    """Grouped aggregation — AstGroup analog.
+    """Grouped aggregation — AstGroup analog, device segment-sums.
 
     ``aggs``: {column: [agg, ...]} with aggs from count/sum/mean/min/max/
-    var/sd.  Group discovery is host-side (small); the per-group
-    aggregation is a one-hot segment matmul on device, psum'd by XLA.
+    var/sd.  Group ids come from a device lexicographic dense-rank; every
+    aggregate is a ``segment_sum``/``segment_min``/``segment_max`` with the
+    rank as segment id (O(N) HBM, no [N, G] one-hot).  Rows with NA in any
+    key column are dropped, mirroring AstGroup's default NA handling.
     """
     by = [by] if isinstance(by, str) else list(by)
     for col, fns in aggs.items():
         for fn in fns:
             if fn not in _AGGS:
                 raise ValueError(f"unknown agg {fn!r} (have {_AGGS})")
-    group_of_row, keys = _group_codes(frame, by)
-    G = len(keys)
-    padded = frame.padded_rows
-    gid = np.full(padded, G, np.int32)          # padding -> overflow bucket
-    gid[: frame.nrows] = np.where(group_of_row >= 0, group_of_row, G)
-    gid_dev = jnp.asarray(gid)
+    keys = _device_keys(frame, by)
+    valid = jnp.ones(frame.padded_rows, bool)
+    for k in keys:
+        valid = valid & jnp.isfinite(k)
+    # collapse ALL columns of any-NA rows to +inf before ranking: a
+    # partial-NA tuple must not consume a dense rank below G (it would
+    # leave a phantom empty group behind when its rows are rerouted)
+    keys = [jnp.where(valid, k, jnp.inf) for k in keys]
+    rank = dev.dense_rank(keys)
+    G = int(jnp.max(jnp.where(valid, rank, -1))) + 1
+    if G <= 0:
+        return Frame.from_numpy(
+            {**{n: np.array([], object) for n in by},
+             **{f"{fn}_{c}": np.array([]) for c, fns in aggs.items()
+                for fn in fns}})
+    # any-NA-key rows -> overflow segment (AstGroup drops them); minimum()
+    # alone would keep partially-NA tuples that rank below G
+    gid = jnp.where(valid, jnp.minimum(rank, G), G)
+    nseg = G + 1
 
+    # one representative row per group, for key decode
+    rep = jax.ops.segment_max(jnp.arange(frame.padded_rows, dtype=jnp.int32),
+                              gid, num_segments=nseg)[:G]
     out_cols: Dict[str, np.ndarray] = {}
-    for i, name in enumerate(by):
-        out_cols[name] = np.asarray([k[i] for k in keys], dtype=object)
+    types: Dict[str, str] = {}
+    domains: Dict[str, Sequence[str]] = {}
+    for name in by:
+        v = frame.vec(name)
+        if v.type == T_CAT:
+            codes = np.asarray(fetch(v.data[rep]))
+            out_cols[name] = codes.astype(np.int32)
+            types[name] = T_CAT
+            domains[name] = v.domain or []
+        else:
+            out_cols[name] = np.asarray(fetch(v.data[rep]), np.float64)
 
-    onehot = jax.nn.one_hot(gid_dev, G, dtype=jnp.float32)   # [N, G]
     counts = None
     for col, fns in aggs.items():
         x = frame.vec(col).numeric_data()
         ok = (~jnp.isnan(x)).astype(jnp.float32)
         xz = jnp.nan_to_num(x)
-        s1 = np.asarray(xz * ok @ onehot, np.float64)
-        n = np.asarray(ok @ onehot, np.float64)
-        counts = n if counts is None else counts
+        s1 = jax.ops.segment_sum(xz * ok, gid, num_segments=nseg)
+        n = jax.ops.segment_sum(ok, gid, num_segments=nseg)
+        mean = s1 / jnp.maximum(n, 1e-30)
+        n_h = np.asarray(n, np.float64)[:G]
+        s1_h = np.asarray(s1, np.float64)[:G]
+        counts = n_h if counts is None else counts
         if any(f in ("min", "max") for f in fns):
             big = jnp.float32(3.4e38)
-            xmin = jnp.where(jnp.isnan(x), big, x)
-            xmax = jnp.where(jnp.isnan(x), -big, x)
-            mn = np.asarray(jax.ops.segment_min(xmin, gid_dev,
-                                                num_segments=G + 1))[:G]
-            mx = np.asarray(jax.ops.segment_max(xmax, gid_dev,
-                                                num_segments=G + 1))[:G]
+            mn = np.asarray(jax.ops.segment_min(
+                jnp.where(jnp.isnan(x), big, x), gid,
+                num_segments=nseg))[:G]
+            mx = np.asarray(jax.ops.segment_max(
+                jnp.where(jnp.isnan(x), -big, x), gid,
+                num_segments=nseg))[:G]
         if any(f in ("var", "sd") for f in fns):
-            s2 = np.asarray((xz * xz) * ok @ onehot, np.float64)
+            # residual pass: numerically stable vs (E[x^2] - E[x]^2) in f32
+            resid = (xz - mean[gid]) * ok
+            ss = np.asarray(jax.ops.segment_sum(
+                resid * resid, gid, num_segments=nseg), np.float64)[:G]
         for fn in fns:
             key = f"{fn}_{col}"
             if fn == "count":
-                out_cols[key] = n
+                out_cols[key] = n_h
             elif fn == "sum":
-                out_cols[key] = s1
+                out_cols[key] = s1_h
             elif fn == "mean":
-                out_cols[key] = s1 / np.maximum(n, 1e-300)
+                out_cols[key] = s1_h / np.maximum(n_h, 1e-300)
             elif fn == "min":
                 out_cols[key] = mn
             elif fn == "max":
                 out_cols[key] = mx
             else:
-                mean = s1 / np.maximum(n, 1e-300)
-                var = (s2 / np.maximum(n, 1e-300) - mean**2) \
-                    * n / np.maximum(n - 1, 1e-300)
-                var = np.maximum(var, 0.0)
+                var = ss / np.maximum(n_h - 1, 1e-300)
                 out_cols[key] = np.sqrt(var) if fn == "sd" else var
-    return Frame.from_numpy(out_cols)
+    return Frame.from_numpy(out_cols, types=types, domains=domains)
 
 
 # -------------------------------------------------------------------- merge
 def merge(left: Frame, right: Frame, by: Union[str, Sequence[str]],
           how: str = "inner") -> Frame:
-    """Join — AstMerge / BinaryMerge analog.
+    """Join — AstMerge / BinaryMerge analog, device sort-merge.
 
-    Single- or multi-key equi-join.  The match step runs on device
-    (binary search against the sorted build side); rows are expanded
-    host-side when the build side has duplicate keys.
+    Single- or multi-key equi-join.  Keys from both frames are dense-ranked
+    together on device; match ranges come from per-rank segment tables and
+    duplicate expansion from a prefix-sum ownership scan (device.py).  Output
+    keeps left-row order with duplicate matches adjacent.  NA keys never
+    match (BinaryMerge semantics).
     """
     by = [by] if isinstance(by, str) else list(by)
     if how not in ("inner", "left"):
         raise ValueError("merge supports how='inner'|'left'")
-    lkeys = _merge_key(left, by)
-    rkeys = _merge_key(right, by)
-    order = np.argsort(rkeys, kind="stable")
-    rsorted = rkeys[order]
-    lo = np.searchsorted(rsorted, lkeys, side="left")
-    hi = np.searchsorted(rsorted, lkeys, side="right")
-    counts = hi - lo
-    matched = counts > 0
-
-    lidx, ridx = [], []
-    for i in np.flatnonzero(matched):
-        span = order[lo[i]: hi[i]]
-        lidx.extend([i] * len(span))
-        ridx.extend(span)
-    lidx = np.asarray(lidx, np.int64)
-    ridx = np.asarray(ridx, np.int64)
-    if how == "left":
-        miss = np.flatnonzero(~matched)
-        lidx = np.concatenate([lidx, miss])
-        ridx = np.concatenate([ridx, np.full(len(miss), -1)])
-        srt = np.argsort(lidx, kind="stable")
-        lidx, ridx = lidx[srt], ridx[srt]
-
-    out = _take_rows(left, lidx)
-    rcols = [n for n in right.names if n not in by]
-    rsub = _take_rows(right[rcols], np.where(ridx >= 0, ridx, 0)) \
-        if rcols else None
-    if rsub is not None:
-        vecs = []
-        for n, v in zip(rsub.names, rsub.vecs):
-            if how == "left" and (ridx < 0).any() and v.data is not None \
-                    and v.type != T_CAT:
-                host = np.array(v.to_numpy(), copy=True)
-                host[ridx < 0] = np.nan
-                v = Vec.from_numpy(host, v.type)
-            elif how == "left" and (ridx < 0).any() and v.type == T_CAT:
-                host = np.array(v.to_numpy(), copy=True)
-                host[ridx < 0] = -1
-                v = Vec.from_numpy(host.astype(np.int32), T_CAT,
-                                   domain=v.domain)
-            vecs.append(v)
-        out = cbind(out, Frame(rsub.names, vecs))
-    return out
-
-
-def _merge_key(frame: Frame, by: List[str]) -> np.ndarray:
-    """Rows -> hashable composite key array (string form for stability)."""
-    parts = []
+    # unify categorical key domains host-side (small); codes remap on device
+    cat_remap: Dict[str, Dict[str, int]] = {}
     for name in by:
-        v = frame.vec(name)
-        if v.type == T_CAT:
-            dom = np.asarray(list(v.domain or []) + ["<NA>"], dtype=object)
-            c = v.to_numpy()
-            parts.append(dom[np.where(c < 0, len(dom) - 1, c)])
-        elif v.data is None:
-            parts.append(v.host_data.astype(str))
-        else:
-            parts.append(v.to_numpy().astype(str))
-    if len(parts) == 1:
-        return parts[0].astype(str)
-    return np.array(["\x1f".join(t) for t in zip(*[p.astype(str)
-                                                   for p in parts])])
+        lv, rv = left.vec(name), right.vec(name)
+        if (lv.data is None) or (rv.data is None):
+            raise TypeError(f"merge key {name!r} is a string column; "
+                            "convert to categorical first")
+        if (lv.type == T_CAT) != (rv.type == T_CAT):
+            raise TypeError(f"merge key {name!r} has mismatched types")
+        if lv.type == T_CAT:
+            shared: Dict[str, int] = {}
+            for lbl in (lv.domain or []) + (rv.domain or []):
+                if lbl not in shared:
+                    shared[lbl] = len(shared)
+            cat_remap[name] = shared
+    lkeys = _device_keys(left, by, cat_remap)
+    rkeys = _device_keys(right, by, cat_remap)
+    pl, pr = left.padded_rows, right.padded_rows
+    rank = dev.dense_rank([jnp.concatenate([l, r])
+                           for l, r in zip(lkeys, rkeys)])
+    lrank, rrank = rank[:pl], rank[pl:]
+    lvalid = jnp.ones(pl, bool)
+    for k in lkeys:
+        lvalid &= jnp.isfinite(k)
+    rvalid = jnp.ones(pr, bool)
+    for k in rkeys:
+        rvalid &= jnp.isfinite(k)
+    nseg = pl + pr + 2
+    big = jnp.int32(nseg - 1)
+    lrank = jnp.where(lvalid, lrank, big)
+    rrank = jnp.where(rvalid, rrank, big)
+
+    rorder = jnp.argsort(rrank, stable=True)
+    rsorted = rrank[rorder]
+    # per-rank [start, count) into rsorted — replaces per-row binary search
+    rstart = jax.ops.segment_min(jnp.arange(pr, dtype=jnp.int32), rsorted,
+                                 num_segments=nseg)
+    rcount = jax.ops.segment_sum(jnp.ones(pr, jnp.int32), rsorted,
+                                 num_segments=nseg)
+    lo = rstart[lrank]
+    counts = jnp.where(lvalid, rcount[lrank], 0)
+    if how == "left":
+        out_counts = jnp.where(jnp.arange(pl) < left.nrows,
+                               jnp.maximum(counts, 1), 0)
+    else:
+        out_counts = counts
+    starts = jnp.cumsum(out_counts) - out_counts
+    m = int(starts[-1] + out_counts[-1]) if pl else 0
+    cl = cluster()
+    p_out = cl.pad_rows(m)
+
+    li = dev.expand_starts(starts, out_counts, p_out)
+    li = jnp.clip(li, 0, max(pl - 1, 0))
+    off = jnp.arange(p_out) - starts[li]
+    matched = counts[li] > 0
+    rpos = jnp.clip(lo[li] + jnp.where(matched, off, 0), 0, max(pr - 1, 0))
+    ridx = jnp.where(matched, rorder[rpos], -1)
+
+    out = dev.gather_rows(left, li, m)
+    rcols = [n for n in right.names if n not in by]
+    if rcols:
+        rsub = dev.gather_rows(right[rcols], jnp.where(ridx >= 0, ridx, 0),
+                               m, na_mask=ridx < 0)
+        out = cbind(out, rsub)
+    return out

@@ -183,4 +183,6 @@ def cluster() -> Cluster:
 def shutdown() -> None:
     global _cluster
     with _lock:
+        from . import dkv
+        dkv.detach()        # stop the DKV service / forget the coordinator
         _cluster = None

@@ -190,7 +190,11 @@ def serve(host: str = "127.0.0.1", port: int = 0) -> int:
     """Start the coordinator DKV service; returns the bound port."""
     global _server
     if _server is not None:
-        return _server.server_address[1]
+        if port in (0, _server.server_address[1]):
+            return _server.server_address[1]
+        # explicit re-serve on a different port: restart the service
+        _server.shutdown()
+        _server = None
     _server = _DKVServer((host, port), _Handler)
     t = threading.Thread(target=_server.serve_forever, daemon=True,
                          name="dkv-coordinator")
