@@ -51,6 +51,10 @@ def _tree_arrays(trees, depth: int, prefix: str = "") -> Dict[str, np.ndarray]:
             [np.asarray(t.valid[d]) for t in trees]).astype(bool)
     out[f"{prefix}values"] = np.stack(
         [np.asarray(t.values) for t in trees]).astype(np.float32)
+    if all(getattr(t, "cover", None) is not None for t in trees):
+        # per-leaf training covers -> TreeSHAP contributions in the scorer
+        out[f"{prefix}covers"] = np.stack(
+            [np.asarray(t.cover) for t in trees]).astype(np.float32)
     return out
 
 

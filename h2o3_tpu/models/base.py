@@ -56,6 +56,9 @@ class Parameters:
     fold_column: Optional[str] = None
     fold_assignment: str = "auto"          # auto|random|modulo|stratified
     keep_cross_validation_predictions: bool = False
+    # custom metric UDF: (predictions, y, w) -> (name, value)
+    # (water/udf/CMetricFunc analog)
+    custom_metric_func: Optional[Any] = None
 
     def effective_seed(self) -> int:
         return np.random.default_rng().integers(2**31) if self.seed in (-1, None) \
@@ -130,7 +133,8 @@ class Model:
         y = di.response(frame)
         w = di.weights(frame)
         return make_metrics(di, raw, y, w, distribution=getattr(
-            self.params, "distribution", None))
+            self.params, "distribution", None),
+            custom_metric_func=self.params.custom_metric_func)
 
     # ------------------------------------------------------------ persistence
     def save(self, path: str) -> str:

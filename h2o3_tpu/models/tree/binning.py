@@ -49,6 +49,19 @@ class BinnedFrame:
     def na_bin(self) -> int:
         return self.nbins
 
+    @property
+    def bin_counts(self) -> tuple:
+        """Per-feature count of bins actually in use (codes < this;
+        DHistogram's per-column bin sizing).  Cats: min(card, nbins);
+        numerics: len(edges)+1 regions."""
+        out = []
+        for e, cat, dom in zip(self.edges, self.is_cat, self.cat_domains):
+            if cat:
+                out.append(max(min(len(dom or []) or 1, self.nbins), 1))
+            else:
+                out.append(min(len(e) + 1, self.nbins))
+        return tuple(out)
+
 
 def fit_bins(frame: Frame, features: List[str], nbins: int = 64,
              sample: int = 1_000_000, seed: int = 0,

@@ -73,9 +73,15 @@ class DRF(SharedTree):
         K = di.nclasses if (di.is_classifier and di.nclasses > 2) else 1
         y = di.response(frame)
         w = di.weights(frame)
-        binned = fit_bins(frame, [s.name for s in di.specs], nbins=p.nbins,
-                          seed=p.effective_seed(),
-                          weights=w if p.weights_column else None)
+        from .shared import (resolve_checkpoint, checkpoint_binned,
+                             prior_stacked)
+        prior = resolve_checkpoint(p, di, self.algo)
+        if prior is not None:
+            binned = checkpoint_binned(frame, di, prior, p.nbins)
+        else:
+            binned = fit_bins(frame, [s.name for s in di.specs],
+                              nbins=p.nbins, seed=p.effective_seed(),
+                              weights=w if p.weights_column else None)
         codes = binned.codes
         edges_mat = jnp.asarray(
             edges_matrix(binned.edges, p.nbins), jnp.float32)
@@ -111,6 +117,20 @@ class DRF(SharedTree):
             y_v, w_v = di.response(valid), di.weights(valid)
             F_v = jnp.zeros((Xv.shape[0], K), jnp.float32) if K > 1 \
                 else jnp.zeros((Xv.shape[0],), jnp.float32)
+        prior_nt = 0
+        if prior is not None:
+            prior_nt = prior.output["ntrees_trained"]
+            # decorrelate the continuation's bootstrap keys from the prior
+            # run (same-seed continuation must not regrow identical trees)
+            rng = jax.random.fold_in(rng, prior_nt)
+            X_ck = model._design(frame)
+            for k in range(K):
+                st = prior_stacked(prior, k if K > 1 else None)
+                dF = traverse_jit(st.levels, st.values, X_ck)
+                F_sum = F_sum.at[:, k].add(dF) if K > 1 else F_sum + dF
+                if valid is not None:
+                    dFv = traverse_jit(st.levels, st.values, Xv)
+                    F_v = F_v.at[:, k].add(dFv) if K > 1 else F_v + dFv
 
         history = []
         metric_name, maximize = metric_direction(p.stopping_metric,
@@ -123,12 +143,17 @@ class DRF(SharedTree):
         scan_fn = make_tree_scan_fn(
             "drf", 0.0, 0.0, 0.0, p.max_depth, p.nbins, Fnum, N,
             p.hist_precision, p.sample_rate, 1.0,
-            hier=use_hier_split_search(p, N))
+            hier=use_hier_split_search(p, N),
+            bin_counts=binned.bin_counts)
         scalars = (p.reg_lambda, p.min_rows, p.min_split_improvement, 1.0,
                    col_rate, p.reg_alpha, p.gamma, p.min_child_weight)
         chunks = [[] for _ in range(K)]
-        for c, t_done, score_now in chunk_schedule(
-                p.ntrees, p.score_tree_interval):
+        if prior is not None:
+            for k in range(K):
+                chunks[k].append(prior_stacked(prior, k if K > 1 else None))
+        for c, t_new, score_now in chunk_schedule(
+                p.ntrees - prior_nt, p.score_tree_interval):
+            t_done = prior_nt + t_new
             rng, kc = jax.random.split(rng)
             keys = jax.random.split(kc, c)
             for k in range(K):
@@ -136,9 +161,9 @@ class DRF(SharedTree):
                 # same keys across classes -> same bootstrap per iteration
                 # (DRF.java samples once per tree); the salt decorrelates
                 # each class tree's per-split feature subsets
-                Fk, lv, vals = scan_fn(codes, targets[k], w, Fk0,
-                                       edges_mat, keys, *scalars, k)
-                chunks[k].append(StackedTrees(lv, vals))
+                Fk, lv, vals, cov = scan_fn(codes, targets[k], w, Fk0,
+                                            edges_mat, keys, *scalars, k)
+                chunks[k].append(StackedTrees(lv, vals, cov))
                 if K > 1:
                     F_sum = F_sum.at[:, k].set(Fk)
                     if valid is not None:

@@ -78,9 +78,15 @@ class GBM(SharedTree):
         multinomial = isinstance(dist, Multinomial) or K > 1
         y = di.response(frame)
         w = di.weights(frame)
-        binned = fit_bins(frame, [s.name for s in di.specs], nbins=p.nbins,
-                          seed=p.effective_seed(),
-                          weights=w if p.weights_column else None)
+        from .shared import (resolve_checkpoint, checkpoint_binned,
+                             prior_stacked)
+        prior = resolve_checkpoint(p, di, self.algo)
+        if prior is not None:
+            binned = checkpoint_binned(frame, di, prior, p.nbins)
+        else:
+            binned = fit_bins(frame, [s.name for s in di.specs],
+                              nbins=p.nbins, seed=p.effective_seed(),
+                              weights=w if p.weights_column else None)
         codes = binned.codes
         edges_mat = jnp.asarray(
             edges_matrix(binned.edges, p.nbins), jnp.float32)
@@ -106,16 +112,41 @@ class GBM(SharedTree):
             Y1 = jax.nn.one_hot(yi, K, dtype=jnp.float32)
             base = jnp.sum(w[:, None] * Y1, axis=0) / jnp.maximum(jnp.sum(w), 1e-12)
             init = jnp.log(jnp.clip(base, 1e-10, 1.0))
+            if prior is not None:
+                init = jnp.asarray(prior.output["init_score"], jnp.float32)
             F = jnp.broadcast_to(init[None, :], (N, K)).astype(jnp.float32)
             F_v = jnp.broadcast_to(init[None, :], (Xv.shape[0], K)) \
                 if valid is not None else None
             init_host = np.asarray(init)
         else:
-            f0 = dist.init_score(y, w)
+            f0 = dist.init_score(y, w) if prior is None \
+                else prior.output["init_score"]
             F = jnp.full((N,), f0, jnp.float32)
             F_v = jnp.full((Xv.shape[0],), f0, jnp.float32) \
                 if valid is not None else None
             init_host = float(f0)
+        prior_nt = 0
+        if prior is not None:
+            # continue from the checkpoint: F starts at its predictions
+            prior_nt = prior.output["ntrees_trained"]
+            # decorrelate the PRNG stream from the prior run: without this,
+            # a fixed seed regenerates the SAME per-tree keys and the
+            # continuation's row/column samples duplicate the prior trees'
+            rng = jax.random.fold_in(rng, prior_nt)
+            X_ck = model._design(frame)
+            if multinomial:
+                for k in range(K):
+                    st = prior_stacked(prior, k)
+                    F = F.at[:, k].add(traverse_jit(st.levels, st.values,
+                                                    X_ck))
+                    if valid is not None:
+                        F_v = F_v.at[:, k].add(
+                            traverse_jit(st.levels, st.values, Xv))
+            else:
+                st = prior_stacked(prior)
+                F = F + traverse_jit(st.levels, st.values, X_ck)
+                if valid is not None:
+                    F_v = F_v + traverse_jit(st.levels, st.values, Xv)
 
         @jax.jit
         def grads_single(y, F):
@@ -154,18 +185,20 @@ class GBM(SharedTree):
                 dist.name, p.tweedie_power, p.quantile_alpha, p.huber_alpha,
                 p.max_depth, p.nbins, binned.nfeatures, N, p.hist_precision,
                 p.sample_rate, p.col_sample_rate_per_tree,
-                hier=use_hier_split_search(p, N))
+                hier=use_hier_split_search(p, N),
+                bin_counts=binned.bin_counts)
             scalars = (p.reg_lambda, p.min_rows, p.min_split_improvement,
                        p.learn_rate, p.col_sample_rate, p.reg_alpha, p.gamma,
                        p.min_child_weight)
-            chunks = []
-            for c, t_done, score_now in chunk_schedule(
-                    p.ntrees, p.score_tree_interval):
+            chunks = [prior_stacked(prior)] if prior is not None else []
+            for c, t_new, score_now in chunk_schedule(
+                    p.ntrees - prior_nt, p.score_tree_interval):
+                t_done = prior_nt + t_new
                 rng, kc = jax.random.split(rng)
                 keys = jax.random.split(kc, c)
-                F, lv, vals = scan_fn(codes, y, w, F, edges_mat, keys,
-                                      *scalars, 0)
-                chunk = StackedTrees(lv, vals)
+                F, lv, vals, cov = scan_fn(codes, y, w, F, edges_mat,
+                                           keys, *scalars, 0)
+                chunk = StackedTrees(lv, vals, cov)
                 chunks.append(chunk)
                 job.update(t_done / p.ntrees, f"tree {t_done}/{p.ntrees}")
                 if valid is not None:
@@ -196,7 +229,16 @@ class GBM(SharedTree):
                 model.validation_metrics = model.model_performance(valid)
             return model
 
-        for t in range(p.ntrees):
+        if prior is not None:
+            # materialized per-tree list continuation (DART / multinomial).
+            # Copy the Tree objects: DART rescales trees[i].values in place,
+            # which must not corrupt the checkpoint model still in the DKV.
+            for t_prior in list(prior.output["trees"]):
+                if isinstance(t_prior, list):
+                    trees.append([dataclasses.replace(tc) for tc in t_prior])
+                else:
+                    trees.append(dataclasses.replace(t_prior))
+        for t in range(prior_nt, p.ntrees):
             rng, ks, kc = jax.random.split(rng, 3)
             w_eff = w
             if p.sample_rate < 1.0:

@@ -56,6 +56,9 @@ def _make_pallas_hist(L: int, F: int, B: int, n_local: int,
     # the scoped-VMEM budget.
     F8 = (F + 7) // 8 * 8
     TB = max(1, min(512 // F8, 2_097_152 // (F8 * R)))
+    # never build one-hot tiles wider than the bin range (small-B coarse
+    # pass: TB=64 for B=17 wasted 3.7x of the kernel's dominant VPU work)
+    TB = min(TB, (B + 7) // 8 * 8)
     FBT = F * TB
     n_fb = (B + TB - 1) // TB
 
@@ -64,6 +67,8 @@ def _make_pallas_hist(L: int, F: int, B: int, n_local: int,
     def _build_A(LS):
         # A[r, planes*l+s] = S[r, s] where leaf[r] == l, else 0.  Plane 3
         # (hierarchical bounds) is |g|, derived in-kernel from plane 0.
+        # (A 3-D match*stat form would halve the op count but Mosaic cannot
+        # shape-cast [R, L, p] minor dims back to [R, L*p].)
         leaf = LS[0].astype(jnp.int32)
         cols = jax.lax.broadcasted_iota(jnp.int32, (R, L3), 1)
         l_of, s_of = cols // planes, cols % planes
@@ -165,6 +170,161 @@ def _make_pallas_hist(L: int, F: int, B: int, n_local: int,
         return out.reshape(B, F, L, planes).transpose(3, 2, 1, 0)
 
     return local
+
+
+def varbin_layout(bin_counts, B: int):
+    """Packed ragged bin-axis layout: per-feature [offset, B_f regular bins,
+    NA slot], each segment 8-padded (sublane alignment).
+
+    Returns (offsets[F], segment row counts [F], total rows Q8, and the
+    dense gather map [F, B+1] -> packed row, with empty bins pointing at
+    padding slots that provably stay zero).
+    """
+    offsets, rows = [], []
+    q = 0
+    for bf in bin_counts:
+        bf = min(bf, B - 1)              # regular bins; NA gets slot bf
+        # pad to sublane multiple with at least ONE spare slot: empty dense
+        # bins map to the spare, which no code ever matches (stays zero)
+        seg = ((bf + 2) + 7) // 8 * 8
+        offsets.append(q)
+        rows.append(seg)
+        q += seg
+    qmap = np.zeros((len(bin_counts), B + 1), np.int32)
+    for f, bf in enumerate(bin_counts):
+        bf = min(bf, B - 1)
+        for b in range(B + 1):
+            if b < bf:                   # regular bin
+                qmap[f, b] = offsets[f] + b
+            elif b == B:                 # NA bin (dense index B-1... see below)
+                qmap[f, b] = offsets[f] + bf
+            else:                        # empty bin -> padded zero slot
+                qmap[f, b] = offsets[f] + rows[f] - 1
+    return (np.asarray(offsets, np.int32), np.asarray(rows, np.int32),
+            q, qmap)
+
+
+def _make_pallas_varbin_hist(L: int, F: int, bin_counts, B: int,
+                             n_local: int, interpret: bool = False,
+                             precision: str = "bf16", planes: int = 3):
+    """tpu_hist with a PACKED per-feature bin axis.
+
+    The uniform kernel compares every feature row against every global bin
+    id — O(F * B) VPU work per row even when most features use a fraction
+    of the bins (a 22-carrier categorical against 257 slots).  Reference
+    DHistogram sizes bins per column (DHistogram.java:48 min/max driven);
+    here each feature gets exactly pad8(B_f+1) one-hot rows, built by a
+    statically unrolled per-feature compare against its own code row, so
+    VPU cost drops from F*B to sum(B_f).  Codes must arrive PRE-OFFSET
+    (code + offset_f, NA -> offset_f + B_f): the build driver does that
+    once per tree.
+    """
+    offsets, seg_rows, Q8, _ = varbin_layout(bin_counts, B)
+    R = int(min(4096, max(512, (4_194_304 // max(Q8 * 2, 1))
+                          // 128 * 128)))
+    R = min(R, max(512, ((n_local + 511) // 512) * 512))
+    nblk = (n_local + R - 1) // R
+    pad_to = nblk * R
+    L3 = planes * L
+    dt = jnp.bfloat16 if precision == "bf16" else jnp.float32
+
+    def kernel(codes_ref, ls_ref, out_ref):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        LS = ls_ref[:]
+        leaf = LS[0].astype(jnp.int32)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (R, L3), 1)
+        l_of, s_of = cols // planes, cols % planes
+        match = leaf[:, None] == l_of
+        sv = jnp.where(s_of == 0, LS[1][:, None],
+                       jnp.where(s_of == 1, LS[2][:, None],
+                                 LS[3][:, None]))
+        if planes == 4:
+            sv = jnp.where(s_of == 3, jnp.abs(LS[1])[:, None], sv)
+        A = jnp.where(match, sv, 0.0).astype(dt)
+        pieces = []
+        for f in range(F):
+            q_of = jax.lax.broadcasted_iota(
+                jnp.int32, (int(seg_rows[f]), 1), 0) + int(offsets[f])
+            pieces.append((codes_ref[f, :][None, :] == q_of).astype(dt))
+        OHT = jnp.concatenate(pieces, axis=0)          # [Q8, R]
+        out_ref[:] += jnp.dot(OHT, A, preferred_element_type=jnp.float32)
+
+    call = pl.pallas_call(
+        kernel,
+        grid=(nblk,),
+        in_specs=[
+            pl.BlockSpec((F, R), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((4, R), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((Q8, L3), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((Q8, L3), jnp.float32,
+                                       vma=frozenset({ROW_AXIS})),
+        interpret=interpret,
+    )
+
+    def local(gcodes, leaf, g, h, w):
+        pad = pad_to - n_local
+
+        def padr(x):
+            if pad == 0:
+                return x
+            return jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad)],
+                           constant_values=-1)
+        LS = jnp.stack([leaf.astype(jnp.float32), g, h, w], axis=0)
+        return call(padr(gcodes), padr(LS))            # [Q8, pL]
+
+    return local
+
+
+def offset_codes(codes, bin_counts, nbins: int):
+    """codes [F, N] (NA == nbins) -> packed global bin ids for the varbin
+    kernel.  Done once per tree by the build driver."""
+    offsets, _, _, _ = varbin_layout(bin_counts, nbins + 1)
+    off = jnp.asarray(offsets)[:, None]
+    bf = jnp.asarray([min(b, nbins) for b in bin_counts],
+                     jnp.int32)[:, None]
+    return jnp.where(codes >= nbins, off + bf, codes + off)
+
+
+@functools.lru_cache(maxsize=None)
+def make_varbin_hist_fn(L: int, F: int, bin_counts: tuple, B: int,
+                        n_padded: int, force_impl: str = "",
+                        precision: str = "bf16"):
+    """Variable-bin histogram with the DENSE output contract of
+    make_hist_fn: (gcodes, leaf, g, h, w) -> H[3, L, F, B].
+
+    ``gcodes`` must be pre-offset (offset_codes).  The packed [Q8, 3L]
+    kernel result is re-expanded through the static qmap gather (tiny).
+    """
+    cl = cluster()
+    n_local = n_padded // cl.n_row_shards
+    _, _, Q8, qmap = varbin_layout(bin_counts, B)
+    if force_impl == "pallas_interpret":
+        inner = _make_pallas_varbin_hist(L, F, bin_counts, B, n_local,
+                                         interpret=True, precision=precision)
+    else:
+        inner = _make_pallas_varbin_hist(L, F, bin_counts, B, n_local,
+                                         precision=precision)
+    qmap_dense = jnp.asarray(qmap[:, list(range(B - 1)) + [B]])  # [F, B]
+    # dense layout [.., F, B]: regular bins 0..B-2 then NA at B-1
+
+    def local_hist(gcodes, leaf, g, h, w):
+        out = inner(gcodes, leaf, g, h, w)             # [Q8, 3L]
+        H = out[qmap_dense.reshape(-1)]                # [F*B, 3L]
+        H = H.reshape(F, B, L, 3).transpose(3, 2, 0, 1)
+        return jax.lax.psum(H, ROW_AXIS)
+
+    specs_in = (P(None, ROW_AXIS), P(ROW_AXIS), P(ROW_AXIS), P(ROW_AXIS),
+                P(ROW_AXIS))
+    f = shard_map(local_hist, mesh=cl.mesh, in_specs=specs_in, out_specs=P(),
+                  check_vma=False)
+    return jax.jit(f)
 
 
 def _make_einsum_hist(L: int, F: int, B: int, n_local: int, planes: int = 3):
@@ -295,7 +455,7 @@ def _make_pallas_fine_hist(L: int, F: int, W: int, K: int, nbins: int,
         # A[r, 3l+s]
         cols = jax.lax.broadcasted_iota(jnp.int32, (R, L3), 1)
         l_of, s_of = cols // 3, cols % 3
-        match = leaf.astype(jnp.int32)[:, None] == l_of
+        match = leaf[:, None] == l_of
         sv = jnp.where(s_of == 0, LS[1][:, None],
                        jnp.where(s_of == 1, LS[2][:, None],
                                  LS[3][:, None]))

@@ -31,8 +31,9 @@ from ..datainfo import DataInfo, ColumnSpec
 from ..scorekeeper import stop_early, metric_direction
 from ..distributions import make_distribution
 from .binning import BinnedFrame, fit_bins, encode_bins
-from .hist import (make_hist_fn, make_fine_hist_fn, best_splits,
-                   best_splits_hier, select_superbins, partition)
+from .hist import (make_hist_fn, make_fine_hist_fn, make_varbin_hist_fn,
+                   offset_codes, best_splits, best_splits_hier,
+                   select_superbins, partition)
 
 
 @dataclasses.dataclass
@@ -69,6 +70,7 @@ class Tree:
     na_left: List[np.ndarray]    # per level [2^d] bool
     valid: List[np.ndarray]      # per level [2^d] bool
     values: np.ndarray           # [2^depth] float32
+    cover: Optional[np.ndarray] = None   # [2^depth] weighted leaf counts
 
 
 def stack_trees(trees: List[Tree]):
@@ -100,6 +102,7 @@ class StackedTrees:
 
     levels: List[tuple]          # per depth: (feat, thr, na_left, valid)
     values: jax.Array            # [T, 2^depth]
+    covers: Optional[jax.Array] = None   # [T, 2^depth] leaf covers
 
     @property
     def ntrees(self) -> int:
@@ -112,7 +115,10 @@ class StackedTrees:
     @staticmethod
     def from_trees(trees: List[Tree]) -> "StackedTrees":
         levels, values = stack_trees(trees)
-        return StackedTrees(levels, values)
+        covers = None
+        if all(t.cover is not None for t in trees):
+            covers = jnp.stack([jnp.asarray(t.cover) for t in trees])
+        return StackedTrees(levels, values, covers)
 
     @staticmethod
     def concat(chunks: Sequence["StackedTrees"]) -> "StackedTrees":
@@ -124,12 +130,16 @@ class StackedTrees:
                 jnp.concatenate([c.levels[d][i] for c in chunks], axis=0)
                 for i in range(4)))
         values = jnp.concatenate([c.values for c in chunks], axis=0)
-        return StackedTrees(levels, values)
+        covers = None
+        if all(c.covers is not None for c in chunks):
+            covers = jnp.concatenate([c.covers for c in chunks], axis=0)
+        return StackedTrees(levels, values, covers)
 
     def to_tree_list(self) -> List[Tree]:
         """Host materialization — one fetch per level array, then slices."""
         host_levels = [tuple(np.asarray(a) for a in lv) for lv in self.levels]
         values = np.asarray(self.values)
+        covers = np.asarray(self.covers) if self.covers is not None else None
         out = []
         for t in range(values.shape[0]):
             out.append(Tree(
@@ -137,7 +147,8 @@ class StackedTrees:
                 thr=[lv[1][t] for lv in host_levels],
                 na_left=[lv[2][t] for lv in host_levels],
                 valid=[lv[3][t] for lv in host_levels],
-                values=values[t]))
+                values=values[t],
+                cover=covers[t] if covers is not None else None))
         return out
 
 
@@ -224,7 +235,7 @@ traverse_jit = jax.jit(traverse)
 @functools.lru_cache(maxsize=None)
 def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
                        hist_precision: str = "bf16", hier: bool = False,
-                       fine_k: int = 2):
+                       fine_k: int = 2, bin_counts=None):
     """One compiled program that grows a whole tree on device.
 
     The level loop (SharedTree.buildLayer) is unrolled inside a single jit:
@@ -246,9 +257,26 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
     (split_search="auto" gate) or on request.
     """
     B = nbins + 1
-    hist_fns = [make_hist_fn(2 ** max(d - 1, 0), F, B, n_padded,
-                             precision=hist_precision)
-                for d in range(max_depth)]
+    from ...runtime.cluster import cluster
+    # per-feature packed bins (DHistogram-style): only the TPU Pallas path
+    # has the ragged kernel; dense einsum covers CPU tests.  The packed
+    # result has the exact same [3, L, F, B] contract, so split search is
+    # byte-identical — this is a pure kernel-cost optimization.
+    use_varbin = (bin_counts is not None
+                  and cluster().mesh.devices.flat[0].platform == "tpu"
+                  and F * B * 3 * 2 ** max(max_depth - 1, 0) * 4
+                  <= 12 * 1024 * 1024
+                  and sum(min(b, nbins) + 9 for b in bin_counts)
+                  < F * (nbins + 1))
+    if use_varbin:
+        hist_fns = [make_varbin_hist_fn(2 ** max(d - 1, 0), F,
+                                        tuple(bin_counts), B, n_padded,
+                                        precision=hist_precision)
+                    for d in range(max_depth)]
+    else:
+        hist_fns = [make_hist_fn(2 ** max(d - 1, 0), F, B, n_padded,
+                                 precision=hist_precision)
+                    for d in range(max_depth)]
     if hier:
         S = 16 if nbins >= 128 else 8
         W = -(-nbins // S)
@@ -269,6 +297,8 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
         H_prev = None
         if hier:
             ccodes = jnp.where(codes >= nbins, S, codes // W)
+        hcodes = offset_codes(codes, bin_counts, nbins) if use_varbin \
+            else codes
         for d in range(max_depth):
             L = 2 ** d
             per_split = jax.random.uniform(keys[d], (L, F)) < col_sample_rate
@@ -299,13 +329,14 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
                         min_child_weight)
             else:
                 if d == 0:
-                    H = hist_fns[0](codes, leaf, g, h, w)
+                    H = hist_fns[0](hcodes, leaf, g, h, w)
                 else:
                     # parent-sibling subtraction (gpu_hist's trick): build
                     # only the left children's histograms; the right child
                     # is parent - left.  Halves the histogram work.
                     em = ((leaf & 1) == 0).astype(jnp.float32)
-                    Hl = hist_fns[d](codes, leaf >> 1, g * em, h * em, w * em)
+                    Hl = hist_fns[d](hcodes, leaf >> 1,
+                                     g * em, h * em, w * em)
                     Hr = H_prev - Hl
                     H = jnp.stack([Hl, Hr], axis=2).reshape(3, L, F, B)
                 H_prev = H
@@ -328,36 +359,39 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
                              0.0)
         vals = jnp.stack([newton(gl, hl, cl), newton(gr, hr, cr)],
                          axis=1).reshape(-1).astype(jnp.float32)
-        return levels, vals, leaf
+        # leaf covers (weighted row counts) from the same child sums — the
+        # per-node weights TreeSHAP needs (PredictTreeSHAPTask reads them
+        # from the compressed tree the same way)
+        cover = jnp.stack([cl, cr], axis=1).reshape(-1).astype(jnp.float32)
+        return levels, vals, cover, leaf
 
     return jax.jit(build)
-
-
-HIER_MIN_ROWS = 2_000_000
 
 
 def use_hier_split_search(params, n_padded: int) -> bool:
     """Policy gate for the hierarchical split-search path.
 
-    ``split_search="hier"`` forces it, "exact" forbids it; "auto" (default)
-    enables it only at benchmark scale — enough rows that the histogram
-    VPU wall dominates and enough bins for the coarse pass to pay for
-    itself.  Small/medium frames keep the exact full-bin search, so model
-    quality and golden tests are byte-identical to the reference math.
+    ``split_search="hier"`` opts in; anything else (incl. the default
+    "auto") takes the exact full-bin search — with the variable-bin kernel
+    the exact path matches or beats the hierarchical one at benchmark
+    scale (PROFILE.md round-2 numbers), so the approximation never
+    engages implicitly.
     """
     mode = getattr(params, "split_search", "auto")
     if mode == "hier":
         return True
-    if mode == "exact":
-        return False
-    return params.nbins >= 32 and n_padded >= HIER_MIN_ROWS
+    # "auto" resolves to the exact search: with the variable-bin kernel the
+    # exact path now matches or beats the hierarchical one at benchmark
+    # scale (PROFILE.md round-2 numbers), so the approximation is opt-in.
+    return False
 
 
 @functools.lru_cache(maxsize=None)
 def make_tree_scan_fn(mode: str, tweedie_power: float, quantile_alpha: float,
                       huber_alpha: float, max_depth: int, nbins: int, F: int,
                       n_padded: int, hist_precision: str, sample_rate: float,
-                      col_sample_rate_per_tree: float, hier: bool = False):
+                      col_sample_rate_per_tree: float, hier: bool = False,
+                      bin_counts=None):
     """Scan a CHUNK of boosting/bagging rounds in ONE device dispatch.
 
     The per-tree driver loop (gradients -> row/column sample -> grow ->
@@ -377,7 +411,7 @@ def make_tree_scan_fn(mode: str, tweedie_power: float, quantile_alpha: float,
             tweedie_power=tweedie_power, quantile_alpha=quantile_alpha,
             huber_alpha=huber_alpha)
     bt_fn = make_build_tree_fn(max_depth, nbins, F, n_padded, hist_precision,
-                               hier=hier)
+                               hier=hier, bin_counts=bin_counts)
 
     def scan_fn(codes, y, w, F0, edges_mat, keys, reg_lambda, min_rows,
                 min_split_improvement, learn_rate, col_sample_rate,
@@ -400,16 +434,16 @@ def make_tree_scan_fn(mode: str, tweedie_power: float, quantile_alpha: float,
             if col_sample_rate_per_tree < 1.0:
                 m = jax.random.uniform(km, (F,)) < col_sample_rate_per_tree
                 tm = m.at[0].set(m[0] | ~m.any())
-            levels, vals, leaf = bt_fn(
+            levels, vals, cover, leaf = bt_fn(
                 codes, g0 * wv, h0 * wv, wv, edges_mat, kb, reg_lambda,
                 min_rows, min_split_improvement, learn_rate, col_sample_rate,
                 tm, reg_alpha, gamma, min_child_weight)
             from .hist import table_lookup
             dF = table_lookup(vals[None, :], leaf, vals.shape[0])[0]
-            return Fc + dF, (tuple(levels), vals)
+            return Fc + dF, (tuple(levels), vals, cover)
 
-        Ff, (lv, vals) = jax.lax.scan(body, F0, keys)
-        return Ff, list(lv), vals
+        Ff, (lv, vals, covers) = jax.lax.scan(body, F0, keys)
+        return Ff, list(lv), vals, covers
 
     return jax.jit(scan_fn, donate_argnums=(3,))
 
@@ -454,17 +488,46 @@ def build_tree(codes, g, h, w, edges, nbins: int, max_depth: int,
         else jnp.ones(F, bool)
     fn = make_build_tree_fn(max_depth, nbins, F, N, hist_precision,
                             hier=hier)
-    levels, vals, leaf = fn(codes, g, h, w, edges_mat, rng_key,
-                            reg_lambda, min_rows, min_split_improvement,
-                            learn_rate, col_sample_rate, tm,
-                            reg_alpha, gamma, min_child_weight)
+    levels, vals, cover, leaf = fn(codes, g, h, w, edges_mat, rng_key,
+                                   reg_lambda, min_rows,
+                                   min_split_improvement, learn_rate,
+                                   col_sample_rate, tm, reg_alpha, gamma,
+                                   min_child_weight)
     tree = Tree([lv[0] for lv in levels], [lv[1] for lv in levels],
-                [lv[2] for lv in levels], [lv[3] for lv in levels], vals)
+                [lv[2] for lv in levels], [lv[3] for lv in levels], vals,
+                cover=cover)
     return tree, leaf
 
 
 class SharedTreeModel(Model):
     """Tree-ensemble model: scores via compiled stacked-tree traversal."""
+
+    def predict_contributions(self, frame: Frame) -> Frame:
+        """Per-feature TreeSHAP contributions + BiasTerm (margin space).
+
+        Reference: EasyPredictModelWrapper.predictContributions /
+        PredictTreeSHAPTask — binomial and regression models only, exact
+        Shapley values per Lundberg's TreeSHAP using the per-node covers
+        recorded at training.  ``sum(contributions) + BiasTerm`` equals
+        the raw margin (GBM/XGBoost) or the averaged leaf sum (DRF).
+        """
+        from ...export import treeshap
+        K = self.output.get("nclass_trees", 1)
+        if K > 1:
+            raise ValueError("predict_contributions supports binomial and "
+                             "regression models only (reference parity)")
+        trees = list(self.output["trees"])
+        st = treeshap.shap_trees_from_model(trees)
+        X = np.asarray(self._design(frame))[: frame.nrows].astype(np.float64)
+        if self.algo == "drf":
+            scale, init = 1.0 / max(len(trees), 1), 0.0
+        else:
+            scale, init = 1.0, float(np.asarray(self.output["init_score"]))
+        contribs = treeshap.ensemble_contributions(st, X, init, scale)
+        names = [s.name for s in self.datainfo.specs] + ["BiasTerm"]
+        from ...frame.vec import Vec
+        vecs = [Vec.from_numpy(contribs[:, j]) for j in range(len(names))]
+        return Frame(names, vecs)
 
     def _score_matrix(self, frame: Frame) -> jax.Array:
         return self._design(frame)
@@ -502,6 +565,68 @@ class SharedTreeModel(Model):
             outs.append(init[k]
                         + traverse_jit(stacked[k].levels, stacked[k].values, X))
         return jnp.stack(outs, axis=1)
+
+
+def resolve_checkpoint(params, di, algo: str):
+    """Load + validate a checkpoint model for continued training.
+
+    Reference: ``hex/Model.java:521`` (checkpoint support for DL/DRF/GBM/
+    XGBoost) and GBM.java's non-modifiable-parameter check: the continued
+    run must keep the tree geometry (max_depth, nbins, distribution) and
+    ask for MORE trees; the prior model's bin edges are reused so codes
+    stay consistent across the two runs.
+    """
+    ckpt = params.checkpoint
+    if ckpt is None:
+        return None
+    prior = ckpt if not isinstance(ckpt, str) else dkv.get(ckpt)
+    if prior is None:
+        raise ValueError(f"checkpoint {ckpt!r} not found in DKV")
+    if prior.algo != algo:
+        raise ValueError(f"checkpoint algo {prior.algo!r} != {algo!r}")
+    for attr in ("max_depth", "nbins", "distribution", "response_column"):
+        a, b = getattr(prior.params, attr, None), getattr(params, attr, None)
+        if a != b:
+            raise ValueError(
+                f"checkpoint parameter mismatch: {attr} was {a!r}, now {b!r}"
+                " (non-modifiable for checkpoint continuation)")
+    prior_nt = prior.output["ntrees_trained"]
+    if params.ntrees <= prior_nt:
+        raise ValueError(
+            f"ntrees={params.ntrees} must exceed the checkpoint's "
+            f"{prior_nt} trees")
+    prior_cols = [s.name for s in prior.datainfo.specs]
+    cols = [s.name for s in di.specs]
+    if prior_cols != cols:
+        raise ValueError("checkpoint feature columns differ from frame")
+    return prior
+
+
+def checkpoint_binned(frame: Frame, di: DataInfo, prior, nbins: int):
+    """Re-encode a frame with the checkpoint model's stored bin edges."""
+    from .binning import BinnedFrame, encode_bins
+    names = [s.name for s in di.specs]
+    is_cat = [s.type == T_CAT for s in di.specs]
+    edges = prior.output["edges"]
+    codes = encode_bins(frame, names, edges, is_cat, nbins)
+    domains = [frame.vec(n).domain if c else None
+               for n, c in zip(names, is_cat)]
+    return BinnedFrame(codes=codes, edges=edges, names=names,
+                       is_cat=is_cat, cat_domains=domains, nbins=nbins)
+
+
+def prior_stacked(prior, k: Optional[int] = None) -> "StackedTrees":
+    """The checkpoint's ensemble as StackedTrees (class k for multinomial)."""
+    st = prior.output.get("stacked")
+    if st is not None:
+        if k is not None and isinstance(st, list):
+            return st[k]
+        if k is None and not isinstance(st, list):
+            return st
+    trees = prior.output["trees"]
+    if k is not None:
+        return StackedTrees.from_trees([t[k] for t in trees])
+    return StackedTrees.from_trees(list(trees))
 
 
 class SharedTree(ModelBuilder):

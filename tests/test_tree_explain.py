@@ -1,0 +1,158 @@
+"""Tree checkpoint continuation + TreeSHAP contribution tests.
+
+Mirrors pyunit_gbm_checkpoint / pyunit_contributions coverage: checkpoint
+10->20 trees equals a straight 20-tree run; SHAP rows sum to the margin
+prediction; exact Shapley golden check against brute-force enumeration
+with path-dependent expectations.
+"""
+
+import itertools
+import math
+
+import numpy as np
+import pytest
+
+from h2o3_tpu import Frame
+from h2o3_tpu.models import GBM, DRF, XGBoost
+
+
+def _reg_frame(rng, n=1500):
+    X = rng.random((n, 4))
+    y = (10 * np.sin(np.pi * X[:, 0]) + 5 * X[:, 1] ** 2
+         + 3 * X[:, 2] + 0.1 * rng.normal(size=n))
+    cols = {f"x{j}": X[:, j] for j in range(4)}
+    cols["y"] = y
+    return Frame.from_numpy(cols)
+
+
+def test_gbm_checkpoint_equals_straight_run(cl, rng):
+    fr = _reg_frame(rng)
+    kw = dict(response_column="y", max_depth=3, learn_rate=0.2, seed=7,
+              score_tree_interval=100)
+    m20 = GBM(ntrees=20, **kw).train(fr)
+    m10 = GBM(ntrees=10, **kw).train(fr)
+    mck = GBM(ntrees=20, checkpoint=m10.key, **kw).train(fr)
+    assert mck.output["ntrees_trained"] == 20
+    p20 = m20.predict(fr).vec("predict").to_numpy()
+    pck = mck.predict(fr).vec("predict").to_numpy()
+    np.testing.assert_allclose(pck, p20, rtol=1e-4, atol=1e-4)
+
+
+def test_checkpoint_validation(cl, rng):
+    fr = _reg_frame(rng)
+    m = GBM(response_column="y", ntrees=5, max_depth=3, seed=1).train(fr)
+    with pytest.raises(ValueError, match="must exceed"):
+        GBM(response_column="y", ntrees=5, max_depth=3, seed=1,
+            checkpoint=m.key).train(fr)
+    with pytest.raises(ValueError, match="non-modifiable"):
+        GBM(response_column="y", ntrees=10, max_depth=4, seed=1,
+            checkpoint=m.key).train(fr)
+
+
+def test_drf_checkpoint_continues(cl, rng):
+    fr = _reg_frame(rng)
+    kw = dict(response_column="y", max_depth=4, seed=3,
+              score_tree_interval=100)
+    m5 = DRF(ntrees=5, **kw).train(fr)
+    mck = DRF(ntrees=12, checkpoint=m5.key, **kw).train(fr)
+    assert mck.output["ntrees_trained"] == 12
+    r2 = mck.training_metrics.r2
+    assert r2 > 0.7, r2
+
+
+def test_shap_sums_to_margin(cl, rng):
+    fr = _reg_frame(rng)
+    m = GBM(response_column="y", ntrees=8, max_depth=3, learn_rate=0.3,
+            seed=2).train(fr)
+    sub = Frame.from_numpy({n: fr.vec(n).to_numpy()[:50]
+                            for n in fr.names})
+    contrib = m.predict_contributions(sub)
+    assert contrib.names[-1] == "BiasTerm"
+    total = contrib.to_numpy().sum(axis=1)
+    pred = m.predict(sub).vec("predict").to_numpy()
+    np.testing.assert_allclose(total, pred, rtol=1e-4, atol=1e-4)
+
+
+def test_shap_sums_to_margin_binomial_and_drf(cl, rng):
+    n = 1200
+    X = rng.normal(size=(n, 3))
+    y = (X[:, 0] + X[:, 1] * X[:, 2] > 0.2)
+    fr = Frame.from_numpy({**{f"x{j}": X[:, j] for j in range(3)},
+                           "y": np.where(y, "Y", "N").astype(object)})
+    sub = Frame.from_numpy({nm: fr.vec(nm).to_numpy()[:40]
+                            if fr.vec(nm).type != "cat"
+                            else fr.vec(nm).decoded()[:40]
+                            for nm in fr.names})
+    m = XGBoost(response_column="y", ntrees=6, max_depth=3, seed=4).train(fr)
+    total = m.predict_contributions(sub).to_numpy().sum(axis=1)
+    p1 = m.predict(sub).vec("Y").to_numpy()
+    margin = np.log(np.clip(p1, 1e-9, 1) / np.clip(1 - p1, 1e-9, 1))
+    np.testing.assert_allclose(total, margin, rtol=1e-3, atol=1e-3)
+
+    d = DRF(response_column="y", ntrees=7, max_depth=4, seed=4).train(fr)
+    total_d = d.predict_contributions(sub).to_numpy().sum(axis=1)
+    p1_d = d.predict(sub).vec("Y").to_numpy()
+    np.testing.assert_allclose(total_d, p1_d, rtol=1e-3, atol=1e-3)
+
+
+def _brute_force_shap(tree, x, F):
+    """Exact Shapley with path-dependent expectations (the TreeSHAP
+    definition): v(S) follows known features, cover-averages unknown."""
+    def ev(d, i, S):
+        if tree.is_leaf(d, i):
+            return tree.value[d][i]
+        f = int(tree.feat[d][i])
+        if f in S:
+            xv = x[f]
+            left = (not np.isnan(xv) and xv < tree.thr[d][i]) or \
+                (np.isnan(xv) and tree.na_left[d][i])
+            return ev(d + 1, 2 * i + (0 if left else 1), S)
+        cl = tree.cover[d + 1][2 * i]
+        cr = tree.cover[d + 1][2 * i + 1]
+        tot = max(cl + cr, 1e-300)
+        return (cl * ev(d + 1, 2 * i, S) + cr * ev(d + 1, 2 * i + 1, S)) / tot
+
+    phi = np.zeros(F)
+    feats = list(range(F))
+    for i in range(F):
+        others = [f for f in feats if f != i]
+        for r in range(F):
+            for S in itertools.combinations(others, r):
+                wgt = math.factorial(len(S)) * math.factorial(
+                    F - len(S) - 1) / math.factorial(F)
+                phi[i] += wgt * (ev(0, 0, set(S) | {i}) - ev(0, 0, set(S)))
+    return phi
+
+
+def test_shap_exact_vs_brute_force(cl, rng):
+    from h2o3_tpu.export.treeshap import (shap_trees_from_model,
+                                          tree_contributions)
+    n = 800
+    X = rng.normal(size=(n, 3))
+    y = X[:, 0] * 2 + np.where(X[:, 1] > 0, X[:, 2], -X[:, 2])
+    fr = Frame.from_numpy({**{f"x{j}": X[:, j] for j in range(3)}, "y": y})
+    m = GBM(response_column="y", ntrees=1, max_depth=3, learn_rate=1.0,
+            seed=5).train(fr)
+    trees = shap_trees_from_model(list(m.output["trees"]))
+    Xq = X[:10].astype(np.float64)
+    got = tree_contributions(trees[0], Xq)
+    for r in range(10):
+        want = _brute_force_shap(trees[0], Xq[r], 3)
+        np.testing.assert_allclose(got[r, :3], want, rtol=1e-5, atol=1e-7)
+
+
+def test_mojo_contributions_roundtrip(cl, rng, tmp_path):
+    import h2o3_tpu
+    fr = _reg_frame(rng)
+    m = GBM(response_column="y", ntrees=5, max_depth=3, seed=6).train(fr)
+    path = str(tmp_path / "m.mojo")
+    m.download_mojo(path)
+    sm = h2o3_tpu.import_mojo(path)
+    data = {nm: fr.vec(nm).to_numpy()[:20] for nm in fr.names
+            if nm != "y"}
+    out = sm.predict_contributions(data)
+    live = m.predict_contributions(
+        Frame.from_numpy({nm: fr.vec(nm).to_numpy()[:20]
+                          for nm in fr.names}))
+    np.testing.assert_allclose(out["contributions"],
+                               live.to_numpy(), rtol=1e-4, atol=1e-5)
