@@ -28,7 +28,7 @@ ALGOS = ("glm", "gbm", "drf", "xgboost", "deeplearning", "kmeans", "pca",
          "svd", "naivebayes", "isolationforest", "extendedisolationforest",
          "isotonicregression", "quantile", "stackedensemble", "adaboost",
          "targetencoder", "glrm", "coxph", "word2vec", "rulefit",
-         "aggregator", "gam")
+         "aggregator", "gam", "upliftdrf", "dt")
 
 
 def _builder(algo: str):
@@ -44,6 +44,7 @@ def _builder(algo: str):
         "adaboost": M.AdaBoost, "targetencoder": M.TargetEncoder,
         "glrm": M.GLRM, "coxph": M.CoxPH, "word2vec": M.Word2Vec,
         "rulefit": M.RuleFit, "aggregator": M.Aggregator, "gam": M.GAM,
+        "upliftdrf": M.UpliftDRF, "dt": M.DecisionTree,
     }[algo]
 
 
@@ -249,6 +250,144 @@ class Api:
         dkv.remove(key)
         return {"removed": key}
 
+    # ---------------------------------------------------------------- rapids
+    def rapids(self, ast: str, **kw) -> dict:
+        """POST /99/Rapids — evaluate a Rapids expression (Rapids.java:29)."""
+        from ..rapids.ast import rapids as _eval
+        from ..frame.frame import Frame
+        out = _eval(ast)
+        if isinstance(out, Frame):
+            return {"key": {"name": out.key},
+                    **_frame_schema(out.key or "", out)}
+        if out is None:
+            return {"result": None}
+        if isinstance(out, (int, float)):
+            return {"scalar": out}
+        return {"string": str(out)}
+
+    # -------------------------------------------------------------- metadata
+    def schemas(self) -> dict:
+        """GET /3/Metadata/schemas — parameter schemas for client codegen
+        (the h2o-bindings gen_python.py contract)."""
+        import dataclasses
+        out = []
+        for algo in ALGOS:
+            try:
+                cls = _builder(algo)
+                pcls = cls(**{}).params.__class__
+            except Exception:
+                import inspect
+                sig = inspect.signature(_builder(algo).__init__)
+                pcls = None
+            fields = []
+            if pcls is not None:
+                for f in dataclasses.fields(pcls):
+                    default = f.default
+                    if default is dataclasses.MISSING:
+                        default = None
+                    fields.append({
+                        "name": f.name,
+                        "type": getattr(f.type, "__name__", str(f.type)),
+                        "default": default
+                        if isinstance(default, (int, float, str, bool,
+                                                type(None))) else
+                        list(default) if isinstance(default, (list, tuple))
+                        else str(default),
+                    })
+            out.append({"algo": algo, "parameters": fields})
+        return {"schemas": out}
+
+    # --------------------------------------------------------------- export
+    def frame_summary(self, key: str) -> dict:
+        from ..runtime import dkv
+        fr = dkv.get(key)
+        if fr is None:
+            raise KeyError(f"no frame {key!r}")
+        return {"frames": [{**_frame_schema(key, fr),
+                            "summary": fr.summary()}]}
+
+    def frame_data(self, key: str, row_offset=0, row_count=100, **kw) -> dict:
+        """GET /3/Frames/{k}/data — paged column data (Flow grid contract)."""
+        from ..runtime import dkv
+        fr = dkv.get(key)
+        if fr is None:
+            raise KeyError(f"no frame {key!r}")
+        lo = int(row_offset)
+        hi = min(fr.nrows, lo + int(row_count))
+        cols = {}
+        for n, v in zip(fr.names, fr.vecs):
+            col = v.decoded()[lo:hi]
+            cols[n] = [None if (x is None or (isinstance(x, float)
+                                              and np.isnan(x))) else x
+                       for x in col.tolist()]
+        return {"frame_id": {"name": key}, "row_offset": lo,
+                "row_count": hi - lo, "data": cols}
+
+    def export_frame(self, key: str, path: str, **kw) -> dict:
+        from ..runtime import dkv
+        from ..frame.parse import export_file
+        fr = dkv.get(key)
+        if fr is None:
+            raise KeyError(f"no frame {key!r}")
+        export_file(fr, path)
+        return {"job": {"status": "DONE"}, "path": path}
+
+    def import_files(self, path: str, **kw) -> dict:
+        """GET /3/ImportFiles — expand globs/dirs (ImportFilesHandler)."""
+        from ..frame.parse import _expand_paths
+        files = _expand_paths(path)
+        return {"files": files, "destination_frames": files}
+
+    def timeline(self) -> dict:
+        """GET /3/Timeline — recent runtime events (TimelineHandler:12)."""
+        from ..runtime.observability import timeline_events
+        return {"events": timeline_events()}
+
+    def logs(self, **kw) -> dict:
+        from ..runtime.observability import recent_logs
+        return {"log": recent_logs()}
+
+    def job(self, key: str) -> dict:
+        from ..runtime.job import list_jobs
+        for j in list_jobs():
+            if j.key == key:
+                return {"jobs": [j.describe()]}
+        raise KeyError(f"no job {key!r}")
+
+    def model_metrics(self, model_key: str, frame_key: str, **kw) -> dict:
+        from ..runtime import dkv
+        m = dkv.get(model_key)
+        fr = dkv.get(frame_key)
+        if m is None or fr is None:
+            raise KeyError(f"missing {model_key!r} or {frame_key!r}")
+        perf = m.model_performance(fr)
+        d = perf.describe() if hasattr(perf, "describe") else {}
+        return {"model_metrics": [{k: v for k, v in d.items()
+                                   if isinstance(v, (int, float, str))}]}
+
+    def scoring_history(self, model_key: str) -> dict:
+        from ..runtime import dkv
+        m = dkv.get(model_key)
+        if m is None:
+            raise KeyError(f"no model {model_key!r}")
+        return {"scoring_history": getattr(m, "scoring_history", [])}
+
+    def split_frame(self, key: str, ratios="[0.75]", seed=0,
+                    **kw) -> dict:
+        from ..runtime import dkv
+        fr = dkv.get(key)
+        if fr is None:
+            raise KeyError(f"no frame {key!r}")
+        rr = json.loads(ratios) if isinstance(ratios, str) else ratios
+        pieces = fr.split_frame([float(r) for r in rr], seed=int(seed))
+        out = []
+        for i, p in enumerate(pieces):
+            k = f"{key}_part{i}"
+            p.key = k
+            dkv.put(k, p)
+            out.append(k)
+        return {"destination_frames": out}
+
 
 class H2OServer:
     """In-process REST server — H2OApp/Jetty boot analog."""
@@ -259,9 +398,19 @@ class H2OServer:
             r"/3/Cloud": lambda a: a.cloud(),
             r"/3/Frames": lambda a: a.frames(),
             r"/3/Frames/([^/]+)": lambda a, k: a.frame(k),
+            r"/3/Frames/([^/]+)/summary": lambda a, k: a.frame_summary(k),
+            r"/3/Frames/([^/]+)/data": lambda a, k, **kw:
+                a.frame_data(k, **kw),
             r"/3/Models": lambda a: a.models(),
             r"/3/Models/([^/]+)": lambda a, k: a.model(k),
+            r"/3/Models/([^/]+)/scoring_history": lambda a, k:
+                a.scoring_history(k),
             r"/3/Jobs": lambda a: a.jobs_list(),
+            r"/3/Jobs/([^/]+)": lambda a, k: a.job(k),
+            r"/3/ImportFiles": lambda a, **kw: a.import_files(**kw),
+            r"/3/Metadata/schemas": lambda a: a.schemas(),
+            r"/3/Timeline": lambda a: a.timeline(),
+            r"/3/Logs": lambda a, **kw: a.logs(**kw),
         }
         _Handler.routes_post = {
             r"/3/Parse": lambda a, **kw: a.parse(**kw),
@@ -269,6 +418,12 @@ class H2OServer:
                 a.train(algo, **kw),
             r"/3/Predictions/models/([^/]+)/frames/([^/]+)":
                 lambda a, m, f, **kw: a.predict(m, f, **kw),
+            r"/99/Rapids": lambda a, **kw: a.rapids(**kw),
+            r"/3/Frames/([^/]+)/export": lambda a, k, **kw:
+                a.export_frame(k, **kw),
+            r"/3/ModelMetrics/models/([^/]+)/frames/([^/]+)":
+                lambda a, m, f, **kw: a.model_metrics(m, f, **kw),
+            r"/3/SplitFrame": lambda a, **kw: a.split_frame(**kw),
         }
         _Handler.routes_delete = {
             r"/3/DKV/([^/]+)": lambda a, k: a.remove(k),

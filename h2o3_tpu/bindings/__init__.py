@@ -1,0 +1,11 @@
+"""Bindings codegen: REST schema metadata -> client estimator classes.
+
+Reference: ``h2o-bindings/bin/gen_python.py`` — the reference generates its
+Python/R estimator classes from the server's schema metadata endpoint so
+clients never drift from the server's parameter surface.  SURVEY.md §2.8:
+"replicate this pattern".
+"""
+
+from .gen import generate_estimators_source, write_estimators
+
+__all__ = ["generate_estimators_source", "write_estimators"]

@@ -1,0 +1,204 @@
+"""Remote REST client — the h2o-py H2OConnection/H2OFrame-over-HTTP analog.
+
+Reference: ``h2o-py/h2o/backend/connection.py`` (H2OConnection: versioned
+REST with retries) and ``h2o-py/h2o/h2o.py`` module functions that drive
+/3/Parse, /3/ModelBuilders, /3/Predictions.  Everything here talks ONLY
+HTTP — no shared memory with the server process — so it exercises the same
+contract a remote notebook would.
+
+Usage::
+
+    import h2o3_tpu.client as h2oc
+    conn = h2oc.connect("http://127.0.0.1:54321")
+    fr = conn.import_file("/data/train.csv")
+    model = conn.train("gbm", training_frame=fr, response_column="y")
+    preds = model.predict(fr)
+    head = preds.head()
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+import urllib.request
+from typing import Dict, List, Optional, Sequence, Union
+
+from .rapids.expr import Backend, LazyFrame
+
+
+class H2OConnectionError(Exception):
+    pass
+
+
+class H2OConnection(Backend):
+    """HTTP connection to a running h2o3_tpu REST server."""
+
+    def __init__(self, url: str):
+        self.url = url.rstrip("/")
+        self.cloud = self.get("/3/Cloud")
+
+    # ------------------------------------------------------------- transport
+    def _req(self, method: str, route: str, params: Optional[dict] = None):
+        url = f"{self.url}{route}"
+        data = None
+        if method == "GET" and params:
+            url += "?" + urllib.parse.urlencode(params)
+        elif params is not None:
+            data = json.dumps(params).encode()
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Content-Type", "application/json")
+        try:
+            with urllib.request.urlopen(req) as resp:
+                payload = json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read().decode())
+            except Exception:
+                payload = {"error": str(e)}
+            raise H2OConnectionError(
+                f"{method} {route} -> {e.code}: "
+                f"{payload.get('error', payload)}") from None
+        return payload
+
+    def get(self, _route: str, **params):
+        return self._req("GET", _route, params or None)
+
+    def post(self, _route: str, **params):
+        return self._req("POST", _route, params)
+
+    def delete(self, _route: str):
+        return self._req("DELETE", _route)
+
+    # ---------------------------------------------------- Backend (rapids)
+    def rapids(self, text: str):
+        out = self.post("/99/Rapids", ast=text)
+        if "scalar" in out:
+            return out["scalar"]
+        return out
+
+    def frame_by_key(self, key: str) -> "RemoteFrame":
+        return RemoteFrame(self, key)
+
+    # -------------------------------------------------------------- actions
+    def import_file(self, path: str,
+                    destination_frame: Optional[str] = None) -> "RemoteFrame":
+        out = self.post("/3/Parse", path=path,
+                        destination_frame=destination_frame)
+        return RemoteFrame(self, out["destination_frame"]["name"])
+
+    def frames(self) -> List[str]:
+        return [f["frame_id"]["name"] for f in self.get("/3/Frames")["frames"]]
+
+    def models(self) -> List[str]:
+        return [m["model_id"]["name"] for m in self.get("/3/Models")["models"]]
+
+    def train(self, algo: str, training_frame, validation_frame=None,
+              **params) -> "RemoteModel":
+        tf = training_frame.key if hasattr(training_frame, "key") \
+            else str(training_frame)
+        if validation_frame is not None:
+            params["validation_frame"] = validation_frame.key \
+                if hasattr(validation_frame, "key") else str(validation_frame)
+        out = self.post(f"/3/ModelBuilders/{algo}", training_frame=tf,
+                        **params)
+        return RemoteModel(self, out["model"]["model_id"]["name"])
+
+    def schemas(self) -> dict:
+        return self.get("/3/Metadata/schemas")
+
+    def remove(self, key: str):
+        self.delete(f"/3/DKV/{key}")
+
+    def lazy(self, frame: "RemoteFrame") -> LazyFrame:
+        return LazyFrame.from_key(frame.key, backend=self)
+
+
+class RemoteFrame:
+    """Handle to a server-side Frame, driven entirely over REST."""
+
+    def __init__(self, conn: H2OConnection, key: str):
+        self.conn = conn
+        self.key = key
+
+    @property
+    def schema(self) -> dict:
+        return self.conn.get(f"/3/Frames/{self.key}")["frames"][0]
+
+    @property
+    def nrows(self) -> int:
+        return int(self.schema["rows"])
+
+    @property
+    def names(self) -> List[str]:
+        return [c["label"] for c in self.schema["columns"]]
+
+    def types(self) -> Dict[str, str]:
+        return {c["label"]: c["type"] for c in self.schema["columns"]}
+
+    def summary(self) -> dict:
+        return self.conn.get(
+            f"/3/Frames/{self.key}/summary")["frames"][0]["summary"]
+
+    def head(self, n: int = 10) -> Dict[str, list]:
+        return self.conn.get(f"/3/Frames/{self.key}/data",
+                             row_offset=0, row_count=n)["data"]
+
+    def export(self, path: str) -> str:
+        return self.conn.post(f"/3/Frames/{self.key}/export",
+                              path=path)["path"]
+
+    def split_frame(self, ratios: Sequence[float],
+                    seed: int = 0) -> List["RemoteFrame"]:
+        out = self.conn.post("/3/SplitFrame", key=self.key,
+                             ratios=json.dumps(list(ratios)), seed=seed)
+        return [RemoteFrame(self.conn, k)
+                for k in out["destination_frames"]]
+
+    def lazy(self) -> LazyFrame:
+        return LazyFrame.from_key(self.key, backend=self.conn)
+
+    def __repr__(self):
+        return f"<RemoteFrame {self.key}>"
+
+
+class RemoteModel:
+    """Handle to a server-side Model."""
+
+    def __init__(self, conn: H2OConnection, key: str):
+        self.conn = conn
+        self.key = key
+
+    @property
+    def schema(self) -> dict:
+        return self.conn.get(f"/3/Models/{self.key}")["models"][0]
+
+    @property
+    def algo(self) -> str:
+        return self.schema["algo"]
+
+    def metrics(self) -> dict:
+        return self.schema["training_metrics"]
+
+    def scoring_history(self) -> list:
+        return self.conn.get(
+            f"/3/Models/{self.key}/scoring_history")["scoring_history"]
+
+    def predict(self, frame: Union[RemoteFrame, str]) -> RemoteFrame:
+        fk = frame.key if isinstance(frame, RemoteFrame) else str(frame)
+        out = self.conn.post(
+            f"/3/Predictions/models/{self.key}/frames/{fk}")
+        return RemoteFrame(self.conn, out["predictions_frame"]["name"])
+
+    def model_performance(self, frame: Union[RemoteFrame, str]) -> dict:
+        fk = frame.key if isinstance(frame, RemoteFrame) else str(frame)
+        return self.conn.post(
+            f"/3/ModelMetrics/models/{self.key}/frames/{fk}"
+        )["model_metrics"][0]
+
+    def __repr__(self):
+        return f"<RemoteModel {self.key}>"
+
+
+def connect(url: str = "http://127.0.0.1:54321") -> H2OConnection:
+    """h2o.connect analog."""
+    return H2OConnection(url)

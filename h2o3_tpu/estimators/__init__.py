@@ -1,0 +1,8 @@
+"""Generated remote estimators (bindings-codegen output).
+
+``from h2o3_tpu.estimators import H2OGBMEstimator`` — classes mirror the
+server's /3/Metadata/schemas parameter surface; see bindings/gen.py.
+"""
+
+from ._generated import *          # noqa: F401,F403
+from ._generated import __all__    # noqa: F401

@@ -1,0 +1,332 @@
+"""Rapids AST: parse + evaluate the Lisp-style expression language.
+
+Reference: ``water/rapids/Rapids.java:29`` (parser) and the Ast* op classes
+under ``water/rapids/ast/prims`` — clients (h2o-py/h2o/expr.py:27) build
+``(op arg ...)`` strings lazily and POST them to /99/Rapids; the server
+parses and evaluates against DKV frames.
+
+The evaluator here maps ops onto the device-side munging engine (ops.py)
+and fused jnp arithmetic; numbers/strings/lists follow the reference's
+literal syntax (``[1 2 3]`` number lists, ``["a" "b"]`` string lists,
+``'col'`` quoted strings).  Temporary results are assigned DKV keys via
+(tmp= ...) / (assign ...) exactly like the reference session protocol.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..frame.frame import Frame
+from ..frame.vec import Vec, T_CAT, T_NUM
+from ..runtime import dkv
+from . import ops
+
+
+# ------------------------------------------------------------------ parser
+class _Tok:
+    def __init__(self, text: str):
+        self.text = text
+        self.i = 0
+
+    def peek(self) -> str:
+        while self.i < len(self.text) and self.text[self.i].isspace():
+            self.i += 1
+        return self.text[self.i] if self.i < len(self.text) else ""
+
+    def next_token(self) -> str:
+        c = self.peek()
+        if c in "()[]":
+            self.i += 1
+            return c
+        if c in "'\"":
+            q = c
+            j = self.i + 1
+            while j < len(self.text) and self.text[j] != q:
+                j += 1
+            tok = self.text[self.i + 1: j]
+            self.i = j + 1
+            return ("str", tok)
+        j = self.i
+        while j < len(self.text) and not self.text[j].isspace() \
+                and self.text[j] not in "()[]":
+            j += 1
+        tok = self.text[self.i: j]
+        self.i = j
+        return tok
+
+
+def parse(text: str):
+    """Rapids text -> nested python lists (strings/floats/markers)."""
+    tok = _Tok(text)
+
+    def read():
+        t = tok.next_token()
+        if t == "(":
+            out = []
+            while tok.peek() != ")":
+                if tok.peek() == "":
+                    raise ValueError("unbalanced (")
+                out.append(read())
+            tok.next_token()
+            return out
+        if t == "[":
+            out = ["__list__"]
+            while tok.peek() != "]":
+                if tok.peek() == "":
+                    raise ValueError("unbalanced [")
+                out.append(read())
+            tok.next_token()
+            return out
+        if t == ")" or t == "]":
+            raise ValueError(f"unexpected {t}")
+        if isinstance(t, tuple):
+            return ("str", t[1])
+        try:
+            return float(t)
+        except ValueError:
+            return t
+
+    out = read()
+    if tok.peek():
+        raise ValueError(f"trailing input: {tok.text[tok.i:]}")
+    return out
+
+
+# --------------------------------------------------------------- evaluator
+def _vecframe(v, name="x") -> Frame:
+    return Frame([name], [v]) if isinstance(v, Vec) else v
+
+
+def _numeric(fr: Frame) -> jnp.ndarray:
+    """[padded, C] numeric view of all columns (cats as codes)."""
+    return jnp.stack([v.numeric_data() for v in fr.vecs], axis=1)
+
+
+def _binop(op, l, r):
+    """Elementwise arithmetic over frames/vecs/scalars — fused on device."""
+    def arr(x):
+        if isinstance(x, Frame):
+            return _numeric(x)
+        if isinstance(x, Vec):
+            return x.numeric_data()[:, None]
+        return x
+    la, ra = arr(l), arr(r)
+    fn = {
+        "+": jnp.add, "-": jnp.subtract, "*": jnp.multiply,
+        "/": jnp.divide, "^": jnp.power, "%": jnp.mod,
+        "intDiv": jnp.floor_divide,
+        "<": jnp.less, "<=": jnp.less_equal, ">": jnp.greater,
+        ">=": jnp.greater_equal, "==": jnp.equal, "!=": jnp.not_equal,
+        "&": jnp.logical_and, "|": jnp.logical_or,
+    }[op]
+    out = fn(la, ra)
+    out = out.astype(jnp.float32)
+    ref = l if isinstance(l, (Frame, Vec)) else r
+    nrows = ref.nrows
+    names = ref.names if isinstance(ref, Frame) else ["x"]
+    if out.ndim == 1:
+        out = out[:, None]
+    return Frame([f"{n}" for n in names[: out.shape[1]]],
+                 [Vec(out[:, j], T_NUM, nrows) for j in range(out.shape[1])])
+
+
+_UNARY = {
+    "abs": jnp.abs, "log": jnp.log, "log10": jnp.log10, "log2": jnp.log2,
+    "log1p": jnp.log1p, "exp": jnp.exp, "expm1": jnp.expm1,
+    "sqrt": jnp.sqrt, "floor": jnp.floor, "ceiling": jnp.ceil,
+    "round": jnp.round, "trunc": jnp.trunc, "sign": jnp.sign,
+    "cos": jnp.cos, "sin": jnp.sin, "tan": jnp.tan, "acos": jnp.arccos,
+    "asin": jnp.arcsin, "atan": jnp.arctan, "cosh": jnp.cosh,
+    "sinh": jnp.sinh, "tanh": jnp.tanh, "not": jnp.logical_not,
+    "is.na": jnp.isnan,
+}
+
+_AGG = {
+    "sum": jnp.nansum, "mean": jnp.nanmean, "max": jnp.nanmax,
+    "min": jnp.nanmin, "sd": lambda x: jnp.nanstd(x, ddof=1),
+    "var": lambda x: jnp.nanvar(x, ddof=1), "median": jnp.nanmedian,
+}
+
+
+class Session:
+    """One Rapids session: evaluates ASTs against the DKV."""
+
+    def eval(self, text: str):
+        return self._ev(parse(text))
+
+    # -- helpers
+    def _frame(self, key: str) -> Frame:
+        fr = dkv.get(key)
+        if fr is None:
+            raise KeyError(f"no frame {key!r}")
+        return fr
+
+    def _ev(self, node) -> Any:
+        if isinstance(node, float):
+            return node
+        if isinstance(node, tuple) and node[0] == "str":
+            return node[1]
+        if isinstance(node, str):
+            # bare identifier: a DKV key
+            return self._frame(node)
+        if not isinstance(node, list):
+            raise ValueError(f"bad node {node!r}")
+        if node and node[0] == "__list__":
+            return [self._ev(x) for x in node[1:]]
+        op, *args = node
+        return self._apply(op, args)
+
+    def _apply(self, op: str, args: List) -> Any:
+        ev = self._ev
+        if op in ("tmp=", "assign"):
+            key = args[0] if isinstance(args[0], str) else ev(args[0])
+            val = ev(args[1])
+            if isinstance(val, Vec):
+                val = _vecframe(val)
+            if isinstance(val, Frame):
+                val = Frame(val.names, val.vecs, key=key)
+            else:
+                dkv.put(key, val)
+            return val
+        if op == "rm":
+            dkv.remove(args[0] if isinstance(args[0], str) else ev(args[0]))
+            return None
+        if op in ("+", "-", "*", "/", "^", "%", "intDiv", "<", "<=", ">",
+                  ">=", "==", "!=", "&", "|"):
+            return _binop(op, ev(args[0]), ev(args[1]))
+        if op in _UNARY:
+            fr = _vecframe(ev(args[0]))
+            X = _numeric(fr)
+            out = _UNARY[op](X).astype(jnp.float32)
+            return Frame(fr.names, [Vec(out[:, j], T_NUM, fr.nrows)
+                                    for j in range(out.shape[1])])
+        if op in _AGG:
+            fr = _vecframe(ev(args[0]))
+            X = _numeric(fr)[: None]
+            mask = jnp.arange(X.shape[0]) < fr.nrows
+            Xv = jnp.where(mask[:, None], X, jnp.nan)
+            return float(_AGG[op](Xv))
+        if op == "cols" or op == "cols_py":
+            fr = ev(args[0])
+            sel = ev(args[1])
+            return fr[self._col_names(fr, sel)]
+        if op == "rows":
+            fr = ev(args[0])
+            sel = ev(args[1])
+            if isinstance(sel, Frame):           # boolean mask frame
+                return ops.filter_rows(fr, sel.vecs[0])
+            idx = np.asarray(sel, dtype=np.int64)
+            return fr.rows(idx)
+        if op == "sort":
+            fr = ev(args[0])
+            cols = self._col_names(fr, ev(args[1]))
+            asc = True
+            if len(args) > 2:
+                a = ev(args[2])
+                asc = [bool(x) for x in a] if isinstance(a, list) else bool(a)
+            return ops.sort(fr, cols, ascending=asc)
+        if op == "merge":
+            left, right = ev(args[0]), ev(args[1])
+            all_left = bool(ev(args[2])) if len(args) > 2 else False
+            by = self._col_names(left, ev(args[3])) if len(args) > 3 and \
+                args[3] is not None else \
+                [c for c in left.names if c in right.names]
+            return ops.merge(left, right, by,
+                             how="left" if all_left else "inner")
+        if op == "GB" or op == "group_by":
+            # (GB frame [by...] agg col na agg col na ...) — AstGroup triples
+            fr = ev(args[0])
+            by = self._col_names(fr, ev(args[1]))
+            aggs: dict = {}
+            rest = args[2:]
+            for i in range(0, len(rest) - 2, 3):
+                fn = rest[i] if isinstance(rest[i], str) else ev(rest[i])
+                col = self._col_names(fr, ev(rest[i + 1]))[0]
+                aggs.setdefault(col, []).append(
+                    {"nrow": "count"}.get(fn, fn))
+            return ops.group_by(fr, by, aggs)
+        if op == "rbind":
+            return ops.rbind(*[ev(a) for a in args])
+        if op == "cbind":
+            return ops.cbind(*[_vecframe(ev(a)) for a in args])
+        if op == "unique":
+            fr = _vecframe(ev(args[0]))
+            vals = ops.unique(fr.vecs[0])
+            return Frame.from_numpy({fr.names[0]: vals})
+        if op == "table":
+            fr = _vecframe(ev(args[0]))
+            t = ops.table(fr.vecs[0])
+            return Frame.from_numpy({
+                fr.names[0]: np.asarray(list(t.keys()), object),
+                "Count": np.asarray(list(t.values()), np.float64)})
+        if op == "ifelse":
+            c, yes, no = ev(args[0]), ev(args[1]), ev(args[2])
+            cv = c.vecs[0] if isinstance(c, Frame) else c
+            yv = yes.vecs[0] if isinstance(yes, Frame) else yes
+            nv = no.vecs[0] if isinstance(no, Frame) else no
+            return _vecframe(ops.ifelse(cv, yv, nv))
+        if op == "hist":
+            fr = _vecframe(ev(args[0]))
+            breaks = int(ev(args[1])) if len(args) > 1 else 20
+            counts, edges = ops.hist(fr.vecs[0], breaks)
+            return Frame.from_numpy({"breaks": edges[1:],
+                                     "counts": counts.astype(np.float64)})
+        if op == "nrow":
+            return float(ev(args[0]).nrows)
+        if op == "ncol":
+            return float(ev(args[0]).ncols)
+        if op == "colnames=":
+            fr = ev(args[0])
+            names = ev(args[2])
+            names = names if isinstance(names, list) else [names]
+            idx = ev(args[1])
+            idx = [int(i) for i in (idx if isinstance(idx, list) else [idx])]
+            mapping = {fr.names[i]: str(n) for i, n in zip(idx, names)}
+            return fr.rename(mapping)
+        if op == "as.factor":
+            fr = _vecframe(ev(args[0]))
+            out = []
+            for v in fr.vecs:
+                if v.type == T_CAT:
+                    out.append(v)
+                else:
+                    x = v.to_numpy()
+                    out.append(Vec.from_numpy(
+                        np.asarray([("" if np.isnan(u) else str(u))
+                                    for u in x], dtype=object), T_CAT))
+            return Frame(fr.names, out)
+        if op == "as.numeric":
+            fr = _vecframe(ev(args[0]))
+            X = _numeric(fr)
+            return Frame(fr.names, [Vec(X[:, j], T_NUM, fr.nrows)
+                                    for j in range(X.shape[1])])
+        if op == "quantile":
+            from ..models.quantile import quantile
+            fr = ev(args[0])
+            probs = [float(p) for p in ev(args[1])]
+            return quantile(fr, probs)
+        raise ValueError(f"unknown rapids op {op!r}")
+
+    def _col_names(self, fr: Frame, sel) -> List[str]:
+        if isinstance(sel, str):
+            return [sel]
+        if isinstance(sel, float):
+            return [fr.names[int(sel)]]
+        out = []
+        for s in sel:
+            out.append(s if isinstance(s, str) else fr.names[int(s)])
+        return out
+
+
+_session: Optional[Session] = None
+
+
+def rapids(text: str):
+    """Evaluate a Rapids expression — h2o.rapids / POST /99/Rapids analog."""
+    global _session
+    if _session is None:
+        _session = Session()
+    return _session.eval(text)

@@ -51,8 +51,10 @@ class Job:
     # ------------------------------------------------------------- lifecycle
     def run(self, fn: Callable[["Job"], Any]) -> Any:
         """Run ``fn(self)`` inline, tracking status/exceptions (blocking)."""
+        from .observability import record
         self.status = RUNNING
         self.start_time = time.time()
+        record("job_start", job=self.key, description=self.description)
         try:
             self.result = fn(self)
             self.status = DONE
@@ -68,6 +70,8 @@ class Job:
             raise
         finally:
             self.end_time = time.time()
+            record("job_end", job=self.key, status=self.status,
+                   duration_s=round(self.run_time, 4))
 
     def start(self, fn: Callable[["Job"], Any]) -> "Job":
         """Run ``fn(self)`` on a background thread (async job)."""

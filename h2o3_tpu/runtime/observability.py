@@ -1,0 +1,82 @@
+"""Observability: logging facade, event timeline, and profiling hooks.
+
+Reference: ``water/TimeLine.java:22`` (per-node ring buffer of runtime
+events, surfaced by ``water/api/TimelineHandler.java:12``), ``water/util/
+Log.java`` (logging facade with per-node files), and the MRProfile timings.
+
+TPU redesign: a process-local ring buffer of (ts, kind, fields) events
+covers the coordinator control plane (jobs, parses, scoring, rapids);
+device-side profiling delegates to ``jax.profiler`` traces, which capture
+the XLA/TPU timeline far better than any hand-rolled counter could.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+_LOG_RING = collections.deque(maxlen=2000)
+_EVENTS = collections.deque(maxlen=2000)
+_lock = threading.Lock()
+
+
+class _RingHandler(logging.Handler):
+    def emit(self, record):
+        with _lock:
+            _LOG_RING.append(self.format(record))
+
+
+log = logging.getLogger("h2o3_tpu")
+if not log.handlers:
+    _h = _RingHandler()
+    _h.setFormatter(logging.Formatter(
+        "%(asctime)s %(levelname)s %(name)s: %(message)s"))
+    log.addHandler(_h)
+    if os.environ.get("H2O3_TPU_LOG_STDERR"):
+        log.addHandler(logging.StreamHandler())
+    log.setLevel(os.environ.get("H2O3_TPU_LOG_LEVEL", "INFO"))
+
+
+def record(kind: str, **fields) -> None:
+    """Append a timeline event (water.TimeLine.record analog)."""
+    with _lock:
+        _EVENTS.append({"ts": time.time(), "kind": kind, **fields})
+
+
+def timeline_events(limit: int = 500) -> List[Dict]:
+    with _lock:
+        return list(_EVENTS)[-limit:]
+
+
+def recent_logs(limit: int = 500) -> List[str]:
+    with _lock:
+        return list(_LOG_RING)[-limit:]
+
+
+@contextlib.contextmanager
+def span(kind: str, **fields):
+    """Timed event: records start/duration — the MRProfile analog for
+    coordinator-side phases."""
+    t0 = time.time()
+    try:
+        yield
+    finally:
+        record(kind, duration_s=round(time.time() - t0, 4), **fields)
+
+
+def start_device_trace(logdir: str) -> None:
+    """Begin a jax.profiler trace (TensorBoard-viewable device timeline)."""
+    import jax
+    jax.profiler.start_trace(logdir)
+    record("profiler_start", logdir=logdir)
+
+
+def stop_device_trace() -> None:
+    import jax
+    jax.profiler.stop_trace()
+    record("profiler_stop")
