@@ -87,51 +87,146 @@ class AutoMLParameters:
     sort_metric: Optional[str] = None
     weights_column: Optional[str] = None
     keep_cross_validation_predictions: bool = True
+    preprocessing: Sequence[str] = ()        # ("target_encoding",)
+    auto_recovery_dir: Optional[str] = None  # resume point (Recovery.java:55)
+    exploitation_ratio: float = 0.25         # grid share of the time budget
+
+
+# --------------------------------------------------------- steps providers
+class StepsProvider:
+    """Per-algo modeling steps with work weights — the
+    ai/h2o/automl/ModelingStep.java:42 + WorkAllocations contract.
+
+    ``defaults()`` returns the fixed-parameter models; ``grids(rng)``
+    returns randomized exploitation steps drawn within the grid space.
+    Weights drive proportional time allocation.
+    """
+
+    algo = ""
+
+    def defaults(self) -> List[dict]:
+        return []
+
+    def grids(self, rng) -> List[dict]:
+        return []
+
+
+class GLMSteps(StepsProvider):
+    algo = "glm"
+
+    def defaults(self):
+        return [{"id": "GLM_1", "weight": 10,
+                 "params": {"lambda_search": True}}]
+
+
+class GBMSteps(StepsProvider):
+    algo = "gbm"
+
+    def defaults(self):
+        return [
+            {"id": "GBM_1", "weight": 10,
+             "params": {"ntrees": 50, "max_depth": 6, "sample_rate": 0.8,
+                        "col_sample_rate": 0.8}},
+            {"id": "GBM_2", "weight": 10,
+             "params": {"ntrees": 50, "max_depth": 7, "sample_rate": 0.9,
+                        "col_sample_rate": 0.9}},
+            {"id": "GBM_3", "weight": 10,
+             "params": {"ntrees": 50, "max_depth": 8}},
+        ]
+
+    def grids(self, rng):
+        out = []
+        for i in range(3):
+            out.append({"id": f"GBM_grid_{i+1}", "weight": 6, "params": {
+                "ntrees": 50,
+                "max_depth": int(rng.integers(3, 10)),
+                "learn_rate": float(rng.choice([0.05, 0.1, 0.2])),
+                "sample_rate": float(rng.choice([0.6, 0.8, 1.0])),
+                "col_sample_rate": float(rng.choice([0.6, 0.8, 1.0])),
+                "min_rows": float(rng.choice([1.0, 5.0, 10.0]))}})
+        return out
+
+
+class DRFSteps(StepsProvider):
+    algo = "drf"
+
+    def defaults(self):
+        return [
+            {"id": "DRF_1", "weight": 10, "params": {"ntrees": 50}},
+            {"id": "XRT_1", "weight": 10,
+             "params": {"ntrees": 50, "sample_rate": 0.632}},
+        ]
+
+
+class XGBoostSteps(StepsProvider):
+    algo = "xgboost"
+
+    def defaults(self):
+        return [
+            {"id": "XGBoost_1", "weight": 10,
+             "params": {"ntrees": 50, "max_depth": 6}},
+            {"id": "XGBoost_2", "weight": 10,
+             "params": {"ntrees": 50, "max_depth": 8, "sample_rate": 0.8}},
+        ]
+
+    def grids(self, rng):
+        out = []
+        for i in range(2):
+            out.append({"id": f"XGBoost_grid_{i+1}", "weight": 6, "params": {
+                "ntrees": 50,
+                "max_depth": int(rng.integers(4, 11)),
+                "learn_rate": float(rng.choice([0.05, 0.1, 0.3])),
+                "reg_lambda": float(rng.choice([0.1, 1.0, 10.0])),
+                "min_child_weight": float(rng.choice([0.0, 1.0, 5.0]))}})
+        return out
+
+
+class DeepLearningSteps(StepsProvider):
+    algo = "deeplearning"
+
+    def defaults(self):
+        return [{"id": "DeepLearning_1", "weight": 8,
+                 "params": {"hidden": [64, 64], "epochs": 10}}]
+
+
+PROVIDERS = (GLMSteps(), GBMSteps(), DRFSteps(), XGBoostSteps(),
+             DeepLearningSteps())
 
 
 class AutoML:
-    """AutoML driver — H2OAutoML analog (plan of steps + leaderboard + SEs)."""
+    """AutoML driver — H2OAutoML analog: planned steps from per-algo
+    providers, WorkAllocations-style time budgeting, optional target-encoding
+    preprocessing, recovery-dir resumability, leaderboard + SEs."""
 
     def __init__(self, params: Optional[AutoMLParameters] = None, **kw):
         self.params = params or AutoMLParameters(**kw)
         self.models: List[Model] = []
         self.leaderboard: Optional[Leaderboard] = None
         self.events: List[dict] = []
+        self._completed_steps: List[str] = []
 
     # ------------------------------------------------------------ the plan
     def _plan(self) -> List[dict]:
-        """Ordered steps — the {algo}StepsProvider defaults, trimmed."""
+        """Ordered steps from the providers: defaults first, then grids."""
         p = self.params
-        steps = [
-            {"algo": "glm", "id": "GLM_1", "params": {"lambda_search": True}},
-            {"algo": "gbm", "id": "GBM_1",
-             "params": {"ntrees": 50, "max_depth": 6, "sample_rate": 0.8,
-                        "col_sample_rate": 0.8}},
-            {"algo": "gbm", "id": "GBM_2",
-             "params": {"ntrees": 50, "max_depth": 7, "sample_rate": 0.9,
-                        "col_sample_rate": 0.9}},
-            {"algo": "gbm", "id": "GBM_3",
-             "params": {"ntrees": 50, "max_depth": 8}},
-            {"algo": "drf", "id": "DRF_1", "params": {"ntrees": 50}},
-            {"algo": "drf", "id": "XRT_1",
-             "params": {"ntrees": 50, "sample_rate": 0.632}},
-            {"algo": "xgboost", "id": "XGBoost_1",
-             "params": {"ntrees": 50, "max_depth": 6}},
-            {"algo": "xgboost", "id": "XGBoost_2",
-             "params": {"ntrees": 50, "max_depth": 8, "sample_rate": 0.8}},
-            {"algo": "deeplearning", "id": "DeepLearning_1",
-             "params": {"hidden": [64, 64], "epochs": 10}},
-        ]
+        rng = np.random.default_rng(p.seed if p.seed not in (-1, None)
+                                    else 0)
         include = set(a.lower() for a in p.include_algos) \
             if p.include_algos else None
         exclude = set(a.lower() for a in p.exclude_algos)
+
+        def allowed(algo):
+            return (include is None or algo in include) \
+                and algo not in exclude
         out = []
-        for s in steps:
-            if include is not None and s["algo"] not in include:
-                continue
-            if s["algo"] in exclude:
-                continue
-            out.append(s)
+        for prov in PROVIDERS:
+            if allowed(prov.algo):
+                for s in prov.defaults():
+                    out.append({**s, "algo": prov.algo, "group": "default"})
+        for prov in PROVIDERS:
+            if allowed(prov.algo):
+                for s in prov.grids(rng):
+                    out.append({**s, "algo": prov.algo, "group": "grid"})
         return out
 
     def _builder(self, algo: str, params: dict):
@@ -146,12 +241,100 @@ class AutoML:
                "deeplearning": DeepLearning}[algo]
         return cls(**{**common, **params})
 
+    # ------------------------------------------------------- preprocessing
+    def _maybe_target_encode(self, frame: Frame,
+                             valid: Optional[Frame]):
+        """TE preprocessing step (AutoML's preprocessing=["target_encoding"]):
+        kfold-encode high-cardinality categoricals, append *_te columns."""
+        p = self.params
+        if "target_encoding" not in tuple(p.preprocessing):
+            return frame, valid
+        from ..models.targetencoder import TargetEncoder
+        from ..frame.vec import T_CAT
+        high_card = [n for n, v in zip(frame.names, frame.vecs)
+                     if v.type == T_CAT and n != p.response_column
+                     and (v.cardinality or 0) > 10]
+        if not high_card:
+            return frame, valid
+        from ..frame.vec import Vec
+        rng = np.random.default_rng(p.seed if p.seed not in (-1, None)
+                                    else 0)
+        folds = rng.integers(0, max(p.nfolds, 2), frame.nrows)
+        fold_vec = Vec.from_numpy(folds.astype(np.float64))
+        fr_te = frame.with_vec("_te_fold", fold_vec)
+        te = TargetEncoder(response_column=p.response_column,
+                           data_leakage_handling="k_fold",
+                           fold_column="_te_fold", seed=p.seed).train(
+            fr_te[high_card + [p.response_column, "_te_fold"]])
+        enc = te.transform(fr_te, as_training=True)
+        out_t = frame
+        for n in enc.names:
+            if n.endswith("_te"):
+                out_t = out_t.with_vec(n, enc.vec(n))
+        out_v = valid
+        if valid is not None:
+            encv = te.transform(valid)
+            for n in encv.names:
+                if n.endswith("_te"):
+                    out_v = out_v.with_vec(n, encv.vec(n))
+        self.events.append({"step": "TE_preprocessing",
+                            "columns": high_card})
+        return out_t, out_v
+
+    # --------------------------------------------------------- recovery
+    def _recovery_state_path(self):
+        import os
+        return os.path.join(self.params.auto_recovery_dir, "automl_state.json")
+
+    def _load_recovery(self):
+        """Resume from auto_recovery_dir (hex/faulttolerance/Recovery:55)."""
+        import json
+        import os
+        from ..models.base import Model as _Model
+        path = self._recovery_state_path()
+        if not os.path.exists(path):
+            return
+        state = json.load(open(path))
+        for step_id, model_file in state.get("models", []):
+            try:
+                m = _Model.load(model_file)
+                self.models.append(m)
+                self._completed_steps.append(step_id)
+            except Exception as e:                      # noqa: BLE001
+                self.events.append({"step": step_id, "resume_error": repr(e)})
+        if self._completed_steps:
+            self.events.append({"resumed_steps": list(self._completed_steps)})
+
+    def _save_recovery(self, step_id: str, model: Model):
+        import json
+        import os
+        d = self.params.auto_recovery_dir
+        os.makedirs(d, exist_ok=True)
+        model_file = os.path.join(d, f"{step_id}.model")
+        model.save(model_file)
+        path = self._recovery_state_path()
+        state = {"models": []}
+        if os.path.exists(path):
+            state = json.load(open(path))
+        # keyed by step id: a retrain after a failed resume-load must
+        # replace the stale entry, not duplicate it
+        state["models"] = [e for e in state["models"] if e[0] != step_id]
+        state["models"].append([step_id, model_file])
+        json.dump(state, open(path, "w"))
+
     # --------------------------------------------------------------- train
     def train(self, frame: Frame, valid: Optional[Frame] = None) -> Model:
         p = self.params
         if not p.response_column:
             raise ValueError("automl requires response_column")
         t0 = time.time()
+        if p.auto_recovery_dir:
+            self._load_recovery()
+        frame, valid = self._maybe_target_encode(frame, valid)
+
+        plan = [s for s in self._plan()
+                if s["id"] not in self._completed_steps]
+        total_weight = sum(s["weight"] for s in plan) or 1
 
         def budget_left(n_planned: int = 0) -> bool:
             if p.max_models and len(self.models) + n_planned > p.max_models:
@@ -160,16 +343,34 @@ class AutoML:
                 return False
             return True
 
-        for step in self._plan():
+        spent_weight = 0
+        for step in plan:
             if not budget_left(1):
                 break
+            # WorkAllocations: skip a step whose proportional time share is
+            # already exhausted (keeps late grid steps from starving SEs)
+            if p.max_runtime_secs:
+                elapsed = time.time() - t0
+                fair_share = p.max_runtime_secs * (
+                    spent_weight / total_weight)
+                if step["group"] == "grid" and elapsed > max(
+                        fair_share, p.max_runtime_secs
+                        * (1 - p.exploitation_ratio)):
+                    self.events.append({"step": step["id"],
+                                        "skipped": "work_allocation"})
+                    spent_weight += step["weight"]
+                    continue
+            spent_weight += step["weight"]
             try:
                 b = self._builder(step["algo"], step["params"])
                 m = b.train(frame, valid)
                 m.output["automl_step"] = step["id"]
                 self.models.append(m)
+                self._completed_steps.append(step["id"])
                 self.events.append({"step": step["id"], "model": m.key,
                                     "t": time.time() - t0})
+                if p.auto_recovery_dir:
+                    self._save_recovery(step["id"], m)
             except Exception as e:                      # noqa: BLE001
                 self.events.append({"step": step["id"], "error": repr(e),
                                     "t": time.time() - t0})

@@ -25,6 +25,14 @@ import numpy as np
 NBINS = 400  # AUC2's default number of threshold bins (hex/AUC2.java)
 
 
+def _merge_custom(self, base: dict) -> dict:
+    """Merge a custom-metric UDF result (plain data attr; picklable)."""
+    cm = getattr(self, "custom_metric", None)
+    if cm:
+        return {**base, cm["name"]: cm["value"]}
+    return base
+
+
 # =========================================================== binomial kernels
 @functools.partial(jax.jit, static_argnums=(3,))
 def _binomial_hist_kernel(p1, y, w, nbins: int):
@@ -81,11 +89,28 @@ class ModelMetricsBinomial:
     def confusion_matrix(self) -> ConfusionMatrix:
         return self.cm
 
+    def gains_lift(self, groups: int = 16) -> dict:
+        """Quantile gains/lift table — hex/GainsLift.java analog."""
+        from .gainslift import gains_lift_table
+        return gains_lift_table(self.thresholds, self.tps, self.fps,
+                                groups=groups)
+
+    @property
+    def ks(self) -> float:
+        """Kolmogorov-Smirnov statistic (max TPR - FPR over thresholds)."""
+        npos = float(self.tps[-1])
+        nneg = float(self.fps[-1])
+        if npos <= 0 or nneg <= 0:
+            return float("nan")
+        return float(np.max(self.tps / npos - self.fps / nneg))
+
     def describe(self) -> dict:
-        return {"auc": self.auc, "pr_auc": self.pr_auc, "logloss": self.logloss,
-                "rmse": self.rmse, "gini": self.gini,
-                "mean_per_class_error": self.mean_per_class_error,
-                "max_f1": self.max_f1, "threshold": self.max_f1_threshold}
+        return _merge_custom(self, {
+            "auc": self.auc, "pr_auc": self.pr_auc, "logloss": self.logloss,
+            "rmse": self.rmse, "gini": self.gini,
+            "mean_per_class_error": self.mean_per_class_error,
+            "max_f1": self.max_f1, "threshold": self.max_f1_threshold,
+            "ks": self.ks})
 
 
 def binomial_metrics(p1, y, w, domain: Optional[List[str]] = None
@@ -165,9 +190,10 @@ class ModelMetricsMultinomial:
         return self.cm
 
     def describe(self) -> dict:
-        return {"logloss": self.logloss, "rmse": self.rmse,
-                "mean_per_class_error": self.mean_per_class_error,
-                "accuracy": self.accuracy}
+        return _merge_custom(self, {
+            "logloss": self.logloss, "rmse": self.rmse,
+            "mean_per_class_error": self.mean_per_class_error,
+            "accuracy": self.accuracy})
 
 
 def multinomial_metrics(probs, y, w, domain: List[str]
@@ -220,8 +246,9 @@ class ModelMetricsRegression:
     mean_residual_deviance: float
 
     def describe(self) -> dict:
-        return {"rmse": self.rmse, "mae": self.mae, "r2": self.r2,
-                "mean_residual_deviance": self.mean_residual_deviance}
+        return _merge_custom(self, {
+            "rmse": self.rmse, "mae": self.mae, "r2": self.r2,
+            "mean_residual_deviance": self.mean_residual_deviance})
 
 
 def regression_metrics(pred, y, w, deviance_sum: Optional[float] = None
@@ -239,14 +266,28 @@ def regression_metrics(pred, y, w, deviance_sum: Optional[float] = None
 
 
 # ============================================================ unified factory
-def make_metrics(di, raw, y, w, distribution=None, deviance_sum=None):
-    """Dispatch on the DataInfo's response type — the BigScore metric step."""
+def make_metrics(di, raw, y, w, distribution=None, deviance_sum=None,
+                 custom_metric_func=None):
+    """Dispatch on the DataInfo's response type — the BigScore metric step.
+
+    ``custom_metric_func``: optional UDF ``(predictions, y, w) -> (name,
+    value)`` — the water/udf/CMetricFunc analog; the result is attached to
+    the metrics object and surfaces in ``describe()``.
+    """
     if di.is_classifier:
         dom = [str(d) for d in di.response_domain]
         if len(dom) == 2:
             p1 = raw[:, 1] if raw.ndim == 2 else raw
-            return binomial_metrics(p1, y, w, domain=dom)
-        return multinomial_metrics(raw, y, w, domain=dom)
-    pred = raw[:, 0] if raw.ndim == 2 else raw
-    return regression_metrics(pred, jnp.nan_to_num(y), w,
-                              deviance_sum=deviance_sum)
+            m = binomial_metrics(p1, y, w, domain=dom)
+        else:
+            m = multinomial_metrics(raw, y, w, domain=dom)
+    else:
+        pred = raw[:, 0] if raw.ndim == 2 else raw
+        m = regression_metrics(pred, jnp.nan_to_num(y), w,
+                               deviance_sum=deviance_sum)
+    if custom_metric_func is not None:
+        name, value = custom_metric_func(np.asarray(raw), np.asarray(y),
+                                         np.asarray(w))
+        # plain data attribute (picklable); describe() merges it
+        m.custom_metric = {"name": str(name), "value": float(value)}
+    return m

@@ -30,3 +30,9 @@ from .tree.isofor import (IsolationForest, IsolationForestModel,
                           ExtendedIsolationForest,
                           ExtendedIsolationForestModel,
                           ExtendedIsolationForestParameters)
+from .tree.uplift import UpliftDRF, UpliftDRFModel, UpliftDRFParameters
+from .tree.dt import DecisionTree, DTModel, DTParameters
+from .segments import SegmentModels, train_segments
+from .modelselection import (ModelSelection, ModelSelectionModel,
+                             ModelSelectionParameters)
+from .anovaglm import ANOVAGLM, ANOVAGLMModel, ANOVAGLMParameters

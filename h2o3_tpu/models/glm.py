@@ -400,10 +400,79 @@ class GLM(ModelBuilder):
         lmin = lmax * p.lambda_min_ratio
         return list(np.geomspace(lmax, lmin, p.nlambdas))
 
+    # ------------------------------------------------------------- l-bfgs
+    def _fit_lbfgs(self, job, frame, di, X, y, w, offset, n, penalize,
+                   lam, fam_name, valid) -> "GLMModel":
+        """L-BFGS solver — GLM.java:2757's solver=L_BFGS analog.
+
+        Minimizes deviance/(2n) + lam*(1-alpha)/2 |b|_2^2 with optax's
+        L-BFGS inside one jit-compiled scan (the whole optimization is a
+        single device program).  Like the reference without ADMM, L1 is
+        not supported on this solver — use IRLSM/COD for alpha > 0.
+        """
+        import optax
+        from ..runtime.observability import log
+        p: GLMParameters = self.params
+        if p.alpha > 0 and (np.asarray(lam) > 0).any():
+            # reference behavior: L_BFGS defaults alpha to 0 (no L1 without
+            # ADMM); drop the L1 component rather than failing
+            log.warning("solver='lbfgs' ignores the L1 component "
+                        "(alpha=%s); keeping the L2 share", p.alpha)
+        fam = _make_family(fam_name, p)
+        pen = jnp.asarray(penalize, jnp.float32)
+        lamf = float(lam)
+
+        def obj(beta):
+            eta = X @ beta + offset
+            mu = fam.linkinv(eta)
+            dev = fam.deviance(y, mu, w)
+            return dev / (2 * n) + 0.5 * lamf * jnp.sum(pen * beta ** 2)
+
+        opt = optax.lbfgs()
+        vg = optax.value_and_grad_from_state(obj)
+
+        iters = int(min(p.max_iterations, 100))
+
+        @jax.jit
+        def run(beta0):
+            state = opt.init(beta0)
+
+            def step_fn(carry, _):
+                params, st = carry
+                value, grad = vg(params, state=st)
+                updates, st = opt.update(grad, st, params, value=value,
+                                         grad=grad, value_fn=obj)
+                params = optax.apply_updates(params, updates)
+                return (params, st), value
+            (beta, _), values = jax.lax.scan(step_fn, (beta0, state),
+                                             None, length=iters)
+            return beta, values
+
+        P = di.nfeatures
+        beta0 = jnp.zeros(P, jnp.float32)
+        if di.add_intercept:
+            beta0 = beta0.at[-1].set(fam.init_eta(y, w)[0])
+        beta_j, values = run(beta0)
+        beta = np.asarray(beta_j, np.float64)
+        hist = [{"lambda": lamf, "iteration": i,
+                 "deviance": float(v) * 2 * n, "delta": float("nan")}
+                for i, v in enumerate(np.asarray(values))]
+        # gram at the solution (p-values / std errors in _finalize)
+        step = _make_irls_step(fam)
+        gram, _, dev = step(X, y, w, jnp.asarray(beta, jnp.float32), offset)
+        model = GLMModel(job.dest_key or dkv.make_key(self.algo), p, di)
+        self._finalize(model, di, beta, fam_name, X, y, w, offset, n,
+                       float(dev), hist, lamf, frame, valid,
+                       gram_last=np.asarray(gram, np.float64))
+        return model
+
     # ------------------------------------------------------- single-class
     def _fit_single(self, job, frame, di, X, y, w, offset, n, penalize,
                     lambdas, fam_name, valid) -> GLMModel:
         p: GLMParameters = self.params
+        if p.solver.lower() in ("l_bfgs", "lbfgs"):
+            return self._fit_lbfgs(job, frame, di, X, y, w, offset, n,
+                                   penalize, lambdas[-1], fam_name, valid)
         fam = _make_family(fam_name, p)
         step = _make_irls_step(fam)
         P = di.nfeatures

@@ -1,0 +1,171 @@
+"""ModelSelection: best-subset GLM search — ``hex/modelselection`` analog.
+
+Reference: ``hex/modelselection/ModelSelection.java`` with modes maxr
+(sequential-replacement best subset), forward (maxrsweep's greedy
+direction), and backward (drop smallest |z|).  Each candidate subset is a
+GLM fit; the result reports the best predictor subset per size with its
+R^2 (gaussian) / deviance metric, mirroring the reference's result frame.
+
+TPU-native redesign: candidate GLMs reuse the device-resident design block
+(the frame matrix cache) and each fit is the usual jit-compiled IRLSM —
+the search is pure host control flow, trivially parallelizable over mesh
+slices later.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..frame.frame import Frame
+from ..runtime import dkv
+from ..runtime.job import Job
+from .base import Model, ModelBuilder, Parameters
+from .glm import GLM, GLMParameters
+
+
+@dataclasses.dataclass
+class ModelSelectionParameters(Parameters):
+    mode: str = "maxr"                   # maxr | forward | backward
+    max_predictor_number: int = 0        # 0 = all
+    min_predictor_number: int = 1
+    family: str = "auto"
+    alpha: float = 0.0
+    lambda_: float = 0.0
+    intercept: bool = True
+
+
+class ModelSelectionModel(Model):
+    algo = "modelselection"
+
+    def result(self) -> Frame:
+        """Per-size best subsets — the reference's result() frame."""
+        rows = self.output["subsets"]
+        return Frame.from_numpy({
+            "model_size": np.asarray([r["size"] for r in rows], np.float64),
+            "best_r2_value": np.asarray([r["metric"] for r in rows],
+                                        np.float64),
+            "predictor_names": np.asarray(
+                [", ".join(r["predictors"]) for r in rows], dtype=object),
+            "model_id": np.asarray([r["model_key"] for r in rows],
+                                   dtype=object),
+        })
+
+    def best_model(self, size: Optional[int] = None) -> Model:
+        rows = self.output["subsets"]
+        if size is None:
+            row = max(rows, key=lambda r: r["metric"])
+        else:
+            row = next(r for r in rows if r["size"] == size)
+        return dkv.get(row["model_key"])
+
+    def coef(self, size: int) -> Dict[str, float]:
+        return dict(self.best_model(size).coef)
+
+    def _predict_raw(self, X):
+        raise NotImplementedError("use best_model(size).predict(...)")
+
+
+class ModelSelection(ModelBuilder):
+    algo = "modelselection"
+    model_class = ModelSelectionModel
+
+    def __init__(self, params: Optional[ModelSelectionParameters] = None,
+                 **kw):
+        super().__init__(params or ModelSelectionParameters(**kw))
+
+    def _fit(self, job: Job, frame: Frame, di, valid) -> ModelSelectionModel:
+        p: ModelSelectionParameters = self.params
+        predictors = [s.name for s in di.specs]
+        maxp = p.max_predictor_number or len(predictors)
+        maxp = min(maxp, len(predictors))
+
+        def fit_subset(cols: Sequence[str]) -> Model:
+            m = GLM(response_column=p.response_column,
+                    weights_column=p.weights_column,
+                    family=p.family, alpha=p.alpha,
+                    lambda_=p.lambda_, seed=p.effective_seed()) \
+                .train(frame[list(cols) + [p.response_column]
+                             + ([p.weights_column] if p.weights_column
+                                else [])])
+            return m
+
+        def metric(m: Model) -> float:
+            tm = m.training_metrics
+            r2 = getattr(tm, "r2", float("nan"))
+            if np.isfinite(r2):
+                return float(r2)
+            return float(getattr(tm, "auc", float("nan")))
+
+        subsets: List[dict] = []
+        if p.mode in ("maxr", "forward"):
+            chosen: List[str] = []
+            for size in range(1, maxp + 1):
+                best = None
+                for cand in predictors:
+                    if cand in chosen:
+                        continue
+                    m = fit_subset(chosen + [cand])
+                    v = metric(m)
+                    if best is None or v > best[0]:
+                        best = (v, cand, m)
+                chosen.append(best[1])
+                best_m, best_v = best[2], best[0]
+                if p.mode == "maxr" and size >= 2:
+                    # sequential replacement: try swapping each chosen
+                    # predictor for each unchosen one (maxr refinement)
+                    improved = True
+                    while improved:
+                        improved = False
+                        for i, old in enumerate(list(chosen)):
+                            for cand in predictors:
+                                if cand in chosen:
+                                    continue
+                                trial = list(chosen)
+                                trial[i] = cand
+                                m2 = fit_subset(trial)
+                                v2 = metric(m2)
+                                if v2 > best_v + 1e-10:
+                                    chosen = trial
+                                    best_m, best_v = m2, v2
+                                    improved = True
+                subsets.append({"size": size, "predictors": list(chosen),
+                                "metric": best_v,
+                                "model_key": best_m.key})
+                job.update(size / maxp, f"size {size}/{maxp}")
+        elif p.mode == "backward":
+            chosen = list(predictors)
+            m = fit_subset(chosen)
+            subsets.append({"size": len(chosen), "predictors": list(chosen),
+                            "metric": metric(m), "model_key": m.key})
+            while len(chosen) > max(p.min_predictor_number, 1):
+                # drop the predictor with the smallest |standardized coef|
+                coefs = dict(m.coef_norm)
+                drop = None
+                drop_mag = np.inf
+                for name in chosen:
+                    mags = [abs(v) for k, v in coefs.items()
+                            if k == name or k.startswith(f"{name}.")]
+                    mag = max(mags) if mags else 0.0
+                    if mag < drop_mag:
+                        drop_mag, drop = mag, name
+                chosen.remove(drop)
+                m = fit_subset(chosen)
+                subsets.append({"size": len(chosen),
+                                "predictors": list(chosen),
+                                "metric": metric(m), "model_key": m.key})
+                job.update(1 - len(chosen) / len(predictors),
+                           f"size {len(chosen)}")
+            subsets.reverse()
+        else:
+            raise ValueError(f"unknown mode {p.mode!r}")
+
+        model = ModelSelectionModel(
+            job.dest_key or dkv.make_key(self.algo), p, di)
+        model.output["subsets"] = subsets
+        model.output["mode"] = p.mode
+        best = max(subsets, key=lambda r: r["metric"])
+        model.training_metrics = dkv.get(best["model_key"]).training_metrics
+        return model

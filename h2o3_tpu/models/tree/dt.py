@@ -1,0 +1,37 @@
+"""Single decision tree (DT) — ``hex/tree/dt/DT.java`` analog.
+
+The reference's DT is a single depth-limited CART classifier (binary
+response, entropy splits).  Here it is the degenerate forest: one
+unsampled tree over all features through the same tpu_hist growth engine,
+predicting per-leaf class frequencies — the same estimator family, one
+compiled program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from .drf import DRF, DRFModel, DRFParameters
+from .shared import SharedTree
+
+
+@dataclasses.dataclass
+class DTParameters(DRFParameters):
+    ntrees: int = 1
+    max_depth: int = 20
+    sample_rate: float = 1.0
+    mtries: int = -2                     # all features at every split
+    min_rows: float = 10.0
+
+
+class DTModel(DRFModel):
+    algo = "dt"
+
+
+class DecisionTree(DRF):
+    algo = "dt"
+    model_class = DTModel
+
+    def __init__(self, params: Optional[DTParameters] = None, **kw):
+        SharedTree.__init__(self, params or DTParameters(**kw))

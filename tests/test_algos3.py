@@ -1,0 +1,101 @@
+"""Round-2 algorithm additions: UpliftDRF, DecisionTree, SegmentModels,
+ModelSelection — golden/semantic tests per reference behavior."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu import Frame
+from h2o3_tpu.models import (UpliftDRF, DecisionTree, ModelSelection,
+                             train_segments, GLM)
+
+
+def _uplift_frame(rng, n=4000):
+    X = rng.normal(size=(n, 4))
+    treat = rng.integers(0, 2, n)
+    base = 1 / (1 + np.exp(-X[:, 1]))
+    effect = np.where(X[:, 0] > 0, 0.3, -0.05)
+    p1 = np.clip(base + treat * effect, 0.01, 0.99)
+    y = (rng.random(n) < p1).astype(int)
+    return Frame.from_numpy({
+        **{f"x{j}": X[:, j] for j in range(4)},
+        "treatment": np.array(["control", "treatment"],
+                              dtype=object)[treat],
+        "y": np.array(["no", "yes"], dtype=object)[y]}), X, treat, y
+
+
+def test_upliftdrf_recovers_heterogeneous_effect(cl, rng):
+    fr, X, treat, y = _uplift_frame(rng)
+    m = UpliftDRF(response_column="y", treatment_column="treatment",
+                  ntrees=10, max_depth=4, seed=1).train(fr)
+    pred = m.predict(fr)
+    assert pred.names == ["uplift_predict", "p_y1_ct1", "p_y1_ct0"]
+    u = pred.vec("uplift_predict").to_numpy()
+    # planted uplift: +0.3 for x0>0, -0.05 otherwise
+    assert u[X[:, 0] > 0].mean() > u[X[:, 0] < 0].mean() + 0.1
+    d = m.training_metrics.describe()
+    assert d["qini"] > 0.3            # much better than random ranking
+    assert d["ate"] == pytest.approx(
+        y[treat == 1].mean() - y[treat == 0].mean(), abs=1e-6)
+    # uplift = p_t - p_c consistency
+    pt = pred.vec("p_y1_ct1").to_numpy()
+    pc = pred.vec("p_y1_ct0").to_numpy()
+    np.testing.assert_allclose(u, pt - pc, atol=1e-5)
+
+
+def test_decision_tree_single_tree(cl, rng):
+    n = 2000
+    X = rng.normal(size=(n, 3))
+    y = (X[:, 0] > 0.3)
+    fr = Frame.from_numpy({**{f"x{j}": X[:, j] for j in range(3)},
+                           "y": np.where(y, "A", "B").astype(object)})
+    m = DecisionTree(response_column="y", max_depth=4, seed=2).train(fr)
+    assert m.output["ntrees_trained"] == 1
+    assert m.training_metrics.auc > 0.95
+
+
+def test_segment_models(cl, rng):
+    n = 3000
+    seg = np.array(["s1", "s2", "s3"], dtype=object)[rng.integers(0, 3, n)]
+    x = rng.normal(size=n)
+    # per-segment slope differs: the per-segment GLM must recover each
+    slope = np.where(seg == "s1", 1.0, np.where(seg == "s2", -2.0, 0.5))
+    y = slope * x + 0.01 * rng.normal(size=n)
+    fr = Frame.from_numpy({"seg": seg, "x": x, "y": y})
+    sm = train_segments(
+        lambda: GLM(response_column="y", family="gaussian"),
+        fr, "seg")
+    tbl = sm.as_frame()
+    assert tbl.nrows == 3
+    assert all(s == "SUCCEEDED" for s in tbl.vec("status").decoded())
+    for name, want in (("s1", 1.0), ("s2", -2.0), ("s3", 0.5)):
+        m = sm.model(seg=name)
+        assert m.coef["x"] == pytest.approx(want, abs=0.05)
+
+
+def test_modelselection_maxr_and_backward(cl, rng):
+    n = 1500
+    X = rng.normal(size=(n, 5))
+    # only x0, x2 matter
+    y = 3 * X[:, 0] - 2 * X[:, 2] + 0.05 * rng.normal(size=n)
+    fr = Frame.from_numpy({**{f"x{j}": X[:, j] for j in range(5)}, "y": y})
+    m = ModelSelection(response_column="y", mode="maxr",
+                       max_predictor_number=3, family="gaussian").train(fr)
+    res = m.result()
+    assert res.nrows == 3
+    names = res.vec("predictor_names").decoded()
+    assert set(names[1].split(", ")) == {"x0", "x2"}, names
+    r2 = res.vec("best_r2_value").to_numpy()
+    assert r2[1] > 0.99
+    assert np.all(np.diff(r2) >= -1e-9)     # monotone in subset size
+    best2 = m.best_model(2)
+    assert best2.coef["x0"] == pytest.approx(3.0, abs=0.05)
+
+    mb = ModelSelection(response_column="y", mode="backward",
+                        min_predictor_number=2,
+                        family="gaussian").train(fr)
+    resb = mb.result()
+    sizes = resb.vec("model_size").to_numpy()
+    assert sizes.min() == 2 and sizes.max() == 5
+    two = next(i for i in range(resb.nrows) if sizes[i] == 2)
+    assert set(resb.vec("predictor_names").decoded()[two]
+               .split(", ")) == {"x0", "x2"}

@@ -106,3 +106,39 @@ def test_automl_small_run(cl, rng):
     assert aml.leaderboard.sort_metric == "auc"
     vals = [r["auc"] for r in table]
     assert vals == sorted(vals, reverse=True)
+
+
+def test_automl_plan_providers_and_grids(cl):
+    aml = AutoML(response_column="y", seed=3)
+    plan = aml._plan()
+    ids = [s["id"] for s in plan]
+    # defaults from every provider, grids after defaults
+    assert "GLM_1" in ids and "GBM_1" in ids and "XGBoost_1" in ids
+    grid_pos = [i for i, s in enumerate(plan) if s["group"] == "grid"]
+    default_pos = [i for i, s in enumerate(plan) if s["group"] == "default"]
+    assert grid_pos and min(grid_pos) > max(default_pos)
+    # grid steps are deterministic under seed
+    ids2 = [s["id"] for s in AutoML(response_column="y", seed=3)._plan()]
+    p2 = AutoML(response_column="y", seed=3)._plan()
+    assert [s["params"] for s in plan] == [s["params"] for s in p2]
+    assert ids == ids2
+
+
+def test_automl_resume_from_recovery_dir(cl, rng, tmp_path):
+    fr = _binary_frame(rng, n=1000)
+    d = str(tmp_path / "recovery")
+    kw = dict(response_column="y", max_models=2, nfolds=0, seed=7,
+              include_algos=["glm", "gbm"], auto_recovery_dir=d,
+              exclude_algos=["stackedensemble"])
+    a1 = AutoML(**kw)
+    a1.train(fr)
+    done1 = list(a1._completed_steps)
+    assert len(done1) == 2
+    # a resumed run skips completed steps and keeps their models
+    a2 = AutoML(**{**kw, "max_models": 4})
+    a2.train(fr)
+    resumed = [e for e in a2.events if "resumed_steps" in e]
+    assert resumed and resumed[0]["resumed_steps"] == done1
+    new_steps = [e["step"] for e in a2.events if "model" in e]
+    assert not set(done1) & set(new_steps), (done1, new_steps)
+    assert len(a2.models) >= 4
