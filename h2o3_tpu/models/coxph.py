@@ -1,18 +1,25 @@
 """Cox proportional hazards: Newton iterations with cumulative risk sets.
 
 Reference: ``hex/coxph/CoxPH.java:28`` — partial-likelihood Newton with
-Efron/Breslow tie handling; per-iteration MRTasks accumulate risk-set sums.
+Efron/Breslow tie handling, optional stratification (separate baseline
+hazard per stratum), counting-process (start, stop] intervals, and
+observation weights; per-iteration MRTasks accumulate risk-set sums.
 
-TPU-native redesign: rows sorted by survival time descending, so every risk
-set is a prefix — the per-event sums S0 = sum(exp(eta)), S1 = sum(exp(eta)x),
-S2 = sum(exp(eta)xx') become cumulative sums on device (one fused program
-per Newton step); ties share the risk set via an inclusive tie boundary
-(Breslow).  The [P, P] Newton solve runs on host.
+TPU-native redesign: rows sorted by (stratum, time DESC) make every risk
+set a stratum-local PREFIX, so the per-event sums S0 = sum(w e^eta),
+S1 = sum(w e^eta x), S2 = sum(w e^eta xx') are cumulative sums read at the
+tie boundary minus the stratum offset — one fused device program per
+Newton step.  Counting-process data subtracts a second prefix (rows sorted
+by start DESC) at a host-precomputed position: {start_j >= t} is a prefix
+of that ordering.  Efron's tie correction uses segment sums over tie
+groups (event-only sums t0/t1/t2 and within-group event ranks), all inside
+the same program.  The [P, P] Newton solve runs on host.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Optional
 
 import jax
@@ -20,7 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..frame.frame import Frame
-from ..frame.vec import Vec, T_NUM
+from ..frame.vec import Vec, T_NUM, T_CAT
 from ..runtime import dkv
 from ..runtime.job import Job
 from .base import Model, ModelBuilder, Parameters
@@ -29,36 +36,66 @@ from .datainfo import DataInfo
 
 @dataclasses.dataclass
 class CoxPHParameters(Parameters):
-    start_column: Optional[str] = None       # not yet supported
+    start_column: Optional[str] = None       # counting-process entry time
     stop_column: str = ""                    # survival time
     event_column: str = ""                   # 1 = event, 0 = censored
-    ties: str = "breslow"
+    stratify_by: Optional[str] = None        # separate baseline per stratum
+    ties: str = "efron"                      # efron | breslow (ref default)
     max_iterations: int = 20
     standardize: bool = True
 
 
-@jax.jit
-def _cox_stats(X, event, tie_end, beta):
-    """(neg log PL, gradient, hessian) with prefix-cumsum risk sets.
-
-    Rows pre-sorted by time DESC; ``tie_end[i]`` = last index sharing
-    row i's time (inclusive), so risk-set sums read the cumsum there.
-    """
+@functools.partial(jax.jit, static_argnames=("efron", "use_start"))
+def _cox_stats(X, w, event, tie_end, strat_first, gid, grank, gsize,
+               perm2, bpos, bstart, beta, efron: bool, use_start: bool):
+    """(neg log PL, gradient, hessian) via stratified prefix risk sets."""
+    n, P = X.shape
     eta = X @ beta
     eta = eta - jnp.max(eta)
-    r = jnp.exp(eta)
-    S0 = jnp.cumsum(r)
-    S1 = jnp.cumsum(r[:, None] * X, axis=0)
-    XX = X[:, :, None] * X[:, None, :]
-    S2 = jnp.cumsum(r[:, None, None] * XX, axis=0)
-    s0 = S0[tie_end]
-    s1 = S1[tie_end]
-    s2 = S2[tie_end]
-    m = s1 / s0[:, None]
-    ll = jnp.sum(event * (eta - jnp.log(s0)))
-    grad = jnp.sum(event[:, None] * (X - m), axis=0)
-    hess_i = s2 / s0[:, None, None] - m[:, :, None] * m[:, None, :]
-    hess = jnp.sum(event[:, None, None] * hess_i, axis=0)
+    r = w * jnp.exp(eta)
+    rX = r[:, None] * X
+    rXX = r[:, None, None] * (X[:, :, None] * X[:, None, :])
+
+    def pref(a):
+        c = jnp.cumsum(a, axis=0)
+        cp = jnp.concatenate([jnp.zeros_like(a[:1]), c], axis=0)
+        # stratum-local prefix ending at the tie boundary
+        return cp[tie_end + 1] - cp[strat_first]
+
+    S0, S1, S2 = pref(r), pref(rX), pref(rXX)
+    if use_start:
+        # subtract rows with start >= t: a STRATUM-LOCAL prefix of the
+        # start-DESC ordering (bstart = stratum's offset in that ordering)
+        def pref2(a):
+            a2 = a[perm2]
+            c = jnp.cumsum(a2, axis=0)
+            cp = jnp.concatenate([jnp.zeros_like(a[:1]), c], axis=0)
+            return cp[bpos] - cp[bstart]
+        S0 = S0 - pref2(r)
+        S1 = S1 - pref2(rX)
+        S2 = S2 - pref2(rXX)
+
+    ew = event * w
+    if efron:
+        nseg = n
+        t0 = jax.ops.segment_sum(event * r, gid, num_segments=nseg)[gid]
+        t1 = jax.ops.segment_sum(event[:, None] * rX, gid,
+                                 num_segments=nseg)[gid]
+        t2 = jax.ops.segment_sum(event[:, None, None] * rXX, gid,
+                                 num_segments=nseg)[gid]
+        frac = jnp.where(gsize > 0, grank / jnp.maximum(gsize, 1.0), 0.0)
+        d0 = jnp.maximum(S0 - frac * t0, 1e-30)
+        d1 = S1 - frac[:, None] * t1
+        d2 = S2 - frac[:, None, None] * t2
+    else:
+        d0 = jnp.maximum(S0, 1e-30)
+        d1, d2 = S1, S2
+
+    m = d1 / d0[:, None]
+    ll = jnp.sum(ew * (eta - jnp.log(d0)))
+    grad = jnp.sum(ew[:, None] * (X - m), axis=0)
+    hess_i = d2 / d0[:, None, None] - m[:, :, None] * m[:, None, :]
+    hess = jnp.sum(ew[:, None, None] * hess_i, axis=0)
     return -ll, grad, hess
 
 
@@ -80,18 +117,12 @@ class CoxPHModel(Model):
         return {"concordance": self._concordance(frame)}
 
     def _concordance(self, frame: Frame) -> float:
+        from ..metrics.gainslift import concordance_index
         p: CoxPHParameters = self.params
         lp = self.predict(frame).vecs[0].to_numpy()
         t = frame.vec(p.stop_column).to_numpy()
         e = frame.vec(p.event_column).to_numpy()
-        num = den = 0
-        ev = np.flatnonzero(e > 0)
-        for i in ev:
-            at_risk = t > t[i]
-            den += at_risk.sum()
-            num += (lp[i] > lp[at_risk]).sum() \
-                + 0.5 * (lp[i] == lp[at_risk]).sum()
-        return float(num / max(den, 1))
+        return concordance_index(t, e > 0, lp)
 
 
 class CoxPH(ModelBuilder):
@@ -108,20 +139,26 @@ class CoxPH(ModelBuilder):
         p: CoxPHParameters = self.params
         if not p.stop_column or not p.event_column:
             raise ValueError("coxph requires stop_column and event_column")
-        if p.ties != "breslow":
-            raise ValueError(f"ties={p.ties!r} not implemented (breslow only)")
-        if p.start_column is not None:
-            raise ValueError("start_column (interval data) not yet supported")
+        if p.ties not in ("efron", "breslow"):
+            raise ValueError(f"ties={p.ties!r}: efron|breslow")
         for c in (p.stop_column, p.event_column):
             if c not in frame.names:
                 raise ValueError(f"column {c!r} not in frame")
+        if p.start_column and p.start_column not in frame.names:
+            raise ValueError(f"start column {p.start_column!r} not in frame")
+        if p.stratify_by and p.stratify_by not in frame.names:
+            raise ValueError(f"strata column {p.stratify_by!r} not in frame")
 
     def _make_datainfo(self, frame: Frame) -> DataInfo:
         p = self.params
+        drop = [p.stop_column, p.event_column]
+        if p.start_column:
+            drop.append(p.start_column)
+        if p.stratify_by:
+            drop.append(p.stratify_by)
         return DataInfo.fit(
             frame, response_column=None,
-            ignored_columns=list(p.ignored_columns) + [p.stop_column,
-                                                       p.event_column],
+            ignored_columns=list(p.ignored_columns) + drop,
             weights_column=p.weights_column, standardize=p.standardize,
             add_intercept=False,             # no intercept in Cox
             missing_values_handling=p.missing_values_handling)
@@ -129,34 +166,98 @@ class CoxPH(ModelBuilder):
     def _fit(self, job: Job, frame: Frame, di: DataInfo,
              valid: Optional[Frame]) -> CoxPHModel:
         p: CoxPHParameters = self.params
-        t = frame.vec(p.stop_column).to_numpy()
-        e = frame.vec(p.event_column).to_numpy()
+        t = frame.vec(p.stop_column).to_numpy().astype(np.float64)
+        e = frame.vec(p.event_column).to_numpy().astype(np.float64)
+        start = frame.vec(p.start_column).to_numpy().astype(np.float64) \
+            if p.start_column else None
+        if p.stratify_by:
+            sv = frame.vec(p.stratify_by)
+            strat = sv.to_numpy() if sv.type == T_CAT else \
+                np.unique(sv.to_numpy(), return_inverse=True)[1]
+        else:
+            strat = np.zeros(frame.nrows, np.int64)
+        wcol = np.ones(frame.nrows)
+        if p.weights_column and p.weights_column in frame.names:
+            wcol = np.nan_to_num(frame.vec(p.weights_column).to_numpy())
         ok = ~(np.isnan(t) | np.isnan(e))
-        order = np.argsort(-t[ok], kind="stable")
-        idx = np.flatnonzero(ok)[order]
+        if start is not None:
+            ok &= ~np.isnan(start)
+        rows = np.flatnonzero(ok)
+        # sort by (stratum, -stop): strata contiguous, time DESC inside
+        order = np.lexsort((-t[rows], strat[rows]))
+        idx = rows[order]
+        ts, es, ws = t[idx], e[idx], wcol[idx]
+        ss = strat[idx]
+        n = len(idx)
         X_full = np.asarray(di.make_matrix(frame))[: frame.nrows]
         Xs = jnp.asarray(X_full[idx], jnp.float32)
-        ts = t[idx]
-        ev = jnp.asarray(e[idx], jnp.float32)
-        # inclusive end of each tie block (time DESC -> ties contiguous)
-        n = len(ts)
-        tie_end = np.searchsorted(-ts, -ts, side="right") - 1
-        tie_end = jnp.asarray(tie_end, jnp.int32)
+
+        # stratum boundaries + tie blocks within stratum (vectorized:
+        # rows already sorted by (stratum, -time), so both are run-length
+        # structures readable from boundary flags)
+        new_strat = np.concatenate([[True], ss[1:] != ss[:-1]])
+        strat_id = np.cumsum(new_strat) - 1
+        strat_first = np.flatnonzero(new_strat)[strat_id]
+        new_tie = new_strat | np.concatenate([[True], ts[1:] != ts[:-1]])
+        gid = np.cumsum(new_tie) - 1
+        group_last = np.concatenate([np.flatnonzero(new_tie)[1:] - 1,
+                                     [n - 1]])
+        tie_end = group_last[gid]
+        # within-group event rank + group event count (Efron)
+        ev = es > 0
+        cum_ev = np.cumsum(ev)
+        gstarts = np.flatnonzero(new_tie)
+        ev_before = np.concatenate([[0], cum_ev[gstarts[1:] - 1]])[gid]
+        grank = np.where(ev, cum_ev - 1 - ev_before, 0.0)
+        gsize = (cum_ev[tie_end] - ev_before) * 1.0
+        # counting-process second ordering (start DESC within stratum)
+        use_start = start is not None
+        if use_start:
+            st = start[idx]
+            perm2 = np.lexsort((-st, ss))
+            st2 = st[perm2]
+            ss2 = ss[perm2]
+            # stratum offsets within the perm2 ordering
+            uniq_s, s_starts = np.unique(ss2, return_index=True)
+            lookup = dict(zip(uniq_s, s_starts))
+            ends = dict(zip(uniq_s, np.append(s_starts[1:], n)))
+            bstart = np.asarray([lookup[s] for s in ss], np.int64)
+            bend = np.asarray([ends[s] for s in ss], np.int64)
+            # cnt = #{start >= t_i} within stratum, vectorized per stratum
+            bpos = np.zeros(n, np.int64)
+            for s in uniq_s:
+                lo, hi = lookup[s], ends[s]
+                sel = ss == s
+                bpos[sel] = lo + np.searchsorted(
+                    -st2[lo:hi], -ts[sel], side="right")
+        else:
+            perm2 = np.zeros(n, np.int64)
+            bpos = np.zeros(n, np.int64)
+            bstart = np.zeros(n, np.int64)
 
         P = di.nfeatures
         if P > 64:
             raise ValueError(
                 "coxph: >64 expanded features would make the cumulative "
                 "S2 risk-set tensor (N x P x P) exceed HBM; reduce features")
+        args = (jnp.asarray(ws, jnp.float32), jnp.asarray(es, jnp.float32),
+                jnp.asarray(tie_end, jnp.int32),
+                jnp.asarray(strat_first, jnp.int32),
+                jnp.asarray(gid, jnp.int32), jnp.asarray(grank, jnp.float32),
+                jnp.asarray(gsize, jnp.float32),
+                jnp.asarray(perm2, jnp.int32), jnp.asarray(bpos, jnp.int32),
+                jnp.asarray(bstart, jnp.int32))
         beta = np.zeros(P)
+        nll = np.inf
         nll_prev = np.inf
         for it in range(p.max_iterations):
-            nll, grad, hess = _cox_stats(Xs, ev, tie_end,
-                                         jnp.asarray(beta, jnp.float32))
+            nll, grad, hess = _cox_stats(
+                Xs, *args, jnp.asarray(beta, jnp.float32),
+                efron=p.ties == "efron", use_start=use_start)
             nll = float(nll)
-            g = np.asarray(grad, np.float64)
+            g2 = np.asarray(grad, np.float64)
             H = np.asarray(hess, np.float64)
-            step = np.linalg.solve(H + 1e-8 * np.eye(P), g)
+            step = np.linalg.solve(H + 1e-8 * np.eye(P), g2)
             beta = beta + step
             job.update((it + 1) / p.max_iterations,
                        f"iter={it} -logPL={nll:.5g}")
@@ -175,7 +276,7 @@ class CoxPH(ModelBuilder):
         model.output.update({
             "beta_std": beta, "coef": dict(zip(di.coef_names, coef)),
             "neg_log_partial_likelihood": nll, "iterations": it + 1,
-            "n_events": int(np.sum(e[ok] > 0)),
+            "n_events": int(np.sum(e[ok] > 0)), "ties": p.ties,
         })
         model.training_metrics = {
             "neg_log_partial_likelihood": nll,

@@ -1,13 +1,18 @@
-"""GAM: spline basis expansion feeding the GLM solver.
+"""GAM: cubic regression splines with curvature penalties over the GLM.
 
-Reference: ``hex/gam/GAM.java:53`` (h2o-algos, 4.7k LoC) — expands each
-``gam_column`` into a spline basis (cubic regression splines at quantile
-knots), then runs GLM over [basis, other features] with the usual families.
+Reference: ``hex/gam/GAM.java:53`` (4.7k LoC) — each ``gam_column`` expands
+into a cubic regression spline (CRS) basis at quantile knots with the
+integrated-squared-second-derivative penalty matrix, sum-to-zero centered
+for identifiability, then the penalized GLM runs over [basis, other
+features] (GamSplines/CubicRegressionSplines + penalty_matrix plumbing).
 
-TPU-native redesign: the basis expansion is a one-pass device program per
-gam column (truncated-power cubic basis at quantile knots — matmul-friendly
-dense columns); everything downstream reuses the GLM driver (IRLSM on psum'd
-Grams).  Smoothing via the GLM's own ridge penalty (scale_tp_penalty).
+TPU-native redesign: the CRS construction follows the standard natural-
+spline form (banded second-difference system; basis values are two knot
+weights + two curvature weights per row — a dense [n, K] matmul-friendly
+block).  The penalty is diagonalized once per column (Demmler-Reinsch:
+rotate by the centered penalty's eigenvectors) so it becomes per-column
+ridge FACTORS on the shared GLM solver — no bespoke penalized solver, and
+the null space (linear trend) stays unpenalized exactly as in mgcv/H2O.
 """
 
 from __future__ import annotations
@@ -29,16 +34,79 @@ from .glm import GLM, GLMParameters
 @dataclasses.dataclass
 class GAMParameters(GLMParameters):
     gam_columns: Sequence[str] = ()
-    num_knots: int = 5
-    scale: float = 0.01                 # smoothing -> ridge on basis terms
+    num_knots: int = 8
+    scale: float = 1.0                  # smoothing strength per gam column
+    bs: str = "cr"                      # basis type (cubic regression)
 
 
-def _spline_basis(x: np.ndarray, knots: np.ndarray) -> np.ndarray:
-    """Truncated-power cubic basis: [x, x^2, x^3, (x-k_j)^3_+ ...]."""
-    cols = [x, x ** 2, x ** 3]
-    for kn in knots[1:-1]:
-        cols.append(np.maximum(x - kn, 0.0) ** 3)
-    return np.stack(cols, axis=1)
+def _crs_construct(knots: np.ndarray):
+    """CRS machinery for one knot vector: returns (F_full, S).
+
+    ``F_full`` [K, K] maps knot values -> second derivatives at the knots
+    (natural boundary: zero curvature at the ends); ``S`` [K, K] is the
+    integrated squared second derivative penalty  D' B^{-1} D  (the exact
+    curvature penalty the reference's penalty_matrix encodes).
+    """
+    K = len(knots)
+    h = np.diff(knots).astype(np.float64)
+    D = np.zeros((K - 2, K))
+    B = np.zeros((K - 2, K - 2))
+    for i in range(K - 2):
+        D[i, i] = 1.0 / h[i]
+        D[i, i + 1] = -1.0 / h[i] - 1.0 / h[i + 1]
+        D[i, i + 2] = 1.0 / h[i + 1]
+        B[i, i] = (h[i] + h[i + 1]) / 3.0
+        if i < K - 3:
+            B[i, i + 1] = h[i + 1] / 6.0
+            B[i + 1, i] = h[i + 1] / 6.0
+    F = np.linalg.solve(B, D)                      # [K-2, K]
+    F_full = np.vstack([np.zeros(K), F, np.zeros(K)])
+    S = D.T @ F                                    # [K, K], PSD
+    return F_full, S
+
+
+def _crs_eval(x: np.ndarray, knots: np.ndarray,
+              F_full: np.ndarray) -> np.ndarray:
+    """Cardinal CRS basis values [n, K]: row r gives the weights such that
+    f(x_r) = weights . f(knots) for the natural interpolating spline."""
+    K = len(knots)
+    h = np.diff(knots)
+    xc = np.clip(x, knots[0], knots[-1])
+    j = np.clip(np.searchsorted(knots, xc, side="right") - 1, 0, K - 2)
+    kj, kj1 = knots[j], knots[j + 1]
+    hj = h[j]
+    am = (kj1 - xc) / hj
+    ap = (xc - kj) / hj
+    cm = ((kj1 - xc) ** 3 / hj - hj * (kj1 - xc)) / 6.0
+    cp = ((xc - kj) ** 3 / hj - hj * (xc - kj)) / 6.0
+    n = len(x)
+    X = np.zeros((n, K))
+    rows = np.arange(n)
+    np.add.at(X, (rows, j), am)
+    np.add.at(X, (rows, j + 1), ap)
+    X += cm[:, None] * F_full[j] + cp[:, None] * F_full[j + 1]
+    return X
+
+
+def _center_and_diagonalize(Xb: np.ndarray, S: np.ndarray):
+    """Sum-to-zero centering + Demmler-Reinsch diagonalization.
+
+    Returns (T, factors): the [K, K-1] transform applied to the basis and
+    the per-output-column penalty factors (eigenvalues of the centered
+    penalty; ~0 = unpenalized null space — the linear trend).
+    """
+    K = Xb.shape[1]
+    # Z: orthogonal complement of the column-mean constraint (mgcv's
+    # sum-to-zero identifiability absorbing the intercept)
+    c = Xb.mean(axis=0)
+    q, _ = np.linalg.qr(np.concatenate([c[:, None],
+                                        np.eye(K)[:, : K - 1]], axis=1))
+    Z = q[:, 1:K]                                   # [K, K-1]
+    Sc = Z.T @ S @ Z
+    d, U = np.linalg.eigh((Sc + Sc.T) / 2)
+    d = np.maximum(d, 0.0)
+    T = Z @ U                                       # [K, K-1]
+    return T, d
 
 
 class GAMModel(Model):
@@ -46,14 +114,13 @@ class GAMModel(Model):
 
     def _expand(self, frame: Frame) -> Frame:
         names, vecs = [], []
-        knots_map = self.output["knots"]
-        scale_map = self.output["basis_scale"]
-        means_map = self.output["gam_col_means"]
+        meta = self.output["gam_meta"]
         for n, v in zip(frame.names, frame.vecs):
-            if n in knots_map:
-                # NaNs impute with the TRAINING mean (batch-independent)
-                x = np.nan_to_num(v.to_numpy(), nan=means_map[n])
-                B = _spline_basis(x, knots_map[n]) / scale_map[n][None, :]
+            if n in meta:
+                m = meta[n]
+                x = np.nan_to_num(v.to_numpy(), nan=m["mean"])
+                B = _crs_eval(x, m["knots"], m["F_full"]) @ m["T"]
+                B = B / m["col_scale"][None, :]
                 for j in range(B.shape[1]):
                     names.append(f"{n}_gam{j}")
                     vecs.append(Vec.from_numpy(B[:, j], T_NUM))
@@ -101,30 +168,43 @@ class GAM(ModelBuilder):
     def _fit(self, job: Job, frame: Frame, di: DataInfo,
              valid: Optional[Frame]) -> GAMModel:
         p: GAMParameters = self.params
-        knots_map: Dict[str, np.ndarray] = {}
-        scale_map: Dict[str, np.ndarray] = {}
-        means_map: Dict[str, float] = {}
+        meta: Dict[str, dict] = {}
+        factors: Dict[str, float] = {}
         for c in p.gam_columns:
             x = frame.vec(c).to_numpy()
             x = x[~np.isnan(x)]
-            qs = np.linspace(0, 1, p.num_knots)
-            knots_map[c] = np.unique(np.quantile(x, qs))
-            means_map[c] = float(x.mean()) if len(x) else 0.0
+            qs = np.linspace(0, 1, max(p.num_knots, 4))
+            knots = np.unique(np.quantile(x, qs))
+            if len(knots) < 4:
+                raise ValueError(
+                    f"gam column {c!r} has too few distinct values "
+                    f"({len(knots)}) for a cubic spline")
+            F_full, S = _crs_construct(knots)
+            Xb = _crs_eval(np.nan_to_num(frame.vec(c).to_numpy(),
+                                         nan=float(x.mean())), knots, F_full)
+            T, d = _center_and_diagonalize(Xb, S)
+            Bt = Xb @ T
+            col_scale = np.maximum(Bt.std(axis=0), 1e-12)
+            meta[c] = {"knots": knots, "F_full": F_full, "T": T,
+                       "mean": float(x.mean()), "col_scale": col_scale}
+            # penalty factor for the scaled column: the design column is
+            # Bt/s, so its coefficient is s*beta and a factor f penalizes
+            # f*s^2*beta^2 — realizing scale*d_j*beta^2 needs f = scale*d/s^2
+            for j, dj in enumerate(d):
+                factors[f"{c}_gam{j}"] = float(
+                    p.scale * dj / max(col_scale[j] ** 2, 1e-30))
         model = GAMModel(job.dest_key or dkv.make_key(self.algo), p, di)
-        model.output["knots"] = knots_map
-        model.output["gam_col_means"] = means_map
-        # per-basis scaling for conditioning of the truncated-power basis
-        for c in p.gam_columns:
-            x = np.nan_to_num(frame.vec(c).to_numpy(), nan=means_map[c])
-            B = _spline_basis(x, knots_map[c])
-            scale_map[c] = np.maximum(B.std(axis=0), 1e-12)
-        model.output["basis_scale"] = scale_map
+        model.output["gam_meta"] = meta
 
+        # non-gam predictors keep the user's lambda as their factor
+        base_lam = 0.0 if p.lambda_ is None else float(np.max(p.lambda_))
         expanded = model._expand(frame)
-        job.update(0.3, "fitting GLM over spline basis")
+        for n in expanded.names:
+            if n not in factors and n != p.response_column:
+                factors[n] = base_lam
+        job.update(0.3, "fitting penalized GLM over CRS basis")
         glm = GLM(response_column=p.response_column, family=p.family,
-                  alpha=0.0,
-                  lambda_=p.lambda_ if p.lambda_ is not None else p.scale,
+                  alpha=0.0, lambda_=1.0, penalty_factors=factors,
                   weights_column=p.weights_column,
                   seed=p.effective_seed(),
                   max_iterations=p.max_iterations).train(

@@ -262,9 +262,12 @@ def _solve_penalized(gram: np.ndarray, xtwz: np.ndarray, n: float,
     """
     G = gram / n
     c = xtwz / n
+    # ``penalize`` is a per-coefficient penalty FACTOR (glmnet-style):
+    # 0 = unpenalized (intercept, spline null space), 1 = standard, other
+    # values scale both the L1 and L2 shares (GAM penalty eigenvalues)
     l2 = lam * (1 - alpha) * penalize
-    l1 = lam * alpha
-    if l1 == 0.0:
+    l1 = lam * alpha * penalize
+    if np.all(l1 == 0.0):
         A = G + np.diag(l2 + 1e-10)
         try:
             return np.linalg.solve(A, c)
@@ -277,8 +280,9 @@ def _solve_penalized(gram: np.ndarray, xtwz: np.ndarray, n: float,
         delta = 0.0
         for j in range(len(beta)):
             r = c[j] - (Gb[j] - d[j] * beta[j])
-            if penalize[j]:
-                bj = np.sign(r) * max(abs(r) - l1, 0.0) / (d[j] + l2[j] + 1e-12)
+            if penalize[j] > 0:
+                bj = np.sign(r) * max(abs(r) - l1[j], 0.0) \
+                    / (d[j] + l2[j] + 1e-12)
             else:
                 bj = r / (d[j] + 1e-12)
             diff = bj - beta[j]
@@ -302,6 +306,9 @@ class GLMParameters(Parameters):
     nlambdas: int = 30
     lambda_min_ratio: float = 1e-4
     solver: str = "irlsm"
+    # per-column penalty factors {column: factor}; cat columns apply the
+    # factor to every one-hot slot (glmnet penalty.factor / GAM penalties)
+    penalty_factors: Optional[dict] = None
     tweedie_variance_power: float = 1.5
     theta: float = 1.0                    # negative binomial
     beta_epsilon: float = 1e-5
@@ -372,6 +379,11 @@ class GLM(ModelBuilder):
         penalize = np.ones(P)
         if di.add_intercept:
             penalize[-1] = 0.0
+        if p.penalty_factors:
+            for spec in di.specs:
+                f = p.penalty_factors.get(spec.name)
+                if f is not None:
+                    penalize[spec.offset: spec.offset + spec.width] = f
 
         lambdas = self._lambda_path(p, X, y, w, di, fam_name)
         if fam_name == "multinomial":

@@ -38,6 +38,81 @@ class GLRMParameters(Parameters):
     max_iterations: int = 100
     init: str = "svd"                  # svd | random
     recover_svd: bool = False
+    # loss/regularizer zoo (GlrmLoss/GlrmRegularizer enums)
+    loss: str = "quadratic"            # quadratic|absolute|huber|poisson|
+    # hinge|logistic
+    multi_loss: str = "categorical"    # loss for categorical blocks
+    loss_by_col: Optional[dict] = None  # {column: loss}
+    regularization_x: str = "none"     # none|quadratic|l1|non_negative|
+    # one_sparse|simplex
+    regularization_y: str = "none"
+
+
+# ------------------------------------------------------- losses (GlrmLoss)
+def _loss_value_grad(name: str):
+    """Elementwise loss l(u, a) and dl/du (u = reconstruction)."""
+    if name == "quadratic":
+        return (lambda u, a: (u - a) ** 2,
+                lambda u, a: 2 * (u - a))
+    if name == "absolute":
+        return (lambda u, a: jnp.abs(u - a),
+                lambda u, a: jnp.sign(u - a))
+    if name == "huber":
+        return (lambda u, a: jnp.where(jnp.abs(u - a) <= 1,
+                                       0.5 * (u - a) ** 2,
+                                       jnp.abs(u - a) - 0.5),
+                lambda u, a: jnp.clip(u - a, -1.0, 1.0))
+    if name == "poisson":
+        return (lambda u, a: jnp.exp(jnp.clip(u, -30, 30)) - a * u,
+                lambda u, a: jnp.exp(jnp.clip(u, -30, 30)) - a)
+    if name == "hinge":                 # a in {0,1} -> s in {-1,+1}
+        return (lambda u, a: jnp.maximum(0.0, 1 - (2 * a - 1) * u),
+                lambda u, a: jnp.where((2 * a - 1) * u < 1,
+                                       -(2 * a - 1), 0.0))
+    if name == "logistic":
+        return (lambda u, a: jnp.log1p(jnp.exp(-jnp.clip(
+            (2 * a - 1) * u, -30, 30))),
+                lambda u, a: -(2 * a - 1) / (1 + jnp.exp(jnp.clip(
+                    (2 * a - 1) * u, -30, 30))))
+    if name == "categorical":           # one-vs-all hinge over the block
+        return (lambda u, a: jnp.maximum(0.0, 1 - (2 * a - 1) * u),
+                lambda u, a: jnp.where((2 * a - 1) * u < 1,
+                                       -(2 * a - 1), 0.0))
+    raise ValueError(f"unknown glrm loss {name!r}")
+
+
+# ------------------------------------------- regularizers (GlrmRegularizer)
+def _prox(name: str, M, step_gamma):
+    """Proximal operator applied row-wise (X) / matrix-wise (Y)."""
+    if name == "none":
+        return M
+    if name == "quadratic":
+        return M / (1.0 + 2.0 * step_gamma)
+    if name == "l1":
+        return jnp.sign(M) * jnp.maximum(jnp.abs(M) - step_gamma, 0.0)
+    if name == "non_negative":
+        return jnp.maximum(M, 0.0)
+    if name == "one_sparse":            # keep the largest entry per row
+        keep = jnp.argmax(jnp.abs(M), axis=-1, keepdims=True)
+        mask = jnp.arange(M.shape[-1])[None, :] == keep
+        return jnp.where(mask, jnp.maximum(M, 0.0), 0.0)
+    if name == "simplex":               # project rows onto the simplex
+        s = jnp.sort(M, axis=-1)[:, ::-1]
+        css = jnp.cumsum(s, axis=-1) - 1
+        idx = jnp.arange(1, M.shape[-1] + 1)
+        cond = s - css / idx > 0
+        rho = jnp.sum(cond, axis=-1, keepdims=True)
+        theta = jnp.take_along_axis(css, rho - 1, axis=-1) / rho
+        return jnp.maximum(M - theta, 0.0)
+    raise ValueError(f"unknown glrm regularizer {name!r}")
+
+
+def _reg_value(name: str, M, gamma):
+    if name == "quadratic":
+        return gamma * jnp.sum(M * M)
+    if name == "l1":
+        return gamma * jnp.sum(jnp.abs(M))
+    return 0.0
 
 
 class GLRMModel(Model):
@@ -121,6 +196,7 @@ class GLRM(ModelBuilder):
         sd_t = jnp.where(var > 0, 1.0 / jnp.sqrt(var), 1.0) if descale \
             else jnp.ones_like(var)
         A = (X0 - mu_t[None, :]) * sd_t[None, :] * (w[:, None] > 0)
+        self._last_mu, self._last_sd = mu_t, sd_t
 
         rng = np.random.default_rng(p.effective_seed())
         if p.init == "svd":
@@ -130,6 +206,22 @@ class GLRM(ModelBuilder):
         else:
             Y = rng.normal(size=(k, di.nfeatures)) / np.sqrt(k)
         Y = jnp.asarray(Y, jnp.float32)
+
+        # per-design-column losses: numeric -> loss/loss_by_col; categorical
+        # one-hot blocks -> multi_loss with {0,1} targets
+        loss_by_col = dict(p.loss_by_col or {})
+        col_loss: list = []
+        for spec in di.specs:
+            name = loss_by_col.get(spec.name,
+                                   p.multi_loss if spec.type == "cat"
+                                   else p.loss)
+            col_loss.extend([name] * spec.width)
+        col_loss = col_loss[: di.nfeatures]
+        all_quadratic = all(c == "quadratic" for c in col_loss)
+        plain_regs = p.regularization_x in ("none", "quadratic") and \
+            p.regularization_y in ("none", "quadratic")
+        if not (all_quadratic and plain_regs):
+            return self._fit_proximal(job, di, A, w, Y, col_loss, k, p)
 
         Ik = jnp.eye(k, dtype=jnp.float32)
 
@@ -167,4 +259,72 @@ class GLRM(ModelBuilder):
             u, s, vt = np.linalg.svd(Xh @ np.asarray(Y), full_matrices=False)
             model.output["singular_values"] = s[:k]
         model.training_metrics = {"objective": obj}
+        return model
+
+    # ----------------------------------------------- proximal (loss zoo)
+    def _fit_proximal(self, job, di, A, w, Y0, col_loss, k, p) -> GLRMModel:
+        """Proximal alternating gradient — the general GlrmLoss/Regularizer
+        path (GLRM.java's update_x/update_y with step halving)."""
+        n, F = A.shape
+        obs = (w[:, None] > 0).astype(jnp.float32)
+        loss_names = sorted(set(col_loss))
+        masks = {nm: jnp.asarray([1.0 if c == nm else 0.0
+                                  for c in col_loss], jnp.float32)
+                 for nm in loss_names}
+
+        def total_loss_grad(U):
+            L = jnp.zeros_like(U)
+            G = jnp.zeros_like(U)
+            for nm in loss_names:
+                lv, lg = _loss_value_grad(nm)
+                m = masks[nm][None, :]
+                L = L + m * lv(U, A)
+                G = G + m * lg(U, A)
+            return jnp.sum(L * obs), G * obs
+
+        @jax.jit
+        def prox_iter(X, Y, step):
+            _, G = total_loss_grad(X @ Y)
+            X2 = _prox(p.regularization_x, X - step * (G @ Y.T),
+                       step * p.gamma_x)
+            _, G2 = total_loss_grad(X2 @ Y)
+            Y2t = _prox(p.regularization_y, (Y - step * (X2.T @ G2)).T,
+                        step * p.gamma_y).T
+            lv, _ = total_loss_grad(X2 @ Y2t)
+            obj = lv + _reg_value(p.regularization_x, X2, p.gamma_x) \
+                + _reg_value(p.regularization_y, Y2t, p.gamma_y)
+            return X2, Y2t, obj
+
+        rng = np.random.default_rng(p.effective_seed())
+        X = jnp.asarray(rng.normal(size=(n, k)) * 0.1, jnp.float32)
+        Y = Y0
+        step = 1.0 / max(float(jnp.abs(A).max()) * F, 1.0)
+        lv0, _ = total_loss_grad(X @ Y)
+        prev = float(lv0 + _reg_value(p.regularization_x, X, p.gamma_x)
+                     + _reg_value(p.regularization_y, Y, p.gamma_y))
+        it = 0
+        for it in range(p.max_iterations):
+            X2, Y2, obj = prox_iter(X, Y, step)
+            obj = float(obj)
+            if obj <= prev or not np.isfinite(prev):
+                X, Y, prev = X2, Y2, obj
+                step *= 1.05                    # accept, grow (GLRM.java)
+            else:
+                step *= 0.5                     # reject, halve
+                if step < 1e-12:
+                    break
+            job.update(it / p.max_iterations, f"iter={it} obj={prev:.5g}")
+
+        model = GLRMModel(job.dest_key or dkv.make_key(self.algo), p, di)
+        mu_t = self._last_mu
+        sd_t = self._last_sd
+        model.output.update({
+            "archetypes": np.asarray(Y, np.float64),
+            "objective": prev, "iterations": it + 1,
+            "feature_names": di.coef_names,
+            "_mu": np.asarray(mu_t, np.float64),
+            "_sd": np.asarray(sd_t, np.float64),
+            "x_factor": np.asarray(X, np.float64),
+        })
+        model.training_metrics = {"objective": prev}
         return model

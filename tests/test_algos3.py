@@ -72,6 +72,68 @@ def test_segment_models(cl, rng):
         assert m.coef["x"] == pytest.approx(want, abs=0.05)
 
 
+def test_gam_crs_splines(cl, rng):
+    """CRS basis fits a sine; huge smoothing collapses EXACTLY to the
+    unpenalized null space (the linear fit) — the penalty is the true
+    curvature quadratic form."""
+    from h2o3_tpu.models import GAM
+    n = 3000
+    x = rng.uniform(-3, 3, n)
+    z = rng.normal(size=n)
+    y = np.sin(2 * x) + 0.5 * z + 0.1 * rng.normal(size=n)
+    fr = Frame.from_numpy({"x": x, "z": z, "y": y})
+    m = GAM(response_column="y", gam_columns=["x"], num_knots=10,
+            scale=0.001, family="gaussian").train(fr)
+    lin = GLM(response_column="y", family="gaussian").train(fr)
+    assert m.training_metrics.r2 > 0.9 > lin.training_metrics.r2
+    ms = GAM(response_column="y", gam_columns=["x"], num_knots=10,
+             scale=1e9, family="gaussian").train(fr)
+    assert abs(ms.training_metrics.r2 - lin.training_metrics.r2) < 0.05
+    # prediction path round-trips the basis expansion
+    pred = m.predict(fr).vec("predict").to_numpy()
+    assert np.corrcoef(pred, y)[0, 1] ** 2 > 0.9
+
+
+def test_glrm_loss_zoo(cl, rng):
+    from h2o3_tpu.models import GLRM
+    n, F, k = 400, 6, 2
+    A = rng.normal(size=(n, k)) @ rng.normal(size=(k, F)) \
+        + 0.05 * rng.normal(size=(n, F))
+    fr = Frame.from_numpy({f"c{j}": A[:, j] for j in range(F)})
+    m = GLRM(k=2, loss="absolute", regularization_x="non_negative",
+             gamma_x=0.1, max_iterations=150, init="random",
+             seed=1).train(fr)
+    assert (m.output["x_factor"] >= -1e-6).all()
+    assert np.isfinite(m.output["objective"])
+    m2 = GLRM(k=2, loss="huber", regularization_y="l1", gamma_y=0.05,
+              max_iterations=100, init="random", seed=1).train(fr)
+    assert np.isfinite(m2.output["objective"])
+
+
+def test_coxph_efron_strata(cl, rng):
+    from h2o3_tpu.models import CoxPH
+    n = 3000
+    x1, x2 = rng.normal(size=n), rng.normal(size=n)
+    strat = rng.integers(0, 2, n)
+    lam0 = np.where(strat == 0, 0.5, 2.0)
+    T = rng.exponential(1.0 / (lam0 * np.exp(0.8 * x1 - 0.5 * x2)))
+    C = rng.exponential(2.0, n)
+    t = np.round(np.minimum(T, C), 1) + 0.01     # induce ties
+    e = (T <= C).astype(float)
+    fr = Frame.from_numpy({"x1": x1, "x2": x2, "stop": t, "event": e,
+                           "s": np.array(["a", "b"], dtype=object)[strat]})
+    m = CoxPH(stop_column="stop", event_column="event", ties="efron",
+              stratify_by="s").train(fr)
+    c = m.output["coef"]
+    assert c["x1"] == pytest.approx(0.8, abs=0.12)
+    assert c["x2"] == pytest.approx(-0.5, abs=0.12)
+    assert m.training_metrics["concordance"] > 0.65
+    mb = CoxPH(stop_column="stop", event_column="event",
+               ties="breslow", stratify_by="s").train(fr)
+    # with heavy ties, Efron's estimates dominate Breslow's toward truth
+    assert abs(c["x1"] - 0.8) <= abs(mb.output["coef"]["x1"] - 0.8) + 0.02
+
+
 def test_modelselection_maxr_and_backward(cl, rng):
     n = 1500
     X = rng.normal(size=(n, 5))
