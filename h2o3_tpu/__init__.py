@@ -11,6 +11,7 @@ Module-level API mirrors the ``h2o`` Python package (h2o-py/h2o/h2o.py):
 """
 
 from .runtime.cluster import init, cluster, shutdown
+from .runtime.scope import Scope
 from .runtime import dkv
 from . import persist
 from .frame.frame import Frame

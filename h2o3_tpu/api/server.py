@@ -28,7 +28,8 @@ ALGOS = ("glm", "gbm", "drf", "xgboost", "deeplearning", "kmeans", "pca",
          "svd", "naivebayes", "isolationforest", "extendedisolationforest",
          "isotonicregression", "quantile", "stackedensemble", "adaboost",
          "targetencoder", "glrm", "coxph", "word2vec", "rulefit",
-         "aggregator", "gam", "upliftdrf", "dt")
+         "aggregator", "gam", "upliftdrf", "dt", "psvm", "anovaglm",
+         "modelselection")
 
 
 def _builder(algo: str):
@@ -45,6 +46,8 @@ def _builder(algo: str):
         "glrm": M.GLRM, "coxph": M.CoxPH, "word2vec": M.Word2Vec,
         "rulefit": M.RuleFit, "aggregator": M.Aggregator, "gam": M.GAM,
         "upliftdrf": M.UpliftDRF, "dt": M.DecisionTree,
+        "psvm": M.PSVM, "anovaglm": M.ANOVAGLM,
+        "modelselection": M.ModelSelection,
     }[algo]
 
 

@@ -36,3 +36,4 @@ from .segments import SegmentModels, train_segments
 from .modelselection import (ModelSelection, ModelSelectionModel,
                              ModelSelectionParameters)
 from .anovaglm import ANOVAGLM, ANOVAGLMModel, ANOVAGLMParameters
+from .psvm import PSVM, PSVMModel, PSVMParameters

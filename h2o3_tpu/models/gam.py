@@ -189,10 +189,14 @@ class GAM(ModelBuilder):
                        "mean": float(x.mean()), "col_scale": col_scale}
             # penalty factor for the scaled column: the design column is
             # Bt/s, so its coefficient is s*beta and a factor f penalizes
-            # f*s^2*beta^2 — realizing scale*d_j*beta^2 needs f = scale*d/s^2
+            # f*s^2*beta^2 — realizing scale*d_j*beta^2 needs f = scale*d/s^2.
+            # d is normalized by its largest eigenvalue (the reference
+            # scales penalty matrices likewise) so scale=1 smooths mildly
+            # regardless of knot spacing / data units.
+            d_max = max(float(d.max()), 1e-30)
             for j, dj in enumerate(d):
                 factors[f"{c}_gam{j}"] = float(
-                    p.scale * dj / max(col_scale[j] ** 2, 1e-30))
+                    p.scale * (dj / d_max) / max(col_scale[j] ** 2, 1e-30))
         model = GAMModel(job.dest_key or dkv.make_key(self.algo), p, di)
         model.output["gam_meta"] = meta
 

@@ -1,0 +1,148 @@
+"""PSVM: kernel support vector machine on a low-rank feature map.
+
+Reference: ``hex/psvm/PSVM.java`` (2.1k LoC) — binary SVM with a gaussian
+kernel, solved distributed via ICF (incomplete Cholesky factorization, a
+rank-r kernel approximation) + an interior-point method; per-class
+weights, sv threshold reporting.
+
+TPU-native redesign: the reference's ICF is a low-rank approximation of
+the kernel matrix; here the same role is played by a random Fourier
+feature map (Rahimi-Recht) of rank ``rank`` — z(x) = sqrt(2/m) cos(Wx+b),
+E[z(x).z(y)] = exp(-gamma ||x-y||^2) — which turns the kernel SVM into a
+linear squared-hinge problem solved by one jit-compiled L-BFGS scan on
+the MXU.  Same model family (low-rank gaussian-kernel SVM), an
+approximation axis that scales with chips instead of the ICF's sequential
+pivoting.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..frame.frame import Frame
+from ..frame.vec import Vec, T_CAT, T_NUM
+from ..runtime import dkv
+from ..runtime.job import Job
+from .base import Model, ModelBuilder, Parameters
+
+
+@dataclasses.dataclass
+class PSVMParameters(Parameters):
+    hyper_param: float = 1.0             # C
+    kernel_type: str = "gaussian"
+    gamma: float = -1.0                  # -1 -> 1/nfeatures
+    rank_ratio: float = -1.0             # -1 -> auto rank
+    positive_weight: float = 1.0
+    negative_weight: float = 1.0
+    sv_threshold: float = 1e-4
+    max_iterations: int = 200
+
+
+class PSVMModel(Model):
+    algo = "psvm"
+
+    def _feature_map(self, X: jax.Array) -> jax.Array:
+        W = jnp.asarray(self.output["rff_w"], jnp.float32)
+        b = jnp.asarray(self.output["rff_b"], jnp.float32)
+        m = W.shape[1]
+        return jnp.sqrt(2.0 / m) * jnp.cos(X @ W + b[None, :])
+
+    def _predict_raw(self, X: jax.Array) -> jax.Array:
+        Z = self._feature_map(X)
+        beta = jnp.asarray(self.output["beta"], jnp.float32)
+        f = Z @ beta[:-1] + beta[-1]
+        p1 = jax.nn.sigmoid(2.0 * f)     # decision -> pseudo-probability
+        return jnp.stack([1 - p1, p1], axis=1)
+
+    def decision_function(self, frame: Frame) -> np.ndarray:
+        X = self._score_matrix(frame)
+        Z = self._feature_map(X)
+        beta = jnp.asarray(self.output["beta"], jnp.float32)
+        return np.asarray(Z @ beta[:-1] + beta[-1])[: frame.nrows]
+
+
+class PSVM(ModelBuilder):
+    """PSVM builder — H2OSupportVectorMachineEstimator analog."""
+
+    algo = "psvm"
+    model_class = PSVMModel
+    _force_classification = True
+
+    def __init__(self, params: Optional[PSVMParameters] = None, **kw):
+        super().__init__(params or PSVMParameters(**kw))
+
+    def _fit(self, job: Job, frame: Frame, di, valid) -> PSVMModel:
+        import optax
+        p: PSVMParameters = self.params
+        if p.kernel_type != "gaussian":
+            raise ValueError("psvm supports kernel_type='gaussian'")
+        if di.nclasses != 2:
+            raise ValueError("psvm is a binary classifier")
+        X = di.make_matrix(frame)
+        y01 = jnp.nan_to_num(di.response(frame))
+        ysvm = 2.0 * y01 - 1.0                       # {-1, +1}
+        w = di.weights(frame)
+        w = w * jnp.where(ysvm > 0, p.positive_weight, p.negative_weight)
+        F = X.shape[1]
+        gamma = (1.0 / max(F, 1)) if p.gamma <= 0 else p.gamma
+        n = frame.nrows
+        rank = int(min(max(64, np.sqrt(n) * 4), 1024)) \
+            if p.rank_ratio <= 0 else int(max(p.rank_ratio * n, 16))
+        rng = np.random.default_rng(p.effective_seed())
+        W = rng.normal(0.0, np.sqrt(2.0 * gamma), size=(F, rank))
+        b = rng.uniform(0, 2 * np.pi, rank)
+
+        model = PSVMModel(job.dest_key or dkv.make_key(self.algo), p, di)
+        model.output["rff_w"] = W
+        model.output["rff_b"] = b
+        model.output["gamma"] = gamma
+        model.output["rank"] = rank
+        Z = model._feature_map(X)
+        C = p.hyper_param
+
+        def obj(beta):
+            f = Z @ beta[:-1] + beta[-1]
+            margin = jnp.maximum(0.0, 1.0 - ysvm * f)
+            return 0.5 * jnp.sum(beta[:-1] ** 2) \
+                + C * jnp.sum(w * margin ** 2)
+
+        opt = optax.lbfgs()
+        vg = optax.value_and_grad_from_state(obj)
+        iters = int(p.max_iterations)
+
+        @jax.jit
+        def run(beta0):
+            state = opt.init(beta0)
+
+            def step(carry, _):
+                params, st = carry
+                value, grad = vg(params, state=st)
+                updates, st = opt.update(grad, st, params, value=value,
+                                         grad=grad, value_fn=obj)
+                params = optax.apply_updates(params, updates)
+                return (params, st), value
+            (beta, _), values = jax.lax.scan(step, (beta0, state), None,
+                                             length=iters)
+            return beta, values
+
+        beta, values = run(jnp.zeros(rank + 1, jnp.float32))
+        f = Z @ beta[:-1] + beta[-1]
+        margins = ysvm * f
+        mask = jnp.arange(X.shape[0]) < n
+        n_sv = int(jnp.sum((margins < 1.0 - p.sv_threshold) & mask
+                           & (w > 0)))
+        model.output.update({
+            "beta": np.asarray(beta, np.float64),
+            "svs_count": n_sv,
+            "objective": float(values[-1]),
+            "iterations": iters,
+        })
+        from ..metrics.core import make_metrics
+        raw = model._predict_raw(X)
+        model.training_metrics = make_metrics(di, raw, y01, di.weights(frame))
+        return model

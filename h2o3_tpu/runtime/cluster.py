@@ -139,6 +139,46 @@ def init(devices=None, model_axis: int = 1, coordinator: str | None = None,
         return _cluster
 
 
+_GUARDRAIL_FRACTION = 0.9
+
+
+def _check_hbm_budget(nbytes: int, sharding=None, shape=None) -> None:
+    """Fail fast with a clear message instead of an opaque XLA OOM.
+
+    The reference spills cold chunks to disk (water/Cleaner.java:12); here
+    frames must fit in HBM, so oversized placements get an actionable
+    error naming the array and the per-device budget.
+    """
+    try:
+        dev = jax.devices()[0]
+        if dev.platform != "tpu":
+            return
+        stats = dev.memory_stats() or {}
+        limit = stats.get("bytes_limit")
+        in_use = stats.get("bytes_in_use", 0)
+        if not limit:
+            return
+        if sharding is not None and shape is not None:
+            try:
+                per_dev = int(np.prod(sharding.shard_shape(tuple(shape)))
+                              * max(nbytes // max(int(np.prod(shape)), 1), 1))
+            except Exception:
+                per_dev = nbytes / max(cluster().n_row_shards, 1)
+        else:
+            per_dev = nbytes / max(cluster().n_row_shards, 1)
+        if in_use + per_dev > _GUARDRAIL_FRACTION * limit:
+            raise MemoryError(
+                f"placing {nbytes / 1e9:.2f} GB ({per_dev / 1e9:.2f} GB/"
+                f"device) would exceed {_GUARDRAIL_FRACTION:.0%} of HBM "
+                f"({limit / 1e9:.2f} GB/device, {in_use / 1e9:.2f} GB in "
+                f"use). Reduce rows/columns, drop unused frames "
+                f"(h2o3_tpu.remove), or add devices to the mesh.")
+    except MemoryError:
+        raise
+    except Exception:
+        return                            # stats unavailable: no guardrail
+
+
 def put_sharded(buf: "np.ndarray", sharding) -> "jax.Array":
     """Place a host buffer onto the mesh under ``sharding``.
 
@@ -147,6 +187,11 @@ def put_sharded(buf: "np.ndarray", sharding) -> "jax.Array":
     callbacks — ``device_put``'s cross-process equality check rejects NaN
     padding (NaN != NaN) and non-addressable shards.
     """
+    if hasattr(buf, "nbytes") and not isinstance(buf, jax.Array):
+        # already-placed jax.Arrays are counted in bytes_in_use; only
+        # fresh host->device placements consume new HBM
+        _check_hbm_budget(int(buf.nbytes), sharding,
+                          getattr(buf, "shape", None))
     if jax.process_count() == 1:
         return jax.device_put(buf, sharding)
     if isinstance(buf, jax.Array) and not isinstance(buf, np.ndarray):

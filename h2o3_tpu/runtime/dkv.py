@@ -70,7 +70,11 @@ def make_key(prefix: str) -> str:
 
 def put(key: str, value: Any) -> str:
     with _lock:
+        is_new = key not in _store
         _store[key] = value
+    if is_new:                           # upserts of pre-existing keys are
+        from . import scope              # NOT scope-owned temporaries
+        scope.track(key)
     if _remote is not None and _is_plain(value):
         _rpc("put", key=key, value=value)
     return key

@@ -1,0 +1,55 @@
+"""Scope: temporary-key lifetime tracking — ``water/Scope.java`` analog.
+
+The reference brackets work in Scope.enter()/exit(): every Key created
+inside the scope is tracked and swept on exit unless protected (tests and
+Rapids sessions lean on this to avoid leaking temporaries).  Here the DKV
+put hook feeds the innermost active scopes; ``protect`` (or returning a
+value from ``with``) keeps survivors.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Set
+
+_local = threading.local()
+
+
+def _stack() -> List["Scope"]:
+    if not hasattr(_local, "stack"):
+        _local.stack = []
+    return _local.stack
+
+
+def track(key: str) -> None:
+    """Called by dkv.put for every new key."""
+    for s in _stack():
+        s._created.add(key)
+
+
+class Scope:
+    """Context manager sweeping unprotected keys created inside it."""
+
+    def __init__(self):
+        self._created: Set[str] = set()
+        self._protected: Set[str] = set()
+
+    def __enter__(self) -> "Scope":
+        _stack().append(self)
+        return self
+
+    def protect(self, *objs) -> None:
+        """Keep these keys (or .key-bearing objects) past scope exit."""
+        for o in objs:
+            key = o if isinstance(o, str) else getattr(o, "key", None)
+            if key:
+                self._protected.add(key)
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        from . import dkv
+        _stack().remove(self)
+        for key in self._created - self._protected:
+            dkv.remove(key)
+        # keys created inside this scope were already tracked by every
+        # outer scope via track(); protecting here defers to them
+        return None

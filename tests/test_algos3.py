@@ -72,6 +72,41 @@ def test_segment_models(cl, rng):
         assert m.coef["x"] == pytest.approx(want, abs=0.05)
 
 
+def test_psvm_nonlinear_boundary(cl, rng):
+    """RBF-kernel SVM separates the circle a linear model cannot."""
+    from h2o3_tpu.models import PSVM
+    n = 3000
+    X = rng.normal(size=(n, 2))
+    y = ((X ** 2).sum(axis=1) < 1.2)
+    fr = Frame.from_numpy({"x0": X[:, 0], "x1": X[:, 1],
+                           "y": np.where(y, "in", "out").astype(object)})
+    m = PSVM(response_column="y", hyper_param=1.0, seed=1).train(fr)
+    lin = GLM(response_column="y", family="binomial").train(fr)
+    assert m.training_metrics.auc > 0.97
+    assert lin.training_metrics.auc < 0.6
+    pred = m.predict(fr)
+    acc = (pred.vec("predict").decoded()
+           == np.where(y, "in", "out")).mean()
+    assert acc > 0.9
+    assert 0 < m.output["svs_count"] < n
+
+
+def test_scope_sweeps_temporaries(cl, rng):
+    import h2o3_tpu
+    from h2o3_tpu import Scope
+    before = set(h2o3_tpu.ls())
+    with Scope() as s:
+        fr = Frame.from_numpy({"x": rng.normal(size=200),
+                               "y": rng.normal(size=200)}, key="scope_tmp")
+        m = GLM(response_column="y", family="gaussian").train(fr)
+        s.protect(m)
+    after = set(h2o3_tpu.ls())
+    assert "scope_tmp" not in after
+    assert m.key in after
+    h2o3_tpu.remove(m.key)
+    assert before <= set(h2o3_tpu.ls()) | {m.key}
+
+
 def test_gam_crs_splines(cl, rng):
     """CRS basis fits a sine; huge smoothing collapses EXACTLY to the
     unpenalized null space (the linear fit) — the penalty is the true
