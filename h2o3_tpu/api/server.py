@@ -91,6 +91,24 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):          # quiet
         pass
 
+    def _authorized(self) -> bool:
+        """HTTP Basic auth when the server has credentials configured —
+        the hash-login analog of the reference's h2o-security module
+        (LDAP/Kerberos are deployment-infra concerns left to the proxy)."""
+        creds = getattr(self.server, "basic_auth", None)
+        if not creds:
+            return True
+        import base64
+        import hmac
+        hdr = self.headers.get("Authorization", "")
+        if not hdr.startswith("Basic "):
+            return False
+        try:
+            got = base64.b64decode(hdr[6:]).decode()
+        except Exception:
+            return False
+        return hmac.compare_digest(got, creds)
+
     def _reply(self, code: int, payload: dict):
         body = json.dumps(payload, default=_json_default).encode()
         self.send_response(code)
@@ -100,6 +118,11 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def _dispatch(self, table):
+        if not self._authorized():
+            self.send_response(401)
+            self.send_header("WWW-Authenticate", 'Basic realm="h2o3_tpu"')
+            self.end_headers()
+            return
         parsed = urlparse(self.path)
         params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
         length = int(self.headers.get("Content-Length") or 0)
@@ -395,8 +418,13 @@ class Api:
 class H2OServer:
     """In-process REST server — H2OApp/Jetty boot analog."""
 
-    def __init__(self, port: int = 54321):
+    def __init__(self, port: int = 54321, username: str = "",
+                 password: str = ""):
         self.api = Api()
+        if password and not username:
+            raise ValueError("basic auth requires a username with the "
+                             "password")
+        self._auth = f"{username}:{password}" if username else None
         _Handler.routes_get = {
             r"/3/Cloud": lambda a: a.cloud(),
             r"/3/Frames": lambda a: a.frames(),
@@ -433,6 +461,7 @@ class H2OServer:
         }
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
         self.httpd.api = self.api
+        self.httpd.basic_auth = self._auth
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
@@ -451,6 +480,8 @@ class H2OServer:
         return f"http://127.0.0.1:{self.port}"
 
 
-def start_server(port: int = 0) -> H2OServer:
+def start_server(port: int = 0, username: str = "",
+                 password: str = "") -> H2OServer:
     """Boot the REST layer on an in-process runtime (port 0 = ephemeral)."""
-    return H2OServer(port=port).start()
+    return H2OServer(port=port, username=username,
+                     password=password).start()

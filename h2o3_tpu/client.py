@@ -33,8 +33,13 @@ class H2OConnectionError(Exception):
 class H2OConnection(Backend):
     """HTTP connection to a running h2o3_tpu REST server."""
 
-    def __init__(self, url: str):
+    def __init__(self, url: str, username: str = "", password: str = ""):
         self.url = url.rstrip("/")
+        self._auth = None
+        if username:
+            import base64
+            self._auth = "Basic " + base64.b64encode(
+                f"{username}:{password}".encode()).decode()
         self.cloud = self.get("/3/Cloud")
 
     # ------------------------------------------------------------- transport
@@ -47,6 +52,8 @@ class H2OConnection(Backend):
             data = json.dumps(params).encode()
         req = urllib.request.Request(url, data=data, method=method)
         req.add_header("Content-Type", "application/json")
+        if self._auth:
+            req.add_header("Authorization", self._auth)
         try:
             with urllib.request.urlopen(req) as resp:
                 payload = json.loads(resp.read().decode())
@@ -199,6 +206,7 @@ class RemoteModel:
         return f"<RemoteModel {self.key}>"
 
 
-def connect(url: str = "http://127.0.0.1:54321") -> H2OConnection:
+def connect(url: str = "http://127.0.0.1:54321", username: str = "",
+            password: str = "") -> H2OConnection:
     """h2o.connect analog."""
-    return H2OConnection(url)
+    return H2OConnection(url, username, password)

@@ -106,25 +106,125 @@ def _column_to_vec(values: np.ndarray, name: str,
     return Vec.from_numpy(codes, T_CAT, domain=[str(u) for u in uniq])
 
 
+def _parse_csv_native(path_or_buf, header, sep, col_names):
+    """Native tokenizer path (h2o3_tpu/native/fastcsv.cpp via ctypes).
+
+    Returns (names, cols) or None when the native library is unavailable
+    or the input shape doesn't fit its fast path.
+    """
+    from .. import native
+    sepc = sep if sep is not None else ","
+    if len(sepc) != 1:
+        return None
+    data = path_or_buf if isinstance(path_or_buf, bytes) else None
+    if data is None:
+        if isinstance(path_or_buf, str):
+            with open(path_or_buf, "rb") as f:
+                data = f.read()
+        else:
+            data = path_or_buf.read()
+            if isinstance(data, str):
+                data = data.encode()
+    first_nl = data.find(b"\n")
+    first = data[: first_nl if first_nl >= 0 else len(data)] \
+        .decode(errors="replace")
+    head_cells = [c.strip().strip('"') for c in first.split(sepc)]
+    has_header = (not _guess_numeric(head_cells)) if header is None \
+        else bool(header)
+    body = data[first_nl + 1:] if has_header and first_nl >= 0 else data
+    out = native.parse_bytes(body, sepc)
+    if out is None:
+        return None
+    vals, flags, offs, consumed = out
+    if consumed != len(body):
+        return None              # unterminated quote etc.: defer to pandas
+    # string-heavy inputs: the per-cell decode loop below loses to the
+    # pandas C reader — defer when text cells dominate
+    if flags.size and flags.mean() > 0.25:
+        try:
+            import pandas  # noqa: F401
+            return None
+        except ImportError:
+            pass
+    nrows, ncols = vals.shape
+    if has_header:
+        names = head_cells
+    else:
+        names = col_names or [f"C{i+1}" for i in range(ncols)]
+    if len(names) != ncols:
+        return None
+    cols = {}
+    for j, name in enumerate(names):
+        if flags[:, j].any():
+            col = np.empty(nrows, dtype=object)
+            for i in range(nrows):
+                s, e2 = offs[i, j]
+                cell = body[s:e2].decode(errors="replace")
+                if '""' in cell:                 # RFC-4180 escaped quotes
+                    cell = cell.replace('""', '"')
+                col[i] = cell
+            # numeric cells keep their text form for uniform type guessing
+            cols[name] = col
+        else:
+            cols[name] = vals[:, j]
+    return names, cols
+
+
 def parse_csv(path_or_buf, destination_frame: Optional[str] = None,
               header: Optional[bool] = None, sep: Optional[str] = None,
               col_types: Optional[Dict[str, str]] = None,
               col_names: Optional[List[str]] = None) -> Frame:
-    """Parse a CSV file/buffer into a sharded Frame (ParseDataset.parse)."""
+    """Parse a CSV file/buffer into a sharded Frame (ParseDataset.parse).
+
+    Tokenization order: the native C++ fast path (numeric cells never
+    become Python objects), then pandas' reader, then the stdlib fallback.
+    """
     col_types = col_types or {}
+    # read streams ONCE up front so the native attempt cannot exhaust a
+    # non-seekable input before a fallback runs
+    source = path_or_buf
+    raw: Optional[bytes] = None
+    if not isinstance(path_or_buf, str):
+        raw = path_or_buf.read()
+        if isinstance(raw, str):
+            raw = raw.encode()
+        source = raw
+    names = cols = None
     try:
-        import pandas as pd
-        df = pd.read_csv(
-            path_or_buf, sep=sep if sep is not None else ",",
-            header=0 if header in (None, True) else None,
-            na_values=sorted(_NA), keep_default_na=True, engine="c",
-            low_memory=False)
-        if col_names:
-            df.columns = col_names
-        names = [str(c) for c in df.columns]
-        cols = {n: df[n].to_numpy() for n in names}
-    except ImportError:
-        names, cols = _parse_csv_stdlib(path_or_buf, header, sep, col_names)
+        parsed = _parse_csv_native(source, header, sep, col_names)
+        if parsed is not None:
+            names, cols = parsed
+    except Exception:
+        names = cols = None
+    if names is None:
+        pd_src = io.BytesIO(raw) if raw is not None else path_or_buf
+        eff_header = header
+        if header is None:
+            # same first-line guess the native path (and stdlib fallback)
+            # use, so parse results don't depend on which engine ran
+            if raw is not None:
+                first = raw.split(b"\n", 1)[0].decode(errors="replace")
+            else:
+                with open(path_or_buf, "r", errors="replace") as fh_:
+                    first = fh_.readline()
+            sepc = sep if sep is not None else ","
+            cells = [c.strip().strip('"') for c in first.strip().split(sepc)]
+            eff_header = not _guess_numeric(cells)
+        try:
+            import pandas as pd
+            df = pd.read_csv(
+                pd_src, sep=sep if sep is not None else ",",
+                header=0 if eff_header else None,
+                na_values=sorted(_NA), keep_default_na=True, engine="c",
+                low_memory=False)
+            if col_names:
+                df.columns = col_names
+            names = [str(c) for c in df.columns]
+            cols = {n: df[n].to_numpy() for n in names}
+        except ImportError:
+            sd = io.StringIO(raw.decode(errors="replace")) \
+                if raw is not None else path_or_buf
+            names, cols = _parse_csv_stdlib(sd, header, sep, col_names)
     vecs = [_column_to_vec(cols[n], n, col_types.get(n)) for n in names]
     key = destination_frame or dkv.make_key(
         os.path.basename(str(path_or_buf)) if isinstance(path_or_buf, str)

@@ -37,3 +37,4 @@ from .modelselection import (ModelSelection, ModelSelectionModel,
                              ModelSelectionParameters)
 from .anovaglm import ANOVAGLM, ANOVAGLMModel, ANOVAGLMParameters
 from .psvm import PSVM, PSVMModel, PSVMParameters
+from .grep import Grep, GrepModel, GrepParameters, grep

@@ -1,0 +1,101 @@
+"""Native runtime components (C++ via ctypes — no pybind11 in-image).
+
+``fastcsv`` is the byte-level CSV tokenizer for the parse hot path (the
+water/parser/CsvParser fast-path analog): numeric cells go straight into
+column-major double buffers with no per-cell Python objects; text cells
+are flagged with byte ranges for the host-side categorical/string pass.
+
+The shared object builds on first use with the in-image g++ (cached next
+to the source); every caller must handle ``load() is None`` and fall back
+to the portable tokenizer — builds can be unavailable in stripped
+deployment images.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+_SRC = os.path.join(os.path.dirname(__file__), "fastcsv.cpp")
+_SO = os.path.join(os.path.dirname(__file__), "_fastcsv.so")
+
+
+def _build() -> bool:
+    try:
+        r = subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", _SO, _SRC],
+            capture_output=True, timeout=120)
+        return r.returncode == 0 and os.path.exists(_SO)
+    except Exception:
+        return False
+
+
+def load():
+    """The loaded library, building it on first use; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO) or \
+                os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.fastcsv_parse.restype = ctypes.c_longlong
+        lib.fastcsv_parse.argtypes = [
+            ctypes.c_char_p, ctypes.c_longlong, ctypes.c_char,
+            ctypes.c_int, ctypes.c_longlong,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_longlong),
+            ctypes.POINTER(ctypes.c_longlong)]
+        lib.fastcsv_ncols.restype = ctypes.c_int
+        lib.fastcsv_ncols.argtypes = [ctypes.c_char_p, ctypes.c_longlong,
+                                      ctypes.c_char]
+        _lib = lib
+        return _lib
+
+
+def parse_bytes(data: bytes, sep: str = ",", ncols: Optional[int] = None):
+    """Tokenize a CSV byte buffer natively.
+
+    Returns (values [rows, ncols] f64 with NaN for non-numeric, flags
+    [rows, ncols] uint8 text markers, offsets [rows, ncols, 2] byte
+    ranges, consumed bytes) — or None when the native library is
+    unavailable (callers fall back to the portable parser).
+    """
+    lib = load()
+    if lib is None:
+        return None
+    n = len(data)
+    if ncols is None:
+        ncols = int(lib.fastcsv_ncols(data, n, sep.encode()[0:1]))
+    max_rows = max(data.count(b"\n") + 2, 4)
+    values = np.empty(ncols * max_rows, np.float64)
+    flags = np.zeros(ncols * max_rows, np.uint8)
+    offsets = np.zeros(ncols * max_rows * 2, np.int64)
+    consumed = ctypes.c_longlong(0)
+    rows = lib.fastcsv_parse(
+        data, n, sep.encode()[0:1], ncols, max_rows,
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        flags.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+        ctypes.byref(consumed))
+    rows = int(rows)
+    vals = values.reshape(ncols, max_rows).T[:rows]
+    flg = flags.reshape(ncols, max_rows).T[:rows]
+    offs = offsets.reshape(ncols, max_rows, 2).transpose(1, 0, 2)[:rows]
+    return vals, flg, offs, int(consumed.value)

@@ -1,0 +1,138 @@
+// fastcsv: native CSV tokenizer for the parse hot path.
+//
+// Reference: the parse fast path in water/parser/CsvParser.java — a
+// byte-level tokenizer over raw chunks that never materializes Java
+// Strings for numeric cells.  This is its native analog for the TPU
+// framework's coordinator: one pass over the buffer, quote-aware, writing
+// numeric cells straight into a preallocated double column-major matrix
+// and flagging cells that need host-side (string/categorical) handling.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <cmath>
+
+extern "C" {
+
+// Tokenize up to max_rows lines of `buf` (len bytes) with `ncols` columns.
+// Outputs:
+//   values  [max_rows * ncols] column-major doubles (NaN when not numeric)
+//   flags   [max_rows * ncols] uint8: 0 = numeric/empty, 1 = text cell
+//   offsets [max_rows * ncols * 2] int64 (start, end) byte ranges per cell
+// Returns number of complete rows parsed; *consumed is set to the number
+// of bytes consumed (ending on a row boundary).
+long long fastcsv_parse(const char* buf, long long len, char sep,
+                        int ncols, long long max_rows,
+                        double* values, uint8_t* flags,
+                        long long* offsets, long long* consumed) {
+    long long row = 0;
+    long long i = 0;
+    while (row < max_rows && i < len) {
+        long long line_start = i;
+        int col = 0;
+        bool in_quotes = false;
+        long long cell_start = i;
+        bool saw_any = false;
+        bool complete = false;
+        while (i <= len) {
+            char c = (i < len) ? buf[i] : '\n';
+            if (in_quotes) {
+                if (c == '"') {
+                    if (i + 1 < len && buf[i + 1] == '"') { i += 2; continue; }
+                    in_quotes = false;
+                }
+                ++i;
+                continue;
+            }
+            if (c == '"') { in_quotes = true; saw_any = true; ++i; continue; }
+            if (c == sep || c == '\n' || c == '\r') {
+                if (col < ncols) {
+                    long long s = cell_start, e = i;
+                    // trim spaces and symmetric quotes
+                    while (s < e && (buf[s] == ' ' || buf[s] == '\t')) ++s;
+                    while (e > s && (buf[e-1] == ' ' || buf[e-1] == '\t')) --e;
+                    if (e - s >= 2 && buf[s] == '"' && buf[e-1] == '"') {
+                        ++s; --e;
+                    }
+                    long long idx = (long long)col * max_rows + row;
+                    offsets[2 * idx] = s;
+                    offsets[2 * idx + 1] = e;
+                    if (s == e) {                      // empty -> NA
+                        values[idx] = NAN;
+                        flags[idx] = 0;
+                    } else {
+                        char* endp = nullptr;
+                        // strtod needs NUL-terminated input; copy small cell
+                        char tmp[64];
+                        long long m = e - s;
+                        if (m < 63) {
+                            memcpy(tmp, buf + s, m);
+                            tmp[m] = 0;
+                            double v = strtod(tmp, &endp);
+                            if (endp == tmp + m) {
+                                values[idx] = v;
+                                flags[idx] = 0;
+                            } else {
+                                values[idx] = NAN;
+                                flags[idx] = 1;        // text cell
+                            }
+                        } else {
+                            values[idx] = NAN;
+                            flags[idx] = 1;
+                        }
+                    }
+                }
+                ++col;
+                if (c == sep) { ++i; cell_start = i; continue; }
+                // end of line (real newline, or the synthetic one at EOF
+                // that closes a final unterminated row)
+                if (i < len) {
+                    if (c == '\r' && i + 1 < len && buf[i + 1] == '\n') ++i;
+                    ++i;
+                } else {
+                    i = len;
+                }
+                complete = true;
+                break;
+            }
+            saw_any = true;
+            ++i;
+        }
+        if (!complete) {                                // ran out mid-quote
+            i = line_start;
+            break;
+        }
+        if (col == 0 && !saw_any) continue;             // blank line
+        // short rows: pad remaining cells with NA
+        for (int c2 = col; c2 < ncols; ++c2) {
+            long long idx = (long long)c2 * max_rows + row;
+            values[idx] = NAN;
+            flags[idx] = 0;
+            offsets[2 * idx] = offsets[2 * idx + 1] = 0;
+        }
+        ++row;
+    }
+    *consumed = (i > len) ? len : i;
+    return row;
+}
+
+// Count columns of the first line (quote-aware) — ParseSetup's guess.
+int fastcsv_ncols(const char* buf, long long len, char sep) {
+    int cols = 1;
+    bool in_quotes = false;
+    for (long long i = 0; i < len; ++i) {
+        char c = buf[i];
+        if (in_quotes) {
+            if (c == '"') in_quotes = false;
+            continue;
+        }
+        if (c == '"') in_quotes = true;
+        else if (c == sep) ++cols;
+        else if (c == '\n' || c == '\r') break;
+    }
+    return cols;
+}
+
+}  // extern "C"

@@ -22,9 +22,15 @@ def _stack() -> List["Scope"]:
 
 
 def track(key: str) -> None:
-    """Called by dkv.put for every new key."""
-    for s in _stack():
-        s._created.add(key)
+    """Called by dkv.put for every new key.
+
+    Only the INNERMOST scope records it (water/Scope.java tracks at the
+    current level): a key protected when the inner scope exits therefore
+    survives all outer scopes without re-declaration.
+    """
+    st = _stack()
+    if st:
+        st[-1]._created.add(key)
 
 
 class Scope:
