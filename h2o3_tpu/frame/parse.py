@@ -147,10 +147,12 @@ def _parse_csv_native(path_or_buf, header, sep, col_names):
         except ImportError:
             pass
     nrows, ncols = vals.shape
-    if has_header:
+    if col_names:                        # explicit names override a header
+        names = list(col_names)
+    elif has_header:
         names = head_cells
     else:
-        names = col_names or [f"C{i+1}" for i in range(ncols)]
+        names = [f"C{i+1}" for i in range(ncols)]
     if len(names) != ncols:
         return None
     cols = {}
@@ -500,14 +502,62 @@ def parse_arff(path: str, destination_frame: Optional[str] = None) -> Frame:
     return Frame(names, vecs, key=destination_frame or dkv.make_key("arff"))
 
 
+def parse_arrow(path: str, fmt: str,
+                destination_frame: Optional[str] = None) -> Frame:
+    """Columnar formats via pyarrow — the h2o-parsers/{parquet,orc} analog.
+
+    ``fmt``: parquet | orc | feather.  Arrow types map onto the Vec types:
+    numerics -> T_NUM, dictionary/string -> categorical/string via the
+    standard guesser, timestamps -> T_TIME (ms since epoch).
+    """
+    from .. import persist
+    import pyarrow as pa
+    raw = persist.open_read(path)
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+        table = pq.read_table(raw)
+    elif fmt == "orc":
+        import pyarrow.orc as porc
+        table = porc.ORCFile(raw).read()
+    elif fmt == "feather":
+        import pyarrow.feather as pf
+        table = pf.read_table(raw)
+    else:
+        raise ValueError(f"unknown arrow format {fmt!r}")
+    names, vecs = [], []
+    for col_name in table.column_names:
+        col = table.column(col_name)
+        pa_type = col.type
+        names.append(str(col_name))
+        if pa.types.is_timestamp(pa_type) or pa.types.is_date(pa_type):
+            ms = col.cast(pa.timestamp("ms")).to_numpy(
+                zero_copy_only=False).astype("datetime64[ms]") \
+                .astype("int64").astype(np.float64)
+            nulls = col.is_null().to_numpy(zero_copy_only=False)
+            ms[nulls] = np.nan
+            vecs.append(Vec.from_numpy(ms, T_TIME))
+        elif pa.types.is_floating(pa_type) or pa.types.is_integer(pa_type) \
+                or pa.types.is_boolean(pa_type):
+            arr = col.cast(pa.float64()).to_numpy(zero_copy_only=False)
+            vecs.append(Vec.from_numpy(arr, T_NUM))
+        else:
+            arr = np.asarray(col.to_pylist(), dtype=object)
+            arr = np.asarray(["" if v is None else str(v) for v in arr],
+                             dtype=object)
+            vecs.append(_column_to_vec(arr, str(col_name)))
+    # register only when a destination was requested: multi-file imports
+    # build unregistered shards and register just the rbind result
+    return Frame(names, vecs, key=destination_frame)
+
+
 def import_file(path, destination_frame: Optional[str] = None,
                 **kw) -> Frame:
     """h2o.import_file analog (h2o-py/h2o/h2o.py import_file -> /3/Parse).
 
     Accepts a single path, a glob pattern, a directory, a list of paths, or
     a persist URI (``gcs://…``, ``file://…``); gzip/zip/bz2/xz shards
-    decompress transparently; ``.svm``/``.svmlight`` and ``.arff`` route to
-    the format-specific parsers.
+    decompress transparently; ``.svm``/``.svmlight``, ``.arff``,
+    ``.parquet``, ``.orc`` and ``.feather`` route to format parsers.
     """
     paths = _expand_paths(path)
     low = paths[0].lower()
@@ -517,6 +567,24 @@ def import_file(path, destination_frame: Optional[str] = None,
             if len(paths) > 1:
                 raise ValueError(f"multi-file {ext} import not supported")
             return fn(paths[0], destination_frame=destination_frame)
+    for ext, fmt in ((".parquet", "parquet"), (".pq", "parquet"),
+                     (".orc", "orc"), (".feather", "feather")):
+        if low.endswith(ext):
+            if len(paths) == 1:
+                return parse_arrow(
+                    paths[0], fmt,
+                    destination_frame=destination_frame
+                    or dkv.make_key(fmt))
+            from ..rapids.ops import rbind
+            frames = [parse_arrow(p2, fmt) for p2 in paths]
+            out = rbind(*frames)
+            out.key = destination_frame or dkv.make_key(fmt)
+            dkv.put(out.key, out)
+            return out
+    if low.endswith(".avro"):
+        raise NotImplementedError(
+            "avro import needs the fastavro library, which is not in this "
+            "build; convert to parquet/orc/csv or install fastavro")
     if len(paths) == 1 and "://" not in paths[0] \
             and not any(paths[0].lower().endswith(e)
                         for e in (".gz", ".zip", ".bz2", ".xz")):
@@ -525,8 +593,31 @@ def import_file(path, destination_frame: Optional[str] = None,
 
 
 def export_file(frame: Frame, uri: str, header: bool = True) -> str:
-    """Write a Frame as CSV to any persist URI — h2o.export_file analog."""
+    """Write a Frame to any persist URI — h2o.export_file analog.
+
+    Format by extension: ``.parquet``/``.feather`` via pyarrow, else CSV.
+    """
     from .. import persist
+    low = uri.lower()
+    if low.endswith((".parquet", ".pq", ".feather")):
+        import pyarrow as pa
+        cols = {}
+        for n, v in zip(frame.names, frame.vecs):
+            col = v.decoded()
+            if v.type == T_TIME:
+                cols[n] = np.asarray(col, "float64").astype("datetime64[ms]")
+            else:
+                cols[n] = col
+        table = pa.table(cols)
+        fh = persist.open_write(uri)
+        if low.endswith(".feather"):
+            import pyarrow.feather as pf
+            pf.write_feather(table, fh)
+        else:
+            import pyarrow.parquet as pq
+            pq.write_table(table, fh)
+        fh.close()
+        return uri
     cols = [v.decoded() for v in frame.vecs]
     fh = persist.open_write(uri)
     out = io.TextIOWrapper(fh, newline="")

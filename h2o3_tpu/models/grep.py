@@ -38,18 +38,20 @@ class GrepModel(Model):
 def grep(path, regex: str, destination_frame: Optional[str] = None) -> Frame:
     """Search file(s) for a regex; returns (file, offset, match) rows."""
     from ..frame.parse import _expand_paths, _open_decompressed
-    pat = re.compile(regex)
+    pat = re.compile(regex.encode())     # byte-level: true byte offsets
     files: List[str] = []
     offsets: List[float] = []
     matches: List[str] = []
     for uri in _expand_paths(path):
         fh = _open_decompressed(uri)
-        text = fh.read()
+        data = fh.read()
         fh.close()
-        for m in pat.finditer(text):
+        if isinstance(data, str):
+            data = data.encode()
+        for m in pat.finditer(data):
             files.append(uri)
             offsets.append(float(m.start()))
-            matches.append(m.group(0))
+            matches.append(m.group(0).decode(errors="replace"))
     fr = Frame.from_numpy({
         "file": np.asarray(files, dtype=object),
         "byte_offset": np.asarray(offsets, np.float64),

@@ -502,6 +502,41 @@ def build_tree(codes, g, h, w, edges, nbins: int, max_depth: int,
 class SharedTreeModel(Model):
     """Tree-ensemble model: scores via compiled stacked-tree traversal."""
 
+    def varimp(self, frame: Optional[Frame] = None,
+               method: str = "cover") -> dict:
+        """Variable importances — hex/tree VarImp analog.
+
+        ``method="cover"``: per-feature sum of training covers at the
+        nodes that split on it (cover-weighted split frequency; computed
+        from the recorded leaf covers, no data pass).  ``method="shap"``:
+        mean |TreeSHAP contribution| over ``frame`` (needs a frame;
+        binomial/regression only).  Returns {feature: relative importance}
+        scaled so the max is 1.
+        """
+        names = [s.name for s in self.datainfo.specs]
+        if method == "shap":
+            if frame is None:
+                raise ValueError("varimp(method='shap') needs a frame")
+            contrib = self.predict_contributions(frame).to_numpy()[:, :-1]
+            imp = np.abs(contrib).mean(axis=0)
+        else:
+            from ...export.treeshap import shap_trees_from_model
+            imp = np.zeros(len(names))
+            trees = list(self.output["trees"])
+            if trees and isinstance(trees[0], list):
+                trees = [tc for kt in trees for tc in kt]  # multinomial
+            for t in shap_trees_from_model(trees):
+                for d in range(t.depth):
+                    valid = t.valid[d]
+                    cover = t.cover[d]
+                    feats = t.feat[d]
+                    for i in np.flatnonzero(valid):
+                        imp[int(feats[i])] += cover[i]
+        mx = imp.max()
+        rel = imp / mx if mx > 0 else imp
+        order = np.argsort(-rel)
+        return {names[i]: float(rel[i]) for i in order}
+
     def predict_contributions(self, frame: Frame) -> Frame:
         """Per-feature TreeSHAP contributions + BiasTerm (margin space).
 

@@ -60,7 +60,7 @@ def load():
             ctypes.c_int, ctypes.c_longlong,
             ctypes.POINTER(ctypes.c_double),
             ctypes.POINTER(ctypes.c_uint8),
-            ctypes.POINTER(ctypes.c_longlong),
+            ctypes.POINTER(ctypes.c_int32),
             ctypes.POINTER(ctypes.c_longlong)]
         lib.fastcsv_ncols.restype = ctypes.c_int
         lib.fastcsv_ncols.argtypes = [ctypes.c_char_p, ctypes.c_longlong,
@@ -81,18 +81,20 @@ def parse_bytes(data: bytes, sep: str = ",", ncols: Optional[int] = None):
     if lib is None:
         return None
     n = len(data)
+    if n > (1 << 31) - 16:               # int32 offsets: pre-split or defer
+        return None
     if ncols is None:
         ncols = int(lib.fastcsv_ncols(data, n, sep.encode()[0:1]))
     max_rows = max(data.count(b"\n") + 2, 4)
     values = np.empty(ncols * max_rows, np.float64)
     flags = np.zeros(ncols * max_rows, np.uint8)
-    offsets = np.zeros(ncols * max_rows * 2, np.int64)
+    offsets = np.zeros(ncols * max_rows * 2, np.int32)
     consumed = ctypes.c_longlong(0)
     rows = lib.fastcsv_parse(
         data, n, sep.encode()[0:1], ncols, max_rows,
         values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
         flags.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         ctypes.byref(consumed))
     rows = int(rows)
     vals = values.reshape(ncols, max_rows).T[:rows]

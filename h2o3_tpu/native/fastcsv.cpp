@@ -20,13 +20,16 @@ extern "C" {
 // Outputs:
 //   values  [max_rows * ncols] column-major doubles (NaN when not numeric)
 //   flags   [max_rows * ncols] uint8: 0 = numeric/empty, 1 = text cell
-//   offsets [max_rows * ncols * 2] int64 (start, end) byte ranges per cell
+//   offsets [max_rows * ncols * 2] int32 (start, end) byte ranges per cell
+//           (callers must keep buffers under 2 GB or pre-split them)
 // Returns number of complete rows parsed; *consumed is set to the number
-// of bytes consumed (ending on a row boundary).
+// of bytes consumed (ending on a row boundary).  A row WIDER than ncols
+// stops the parse at that row (consumed < len) so callers fail over to a
+// stricter engine instead of silently truncating cells.
 long long fastcsv_parse(const char* buf, long long len, char sep,
                         int ncols, long long max_rows,
                         double* values, uint8_t* flags,
-                        long long* offsets, long long* consumed) {
+                        int32_t* offsets, long long* consumed) {
     long long row = 0;
     long long i = 0;
     while (row < max_rows && i < len) {
@@ -57,8 +60,8 @@ long long fastcsv_parse(const char* buf, long long len, char sep,
                         ++s; --e;
                     }
                     long long idx = (long long)col * max_rows + row;
-                    offsets[2 * idx] = s;
-                    offsets[2 * idx + 1] = e;
+                    offsets[2 * idx] = (int32_t)s;
+                    offsets[2 * idx + 1] = (int32_t)e;
                     if (s == e) {                      // empty -> NA
                         values[idx] = NAN;
                         flags[idx] = 0;
@@ -100,11 +103,11 @@ long long fastcsv_parse(const char* buf, long long len, char sep,
             saw_any = true;
             ++i;
         }
-        if (!complete) {                                // ran out mid-quote
+        if (!complete || col > ncols) {   // mid-quote EOF or over-wide row
             i = line_start;
             break;
         }
-        if (col == 0 && !saw_any) continue;             // blank line
+        if (!saw_any && col <= 1) continue;             // blank line
         // short rows: pad remaining cells with NA
         for (int c2 = col; c2 < ncols; ++c2) {
             long long idx = (long long)c2 * max_rows + row;

@@ -5,11 +5,10 @@ Reference: ``water/rapids/`` — a Lisp-like expression language with 221
 string, time, …), plus distributed radix sort/merge
 (``RadixOrder.java``/``BinaryMerge.java``) and group-by (``AstGroup``).
 
-TPU-native redesign: there is no expression-string interpreter — the client
-IS Python, so munging primitives are plain functions/operators over the
-sharded Frame/Vec (the lazy-DAG-to-Rapids compile step in h2o-py exists only
-because the reference's client is remote; here frames are already
-device-resident).  Row-scale work (sort keys, segment aggregation, joins,
+TPU-native redesign: in-process munging primitives are plain functions
+over the sharded Frame/Vec, and the REMOTE contract still exists — the
+expression-string interpreter (ast.py, /99/Rapids) and the lazy client DAG
+(expr.py) mirror h2o-py's ExprNode protocol for REST clients.  Row-scale work (sort keys, segment aggregation, joins,
 filters) runs as compiled device programs: sort = ``jnp.argsort`` (TPU
 bitonic network, the RadixOrder analog), group-by = one-hot/segment sums
 psum'd over the mesh, merge = binary search against the sorted build side
@@ -18,3 +17,7 @@ psum'd over the mesh, merge = binary search against the sorted build side
 
 from .ops import (sort, group_by, merge, rbind, cbind, filter_rows, unique,
                   table, ifelse, hist)
+from .strings import (toupper, tolower, trim, lstrip, rstrip, substring,
+                      sub, gsub, nchar, strsplit, countmatches)
+from .ast import rapids
+from .expr import lazy, LazyFrame

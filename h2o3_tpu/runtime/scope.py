@@ -56,6 +56,6 @@ class Scope:
         _stack().remove(self)
         for key in self._created - self._protected:
             dkv.remove(key)
-        # keys created inside this scope were already tracked by every
-        # outer scope via track(); protecting here defers to them
+        # track() records at the innermost level only, so keys that
+        # survive here (protected) are invisible to outer scopes
         return None

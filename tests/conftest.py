@@ -43,3 +43,27 @@ def cl():
 @pytest.fixture()
 def rng():
     return np.random.default_rng(42)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _release_compiled_programs():
+    """Drop compiled XLA programs between test modules.
+
+    The full suite accumulates hundreds of compiled CPU executables (one
+    per tree geometry etc.); past ~120 tests that reliably ended in a
+    segfault inside XLA:CPU execution.  Clearing the builder lru_caches +
+    jax caches per module keeps the executable population bounded (each
+    module recompiles what it needs)."""
+    yield
+    import gc
+    import jax as _jax
+    try:
+        from h2o3_tpu.models.tree import hist as _h, shared as _s
+        for fn in (_h.make_hist_fn, _h.make_fine_hist_fn,
+                   _h.make_varbin_hist_fn, _s.make_build_tree_fn,
+                   _s.make_tree_scan_fn):
+            fn.cache_clear()
+    except Exception:
+        pass
+    _jax.clear_caches()
+    gc.collect()

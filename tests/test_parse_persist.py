@@ -88,6 +88,30 @@ def test_arff(cl, tmp_path):
     assert x[0] == 1.5 and np.isnan(x[2])
 
 
+def test_parquet_orc_feather(cl, tmp_path, rng):
+    fr = Frame.from_numpy({
+        "a": rng.normal(size=40),
+        "g": np.array(["x", "y"], dtype=object)[rng.integers(0, 2, 40)]})
+    for ext in ("parquet", "feather"):
+        uri = str(tmp_path / f"t.{ext}")
+        export_file(fr, uri)
+        back = import_file(uri)
+        np.testing.assert_allclose(back.vec("a").to_numpy(),
+                                   fr.vec("a").to_numpy(), rtol=1e-9)
+        assert list(back.vec("g").decoded()) == list(fr.vec("g").decoded())
+    # ORC import (written via pyarrow directly)
+    import pyarrow as pa
+    import pyarrow.orc as porc
+    porc.write_table(pa.table({"v": np.arange(5.0)}),
+                     str(tmp_path / "t.orc"))
+    orc_fr = import_file(str(tmp_path / "t.orc"))
+    np.testing.assert_array_equal(orc_fr.vec("v").to_numpy(),
+                                  np.arange(5.0))
+    with pytest.raises(NotImplementedError, match="avro"):
+        (tmp_path / "x.avro").write_bytes(b"Obj\x01")
+        import_file(str(tmp_path / "x.avro"))
+
+
 def test_export_roundtrip(cl, tmp_path, rng):
     fr = Frame.from_numpy({
         "a": rng.normal(size=20),

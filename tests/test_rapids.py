@@ -106,6 +106,46 @@ def test_cbind_renames_dups(cl, rng):
     assert out.names == ["x", "x1"]
 
 
+def test_string_ops(cl):
+    from h2o3_tpu.rapids import (toupper, tolower, trim, gsub, sub, nchar,
+                                 strsplit, substring, countmatches)
+    fr = Frame.from_numpy({"g": np.array(["  a-b ", "c-d", "a-b-e"],
+                                         dtype=object)})
+    t = trim(fr.vec("g"))
+    assert list(t.decoded()) == ["a-b", "c-d", "a-b-e"]
+    up = toupper(t)
+    assert list(up.decoded()) == ["A-B", "C-D", "A-B-E"]
+    assert list(tolower(up).decoded()) == ["a-b", "c-d", "a-b-e"]
+    assert list(gsub(t, "-", "_").decoded()) == ["a_b", "c_d", "a_b_e"]
+    assert list(sub(t, "-", "_").decoded()) == ["a_b", "c_d", "a_b-e"]
+    assert list(nchar(t).to_numpy()) == [3.0, 3.0, 5.0]
+    assert list(substring(t, 0, 1).decoded()) == ["a", "c", "a"]
+    assert list(countmatches(t, "-").to_numpy()) == [1.0, 1.0, 2.0]
+    sp = strsplit(t, "-")
+    assert sp.names == ["C1", "C2", "C3"]
+    assert sp.vec("C3").host_data[2] == "e"
+    # cat transforms are domain-only: collapsing labels merges codes
+    fr2 = Frame.from_numpy({"g": np.array(["A", "a", "B"], dtype=object)})
+    lo = tolower(fr2.vec("g"))
+    assert lo.cardinality == 2
+    assert list(lo.decoded()) == ["a", "a", "b"]
+
+
+def test_tree_varimp(cl, rng):
+    from h2o3_tpu.models import GBM
+    n = 1500
+    X = rng.normal(size=(n, 4))
+    y = 3 * X[:, 1] + 0.8 * X[:, 3] + 0.05 * rng.normal(size=n)
+    fr = Frame.from_numpy({**{f"x{j}": X[:, j] for j in range(4)},
+                           "y": y})
+    m = GBM(response_column="y", ntrees=15, max_depth=3, seed=1).train(fr)
+    vi = m.varimp()
+    assert list(vi)[0] == "x1" and vi["x1"] == 1.0
+    assert vi["x3"] > vi["x0"]
+    vs = m.varimp(fr, method="shap")
+    assert list(vs)[0] == "x1" and vs["x3"] > vs["x0"]
+
+
 def test_filter_unique_table_ifelse_hist(cl, rng):
     n = 400
     fr = Frame.from_numpy({
