@@ -198,9 +198,9 @@ class DRF(SharedTree):
         stacks = [StackedTrees.concat(ch) for ch in chunks]
         ntrees_trained = stacks[0].ntrees
         if K > 1:
+            from .shared import TreeListMulti
             model.output["stacked"] = stacks
-            per_class = [s.to_tree_list() for s in stacks]
-            model.output["trees"] = [list(t) for t in zip(*per_class)]
+            model.output["trees"] = TreeListMulti(stacks)
         else:
             model.output["stacked"] = stacks[0]
             model.output["trees"] = TreeList(stacks[0])

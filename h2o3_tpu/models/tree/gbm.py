@@ -178,6 +178,58 @@ class GBM(SharedTree):
         metric_name, maximize = metric_direction(
             p.stopping_metric, di.is_classifier)
         fused = not multinomial and not dart
+        fused_multi = multinomial and not dart
+
+        if fused_multi:
+            # multinomial fast path: K class trees per round, a whole
+            # scoring interval of rounds per dispatch
+            from .shared import make_multinomial_scan_fn
+            scan_fn = make_multinomial_scan_fn(
+                K, p.max_depth, p.nbins, binned.nfeatures, N,
+                p.hist_precision, p.sample_rate, p.col_sample_rate_per_tree,
+                hier=use_hier_split_search(p, N),
+                bin_counts=binned.bin_counts)
+            scalars = (p.reg_lambda, p.min_rows, p.min_split_improvement,
+                       p.learn_rate, p.col_sample_rate, p.reg_alpha, p.gamma,
+                       p.min_child_weight)
+            chunks_k = [[prior_stacked(prior, k)] if prior is not None
+                        else [] for k in range(K)]
+            for c, t_new, score_now in chunk_schedule(
+                    p.ntrees - prior_nt, p.score_tree_interval):
+                t_done = prior_nt + t_new
+                rng, kc = jax.random.split(rng)
+                keys = jax.random.split(kc, c)
+                F, lv, vals, cov = scan_fn(codes, Y1, w, F, edges_mat,
+                                           keys, *scalars)
+                for k in range(K):
+                    lv_k = [tuple(lvd[i][:, k] for i in range(4))
+                            for lvd in lv]
+                    chunk = StackedTrees(lv_k, vals[:, k], cov[:, k])
+                    chunks_k[k].append(chunk)
+                    if valid is not None:
+                        F_v = F_v.at[:, k].add(
+                            traverse_jit(chunk.levels, chunk.values, Xv))
+                job.update(t_done / p.ntrees, f"tree {t_done}/{p.ntrees}")
+                if not score_now:
+                    continue
+                vstate = (F_v, y_v, w_v) if valid is not None else None
+                if self._interval_score(model, t_done, F, y, w, di, dist,
+                                        history, vstate, metric_name,
+                                        maximize):
+                    break
+            from .shared import TreeListMulti
+            stacks = [StackedTrees.concat(ch) for ch in chunks_k]
+            model.output["stacked"] = stacks
+            model.output["trees"] = TreeListMulti(stacks)
+            model.output["init_score"] = init_host
+            model.output["ntrees_trained"] = stacks[0].ntrees
+            model.output["edges"] = binned.edges
+            model.scoring_history = history
+            model.training_metrics = make_metrics(
+                di, self._scores_to_preds(F, dist, di), y, w)
+            if valid is not None:
+                model.validation_metrics = model.model_performance(valid)
+            return model
 
         if fused:
             # fast path: scan a whole scoring interval of trees per dispatch
@@ -206,16 +258,10 @@ class GBM(SharedTree):
                 if not score_now:
                     continue
                 vstate = (F_v, y_v, w_v) if valid is not None else None
-                self._score_and_log(model, t_done, F, y, w, di, dist,
-                                    history, vstate)
-                if p.stopping_rounds:
-                    key = (f"valid_{metric_name}" if valid is not None
-                           else metric_name)
-                    series = [hh.get(key) for hh in history
-                              if hh.get(key) is not None]
-                    if series and stop_early(series, p.stopping_rounds,
-                                             p.stopping_tolerance, maximize):
-                        break
+                if self._interval_score(model, t_done, F, y, w, di, dist,
+                                        history, vstate, metric_name,
+                                        maximize):
+                    break
             stacked = StackedTrees.concat(chunks)
             model.output["stacked"] = stacked
             model.output["trees"] = TreeList(stacked)

@@ -152,6 +152,42 @@ class StackedTrees:
         return out
 
 
+class TreeListMulti:
+    """Lazy per-round list of per-class ``Tree`` lists (multinomial form).
+
+    ``output["trees"][t][k]`` — materialized from the K per-class
+    ``StackedTrees`` only on first index, mirroring ``TreeList``.
+    """
+
+    def __init__(self, stacks: List[StackedTrees]):
+        self._stacks = stacks
+        self._cache: Optional[List[list]] = None
+
+    def _mat(self) -> List[list]:
+        if self._cache is None:
+            per_class = [s.to_tree_list() for s in self._stacks]
+            self._cache = [list(t) for t in zip(*per_class)]
+        return self._cache
+
+    def __len__(self):
+        return self._stacks[0].ntrees
+
+    def __getitem__(self, i):
+        return self._mat()[i]
+
+    def __iter__(self):
+        return iter(self._mat())
+
+    def __getstate__(self):
+        return {"trees": self._mat()}
+
+    def __setstate__(self, state):
+        self._cache = state["trees"]
+        self._stacks = [
+            StackedTrees.from_trees([t[k] for t in self._cache])
+            for k in range(len(self._cache[0]))]
+
+
 class TreeList:
     """Lazy list-of-``Tree`` view over a ``StackedTrees``.
 
@@ -448,6 +484,73 @@ def make_tree_scan_fn(mode: str, tweedie_power: float, quantile_alpha: float,
     return jax.jit(scan_fn, donate_argnums=(3,))
 
 
+@functools.lru_cache(maxsize=None)
+def make_multinomial_scan_fn(K: int, max_depth: int, nbins: int, F: int,
+                             n_padded: int, hist_precision: str,
+                             sample_rate: float,
+                             col_sample_rate_per_tree: float,
+                             hier: bool = False, bin_counts=None):
+    """Scan a chunk of multinomial boosting rounds in ONE dispatch.
+
+    Each round grows K one-vs-rest trees on softmax gradients
+    (GBM.java buildNextKTrees' K-tree loop), all inside the scan body —
+    the multinomial analog of make_tree_scan_fn.  Rows are sampled once
+    per round and shared across the K class trees (reference semantics).
+    Returns (F_final [N, K], levels with leading [T, K, ...] dims, values
+    [T, K, 2^depth], covers [T, K, 2^depth]).
+    """
+    bt_fn = make_build_tree_fn(max_depth, nbins, F, n_padded,
+                               hist_precision, hier=hier,
+                               bin_counts=bin_counts)
+
+    def scan_fn(codes, Y1, w, F0, edges_mat, keys, reg_lambda, min_rows,
+                min_split_improvement, learn_rate, col_sample_rate,
+                reg_alpha, gamma, min_child_weight):
+        from .hist import table_lookup
+
+        def body(Fc, key_t):
+            ks, km, kb = jax.random.split(key_t, 3)
+            Pr = jax.nn.softmax(Fc, axis=1)
+            g = Pr - Y1
+            h = jnp.maximum(Pr * (1 - Pr), 1e-10)
+            wv = w
+            if sample_rate < 1.0:
+                wv = w * jax.random.bernoulli(ks, sample_rate, w.shape)
+            per_levels, per_vals, per_covers, dFs = [], [], [], []
+            for k in range(K):
+                kk = jax.random.fold_in(kb, k)
+                tm = jnp.ones((F,), bool)
+                if col_sample_rate_per_tree < 1.0:
+                    m = jax.random.uniform(
+                        jax.random.fold_in(km, k),
+                        (F,)) < col_sample_rate_per_tree
+                    tm = m.at[0].set(m[0] | ~m.any())
+                levels, vals, cover, leaf = bt_fn(
+                    codes, g[:, k] * wv, h[:, k] * wv, wv, edges_mat, kk,
+                    reg_lambda, min_rows, min_split_improvement,
+                    learn_rate, col_sample_rate, tm, reg_alpha, gamma,
+                    min_child_weight)
+                per_levels.append(levels)
+                per_vals.append(vals)
+                per_covers.append(cover)
+                dFs.append(table_lookup(vals[None, :], leaf,
+                                        vals.shape[0])[0])
+            Fc = Fc + jnp.stack(dFs, axis=1)
+            # stack class-k trees: per depth, each field gains a [K] dim
+            lv = tuple(
+                tuple(jnp.stack([per_levels[k][d][i] for k in range(K)])
+                      for i in range(4))
+                for d in range(max_depth))
+            vals = jnp.stack(per_vals)
+            covers = jnp.stack(per_covers)
+            return Fc, (lv, vals, covers)
+
+        Ff, (lv, vals, covers) = jax.lax.scan(body, F0, keys)
+        return Ff, list(lv), vals, covers
+
+    return jax.jit(scan_fn, donate_argnums=(3,))
+
+
 def chunk_schedule(ntrees: int, score_tree_interval: int,
                    chunk_cap: int = 10):
     """Yield (chunk_len, trees_done, score_now) for the scan driver loop.
@@ -689,6 +792,21 @@ class SharedTree(ModelBuilder):
             entry.update({f"valid_{k}": v for k, v in mv.describe().items()})
         history.append(entry)
         return m
+
+    def _interval_score(self, model, t_done, F, y, w, di, dist, history,
+                        vstate, metric_name, maximize) -> bool:
+        """Score at an interval boundary; True = early-stop now (the
+        shared tail of every fused chunk loop)."""
+        p = self.params
+        self._score_and_log(model, t_done, F, y, w, di, dist, history,
+                            vstate)
+        if not p.stopping_rounds:
+            return False
+        key = (f"valid_{metric_name}" if vstate is not None
+               else metric_name)
+        series = [hh.get(key) for hh in history if hh.get(key) is not None]
+        return bool(series and stop_early(series, p.stopping_rounds,
+                                          p.stopping_tolerance, maximize))
 
     def _scores_to_preds(self, F, dist, di):
         if di.is_classifier and di.nclasses > 2:

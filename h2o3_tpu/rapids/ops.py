@@ -155,6 +155,84 @@ def hist(vec: Vec, breaks: int = 20) -> Tuple[np.ndarray, np.ndarray]:
     return counts, edges
 
 
+def impute(frame: Frame, column: str, method: str = "mean",
+           combine_method: str = "interpolate") -> Frame:
+    """Fill a column's NAs in place of a new frame — AstImpute analog.
+
+    ``method``: mean | median | mode.  Numeric columns use mean/median;
+    categorical use mode (most frequent level).
+    """
+    v = frame.vec(column)
+    if v.type == T_CAT:
+        t = table(v)
+        if not t:
+            return frame
+        mode_lbl = max(t, key=t.get)
+        code = (v.domain or []).index(mode_lbl)
+        data = jnp.where(v.data < 0, code, v.data)
+        newv = Vec(data, T_CAT, v.nrows, domain=v.domain)
+        return frame.with_vec(column, newv)
+    qmethod = {"interpolate": "linear", "lo": "lower",
+               "hi": "higher", "low": "lower", "high": "higher",
+               "average": "linear"}.get(combine_method, "linear")
+    if v.type == T_TIME:
+        # fill in the EXACT host ms payload and rebuild (keeps time_base)
+        host = np.array(v.to_numpy(), copy=True)
+        finite = np.isfinite(host)
+        if not finite.any():
+            return frame
+        fill = float(np.nanquantile(host, 0.5, method=qmethod)) \
+            if method == "median" else float(host[finite].mean())
+        host[~finite] = fill
+        return frame.with_vec(column, Vec.from_numpy(host, T_TIME))
+    if method == "median":
+        x = v.to_numpy()
+        fill = float(np.nanquantile(x, 0.5, method=qmethod)) \
+            if np.isfinite(x).any() else 0.0
+    else:
+        fill = v.mean()
+    data = jnp.where(jnp.isnan(v.data), jnp.float32(fill), v.data)
+    return frame.with_vec(column, Vec(data, v.type, v.nrows))
+
+
+def cut(vec: Vec, breaks: Sequence[float],
+        labels: Optional[Sequence[str]] = None,
+        include_lowest: bool = False, right: bool = True) -> Vec:
+    """Numeric -> categorical by interval — AstCut analog."""
+    edges = jnp.asarray(list(breaks), jnp.float32)
+    x = vec.data
+    idx = jnp.searchsorted(edges, x, side="left" if right else "right") - 1
+    nb = len(breaks) - 1
+    if include_lowest:
+        idx = jnp.where(x == edges[0], 0, idx)
+    bad = jnp.isnan(x) | (idx < 0) | (idx >= nb)
+    codes = jnp.where(bad, -1, idx).astype(jnp.int32)
+    if labels is None:
+        b = list(breaks)
+        if right:
+            lb0 = "[" if include_lowest else "("
+            labels = [f"{lb0 if i == 0 else '('}{b[i]},{b[i+1]}]"
+                      for i in range(nb)]
+        else:
+            labels = [f"[{b[i]},{b[i+1]})" for i in range(nb)]
+    return Vec(codes, T_CAT, vec.nrows, domain=list(labels))
+
+
+def scale(frame: Frame, center: bool = True,
+          scale_: bool = True) -> Frame:
+    """Standardize numeric columns — AstScale analog (device pass)."""
+    vecs = []
+    for v in frame.vecs:
+        if v.type == T_NUM:
+            r = v.rollups()
+            mu = r.mean if center else 0.0
+            sd = r.sigma if (scale_ and r.sigma and r.sigma > 0) else 1.0
+            vecs.append(Vec((v.data - mu) / sd, T_NUM, v.nrows))
+        else:
+            vecs.append(v)
+    return Frame(frame.names, vecs)
+
+
 # ---------------------------------------------------------------- group-by
 _AGGS = ("count", "sum", "mean", "min", "max", "var", "sd")
 

@@ -131,6 +131,25 @@ def test_string_ops(cl):
     assert list(lo.decoded()) == ["a", "a", "b"]
 
 
+def test_impute_cut_scale(cl):
+    from h2o3_tpu.frame.vec import Vec, T_CAT
+    from h2o3_tpu.rapids import impute, cut, scale
+    fr = Frame.from_numpy({"x": np.array([1.0, np.nan, 3.0, np.nan])})
+    gv = Vec.from_numpy(np.array([0, 0, -1, 1], np.int32), T_CAT,
+                        domain=["a", "b"])
+    fr = fr.with_vec("g", gv)
+    np.testing.assert_allclose(impute(fr, "x").vec("x").to_numpy(),
+                               [1, 2, 3, 2])
+    np.testing.assert_allclose(
+        impute(fr, "x", method="median").vec("x").to_numpy(), [1, 2, 3, 2])
+    assert list(impute(fr, "g").vec("g").decoded()) == ["a", "a", "a", "b"]
+    c = cut(fr.vec("x"), [0.0, 2.0, 4.0])
+    assert list(c.decoded()) == ["(0.0,2.0]", None, "(2.0,4.0]", None]
+    s = scale(Frame.from_numpy({"x": np.arange(10.0)}))
+    x = s.vec("x").to_numpy()
+    assert abs(x.mean()) < 1e-6 and abs(x.std(ddof=1) - 1) < 1e-5
+
+
 def test_tree_varimp(cl, rng):
     from h2o3_tpu.models import GBM
     n = 1500
