@@ -228,6 +228,7 @@ class ModelBuilder:
                 model = self._fit(job, frame, di, valid)
             model.output.setdefault("run_time_s", time.time() - t0)
             model.output.setdefault("training_frame_rows", frame.nrows)
+            self._post_fit(model, frame, valid)
             if self.params.export_checkpoints_dir:
                 import os
                 os.makedirs(self.params.export_checkpoints_dir, exist_ok=True)
@@ -236,6 +237,10 @@ class ModelBuilder:
             return model
 
         return self.job.run(_driver)
+
+    def _post_fit(self, model: Model, frame: Frame,
+                  valid: Optional[Frame]) -> None:
+        """Hook after _fit (calibration, etc.); default no-op."""
 
     # -- cross-validation (hex/CVModelBuilder.java:10) -----------------------
     def _train_cv(self, job: Job, frame: Frame, di: DataInfo,

@@ -67,6 +67,21 @@ class GBM(SharedTree):
     def __init__(self, params: Optional[GBMParameters] = None, **kw):
         super().__init__(params or GBMParameters(**kw))
 
+    def _finalize_fused(self, model, di, dist, F, y, w, valid, history,
+                        binned, init_host, ntrees, stacked, trees):
+        """Shared fused-path epilogue (single-class and multinomial)."""
+        model.output["stacked"] = stacked
+        model.output["trees"] = trees
+        model.output["init_score"] = init_host
+        model.output["ntrees_trained"] = ntrees
+        model.output["edges"] = binned.edges
+        model.scoring_history = history
+        model.training_metrics = make_metrics(
+            di, self._scores_to_preds(F, dist, di), y, w)
+        if valid is not None:
+            model.validation_metrics = model.model_performance(valid)
+        return model
+
     def _fit(self, job: Job, frame: Frame, di: DataInfo,
              valid: Optional[Frame]) -> GBMModel:
         p: GBMParameters = self.params
@@ -219,17 +234,10 @@ class GBM(SharedTree):
                     break
             from .shared import TreeListMulti
             stacks = [StackedTrees.concat(ch) for ch in chunks_k]
-            model.output["stacked"] = stacks
-            model.output["trees"] = TreeListMulti(stacks)
-            model.output["init_score"] = init_host
-            model.output["ntrees_trained"] = stacks[0].ntrees
-            model.output["edges"] = binned.edges
-            model.scoring_history = history
-            model.training_metrics = make_metrics(
-                di, self._scores_to_preds(F, dist, di), y, w)
-            if valid is not None:
-                model.validation_metrics = model.model_performance(valid)
-            return model
+            return self._finalize_fused(
+                model, di, dist, F, y, w, valid, history, binned, init_host,
+                stacks[0].ntrees, stacked=stacks,
+                trees=TreeListMulti(stacks))
 
         if fused:
             # fast path: scan a whole scoring interval of trees per dispatch
@@ -263,17 +271,9 @@ class GBM(SharedTree):
                                         maximize):
                     break
             stacked = StackedTrees.concat(chunks)
-            model.output["stacked"] = stacked
-            model.output["trees"] = TreeList(stacked)
-            model.output["init_score"] = init_host
-            model.output["ntrees_trained"] = stacked.ntrees
-            model.output["edges"] = binned.edges
-            model.scoring_history = history
-            model.training_metrics = make_metrics(
-                di, self._scores_to_preds(F, dist, di), y, w)
-            if valid is not None:
-                model.validation_metrics = model.model_performance(valid)
-            return model
+            return self._finalize_fused(
+                model, di, dist, F, y, w, valid, history, binned, init_host,
+                stacked.ntrees, stacked=stacked, trees=TreeList(stacked))
 
         if prior is not None:
             # materialized per-tree list continuation (DART / multinomial).

@@ -60,6 +60,10 @@ class SharedTreeParameters(Parameters):
     standardize: bool = False            # trees never standardize
     hist_precision: str = "bf16"         # f32 for exact reproducibility
     split_search: str = "auto"           # auto | exact | hier (see shared.py)
+    # probability calibration (hex/tree CalibrationHelper)
+    calibrate_model: bool = False
+    calibration_frame: Optional[object] = None
+    calibration_method: str = "platt"    # platt | isotonic
 
 
 @dataclasses.dataclass
@@ -605,6 +609,33 @@ def build_tree(codes, g, h, w, edges, nbins: int, max_depth: int,
 class SharedTreeModel(Model):
     """Tree-ensemble model: scores via compiled stacked-tree traversal."""
 
+    def _calibration_curve(self, p1: np.ndarray) -> np.ndarray:
+        cal = self.output.get("calibration")
+        if cal is None:
+            raise ValueError("model was not calibrated "
+                             "(calibrate_model=True + calibration_frame)")
+        if cal["method"] == "platt":
+            return 1.0 / (1.0 + np.exp(-(cal["a"] * p1 + cal["b"])))
+        return np.interp(p1, cal["x"], cal["y"])
+
+    def calibrated_probabilities(self, frame: Frame) -> np.ndarray:
+        """P(class 1) after calibration — CalibrationHelper.predict."""
+        raw = np.asarray(self._predict_raw(
+            self._score_matrix(frame)))[: frame.nrows]
+        return self._calibration_curve(raw[:, 1] if raw.ndim == 2 else raw)
+
+    def predict(self, frame: Frame) -> Frame:
+        out = super().predict(frame)
+        if self.output.get("calibration") is not None:
+            from ...frame.vec import Vec
+            # reuse the class-1 probability column already computed —
+            # no second traversal of the ensemble
+            dom = self.datainfo.response_domain
+            p1 = self._calibration_curve(out.vec(str(dom[1])).to_numpy())
+            out = out.with_vec("cal_p0", Vec.from_numpy(1.0 - p1))
+            out = out.with_vec("cal_p1", Vec.from_numpy(p1))
+        return out
+
     def varimp(self, frame: Optional[Frame] = None,
                method: str = "cover") -> dict:
         """Variable importances — hex/tree VarImp analog.
@@ -769,6 +800,64 @@ def prior_stacked(prior, k: Optional[int] = None) -> "StackedTrees":
 
 class SharedTree(ModelBuilder):
     """Common driver: binning, main loop, scoring, early stopping."""
+
+    def _validate(self, frame) -> None:
+        super()._validate(frame)
+        p = self.params
+        if getattr(p, "calibrate_model", False):
+            # fail BEFORE training, not after (CalibrationHelper checks)
+            if getattr(p, "calibration_frame", None) is None:
+                raise ValueError(
+                    "calibrate_model=True needs calibration_frame")
+            if getattr(p, "calibration_method", "platt") not in (
+                    "platt", "isotonic"):
+                raise ValueError("calibration_method: platt | isotonic")
+            rc = p.response_column
+            dom = frame.vec(rc).domain if rc in frame.names else None
+            if dom is not None and len(dom) != 2:
+                raise ValueError("calibration supports binomial models only")
+
+    def _post_fit(self, model, frame, valid) -> None:
+        """Probability calibration on a held-out frame —
+        hex/tree/CalibrationHelper (Platt scaling / isotonic)."""
+        p = self.params
+        if not getattr(p, "calibrate_model", False):
+            return
+        cal_fr = p.calibration_frame
+        di = model.datainfo
+        if not di.is_classifier or di.nclasses != 2:
+            raise ValueError("calibration supports binomial models only")
+        raw = np.asarray(model._predict_raw(
+            model._score_matrix(cal_fr)))[: cal_fr.nrows]
+        p1 = np.clip(raw[:, 1] if raw.ndim == 2 else raw, 1e-12, 1 - 1e-12)
+        y = np.asarray(di.response(cal_fr))[: cal_fr.nrows]
+        ok = np.isfinite(y)
+        p1, y = p1[ok], y[ok]
+        if p.calibration_method == "isotonic":
+            from ..isotonic import _pav
+            order = np.argsort(p1)
+            ys = _pav(y[order].astype(np.float64),
+                      np.ones(len(y), np.float64))
+            model.output["calibration"] = {
+                "method": "isotonic", "x": p1[order], "y": ys}
+        else:
+            # Platt: logistic regression of y on the raw score (1-D IRLS)
+            a, b = 1.0, 0.0
+            for _ in range(25):
+                eta = a * p1 + b
+                mu = 1.0 / (1.0 + np.exp(-eta))
+                wq = np.maximum(mu * (1 - mu), 1e-9)
+                z = eta + (y - mu) / wq
+                X2 = np.stack([p1, np.ones_like(p1)], axis=1)
+                A = (X2 * wq[:, None]).T @ X2
+                rhs = (X2 * wq[:, None]).T @ z
+                sol = np.linalg.solve(A + 1e-9 * np.eye(2), rhs)
+                if abs(sol[0] - a) + abs(sol[1] - b) < 1e-9:
+                    a, b = float(sol[0]), float(sol[1])
+                    break
+                a, b = float(sol[0]), float(sol[1])
+            model.output["calibration"] = {"method": "platt",
+                                           "a": a, "b": b}
 
     def _make_datainfo(self, frame: Frame) -> DataInfo:
         p = self.params

@@ -155,6 +155,45 @@ def hist(vec: Vec, breaks: int = 20) -> Tuple[np.ndarray, np.ndarray]:
     return counts, edges
 
 
+def interaction(frame: Frame, factors: Sequence[str], pairwise: bool = True,
+                max_factors: int = 100, min_occurrence: int = 1) -> Frame:
+    """Categorical interaction columns — hex/Interaction analog.
+
+    ``pairwise``: one column per factor pair; otherwise a single column
+    over the full tuple.  Levels rank by frequency; beyond ``max_factors``
+    (or under ``min_occurrence``) they collapse into "other".
+    """
+    from itertools import combinations
+    factors = list(factors)
+    for f in factors:
+        if frame.vec(f).type != T_CAT:
+            raise ValueError(f"interaction factor {f!r} must be categorical")
+    if pairwise and len(factors) >= 2:
+        groups = list(combinations(factors, 2))
+    else:
+        groups = [tuple(factors)]
+    out = frame
+    for grp in groups:
+        labels = None
+        for f in grp:
+            v = frame.vec(f)
+            dec = v.decoded()
+            part = np.asarray(["NA" if x is None else str(x) for x in dec],
+                              dtype=object)
+            labels = part if labels is None else \
+                np.asarray([a + "_" + b for a, b in zip(labels, part)],
+                           dtype=object)
+        uniq, counts = np.unique(labels, return_counts=True)
+        order = np.argsort(-counts)
+        keep = [u for u, c in zip(uniq[order], counts[order])
+                if c >= min_occurrence][:max_factors]
+        keepset = set(keep)
+        col = np.asarray([x if x in keepset else "other" for x in labels],
+                         dtype=object)
+        out = out.with_vec("_".join(grp), Vec.from_numpy(col, T_CAT))
+    return out
+
+
 def impute(frame: Frame, column: str, method: str = "mean",
            combine_method: str = "interpolate") -> Frame:
     """Fill a column's NAs in place of a new frame — AstImpute analog.
@@ -163,6 +202,10 @@ def impute(frame: Frame, column: str, method: str = "mean",
     categorical use mode (most frequent level).
     """
     v = frame.vec(column)
+    if method not in ("mean", "median", "mode"):
+        raise ValueError(f"impute method {method!r}: mean | median | mode")
+    if v.type != T_CAT and method == "mode":
+        raise ValueError("impute method='mode' is for categorical columns")
     if v.type == T_CAT:
         t = table(v)
         if not t:

@@ -72,6 +72,41 @@ def test_segment_models(cl, rng):
         assert m.coef["x"] == pytest.approx(want, abs=0.05)
 
 
+def test_tree_calibration(cl, rng):
+    """Platt/isotonic calibration — hex/tree CalibrationHelper analog."""
+    n = 3000
+    X = rng.normal(size=(n, 3))
+    y = (X[:, 0] + X[:, 1] > 0.3)
+    fr = Frame.from_numpy({**{f"x{j}": X[:, j] for j in range(3)},
+                           "y": np.where(y, "Y", "N").astype(object)})
+    from h2o3_tpu.models import GBM
+    tr, cal = fr.split_frame([0.7], seed=2)
+    yv = (cal.vec("y").decoded() == "Y").astype(float)
+    for method in ("platt", "isotonic"):
+        m = GBM(response_column="y", ntrees=15, max_depth=4, seed=1,
+                calibrate_model=True, calibration_frame=cal,
+                calibration_method=method).train(tr)
+        p1 = m.calibrated_probabilities(cal)
+        assert abs(p1.mean() - yv.mean()) < 0.03
+        pred = m.predict(cal)
+        assert "cal_p1" in pred.names and "cal_p0" in pred.names
+
+
+def test_interaction_columns(cl, rng):
+    from h2o3_tpu.rapids import interaction
+    n = 2000
+    g1 = np.array(["a", "b"], dtype=object)[rng.integers(0, 2, n)]
+    g2 = np.array(["x", "y", "z"], dtype=object)[rng.integers(0, 3, n)]
+    fr = Frame.from_numpy({"g1": g1, "g2": g2})
+    out = interaction(fr, ["g1", "g2"])
+    assert "g1_g2" in out.names
+    assert out.vec("g1_g2").cardinality == 6
+    dec = out.vec("g1_g2").decoded()
+    assert all(d == f"{a}_{b}" for d, a, b in zip(dec, g1, g2))
+    capped = interaction(fr, ["g1", "g2"], max_factors=3)
+    assert capped.vec("g1_g2").cardinality <= 4   # 3 + "other"
+
+
 def test_psvm_nonlinear_boundary(cl, rng):
     """RBF-kernel SVM separates the circle a linear model cannot."""
     from h2o3_tpu.models import PSVM
