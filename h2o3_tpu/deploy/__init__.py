@@ -1,0 +1,1 @@
+"""Deployment entry points (launcher analog of the reference's h2oapp)."""

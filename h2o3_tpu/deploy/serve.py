@@ -1,0 +1,55 @@
+"""Launcher: boot the runtime + REST server — the water.H2OApp analog.
+
+Single host:   python -m h2o3_tpu.deploy.serve --port 54321
+Multi-host:    ... --coordinator host:port --num-processes N --process-id I
+(REST serves from process 0; workers join the mesh and block.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser("h2o3_tpu.deploy.serve")
+    ap.add_argument("--port", type=int, default=54321)
+    ap.add_argument("--coordinator", default=None,
+                    help="host:port of process 0 (multi-host)")
+    ap.add_argument("--num-processes", type=int, default=None)
+    ap.add_argument("--process-id", type=int, default=None)
+    ap.add_argument("--username", default="")
+    ap.add_argument("--password", default="")
+    args = ap.parse_args(argv)
+
+    import os
+    import jax
+    if os.environ.get("JAX_PLATFORMS"):
+        # some images pre-import jax with a baked-in platform (e.g. a TPU
+        # plugin from sitecustomize); the env var must win for the launcher
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import h2o3_tpu
+    cl = h2o3_tpu.init(coordinator=args.coordinator,
+                       num_processes=args.num_processes,
+                       process_id=args.process_id)
+    import jax
+    if jax.process_index() == 0:
+        from h2o3_tpu.api.server import start_server
+        server = start_server(port=args.port, username=args.username,
+                              password=args.password)
+        print(f"h2o3_tpu serving on {server.url} "
+              f"(mesh: {dict(cl.mesh.shape)})", flush=True)
+    else:
+        print(f"h2o3_tpu worker {jax.process_index()} joined "
+              f"(mesh: {dict(cl.mesh.shape)})", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -95,3 +95,37 @@ def test_unknown_routes_404(cl, server):
     with pytest.raises(urllib.error.HTTPError) as e:
         _get(server, "/3/Frames/not_a_frame")
     assert e.value.code == 404
+
+
+def test_deploy_serve_launcher(cl, tmp_path):
+    """The launcher boots the runtime + REST and shuts down on SIGTERM."""
+    import json
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time
+    import urllib.request
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    p = subprocess.Popen(
+        [sys.executable, "-m", "h2o3_tpu.deploy.serve", "--port", "54391"],
+        env=env, cwd="/root/repo",
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    try:
+        for _ in range(90):
+            time.sleep(1)
+            try:
+                out = json.load(urllib.request.urlopen(
+                    "http://127.0.0.1:54391/3/Cloud", timeout=2))
+                assert out["cloud_healthy"]
+                break
+            except AssertionError:
+                raise
+            except Exception:
+                continue
+        else:
+            raise AssertionError("launcher never served /3/Cloud")
+    finally:
+        p.send_signal(signal.SIGTERM)
+        assert p.wait(timeout=15) == 0
