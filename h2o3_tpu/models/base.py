@@ -219,7 +219,12 @@ class ModelBuilder:
         self._validate(frame)
         di = self._make_datainfo(frame)
         self.job = Job(f"{self.algo} train", dest_key=dkv.make_key(self.algo))
+        return self.job.run(self._make_driver(frame, di, valid))
 
+    def _make_driver(self, frame: Frame, di: DataInfo,
+                     valid: Optional[Frame]):
+        """The full training driver (CV, post-fit hooks, checkpoint export)
+        shared by the blocking and async entry points."""
         def _driver(job: Job) -> Model:
             t0 = time.time()
             if self.params.nfolds and self.params.nfolds > 1:
@@ -235,12 +240,29 @@ class ModelBuilder:
                 model.save(os.path.join(self.params.export_checkpoints_dir,
                                         model.key + ".bin"))
             return model
-
-        return self.job.run(_driver)
+        return _driver
 
     def _post_fit(self, model: Model, frame: Frame,
                   valid: Optional[Frame]) -> None:
         """Hook after _fit (calibration, etc.); default no-op."""
+
+    def train_async(self, frame: Frame, valid: Optional[Frame] = None,
+                    priority: Optional[int] = None) -> Job:
+        """Queue training on the priority scheduler; returns the Job.
+
+        The h2o.train(..., async) analog over the F/J-pool replacement
+        (runtime/job.JobScheduler): poll ``job.status`` / ``/3/Jobs`` or
+        ``job.join()`` for the model.
+        """
+        from ..runtime.job import scheduler, JobScheduler
+        self._validate(frame)
+        di = self._make_datainfo(frame)
+        self.job = Job(f"{self.algo} train",
+                       dest_key=dkv.make_key(self.algo))
+        return scheduler().submit(
+            self.job, self._make_driver(frame, di, valid),
+            priority=JobScheduler.PRIORITY_BUILD
+            if priority is None else priority)
 
     # -- cross-validation (hex/CVModelBuilder.java:10) -----------------------
     def _train_cv(self, job: Job, frame: Frame, di: DataInfo,

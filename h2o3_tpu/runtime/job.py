@@ -12,6 +12,7 @@ thread (``start(fn)``) for the async ``h2o.train(..., async)`` pattern.
 
 from __future__ import annotations
 
+import heapq
 import threading
 import time
 import traceback
@@ -44,6 +45,8 @@ class Job:
         self.start_time: Optional[float] = None
         self.end_time: Optional[float] = None
         self._cancel_requested = threading.Event()
+        self._done = threading.Event()
+        self._queued = False                 # on a scheduler queue
         self._thread: Optional[threading.Thread] = None
         self.result: Any = None
         dkv.put(self.key, self)
@@ -70,6 +73,7 @@ class Job:
             raise
         finally:
             self.end_time = time.time()
+            self._done.set()
             record("job_end", job=self.key, status=self.status,
                    duration_s=round(self.run_time, 4))
 
@@ -85,8 +89,13 @@ class Job:
         return self
 
     def join(self, timeout: Optional[float] = None) -> Any:
+        """Wait for completion (threaded OR scheduler-queued runs).
+
+        A job that was never started or queued returns immediately."""
         if self._thread is not None:
             self._thread.join(timeout)
+        elif self._queued or self.status != CREATED:
+            self._done.wait(timeout)
         if self.status == FAILED:
             raise self.exception
         return self.result
@@ -126,3 +135,81 @@ class Job:
 def list_jobs() -> list:
     """All jobs in the DKV — the `/3/Jobs` analog."""
     return [dkv.get(k) for k in dkv.keys("job_")]
+
+
+# ---------------------------------------------------------------- scheduler
+class JobScheduler:
+    """Priority work queue — the H2O.submitTask / F/J priority-pool analog.
+
+    The reference runs MRTasks on fork/join pools indexed by priority so
+    admin/interactive tasks never starve behind long builds
+    (water/H2O.java H2OCountedCompleter priorities).  Here the DEVICE is
+    the scarce resource and jit dispatch is serialized anyway, so the
+    scheduler is a small thread pool draining a heap: lower ``priority``
+    value runs first, FIFO within a level.
+    """
+
+    #: reference-like priority levels
+    PRIORITY_ADMIN = 0
+    PRIORITY_INTERACTIVE = 50
+    PRIORITY_BUILD = 100
+
+    def __init__(self, workers: int = 2):
+        self._heap: list = []
+        self._cv = threading.Condition()
+        self._seq = 0
+        self._shutdown = False
+        self._threads = [
+            threading.Thread(target=self._worker, daemon=True,
+                             name=f"job-scheduler-{i}")
+            for i in range(max(workers, 1))]
+        for t in self._threads:
+            t.start()
+
+    def submit(self, job: "Job", fn: Callable[["Job"], Any],
+               priority: int = PRIORITY_BUILD) -> "Job":
+        """Queue ``fn(job)``; returns the job immediately (poll/join it)."""
+        with self._cv:
+            if self._shutdown:
+                raise RuntimeError("job scheduler is stopped")
+            job._queued = True
+            self._seq += 1
+            heapq.heappush(self._heap, (priority, self._seq, job, fn))
+            self._cv.notify()
+        return job
+
+    def _worker(self):
+        while True:
+            with self._cv:
+                while not self._heap and not self._shutdown:
+                    self._cv.wait()
+                if not self._heap:        # shutdown with a drained queue
+                    return
+                _, _, job, fn = heapq.heappop(self._heap)
+            try:
+                job.run(fn)
+            except BaseException:
+                pass                      # recorded on the job
+
+    def stop(self):
+        """Stop accepting work; workers drain what is already queued."""
+        global _scheduler
+        with self._cv:
+            self._shutdown = True
+            self._cv.notify_all()
+        with _sched_lock:
+            if _scheduler is self:
+                _scheduler = None
+
+
+_scheduler: Optional[JobScheduler] = None
+_sched_lock = threading.Lock()
+
+
+def scheduler() -> JobScheduler:
+    """Process-wide scheduler, created on first use."""
+    global _scheduler
+    with _sched_lock:
+        if _scheduler is None:
+            _scheduler = JobScheduler()
+        return _scheduler

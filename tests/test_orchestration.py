@@ -142,3 +142,30 @@ def test_automl_resume_from_recovery_dir(cl, rng, tmp_path):
     new_steps = [e["step"] for e in a2.events if "model" in e]
     assert not set(done1) & set(new_steps), (done1, new_steps)
     assert len(a2.models) >= 4
+
+
+def test_job_scheduler_priorities(cl, rng):
+    """Priority scheduler (F/J pool analog): async training + priority
+    queue-jumping + Job.join on scheduler-run jobs."""
+    from h2o3_tpu.models import GLM
+    from h2o3_tpu.runtime.job import scheduler, JobScheduler, Job
+    n = 600
+    X = rng.normal(size=(n, 3))
+    fr = _frame_for_sched(X, rng)
+    jobs = [GLM(response_column="y", family="gaussian").train_async(fr)
+            for _ in range(2)]
+    done = []
+    aj = scheduler().submit(Job("admin ping"), lambda j: done.append(1),
+                            priority=JobScheduler.PRIORITY_ADMIN)
+    models = [j.join(timeout=180) for j in jobs]
+    aj.join(timeout=10)
+    assert done == [1]
+    assert all(j.status == "DONE" for j in jobs)
+    assert all(m.training_metrics.r2 > 0.99 for m in models)
+
+
+def _frame_for_sched(X, rng):
+    import numpy as _np
+    from h2o3_tpu import Frame as _F
+    y = X @ [1.0, -1.0, 2.0] + 0.01 * rng.normal(size=len(X))
+    return _F.from_numpy({**{f"x{j}": X[:, j] for j in range(3)}, "y": y})
