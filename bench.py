@@ -19,6 +19,7 @@ secondary metrics.
 """
 
 import json
+import os
 import time
 
 import numpy as np
@@ -26,8 +27,9 @@ import numpy as np
 REFERENCE_TREES_PER_SEC = 4.5     # best of the reference gpu_hist interval
 REFERENCE_SORT_10M_S = 2.0        # best of Jenkins sort interval (10M rows)
 REFERENCE_MERGE_10M_S = 4.0       # best of Jenkins merge interval (10M rows)
-N_ROWS = 10_000_000
-N_TREES = 50
+# H2O3_BENCH_ROWS/TREES: smoke-test overrides (CI runs the full shape)
+N_ROWS = int(os.environ.get("H2O3_BENCH_ROWS", 10_000_000))
+N_TREES = int(os.environ.get("H2O3_BENCH_TREES", 50))
 
 
 def make_airlines_like(n):
@@ -74,7 +76,7 @@ def bench_trees(Frame, T_CAT, XGBoost):
 
 def bench_deeplearning(Frame, DeepLearning):
     """MNIST-shape MLP throughput (samples/sec/chip)."""
-    n, d = 60_000, 784
+    n, d = min(60_000, max(N_ROWS, 4_096)), 784
     rng = np.random.default_rng(1)
     X = (rng.random((n, d)) * 255).astype(np.float32)
     y = rng.integers(0, 10, n)
@@ -85,7 +87,7 @@ def bench_deeplearning(Frame, DeepLearning):
               mini_batch_size=512, score_interval=1e9, stopping_rounds=0,
               seed=1)
     DeepLearning(epochs=0.2, **kw).train(fr)          # compile warmup
-    epochs = 3.0
+    epochs = 3.0 if N_ROWS >= 1_000_000 else 0.5      # smoke override
     t0 = time.time()
     DeepLearning(epochs=epochs, **kw).train(fr)
     dt = time.time() - t0

@@ -83,7 +83,7 @@ class AdaBoost(SharedTree):
                 codes, -ysign * D, D, D, edges_mat, p.nbins, p.max_depth,
                 p.reg_lambda, p.min_rows / max(frame.nrows, 1),
                 p.min_split_improvement, 1.0, k, p.col_sample_rate, None,
-                hist_precision=p.hist_precision)
+                hist_precision=p.effective_hist_precision)
             h = jnp.sign(jnp.asarray(tree.values)[leaf])
             h = jnp.where(h == 0, 1.0, h)
             err = jnp.sum(D * (h != ysign) * (w0 > 0))

@@ -326,6 +326,14 @@ class GLMModel(Model):
         if family == "multinomial":
             probs = jax.nn.softmax(X @ beta, axis=1)
             return probs
+        if family == "ordinal":
+            thetas = jnp.asarray(self.output["ordinal_thresholds"])
+            eta = X @ beta                    # intercept col has beta 0
+            cdf = jax.nn.sigmoid(thetas[None, :] - eta[:, None])
+            cdf = jnp.concatenate(
+                [jnp.zeros((cdf.shape[0], 1)), cdf,
+                 jnp.ones((cdf.shape[0], 1))], axis=1)
+            return jnp.clip(jnp.diff(cdf, axis=1), 0.0, 1.0)
         eta = X @ beta
         fam = _make_family(family, self.params)
         mu = fam.linkinv(eta)
@@ -362,6 +370,9 @@ class GLM(ModelBuilder):
             raise ValueError(f"family={fam} needs a categorical response")
         if fam == "multinomial" and di.nclasses < 3:
             fam = "binomial"
+        if fam == "ordinal" and (not di.is_classifier or di.nclasses < 3):
+            raise ValueError("family=ordinal needs a categorical response "
+                             "with 3+ ordered levels")
         return fam
 
     def _fit(self, job: Job, frame: Frame, di: DataInfo,
@@ -385,6 +396,10 @@ class GLM(ModelBuilder):
                 if f is not None:
                     penalize[spec.offset: spec.offset + spec.width] = f
 
+        if fam_name == "ordinal":
+            lam0 = 0.0 if p.lambda_ is None else float(np.max(p.lambda_))
+            return self._fit_ordinal(job, frame, di, X, y, w, offset, n,
+                                     lam0, valid)
         lambdas = self._lambda_path(p, X, y, w, di, fam_name)
         if fam_name == "multinomial":
             model = self._fit_multinomial(job, frame, di, X, y, w, offset, n,
@@ -476,6 +491,108 @@ class GLM(ModelBuilder):
         self._finalize(model, di, beta, fam_name, X, y, w, offset, n,
                        float(dev), hist, lamf, frame, valid,
                        gram_last=np.asarray(gram, np.float64))
+        return model
+
+    # ----------------------------------------------------------- ordinal
+    def _fit_ordinal(self, job, frame, di, X, y, w, offset, n, lam,
+                     valid) -> "GLMModel":
+        """Proportional-odds (cumulative logit) — GLM.java family=ordinal.
+
+        P(y <= j) = sigmoid(theta_j - X beta) with ordered thresholds,
+        fit jointly by L-BFGS on the NLL inside one jit scan; thresholds
+        are parameterized as theta_0 + cumulative softplus gaps so the
+        ordering constraint holds by construction.
+        """
+        import optax
+        p: GLMParameters = self.params
+        K = di.nclasses
+        P = di.nfeatures
+        # drop the intercept column (absorbed into the thresholds)
+        has_icpt = di.add_intercept
+        Xf = X[:, :-1] if has_icpt else X
+        Pf = Xf.shape[1]
+        yi = jnp.clip(y.astype(jnp.int32), 0, K - 1)
+        lamf = float(lam)
+
+        def unpack(params):
+            beta = params[:Pf]
+            t0 = params[Pf]
+            gaps = jax.nn.softplus(params[Pf + 1:])
+            thetas = t0 + jnp.concatenate(
+                [jnp.zeros(1), jnp.cumsum(gaps)])
+            return beta, thetas
+
+        def nll_fn(params):
+            beta, thetas = unpack(params)
+            eta = Xf @ beta + offset
+            # cdf_j = P(y <= j), j = 0..K-2; boundaries 0 and 1 appended
+            cdf = jax.nn.sigmoid(thetas[None, :] - eta[:, None])
+            cdf = jnp.concatenate(
+                [jnp.zeros((cdf.shape[0], 1)), cdf,
+                 jnp.ones((cdf.shape[0], 1))], axis=1)
+            probs = jnp.clip(jnp.diff(cdf, axis=1), 1e-12, 1.0)
+            pick = jnp.take_along_axis(probs, yi[:, None], 1)[:, 0]
+            return -jnp.sum(w * jnp.log(pick)) / n
+
+        def obj(params):
+            beta, _ = unpack(params)
+            return nll_fn(params) + 0.5 * lamf * jnp.sum(beta ** 2)
+
+        opt = optax.lbfgs()
+        vg = optax.value_and_grad_from_state(obj)
+        iters = int(min(p.max_iterations * 4, 200))
+
+        @jax.jit
+        def run(p0):
+            state = opt.init(p0)
+
+            def step(carry, _):
+                prm, st = carry
+                value, grad = vg(prm, state=st)
+                upd, st = opt.update(grad, st, prm, value=value, grad=grad,
+                                     value_fn=obj)
+                return (optax.apply_updates(prm, upd), st), value
+            (prm, _), values = jax.lax.scan(step, (p0, state), None,
+                                            length=iters)
+            return prm, values
+
+        p0 = jnp.concatenate([jnp.zeros(Pf),
+                              jnp.asarray([-1.0]),
+                              jnp.full(K - 2, 0.5)]).astype(jnp.float32)
+        prm, values = run(p0)
+        beta, thetas = unpack(prm)
+        final_nll = float(nll_fn(prm))     # penalty-free, at the FINAL point
+
+        model = GLMModel(job.dest_key or dkv.make_key(self.algo), p, di)
+        beta_full = np.zeros(P)
+        beta_full[:Pf] = np.asarray(beta, np.float64)
+        # destandardize for reporting (what _finalize does elsewhere)
+        beta_orig = beta_full.copy()
+        if di.standardize:
+            ci = 0
+            for spec in di.specs:
+                if spec.type != "cat" and spec.width == 1 \
+                        and ci < Pf and spec.sigma:
+                    beta_orig[ci] = beta_full[ci] / spec.sigma
+                ci += spec.width
+        model.output.update({
+            "family": "ordinal",
+            "beta_std": beta_full,
+            "ordinal_thresholds": np.asarray(thetas, np.float64),
+            "coef_names": di.coef_names,
+            "beta_std_flat": beta_full.tolist(),
+            "beta": beta_orig.tolist(),
+            "iterations": iters,
+            "residual_deviance": final_nll * 2 * n,
+        })
+        model.scoring_history = [
+            {"iteration": i, "deviance": float(v) * 2 * n}
+            for i, v in enumerate(np.asarray(values[-5:]))]
+        from ..metrics.core import make_metrics
+        raw = model._predict_raw(X)
+        model.training_metrics = make_metrics(di, raw, y, w)
+        if valid is not None:
+            model.validation_metrics = model.model_performance(valid)
         return model
 
     # ------------------------------------------------------- single-class

@@ -142,7 +142,7 @@ class DRF(SharedTree):
         from .shared import use_hier_split_search
         scan_fn = make_tree_scan_fn(
             "drf", 0.0, 0.0, 0.0, p.max_depth, p.nbins, Fnum, N,
-            p.hist_precision, p.sample_rate, 1.0,
+            p.effective_hist_precision, p.sample_rate, 1.0,
             hier=use_hier_split_search(p, N),
             bin_counts=binned.bin_counts)
         scalars = (p.reg_lambda, p.min_rows, p.min_split_improvement, 1.0,

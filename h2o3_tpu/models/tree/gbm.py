@@ -201,7 +201,7 @@ class GBM(SharedTree):
             from .shared import make_multinomial_scan_fn
             scan_fn = make_multinomial_scan_fn(
                 K, p.max_depth, p.nbins, binned.nfeatures, N,
-                p.hist_precision, p.sample_rate, p.col_sample_rate_per_tree,
+                p.effective_hist_precision, p.sample_rate, p.col_sample_rate_per_tree,
                 hier=use_hier_split_search(p, N),
                 bin_counts=binned.bin_counts)
             scalars = (p.reg_lambda, p.min_rows, p.min_split_improvement,
@@ -243,7 +243,7 @@ class GBM(SharedTree):
             # fast path: scan a whole scoring interval of trees per dispatch
             scan_fn = make_tree_scan_fn(
                 dist.name, p.tweedie_power, p.quantile_alpha, p.huber_alpha,
-                p.max_depth, p.nbins, binned.nfeatures, N, p.hist_precision,
+                p.max_depth, p.nbins, binned.nfeatures, N, p.effective_hist_precision,
                 p.sample_rate, p.col_sample_rate_per_tree,
                 hier=use_hier_split_search(p, N),
                 bin_counts=binned.bin_counts)
@@ -330,7 +330,7 @@ class GBM(SharedTree):
                         p.min_split_improvement, lr_build, kk,
                         p.col_sample_rate, tree_mask,
                         p.reg_alpha, p.gamma, p.min_child_weight,
-                    hist_precision=p.hist_precision,
+                    hist_precision=p.effective_hist_precision,
                         hier=use_hier_split_search(p, N))
                     if dart:
                         tree.values = tree.values * b_scale
@@ -357,7 +357,7 @@ class GBM(SharedTree):
                     p.min_split_improvement, lr_build, kc,
                     p.col_sample_rate, tree_mask,
                     p.reg_alpha, p.gamma, p.min_child_weight,
-                    hist_precision=p.hist_precision,
+                    hist_precision=p.effective_hist_precision,
                     hier=use_hier_split_search(p, N))
                 tree.values = tree.values * b_scale
                 trees.append(tree)

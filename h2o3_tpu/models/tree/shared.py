@@ -64,6 +64,16 @@ class SharedTreeParameters(Parameters):
     calibrate_model: bool = False
     calibration_frame: Optional[object] = None
     calibration_method: str = "platt"    # platt | isotonic
+    # bit-reproducible runs (the reference's `reproducible` flag): forces
+    # f32 histogram accumulation so sums don't depend on bf16 rounding;
+    # psum ordering is already deterministic for a FIXED mesh shape —
+    # results vary across different device counts, as in the reference
+    # when node counts change
+    reproducible: bool = False
+
+    @property
+    def effective_hist_precision(self) -> str:
+        return "f32" if self.reproducible else self.hist_precision
 
 
 @dataclasses.dataclass

@@ -400,6 +400,52 @@ def group_by(frame: Frame, by: Union[str, Sequence[str]],
 
 
 # -------------------------------------------------------------------- merge
+def _na_vec(template: Vec, n: int) -> Vec:
+    """All-NA vec of the template's type (outer-join fill)."""
+    if template.type == T_CAT:
+        return Vec.from_numpy(np.full(n, -1, np.int32), T_CAT,
+                              domain=template.domain)
+    if template.data is None:
+        return Vec(None, template.type, n,
+                   host_data=np.array([None] * n, dtype=object))
+    if template.type == T_TIME:
+        return Vec.from_numpy(np.full(n, np.nan), T_TIME)
+    return Vec.from_numpy(np.full(n, np.nan), template.type)
+
+
+def _unmatched_right(left: Frame, right: Frame, by: List[str]) -> Frame:
+    """Right rows whose key matches NO left row (device rank membership)."""
+    cat_remap: Dict[str, Dict[str, int]] = {}
+    for name in by:
+        lv, rv = left.vec(name), right.vec(name)
+        if lv.type == T_CAT:
+            shared: Dict[str, int] = {}
+            for lbl in (lv.domain or []) + (rv.domain or []):
+                if lbl not in shared:
+                    shared[lbl] = len(shared)
+            cat_remap[name] = shared
+    lkeys = _device_keys(left, by, cat_remap)
+    rkeys = _device_keys(right, by, cat_remap)
+    pl, pr = left.padded_rows, right.padded_rows
+    rank = dev.dense_rank([jnp.concatenate([l, r])
+                           for l, r in zip(lkeys, rkeys)])
+    lrank, rrank = rank[:pl], rank[pl:]
+    lvalid = jnp.ones(pl, bool)
+    for k in lkeys:
+        lvalid &= jnp.isfinite(k)
+    rvalid = jnp.ones(pr, bool)
+    for k in rkeys:
+        rvalid &= jnp.isfinite(k)
+    nseg = pl + pr + 2
+    big = jnp.int32(nseg - 1)
+    lcount = jax.ops.segment_sum(
+        jnp.where(lvalid, 1, 0), jnp.where(lvalid, lrank, big),
+        num_segments=nseg)
+    unmatched = rvalid & (lcount[rrank] == 0)
+    return filter_rows(right, Vec(unmatched.astype(jnp.float32), T_NUM,
+                                  right.nrows))
+
+
 def merge(left: Frame, right: Frame, by: Union[str, Sequence[str]],
           how: str = "inner") -> Frame:
     """Join — AstMerge / BinaryMerge analog, device sort-merge.
@@ -411,8 +457,31 @@ def merge(left: Frame, right: Frame, by: Union[str, Sequence[str]],
     match (BinaryMerge semantics).
     """
     by = [by] if isinstance(by, str) else list(by)
+    if how == "right":
+        # all.y: a left join from the other side, columns re-laid out to
+        # the conventional (left cols, right-only cols) order
+        out = merge(right, left, by, how="left")
+        lcols = [n for n in left.names if n not in by]
+        rcols = [n for n in right.names if n not in by]
+        return out[by + [c for c in lcols if c in out.names]
+                   + [c for c in rcols if c in out.names]]
+    if how == "outer":
+        li = merge(left, right, by, how="left")
+        extra = _unmatched_right(left, right, by)
+        if extra.nrows == 0:
+            return li
+        # align to the left-join layout, NA-filling left-only columns with
+        # TYPE-correct NA vecs (cat -> -1 codes with the left domain)
+        cols = li.names
+        aligned = []
+        for c in cols:
+            if c in extra.names:
+                aligned.append(extra.vec(c))
+            else:
+                aligned.append(_na_vec(left.vec(c), extra.nrows))
+        return rbind(li, Frame(cols, aligned))
     if how not in ("inner", "left"):
-        raise ValueError("merge supports how='inner'|'left'")
+        raise ValueError("merge supports how='inner'|'left'|'right'|'outer'")
     # unify categorical key domains host-side (small); codes remap on device
     cat_remap: Dict[str, Dict[str, int]] = {}
     for name in by:

@@ -32,6 +32,31 @@ def _make_logistic(rng, n=4000, p=4):
     return Frame.from_numpy(cols), X, y
 
 
+def test_glm_ordinal_proportional_odds(cl, rng):
+    """family=ordinal recovers latent slopes AND the true cutpoints."""
+    n = 3000
+    x1, x2 = rng.normal(size=n), rng.normal(size=n)
+    latent = 1.5 * x1 - 1.0 * x2 + rng.logistic(size=n)
+    yi = np.digitize(latent, [-1.5, 0.5, 2.0])
+    labels = np.array(["lvl0", "lvl1", "lvl2", "lvl3"], dtype=object)[yi]
+    fr = Frame.from_numpy({"x1": x1, "x2": x2, "y": labels})
+    m = GLM(response_column="y", family="ordinal").train(fr)
+    beta = dict(zip(m.output["coef_names"], m.output["beta_std"]))
+    assert beta["x1"] == pytest.approx(1.5, abs=0.25)
+    assert beta["x2"] == pytest.approx(-1.0, abs=0.25)
+    th = m.output["ordinal_thresholds"]
+    assert np.all(np.diff(th) > 0)
+    np.testing.assert_allclose(th, [-1.5, 0.5, 2.0], atol=0.3)
+    pred = m.predict(fr)
+    probs = np.stack([pred.vec(c).to_numpy()
+                      for c in ["lvl0", "lvl1", "lvl2", "lvl3"]], axis=1)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-4)
+    acc = (pred.vec("predict").decoded() == labels).mean()
+    assert acc > 0.45                    # 4 ordered classes, noisy latent
+    with pytest.raises(ValueError, match="ordered levels"):
+        GLM(response_column="x1", family="ordinal").train(fr)
+
+
 def test_gaussian_matches_ols(cl, rng):
     fr, beta_true = _make_regression(rng)
     m = GLM(family="gaussian", lambda_=0.0, response_column="y").train(fr)

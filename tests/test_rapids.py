@@ -91,6 +91,23 @@ def test_merge_inner_and_left(cl):
     assert np.isnan(y[k == "a"]).all() and np.isnan(y[k == "d"]).all()
 
 
+def test_merge_right_and_outer(cl):
+    left = Frame.from_numpy({"k": np.array([1.0, 2, 3]),
+                             "x": np.array([10.0, 20, 30])})
+    right = Frame.from_numpy({"k": np.array([2.0, 3, 4]),
+                              "y": np.array([200.0, 300, 400])})
+    r = merge(left, right, "k", how="right")
+    assert r.nrows == 3
+    np.testing.assert_array_equal(np.sort(r.vec("k").to_numpy()), [2, 3, 4])
+    assert np.isnan(r.vec("x").to_numpy()[r.vec("k").to_numpy() == 4]).all()
+    o = merge(left, right, "k", how="outer")
+    assert o.nrows == 4
+    np.testing.assert_array_equal(np.sort(o.vec("k").to_numpy()),
+                                  [1, 2, 3, 4])
+    assert np.isnan(o.vec("y").to_numpy()[o.vec("k").to_numpy() == 1]).all()
+    assert np.isnan(o.vec("x").to_numpy()[o.vec("k").to_numpy() == 4]).all()
+
+
 def test_rbind_unifies_domains(cl):
     f1 = Frame.from_numpy({"c": np.array(["x", "y"], dtype=object)})
     f2 = Frame.from_numpy({"c": np.array(["y", "z"], dtype=object)})
