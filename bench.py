@@ -128,6 +128,11 @@ def bench_rapids(Frame, sort, merge):
 
 
 def main():
+    if os.environ.get("JAX_PLATFORMS"):
+        # the image pre-imports jax with a baked-in platform; the env var
+        # must win (lets CI smoke-run this on CPU)
+        import jax
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     import h2o3_tpu
     from h2o3_tpu import Frame
     from h2o3_tpu.frame.vec import T_CAT
