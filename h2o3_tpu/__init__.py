@@ -19,6 +19,7 @@ from .frame.vec import Vec
 from .frame.parse import (import_file, parse_csv, parse_files,
                           parse_svmlight, parse_arff, export_file,
                           upload_string)
+from .frame.sql import import_sql_table, import_sql_select
 from .export.mojo import import_mojo
 
 

@@ -1,0 +1,73 @@
+"""Flow-lite: a single-page dashboard over the REST API.
+
+Reference: ``h2o-web``'s Flow notebook UI.  This is deliberately a
+minimal read-only surface (cloud status, frames with summaries and data
+preview, models with metrics, jobs, timeline) driven purely by the same
+/3 endpoints any client uses — an honest subset, not a notebook clone.
+"""
+
+FLOW_HTML = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>h2o3_tpu</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:0;background:#f6f7f9;color:#1c2b33}
+ header{background:#12333d;color:#fff;padding:10px 20px;font-size:18px}
+ header small{opacity:.7;margin-left:12px}
+ main{padding:16px 20px;display:grid;gap:16px;grid-template-columns:1fr 1fr}
+ section{background:#fff;border:1px solid #dde3e8;border-radius:8px;padding:12px 16px}
+ h2{font-size:14px;text-transform:uppercase;letter-spacing:.06em;color:#5b6b73;margin:0 0 8px}
+ table{border-collapse:collapse;width:100%;font-size:13px}
+ td,th{border-bottom:1px solid #eef1f4;padding:4px 8px;text-align:left}
+ th{color:#5b6b73;font-weight:600}
+ tr:hover{background:#f2f7fa}
+ pre{background:#f2f4f6;padding:8px;border-radius:6px;overflow:auto;font-size:12px;max-height:320px}
+ .pill{display:inline-block;background:#e4f0ee;border-radius:10px;padding:1px 8px;font-size:12px}
+ #detail{grid-column:1 / -1}
+ a{color:#176d81;cursor:pointer;text-decoration:none}
+</style></head><body>
+<header>h2o3_tpu<small id="cloud"></small></header>
+<main>
+ <section><h2>Frames</h2><table id="frames"></table></section>
+ <section><h2>Models</h2><table id="models"></table></section>
+ <section><h2>Jobs</h2><table id="jobs"></table></section>
+ <section><h2>Timeline</h2><table id="timeline"></table></section>
+ <section id="detail"><h2 id="dtitle">Detail</h2><pre id="dbody">select a frame or model…</pre></section>
+</main>
+<script>
+const J = async p => (await fetch(p)).json();
+const el = id => document.getElementById(id);
+const esc = s => String(s).replace(/[&<>"'`]/g, c => ({'&':'&amp;','<':'&lt;','>':'&gt;','\"':'&quot;',"'":'&#39;','`':'&#96;'}[c]));
+async function show(title, path){
+  el('dtitle').textContent = title;
+  el('dbody').textContent = JSON.stringify(await J(path), null, 2);
+}
+async function refresh(){
+  const c = await J('/3/Cloud');
+  el('cloud').textContent = `${c.platform} · ${JSON.stringify(c.mesh_shape)} · ${c.cloud_size} process(es)`;
+  const fr = await J('/3/Frames');
+  el('frames').innerHTML = '<tr><th>frame</th><th>rows</th><th>cols</th><th></th></tr>' +
+    fr.frames.map(f => `<tr><td>${esc(f.frame_id.name)}</td><td>${f.rows}</td>
+      <td>${f.columns.length}</td>
+      <td><a onclick="show('frame ${esc(f.frame_id.name)}','/3/Frames/${encodeURIComponent(f.frame_id.name)}/data?row_count=20')">data</a>
+          <a onclick="show('summary ${esc(f.frame_id.name)}','/3/Frames/${encodeURIComponent(f.frame_id.name)}/summary')">summary</a></td></tr>`).join('');
+  const mo = await J('/3/Models');
+  el('models').innerHTML = '<tr><th>model</th><th>algo</th><th>metrics</th></tr>' +
+    mo.models.map(m => {
+      const t = m.training_metrics || {};
+      const head = ['auc','rmse','logloss','r2'].filter(k => t[k] != null)
+        .map(k => `${k}=${(+t[k]).toFixed(4)}`).join(' ');
+      return `<tr><td><a onclick="show('model ${esc(m.model_id.name)}','/3/Models/${encodeURIComponent(m.model_id.name)}')">${esc(m.model_id.name)}</a></td>
+        <td><span class="pill">${esc(m.algo)}</span></td><td>${head}</td></tr>`;}).join('');
+  const jo = await J('/3/Jobs');
+  el('jobs').innerHTML = '<tr><th>job</th><th>status</th><th>progress</th></tr>' +
+    jo.jobs.slice(-12).reverse().map(j =>
+      `<tr><td>${esc(j.description)}</td><td>${esc(j.status)}</td>
+       <td>${Math.round((j.progress||0)*100)}%</td></tr>`).join('');
+  const tl = await J('/3/Timeline');
+  el('timeline').innerHTML = '<tr><th>event</th><th>info</th></tr>' +
+    tl.events.slice(-12).reverse().map(e => {
+      const {ts, kind, ...rest} = e;
+      return `<tr><td>${esc(kind)}</td><td>${esc(JSON.stringify(rest)).slice(0,90)}</td></tr>`;}).join('');
+}
+refresh(); setInterval(refresh, 4000);
+</script></body></html>
+"""

@@ -117,12 +117,22 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _reply_html(self, html: str):
+        body = html.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/html; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _deny(self):
+        self.send_response(401)
+        self.send_header("WWW-Authenticate", 'Basic realm="h2o3_tpu"')
+        self.end_headers()
+
     def _dispatch(self, table):
         if not self._authorized():
-            self.send_response(401)
-            self.send_header("WWW-Authenticate", 'Basic realm="h2o3_tpu"')
-            self.end_headers()
-            return
+            return self._deny()
         parsed = urlparse(self.path)
         params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
         length = int(self.headers.get("Content-Length") or 0)
@@ -148,6 +158,12 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply(404, {"error": f"no route {parsed.path}"})
 
     def do_GET(self):
+        path = urlparse(self.path).path
+        if path in ("/", "/flow", "/flow/index.html"):
+            if not self._authorized():
+                return self._deny()
+            from .flow import FLOW_HTML
+            return self._reply_html(FLOW_HTML)
         self._dispatch(self.routes_get)
 
     def do_POST(self):

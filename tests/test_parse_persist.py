@@ -148,3 +148,24 @@ def test_model_save_load_uri(cl, tmp_path, rng, monkeypatch):
     p1 = m.predict(fr).vec("predict").to_numpy()
     p2 = m2.predict(fr).vec("predict").to_numpy()
     np.testing.assert_allclose(p1, p2, rtol=1e-6)
+
+
+def test_sql_import(cl, tmp_path):
+    import sqlite3
+    import h2o3_tpu
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE users (age REAL, city TEXT, income REAL)")
+    conn.executemany(
+        "INSERT INTO users VALUES (?,?,?)",
+        [(30 + i, ["sf", "nyc", "la"][i % 3], 50000 + i * 1000)
+         for i in range(50)])
+    conn.commit()
+    fr = h2o3_tpu.import_sql_table(conn, "users")
+    assert fr.shape == (50, 3)
+    assert fr.types() == {"age": "num", "city": "cat", "income": "num"}
+    fr2 = h2o3_tpu.import_sql_select(
+        f"sqlite://{db}", "SELECT age, income FROM users WHERE age > 50")
+    assert fr2.nrows == 29 and fr2.names == ["age", "income"]
+    with pytest.raises(NotImplementedError, match="DB-API"):
+        h2o3_tpu.import_sql_table("jdbc:postgresql://x/y", "users")

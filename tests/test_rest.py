@@ -129,3 +129,16 @@ def test_deploy_serve_launcher(cl, tmp_path):
     finally:
         p.send_signal(signal.SIGTERM)
         assert p.wait(timeout=15) == 0
+
+
+def test_flow_dashboard_served(cl):
+    from h2o3_tpu.api.server import start_server
+    import urllib.request
+    srv = start_server()
+    try:
+        html = urllib.request.urlopen(srv.url + "/").read().decode()
+        assert "h2o3_tpu" in html and "/3/Frames" in html
+        assert urllib.request.urlopen(
+            srv.url + "/flow").read().decode() == html
+    finally:
+        srv.stop()
