@@ -20,9 +20,11 @@ clients (REST) a consistent view.  The API mirrors DKV.get/put/remove.
 
 from __future__ import annotations
 
+import os
 import pickle
 import socket
 import socketserver
+import ssl
 import struct
 import threading
 import time
@@ -35,6 +37,28 @@ _counter = 0
 # coordinator service state
 _remote: Optional[Tuple[str, int]] = None     # set on non-coordinator procs
 _server: Optional["_DKVServer"] = None
+_client_ssl: Optional[ssl.SSLContext] = None
+
+
+def _tls_contexts():
+    """Optional internode TLS (h2o-security internal_security analog).
+
+    Set H2O3_TPU_TLS_CERT / H2O3_TPU_TLS_KEY (PEM paths) on every process
+    to wrap the DCN control plane in TLS; the cert doubles as the trust
+    anchor (private-CA / self-signed deployment model, like the
+    reference's keystore-based internal security).  Returns
+    (server_ctx, client_ctx) or (None, None).
+    """
+    cert = os.environ.get("H2O3_TPU_TLS_CERT")
+    key = os.environ.get("H2O3_TPU_TLS_KEY")
+    if not cert:
+        return None, None
+    srv = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    srv.load_cert_chain(cert, key or None)
+    cli = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    cli.check_hostname = False
+    cli.load_verify_locations(cert)
+    return srv, cli
 
 
 def _is_plain(value: Any, depth: int = 0) -> bool:
@@ -108,6 +132,30 @@ def clear() -> None:
         _store.clear()
 
 
+# ------------------------------------------------------------- atomic ops
+def cas(key: str, expected: Any, new: Any) -> bool:
+    """Compare-and-set — the water/Atomic/TAtomic analog for control-plane
+    state (grid bookkeeping, counters).  Equality-compared; atomic under
+    the store lock locally, executed coordinator-side when attached."""
+    if _remote is not None:
+        return bool(_rpc("cas", key=key, expected=expected, new=new))
+    with _lock:
+        if _store.get(key) == expected:
+            _store[key] = new
+            return True
+        return False
+
+
+def incr(key: str, delta: float = 1.0) -> float:
+    """Atomic numeric increment; missing keys start at 0."""
+    if _remote is not None:
+        return float(_rpc("incr", key=key, delta=delta))
+    with _lock:
+        v = float(_store.get(key, 0.0)) + delta
+        _store[key] = v
+        return v
+
+
 # --------------------------------------------------------------------------
 # Coordinator service: length-prefixed pickle RPC over TCP (the control
 # plane of SURVEY.md §5 — DCN traffic, never device payloads).
@@ -136,10 +184,14 @@ def _recvall(sock: socket.socket, n: int) -> bytes:
 def _rpc(op: str, **kw) -> Any:
     payload = pickle.dumps({"op": op, **kw},
                            protocol=pickle.HIGHEST_PROTOCOL)
-    with socket.create_connection(_remote, timeout=60) as s:
+    with socket.create_connection(_remote, timeout=60) as raw:
+        s = _client_ssl.wrap_socket(raw, server_hostname=_remote[0]) \
+            if _client_ssl is not None else raw
         s.sendall(struct.pack("<Q", len(payload)) + payload)
         n = struct.unpack("<Q", _recvall(s, 8))[0]
         resp = pickle.loads(_recvall(s, n))
+        if s is not raw:
+            s.close()
     if resp.get("err"):
         raise RuntimeError(f"DKV coordinator error: {resp['err']}")
     return resp.get("value")
@@ -167,6 +219,18 @@ class _Handler(socketserver.BaseRequestHandler):
                 with _lock:
                     value = sorted(k for k in _store
                                    if k.startswith(req["prefix"]))
+            elif op == "cas":
+                with _lock:
+                    if _store.get(req["key"]) == req["expected"]:
+                        _store[req["key"]] = req["new"]
+                        value = True
+                    else:
+                        value = False
+            elif op == "incr":
+                with _lock:
+                    value = float(_store.get(req["key"], 0.0)) \
+                        + req["delta"]
+                    _store[req["key"]] = value
             elif op == "make_key":
                 with _lock:
                     _counter += 1
@@ -188,6 +252,13 @@ class _Handler(socketserver.BaseRequestHandler):
 class _DKVServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
+    ssl_context: Optional[ssl.SSLContext] = None
+
+    def get_request(self):
+        sock, addr = super().get_request()
+        if self.ssl_context is not None:
+            sock = self.ssl_context.wrap_socket(sock, server_side=True)
+        return sock, addr
 
 
 def serve(host: str = "127.0.0.1", port: int = 0) -> int:
@@ -200,6 +271,8 @@ def serve(host: str = "127.0.0.1", port: int = 0) -> int:
         _server.shutdown()
         _server = None
     _server = _DKVServer((host, port), _Handler)
+    srv_ctx, _ = _tls_contexts()
+    _server.ssl_context = srv_ctx
     t = threading.Thread(target=_server.serve_forever, daemon=True,
                          name="dkv-coordinator")
     t.start()
@@ -208,7 +281,8 @@ def serve(host: str = "127.0.0.1", port: int = 0) -> int:
 
 def attach(host: str, port: int, timeout: float = 60.0) -> None:
     """Point this process's DKV at the coordinator service (with retry)."""
-    global _remote
+    global _remote, _client_ssl
+    _, _client_ssl = _tls_contexts()
     _remote = (host, port)
     deadline = time.time() + timeout
     while True:

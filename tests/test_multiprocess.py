@@ -145,3 +145,60 @@ def test_two_process_cluster(tmp_path):
         assert r["peers"]["0"] is not None or r["peers"].get(0) is not None
         vals = list(r["peers"].values())
         assert all(v is not None for v in vals), r["peers"]
+
+
+def test_dkv_tls_and_atomics(cl, tmp_path):
+    """TLS-wrapped control plane + atomic CAS/incr (single-process)."""
+    import os
+    import subprocess
+    import socket
+    import struct
+    import pickle
+    import threading
+    from h2o3_tpu.runtime import dkv
+    cert, key = str(tmp_path / "c.pem"), str(tmp_path / "k.pem")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-keyout", key,
+         "-out", cert, "-days", "1", "-nodes", "-subj", "/CN=localhost"],
+        capture_output=True, check=True)
+    os.environ["H2O3_TPU_TLS_CERT"] = cert
+    os.environ["H2O3_TPU_TLS_KEY"] = key
+    try:
+        dkv.detach()
+        port = dkv.serve(port=0)
+        dkv.attach("127.0.0.1", port)
+        dkv._rpc("put", key="tls_test", value=42)
+        assert dkv._rpc("get", key="tls_test") == 42
+        # remote-side atomics
+        assert dkv._rpc("cas", key="c1", expected=None, new="a")
+        assert not dkv._rpc("cas", key="c1", expected="b", new="x")
+        assert dkv._rpc("incr", key="n1", delta=2.5) == 2.5
+        # a plaintext client gets no handshake
+        try:
+            with socket.create_connection(("127.0.0.1", port),
+                                          timeout=3) as s:
+                payload = pickle.dumps({"op": "ping"})
+                s.sendall(struct.pack("<Q", len(payload)) + payload)
+                s.settimeout(3)
+                data = s.recv(8)
+                assert not data or len(data) < 8
+        except (ConnectionError, socket.timeout, OSError):
+            pass
+    finally:
+        dkv.detach()
+        os.environ.pop("H2O3_TPU_TLS_CERT", None)
+        os.environ.pop("H2O3_TPU_TLS_KEY", None)
+
+    # local atomics under contention
+    assert dkv.cas("casme", None, "v1")
+    assert dkv.cas("casme", "v1", "v2") and dkv.get("casme") == "v2"
+
+    def worker():
+        for _ in range(500):
+            dkv.incr("ctr_t", 1)
+    ts = [threading.Thread(target=worker) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert dkv.get("ctr_t") == 4000
