@@ -307,6 +307,14 @@ class Api:
             return {"scalar": out}
         return {"string": str(out)}
 
+    def about(self) -> dict:
+        """GET /3/About — effective config + extensions (AboutHandler)."""
+        from ..runtime.config import config
+        from ..runtime.extensions import loaded
+        from .. import __version__
+        return {"version": __version__, "config": config().describe(),
+                "extensions": loaded()}
+
     # -------------------------------------------------------------- metadata
     def schemas(self) -> dict:
         """GET /3/Metadata/schemas — parameter schemas for client codegen
@@ -456,6 +464,7 @@ class H2OServer:
             r"/3/Jobs/([^/]+)": lambda a, k: a.job(k),
             r"/3/ImportFiles": lambda a, **kw: a.import_files(**kw),
             r"/3/Metadata/schemas": lambda a: a.schemas(),
+            r"/3/About": lambda a: a.about(),
             r"/3/Timeline": lambda a: a.timeline(),
             r"/3/Logs": lambda a, **kw: a.logs(**kw),
         }

@@ -136,10 +136,14 @@ def init(devices=None, model_axis: int = 1, coordinator: str | None = None,
         dev_grid = np.array(devices).reshape(n // model_axis, model_axis)
         mesh = Mesh(dev_grid, (ROW_AXIS, MODEL_AXIS))
         _cluster = Cluster(mesh=mesh)
-        return _cluster
+    from . import extensions
+    extensions.load_all()
+    return _cluster
 
 
-_GUARDRAIL_FRACTION = 0.9
+def _guardrail_fraction() -> float:
+    from .config import config
+    return config().hbm_guardrail_fraction
 
 
 def _check_hbm_budget(nbytes: int, sharding=None, shape=None) -> None:
@@ -166,10 +170,11 @@ def _check_hbm_budget(nbytes: int, sharding=None, shape=None) -> None:
                 per_dev = nbytes / max(cluster().n_row_shards, 1)
         else:
             per_dev = nbytes / max(cluster().n_row_shards, 1)
-        if in_use + per_dev > _GUARDRAIL_FRACTION * limit:
+        frac = _guardrail_fraction()
+        if in_use + per_dev > frac * limit:
             raise MemoryError(
                 f"placing {nbytes / 1e9:.2f} GB ({per_dev / 1e9:.2f} GB/"
-                f"device) would exceed {_GUARDRAIL_FRACTION:.0%} of HBM "
+                f"device) would exceed {frac:.0%} of HBM "
                 f"({limit / 1e9:.2f} GB/device, {in_use / 1e9:.2f} GB in "
                 f"use). Reduce rows/columns, drop unused frames "
                 f"(h2o3_tpu.remove), or add devices to the mesh.")

@@ -1,0 +1,71 @@
+"""Runtime configuration — the H2O.OptArgs / system-property analog.
+
+The reference layers CLI flags, system properties and env vars; here a
+single typed env surface (``H2O3_TPU_*``) feeds a process-wide config
+read at first use.  ``describe()`` backs the REST /3/About view so
+operators can see effective settings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Optional
+
+
+@dataclasses.dataclass
+class Config:
+    # REST
+    port: int = 54321
+    # scheduler
+    scheduler_workers: int = 2
+    # HBM guardrail share (cluster._check_hbm_budget)
+    hbm_guardrail_fraction: float = 0.9
+    # logging
+    log_level: str = "INFO"
+    # extension modules (comma-separated import paths)
+    extensions: str = ""
+    # internode TLS (PEM paths)
+    tls_cert: Optional[str] = None
+    tls_key: Optional[str] = None
+
+    @staticmethod
+    def from_env() -> "Config":
+        e = os.environ.get
+        return Config(
+            port=int(e("H2O3_TPU_PORT", 54321)),
+            scheduler_workers=int(e("H2O3_TPU_SCHEDULER_WORKERS", 2)),
+            hbm_guardrail_fraction=float(
+                e("H2O3_TPU_HBM_GUARDRAIL", 0.9)),
+            log_level=e("H2O3_TPU_LOG_LEVEL", "INFO"),
+            extensions=e("H2O3_TPU_EXTENSIONS", ""),
+            tls_cert=e("H2O3_TPU_TLS_CERT"),
+            tls_key=e("H2O3_TPU_TLS_KEY"),
+        )
+
+    def describe(self) -> dict:
+        d = dataclasses.asdict(self)
+        if d.get("tls_key"):
+            d["tls_key"] = "<set>"
+        return d
+
+
+_config: Optional[Config] = None
+_lock = threading.Lock()
+
+
+def config() -> Config:
+    global _config
+    with _lock:
+        if _config is None:
+            _config = Config.from_env()
+        return _config
+
+
+def reload() -> Config:
+    """Re-read the environment (tests / dynamic reconfiguration)."""
+    global _config
+    with _lock:
+        _config = Config.from_env()
+        return _config

@@ -1,0 +1,68 @@
+"""Extensions SPI — the water/AbstractH2OExtension / RestApiExtension
+registration analog.
+
+The reference discovers extensions via ServiceLoader manifests; here an
+extension is any importable module (or ``module:function``) listed in
+``H2O3_TPU_EXTENSIONS`` (comma-separated) or registered explicitly.  At
+cluster init every extension's entry point runs with the runtime module
+as its argument — extensions register persist backends
+(``persist.register``), REST routes, new estimators, etc.
+"""
+
+from __future__ import annotations
+
+import importlib
+import threading
+from typing import Callable, Dict, List
+
+_loaded: Dict[str, object] = {}
+_lock = threading.Lock()
+
+
+def register(name: str, init_fn: Callable) -> None:
+    """Programmatic registration (tests, embedded extensions)."""
+    with _lock:
+        _loaded[name] = init_fn
+
+
+def load_all() -> List[str]:
+    """Import + initialize every configured extension; returns names.
+
+    Called from ``h2o3_tpu.init()``; failures log and skip (a broken
+    extension must not take the cluster down), mirroring the reference's
+    best-effort extension boot.
+    """
+    from .config import config
+    from .observability import log, record
+    import h2o3_tpu
+    specs = [s.strip() for s in config().extensions.split(",") if s.strip()]
+    with _lock:
+        pending = dict(_loaded)
+    for spec in specs:
+        if spec in pending or spec in _loaded and _loaded[spec] is None:
+            continue
+        try:
+            mod_name, _, fn_name = spec.partition(":")
+            mod = importlib.import_module(mod_name)
+            pending[spec] = getattr(mod, fn_name) if fn_name else \
+                getattr(mod, "init", None)
+        except Exception as e:                 # noqa: BLE001
+            log.warning("extension %s failed to import: %r", spec, e)
+    initialized = []
+    for name, fn in pending.items():
+        try:
+            if callable(fn):
+                fn(h2o3_tpu)
+            initialized.append(name)
+            record("extension_loaded", name=name)
+        except Exception as e:                 # noqa: BLE001
+            log.warning("extension %s failed to initialize: %r", name, e)
+    with _lock:
+        for name in initialized:
+            _loaded[name] = None               # mark done
+    return initialized
+
+
+def loaded() -> List[str]:
+    with _lock:
+        return sorted(_loaded)

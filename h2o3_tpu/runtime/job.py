@@ -211,5 +211,7 @@ def scheduler() -> JobScheduler:
     global _scheduler
     with _sched_lock:
         if _scheduler is None:
-            _scheduler = JobScheduler()
+            from .config import config
+            _scheduler = JobScheduler(
+                workers=config().scheduler_workers)
         return _scheduler

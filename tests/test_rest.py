@@ -142,3 +142,26 @@ def test_flow_dashboard_served(cl):
             srv.url + "/flow").read().decode() == html
     finally:
         srv.stop()
+
+
+def test_about_config_and_extensions(cl, monkeypatch):
+    from h2o3_tpu.api.server import start_server
+    from h2o3_tpu.runtime import config as cfg
+    from h2o3_tpu.runtime import extensions
+    import json
+    import urllib.request
+    monkeypatch.setenv("H2O3_TPU_SCHEDULER_WORKERS", "5")
+    cfg.reload()
+    ran = []
+    extensions.register("demo_ext", lambda h2o: ran.append(h2o.__version__))
+    extensions.load_all()
+    assert ran
+    srv = start_server()
+    try:
+        about = json.load(urllib.request.urlopen(srv.url + "/3/About"))
+        assert about["config"]["scheduler_workers"] == 5
+        assert "demo_ext" in about["extensions"]
+        assert "version" in about
+    finally:
+        srv.stop()
+        cfg.reload()
