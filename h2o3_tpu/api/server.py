@@ -194,9 +194,12 @@ class Api:
     # ---------------------------------------------------------------- cloud
     def cloud(self) -> dict:
         from ..runtime.cluster import cluster
+        from ..runtime import heartbeat
         c = cluster().describe()
-        return {"version": "h2o3_tpu", "cloud_healthy": True,
-                "cloud_size": c["process_count"], **c}
+        members = heartbeat.members()
+        healthy = all(m["status"] == "alive" for m in members.values())
+        return {"version": "h2o3_tpu", "cloud_healthy": healthy,
+                "cloud_size": c["process_count"], "members": members, **c}
 
     # ---------------------------------------------------------------- frames
     def frames(self) -> dict:

@@ -136,8 +136,9 @@ def init(devices=None, model_axis: int = 1, coordinator: str | None = None,
         dev_grid = np.array(devices).reshape(n // model_axis, model_axis)
         mesh = Mesh(dev_grid, (ROW_AXIS, MODEL_AXIS))
         _cluster = Cluster(mesh=mesh)
-    from . import extensions
+    from . import extensions, heartbeat
     extensions.load_all()
+    heartbeat.start()
     return _cluster
 
 
@@ -233,6 +234,7 @@ def cluster() -> Cluster:
 def shutdown() -> None:
     global _cluster
     with _lock:
-        from . import dkv
+        from . import dkv, heartbeat
+        heartbeat.stop()
         dkv.detach()        # stop the DKV service / forget the coordinator
         _cluster = None

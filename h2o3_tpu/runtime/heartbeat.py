@@ -1,0 +1,94 @@
+"""Heartbeat / liveness daemon — the water/HeartBeatThread analog.
+
+Each process runs a daemon thread that stamps ``!hb/<node>`` in the DKV
+every ``interval`` seconds with its wall-clock time and load facts.  Any
+member (or a REST client via /3/Cloud) classifies peers from the stamp
+age: ``alive`` (< 3 intervals), ``suspect`` (< 10), ``dead`` otherwise —
+the reference's client_disconnect/suspect escalation, minus UDP
+multicast (the DKV coordinator is the rendezvous; heartbeats ride the
+same DCN control plane as every other key).
+
+Wall clocks are compared across processes, so the suspect window is
+deliberately generous; sub-second skew cannot cause a false ``dead``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+from . import dkv
+
+PREFIX = "!hb/"
+
+_thread: Optional[threading.Thread] = None
+_stop = threading.Event()
+_node: Optional[str] = None
+
+
+def node_name() -> str:
+    import socket
+    return f"{socket.gethostname()}:{os.getpid()}"
+
+
+def _beat(name: str) -> None:
+    dkv.put(PREFIX + name, {
+        "ts": time.time(),
+        "pid": os.getpid(),
+        "keys": len(dkv.keys()),
+    })
+
+
+def start(interval: float = 5.0, name: Optional[str] = None) -> str:
+    """Start (or restart) this process's heartbeat thread."""
+    global _thread, _node
+    stop()
+    _node = name or node_name()
+    _stop.clear()
+    _beat(_node)                        # immediate first stamp
+
+    def _run():
+        while not _stop.wait(interval):
+            try:
+                _beat(_node)
+            except Exception:           # noqa: BLE001 — beat must not die
+                pass
+
+    _thread = threading.Thread(target=_run, name="heartbeat", daemon=True)
+    _thread.start()
+    return _node
+
+
+def stop() -> None:
+    global _thread
+    _stop.set()
+    if _thread is not None:
+        _thread.join(timeout=2.0)
+        _thread = None
+    if _node is not None:
+        try:
+            dkv.remove(PREFIX + _node)  # clean departure ≠ failure
+        except Exception:               # noqa: BLE001
+            pass
+
+
+def members(interval: float = 5.0, now: Optional[float] = None) -> Dict[str, dict]:
+    """Liveness view over every heartbeating process.
+
+    Returns ``{node: {status, age, ...stamp}}`` with status alive /
+    suspect / dead by stamp age in units of the heartbeat interval.
+    """
+    now = time.time() if now is None else now
+    out: Dict[str, dict] = {}
+    for key in dkv.keys(PREFIX):
+        stamp = dkv.get(key)
+        if not isinstance(stamp, dict):
+            continue
+        age = now - float(stamp.get("ts", 0.0))
+        status = ("alive" if age < 3 * interval
+                  else "suspect" if age < 10 * interval else "dead")
+        out[key[len(PREFIX):]] = {"status": status,
+                                  "age": round(age, 3), **stamp}
+    return out

@@ -202,3 +202,27 @@ def test_dkv_tls_and_atomics(cl, tmp_path):
     for t in ts:
         t.join()
     assert dkv.get("ctr_t") == 4000
+
+
+def test_heartbeat_liveness(cl):
+    import time
+    from h2o3_tpu.runtime import dkv, heartbeat
+    name = heartbeat.start(interval=0.05)
+    try:
+        time.sleep(0.2)
+        m = heartbeat.members(interval=0.05)
+        assert m[name]["status"] == "alive"
+        assert m[name]["pid"] > 0
+        # a peer that stopped stamping decays to suspect, then dead
+        dkv.put(heartbeat.PREFIX + "ghost",
+                {"ts": time.time() - 0.3, "pid": 1})
+        m = heartbeat.members(interval=0.05)
+        assert m["ghost"]["status"] == "suspect"
+        dkv.put(heartbeat.PREFIX + "ghost",
+                {"ts": time.time() - 60.0, "pid": 1})
+        assert heartbeat.members(interval=0.05)["ghost"]["status"] == "dead"
+    finally:
+        heartbeat.stop()
+        dkv.remove(heartbeat.PREFIX + "ghost")
+    # clean stop removes this node's stamp (departure, not failure)
+    assert name not in heartbeat.members(interval=0.05)
