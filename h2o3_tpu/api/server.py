@@ -445,7 +445,7 @@ class Api:
 class H2OServer:
     """In-process REST server — H2OApp/Jetty boot analog."""
 
-    def __init__(self, port: int = 54321, username: str = "",
+    def __init__(self, port: Optional[int] = None, username: str = "",
                  password: str = ""):
         self.api = Api()
         if password and not username:
@@ -487,6 +487,9 @@ class H2OServer:
         _Handler.routes_delete = {
             r"/3/DKV/([^/]+)": lambda a, k: a.remove(k),
         }
+        if port is None:
+            from ..runtime.config import config
+            port = config().port
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
         self.httpd.api = self.api
         self.httpd.basic_auth = self._auth

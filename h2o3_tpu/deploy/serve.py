@@ -15,7 +15,8 @@ import threading
 
 def main(argv=None):
     ap = argparse.ArgumentParser("h2o3_tpu.deploy.serve")
-    ap.add_argument("--port", type=int, default=54321)
+    from h2o3_tpu.runtime.config import config
+    ap.add_argument("--port", type=int, default=config().port)
     ap.add_argument("--coordinator", default=None,
                     help="host:port of process 0 (multi-host)")
     ap.add_argument("--num-processes", type=int, default=None)

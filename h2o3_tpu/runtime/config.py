@@ -65,7 +65,9 @@ def config() -> Config:
 
 def reload() -> Config:
     """Re-read the environment (tests / dynamic reconfiguration)."""
+    import logging
     global _config
     with _lock:
         _config = Config.from_env()
+        logging.getLogger("h2o3_tpu").setLevel(_config.log_level)
         return _config

@@ -49,8 +49,9 @@ def _tls_contexts():
     reference's keystore-based internal security).  Returns
     (server_ctx, client_ctx) or (None, None).
     """
-    cert = os.environ.get("H2O3_TPU_TLS_CERT")
-    key = os.environ.get("H2O3_TPU_TLS_KEY")
+    from .config import config
+    cert = config().tls_cert
+    key = config().tls_key
     if not cert:
         return None, None
     srv = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
@@ -130,6 +131,12 @@ def keys(prefix: str = "") -> List[str]:
 def clear() -> None:
     with _lock:
         _store.clear()
+
+
+def local_size() -> int:
+    """Local key count only — no coordinator round trip (heartbeat)."""
+    with _lock:
+        return len(_store)
 
 
 # ------------------------------------------------------------- atomic ops

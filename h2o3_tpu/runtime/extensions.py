@@ -13,20 +13,22 @@ from __future__ import annotations
 
 import importlib
 import threading
-from typing import Callable, Dict, List
+from typing import Callable, Dict, List, Optional
 
-_loaded: Dict[str, object] = {}
+# name -> pending init fn (callable), "loaded", or "failed"
+_registry: Dict[str, object] = {}
 _lock = threading.Lock()
 
 
 def register(name: str, init_fn: Callable) -> None:
     """Programmatic registration (tests, embedded extensions)."""
     with _lock:
-        _loaded[name] = init_fn
+        _registry[name] = init_fn
 
 
 def load_all() -> List[str]:
-    """Import + initialize every configured extension; returns names.
+    """Import + initialize every configured extension not yet run;
+    returns the names initialized by THIS call.
 
     Called from ``h2o3_tpu.init()``; failures log and skip (a broken
     extension must not take the cluster down), mirroring the reference's
@@ -37,32 +39,47 @@ def load_all() -> List[str]:
     import h2o3_tpu
     specs = [s.strip() for s in config().extensions.split(",") if s.strip()]
     with _lock:
-        pending = dict(_loaded)
+        pending = {k: v for k, v in _registry.items() if callable(v)}
+        known = set(_registry)
     for spec in specs:
-        if spec in pending or spec in _loaded and _loaded[spec] is None:
+        if spec in known or spec in pending:
             continue
         try:
             mod_name, _, fn_name = spec.partition(":")
             mod = importlib.import_module(mod_name)
-            pending[spec] = getattr(mod, fn_name) if fn_name else \
+            fn = getattr(mod, fn_name) if fn_name else \
                 getattr(mod, "init", None)
+            if not callable(fn):
+                raise AttributeError(
+                    f"{spec!r} has no callable entry point "
+                    f"({fn_name or 'init'})")
+            pending[spec] = fn
         except Exception as e:                 # noqa: BLE001
             log.warning("extension %s failed to import: %r", spec, e)
+            with _lock:
+                _registry[spec] = "failed"
     initialized = []
     for name, fn in pending.items():
         try:
-            if callable(fn):
-                fn(h2o3_tpu)
+            fn(h2o3_tpu)
             initialized.append(name)
             record("extension_loaded", name=name)
+            status: object = "loaded"
         except Exception as e:                 # noqa: BLE001
             log.warning("extension %s failed to initialize: %r", name, e)
-    with _lock:
-        for name in initialized:
-            _loaded[name] = None               # mark done
+            status = "failed"
+        with _lock:
+            _registry[name] = status
     return initialized
 
 
 def loaded() -> List[str]:
+    """Names of successfully initialized extensions (REST /3/About)."""
     with _lock:
-        return sorted(_loaded)
+        return sorted(k for k, v in _registry.items() if v == "loaded")
+
+
+def status(name: str) -> Optional[str]:
+    with _lock:
+        v = _registry.get(name)
+        return v if isinstance(v, str) else ("pending" if v else None)

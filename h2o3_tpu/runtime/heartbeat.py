@@ -3,10 +3,15 @@
 Each process runs a daemon thread that stamps ``!hb/<node>`` in the DKV
 every ``interval`` seconds with its wall-clock time and load facts.  Any
 member (or a REST client via /3/Cloud) classifies peers from the stamp
-age: ``alive`` (< 3 intervals), ``suspect`` (< 10), ``dead`` otherwise —
-the reference's client_disconnect/suspect escalation, minus UDP
-multicast (the DKV coordinator is the rendezvous; heartbeats ride the
-same DCN control plane as every other key).
+age IN UNITS OF THE STAMP'S OWN INTERVAL (each stamp carries the
+interval it was made under, so mixed or non-default intervals classify
+correctly): ``alive`` (< 3 intervals), ``suspect`` (< 10), ``dead``
+otherwise — the reference's client_disconnect/suspect escalation, minus
+UDP multicast (the DKV coordinator is the rendezvous; heartbeats ride
+the same DCN control plane as every other key).  Stamps dead for > 100
+intervals are garbage-collected by ``members()`` so a crashed-and-
+restarted process (new pid ⇒ new node name) does not poison
+``cloud_healthy`` forever.
 
 Wall clocks are compared across processes, so the suspect window is
 deliberately generous; sub-second skew cannot cause a false ``dead``.
@@ -33,11 +38,12 @@ def node_name() -> str:
     return f"{socket.gethostname()}:{os.getpid()}"
 
 
-def _beat(name: str) -> None:
+def _beat(name: str, interval: float) -> None:
     dkv.put(PREFIX + name, {
         "ts": time.time(),
+        "interval": interval,
         "pid": os.getpid(),
-        "keys": len(dkv.keys()),
+        "keys": dkv.local_size(),
     })
 
 
@@ -47,12 +53,15 @@ def start(interval: float = 5.0, name: Optional[str] = None) -> str:
     stop()
     _node = name or node_name()
     _stop.clear()
-    _beat(_node)                        # immediate first stamp
+    try:
+        _beat(_node, interval)          # immediate first stamp, best-effort
+    except Exception:                   # noqa: BLE001 — must not fail init
+        pass
 
     def _run():
         while not _stop.wait(interval):
             try:
-                _beat(_node)
+                _beat(_node, interval)
             except Exception:           # noqa: BLE001 — beat must not die
                 pass
 
@@ -77,8 +86,9 @@ def stop() -> None:
 def members(interval: float = 5.0, now: Optional[float] = None) -> Dict[str, dict]:
     """Liveness view over every heartbeating process.
 
-    Returns ``{node: {status, age, ...stamp}}`` with status alive /
-    suspect / dead by stamp age in units of the heartbeat interval.
+    Returns ``{node: {status, age, ...stamp}}``.  ``interval`` is only
+    the fallback for stamps that don't carry their own (pre-upgrade
+    peers); long-dead stamps are removed from the DKV as a side effect.
     """
     now = time.time() if now is None else now
     out: Dict[str, dict] = {}
@@ -86,9 +96,13 @@ def members(interval: float = 5.0, now: Optional[float] = None) -> Dict[str, dic
         stamp = dkv.get(key)
         if not isinstance(stamp, dict):
             continue
+        step = float(stamp.get("interval", interval))
         age = now - float(stamp.get("ts", 0.0))
-        status = ("alive" if age < 3 * interval
-                  else "suspect" if age < 10 * interval else "dead")
+        if age > 100 * step:            # GC: crashed peer, long gone
+            dkv.remove(key)
+            continue
+        status = ("alive" if age < 3 * step
+                  else "suspect" if age < 10 * step else "dead")
         out[key[len(PREFIX):]] = {"status": status,
                                   "age": round(age, 3), **stamp}
     return out

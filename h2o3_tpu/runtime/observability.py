@@ -39,7 +39,8 @@ if not log.handlers:
     log.addHandler(_h)
     if os.environ.get("H2O3_TPU_LOG_STDERR"):
         log.addHandler(logging.StreamHandler())
-    log.setLevel(os.environ.get("H2O3_TPU_LOG_LEVEL", "INFO"))
+    from .config import config
+    log.setLevel(config().log_level)
 
 
 def record(kind: str, **fields) -> None:

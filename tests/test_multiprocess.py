@@ -163,6 +163,8 @@ def test_dkv_tls_and_atomics(cl, tmp_path):
         capture_output=True, check=True)
     os.environ["H2O3_TPU_TLS_CERT"] = cert
     os.environ["H2O3_TPU_TLS_KEY"] = key
+    from h2o3_tpu.runtime import config as _cfg
+    _cfg.reload()
     try:
         dkv.detach()
         port = dkv.serve(port=0)
@@ -188,6 +190,7 @@ def test_dkv_tls_and_atomics(cl, tmp_path):
         dkv.detach()
         os.environ.pop("H2O3_TPU_TLS_CERT", None)
         os.environ.pop("H2O3_TPU_TLS_KEY", None)
+        _cfg.reload()
 
     # local atomics under contention
     assert dkv.cas("casme", None, "v1")
@@ -219,8 +222,12 @@ def test_heartbeat_liveness(cl):
         m = heartbeat.members(interval=0.05)
         assert m["ghost"]["status"] == "suspect"
         dkv.put(heartbeat.PREFIX + "ghost",
-                {"ts": time.time() - 60.0, "pid": 1})
+                {"ts": time.time() - 1.0, "pid": 1})
         assert heartbeat.members(interval=0.05)["ghost"]["status"] == "dead"
+        # stamps dead >100 intervals are garbage-collected entirely
+        dkv.put(heartbeat.PREFIX + "ghost",
+                {"ts": time.time() - 60.0, "pid": 1})
+        assert "ghost" not in heartbeat.members(interval=0.05)
     finally:
         heartbeat.stop()
         dkv.remove(heartbeat.PREFIX + "ghost")
