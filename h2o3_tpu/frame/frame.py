@@ -18,6 +18,8 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -42,6 +44,7 @@ class Frame:
         self.nrows: int = vecs[0].nrows if vecs else 0
         self.key = key
         self._matrix_cache: Dict[tuple, jax.Array] = {}
+        self._atime = time.monotonic()       # LRU clock for the Cleaner
         if key is not None:
             dkv.put(key, self)
 
@@ -59,6 +62,7 @@ class Frame:
         return self.vecs[0].padded_len if self.vecs else 0
 
     def vec(self, name: str) -> Vec:
+        self._atime = time.monotonic()
         try:
             return self.vecs[self.names.index(name)]
         except ValueError:
@@ -159,6 +163,7 @@ class Frame:
         array.  Cached per column-set (the reference caches the per-algo
         DataInfo adaptation similarly, hex/DataInfo.java).
         """
+        self._atime = time.monotonic()
         cols = list(cols) if cols is not None else list(self.names)
         ck = (tuple(cols), str(dtype))
         hit = self._matrix_cache.get(ck)
@@ -181,6 +186,12 @@ class Frame:
     def to_pandas(self):
         import pandas as pd
         return pd.DataFrame({n: v.decoded() for n, v in zip(self.names, self.vecs)})
+
+    def spill(self) -> int:
+        """Evict all device payloads to host RAM (Cleaner analog)."""
+        freed = sum(int(m.nbytes) for m in self._matrix_cache.values())
+        self._matrix_cache.clear()
+        return freed + sum(v.spill() for v in self.vecs)
 
     def to_numpy(self) -> np.ndarray:
         return np.stack([np.asarray(v.to_numpy(), dtype=np.float64)

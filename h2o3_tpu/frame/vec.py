@@ -85,8 +85,46 @@ class Vec:
         self.domain = list(domain) if domain is not None else None
         self.host_data = host_data          # str/uuid payload (numpy object)
         self.time_base = time_base          # TIME: ms-since-epoch of code 0
+        self._spill = None                  # host copy while evicted from HBM
+        self._spill_dtype = None
         self.data = data                    # padded row-sharded jax.Array
         self._rollups: Optional[RollupStats] = None
+
+    # ------------------------------------------------------------ HBM spill
+    # The reference's Cleaner evicts cold chunks from the K/V cache to disk
+    # (water/Cleaner.java:12); here the scarce tier is HBM and the spill
+    # target is host RAM: spill() fetches the device payload to numpy and
+    # drops the jax.Array, and the next .data access transparently places
+    # it back onto the row sharding.
+
+    @property
+    def data(self):
+        if self._device is None and self._spill is not None:
+            from ..runtime.cluster import cluster, put_sharded
+            buf = self._spill.astype(self._spill_dtype)
+            self._device = put_sharded(buf, cluster().row_sharding)
+            self._spill = None
+        return self._device
+
+    @data.setter
+    def data(self, value):
+        self._device = value
+        self._spill = None
+
+    @property
+    def is_spilled(self) -> bool:
+        return self._device is None and self._spill is not None
+
+    def spill(self) -> int:
+        """Evict the device payload to host RAM; returns bytes freed."""
+        if self._device is None:
+            return 0
+        from ..runtime.cluster import fetch
+        freed = int(self._device.nbytes)
+        self._spill_dtype = self._device.dtype
+        self._spill = np.asarray(fetch(self._device))
+        self._device = None
+        return freed
 
     # ------------------------------------------------------------------ ctor
     @staticmethod

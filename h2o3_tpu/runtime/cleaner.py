@@ -1,0 +1,44 @@
+"""Cleaner — HBM-pressure eviction of cold frames to host RAM.
+
+Reference: ``water/Cleaner.java`` sweeps the K/V store and writes cold
+chunks to disk when the memory manager signals pressure.  Here the
+scarce tier is HBM: when a new placement would blow the guardrail
+(cluster._check_hbm_budget), ``spill_until`` evicts whole frames —
+least-recently-used first, by Frame._atime — to host numpy until enough
+HBM is projected free.  Spilled frames restore transparently on the
+next ``.data`` access (frame/vec.py).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+
+def spill_until(needed: int, exclude: Iterable[str] = ()) -> int:
+    """Evict LRU frames until ~``needed`` bytes are freed; returns freed.
+
+    Best-effort: freed bytes are the arrays' nbytes, a proxy for the
+    allocator's view; the guardrail re-checks real memory_stats after.
+    """
+    from . import dkv
+    from .observability import log, record
+    from ..frame.frame import Frame
+    skip = set(exclude)
+    frames = []
+    for key in dkv.keys():
+        if key in skip:
+            continue
+        v = dkv.get(key)
+        if isinstance(v, Frame) and any(vec._device is not None
+                                        for vec in v.vecs):
+            frames.append((getattr(v, "_atime", 0.0), key, v))
+    freed = 0
+    for _, key, fr in sorted(frames, key=lambda t: t[0]):
+        if freed >= needed:
+            break
+        got = fr.spill()
+        freed += got
+        log.info("cleaner: spilled frame %s (%.1f MB) to host RAM",
+                 key, got / 1e6)
+        record("spill", frame=key, bytes=got)
+    return freed

@@ -173,6 +173,14 @@ def _check_hbm_budget(nbytes: int, sharding=None, shape=None) -> None:
             per_dev = nbytes / max(cluster().n_row_shards, 1)
         frac = _guardrail_fraction()
         if in_use + per_dev > frac * limit:
+            # pressure: let the Cleaner evict cold frames to host RAM,
+            # then re-read the allocator before giving up
+            from . import cleaner
+            deficit = int(in_use + per_dev - frac * limit)
+            if cleaner.spill_until(deficit) > 0:
+                in_use = (dev.memory_stats() or {}).get("bytes_in_use",
+                                                        in_use)
+        if in_use + per_dev > frac * limit:
             raise MemoryError(
                 f"placing {nbytes / 1e9:.2f} GB ({per_dev / 1e9:.2f} GB/"
                 f"device) would exceed {frac:.0%} of HBM "

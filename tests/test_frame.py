@@ -160,3 +160,27 @@ def test_reinit_conflict_raises(cl):
     import pytest as _pytest
     with _pytest.raises(RuntimeError):
         h2o3_tpu.init(model_axis=4)
+
+
+def test_spill_and_transparent_restore(cl, rng):
+    from h2o3_tpu.runtime import cleaner, dkv
+    fr = h2o3_tpu.Frame.from_numpy(
+        {"a": rng.normal(size=100), "g": np.array(["x", "y"], object)[
+            rng.integers(0, 2, 100)]}, key="spillme")
+    a0 = fr.vec("a").to_numpy().copy()
+    freed = fr.spill()
+    assert freed > 0
+    assert fr.vec("a").is_spilled and fr.vec("g").is_spilled
+    assert fr.vec("a")._device is None
+    # transparent restore on access, values and dtype preserved
+    np.testing.assert_array_equal(fr.vec("a").to_numpy(), a0)
+    assert not fr.vec("a").is_spilled
+    assert fr.vec("g").data.dtype == np.int32     # cat codes restored
+    # cleaner targets LRU frames and skips excluded keys
+    fr2 = h2o3_tpu.Frame.from_numpy({"b": rng.normal(size=50)},
+                                    key="hot")
+    fr2.vec("b")                                   # touch: most recent
+    got = cleaner.spill_until(1 << 40, exclude=["hot"])
+    assert got > 0 and fr.vec("a").is_spilled
+    assert not fr2.vec("b").is_spilled
+    dkv.remove("spillme"); dkv.remove("hot")
