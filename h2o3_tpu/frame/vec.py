@@ -19,6 +19,7 @@ mirroring the reference's RollupStats contract.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Optional, Sequence
 
 import jax
@@ -86,7 +87,7 @@ class Vec:
         self.host_data = host_data          # str/uuid payload (numpy object)
         self.time_base = time_base          # TIME: ms-since-epoch of code 0
         self._spill = None                  # host copy while evicted from HBM
-        self._spill_dtype = None
+        self._atime = 0.0                   # LRU clock (shared via aliasing)
         self.data = data                    # padded row-sharded jax.Array
         self._rollups: Optional[RollupStats] = None
 
@@ -99,10 +100,10 @@ class Vec:
 
     @property
     def data(self):
+        self._atime = time.monotonic()
         if self._device is None and self._spill is not None:
             from ..runtime.cluster import cluster, put_sharded
-            buf = self._spill.astype(self._spill_dtype)
-            self._device = put_sharded(buf, cluster().row_sharding)
+            self._device = put_sharded(self._spill, cluster().row_sharding)
             self._spill = None
         return self._device
 
@@ -121,7 +122,6 @@ class Vec:
             return 0
         from ..runtime.cluster import fetch
         freed = int(self._device.nbytes)
-        self._spill_dtype = self._device.dtype
         self._spill = np.asarray(fetch(self._device))
         self._device = None
         return freed
@@ -185,6 +185,8 @@ class Vec:
 
     @property
     def padded_len(self) -> int:
+        if self._spill is not None:          # serve from host, no restore
+            return int(self._spill.shape[0])
         return int(self.data.shape[0]) if self.data is not None else self.nrows
 
     def valid_mask(self) -> jax.Array:
@@ -256,6 +258,8 @@ class Vec:
         """
         if self.type == T_TIME and self.host_data is not None:
             return self.host_data[: self.nrows]
+        if self._spill is not None:          # serve from host, no restore
+            return self._spill[: self.nrows]
         if self.data is None:
             return self.host_data[: self.nrows]
         from ..runtime.cluster import fetch

@@ -31,7 +31,9 @@ def spill_until(needed: int, exclude: Iterable[str] = ()) -> int:
         v = dkv.get(key)
         if isinstance(v, Frame) and any(vec._device is not None
                                         for vec in v.vecs):
-            frames.append((getattr(v, "_atime", 0.0), key, v))
+            atime = max([getattr(v, "_atime", 0.0)] +
+                        [vec._atime for vec in v.vecs])
+            frames.append((atime, key, v))
     freed = 0
     for _, key, fr in sorted(frames, key=lambda t: t[0]):
         if freed >= needed:

@@ -174,12 +174,21 @@ def _check_hbm_budget(nbytes: int, sharding=None, shape=None) -> None:
         frac = _guardrail_fraction()
         if in_use + per_dev > frac * limit:
             # pressure: let the Cleaner evict cold frames to host RAM,
-            # then re-read the allocator before giving up
-            from . import cleaner
-            deficit = int(in_use + per_dev - frac * limit)
-            if cleaner.spill_until(deficit) > 0:
-                in_use = (dev.memory_stats() or {}).get("bytes_in_use",
-                                                        in_use)
+            # then re-read the allocator before giving up.  Single-process
+            # only: the trigger is process-LOCAL memory_stats, and spilling
+            # fetches via collectives — divergent triggers across hosts
+            # would deadlock, so multi-host keeps the fail-fast behaviour.
+            if jax.process_count() == 1:
+                from . import cleaner
+                n_shards = max(cluster().n_row_shards, 1)
+                deficit = int((in_use + per_dev - frac * limit) * n_shards)
+                try:
+                    freed = cleaner.spill_until(deficit)
+                except Exception:     # noqa: BLE001 — spill is best-effort
+                    freed = 0
+                if freed > 0:
+                    in_use = (dev.memory_stats() or {}).get("bytes_in_use",
+                                                            in_use)
         if in_use + per_dev > frac * limit:
             raise MemoryError(
                 f"placing {nbytes / 1e9:.2f} GB ({per_dev / 1e9:.2f} GB/"

@@ -172,9 +172,14 @@ def test_spill_and_transparent_restore(cl, rng):
     assert freed > 0
     assert fr.vec("a").is_spilled and fr.vec("g").is_spilled
     assert fr.vec("a")._device is None
-    # transparent restore on access, values and dtype preserved
+    # host reads serve from the spill buffer without touching HBM
     np.testing.assert_array_equal(fr.vec("a").to_numpy(), a0)
+    assert fr.vec("a").is_spilled
+    assert fr.vec("a").padded_len >= 100
+    # device access transparently restores, dtype preserved
+    assert fr.vec("a").data is not None
     assert not fr.vec("a").is_spilled
+    np.testing.assert_array_equal(fr.vec("a").to_numpy(), a0)
     assert fr.vec("g").data.dtype == np.int32     # cat codes restored
     # cleaner targets LRU frames and skips excluded keys
     fr2 = h2o3_tpu.Frame.from_numpy({"b": rng.normal(size=50)},
