@@ -127,10 +127,34 @@ def bench_rapids(Frame, sort, merge):
     return dt_sort, dt_merge
 
 
+def _devices_reachable(timeout_s: float = 150.0) -> bool:
+    """Probe device init in a subprocess so a dead accelerator tunnel
+    (hung jax.devices(), observed with the axon plugin) cannot hang the
+    whole bench — the probe is killed and we fall back to CPU."""
+    import subprocess
+    import sys
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.devices(); print('probe-ok')"],
+            timeout=timeout_s, capture_output=True, text=True)
+        return r.returncode == 0 and r.stdout.strip().endswith("probe-ok")
+    except Exception:
+        return False
+
+
 def main():
+    if (not os.environ.get("JAX_PLATFORMS")
+            and not os.environ.get("H2O3_BENCH_SKIP_PROBE")
+            and not _devices_reachable()):
+        import sys
+        print("bench: device init unreachable; falling back to CPU",
+              file=sys.stderr, flush=True)
+        os.environ["JAX_PLATFORMS"] = "cpu"
     if os.environ.get("JAX_PLATFORMS"):
         # the image pre-imports jax with a baked-in platform; the env var
-        # must win (lets CI smoke-run this on CPU)
+        # must win (lets CI smoke-run this on CPU, and backs the dead-
+        # tunnel fallback above)
         import jax
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     import h2o3_tpu
@@ -140,7 +164,8 @@ def main():
     from h2o3_tpu.rapids import sort, merge
 
     h2o3_tpu.init()
-    extra = {}
+    import jax
+    extra = {"platform": jax.devices()[0].platform}
     tps = bench_trees(Frame, T_CAT, XGBoost)
     try:
         sps = bench_deeplearning(Frame, DeepLearning)
