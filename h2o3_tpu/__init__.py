@@ -14,6 +14,7 @@ from .runtime.cluster import init, cluster, shutdown
 from .runtime.scope import Scope
 from .runtime import dkv
 from . import persist
+from . import explain
 from .frame.frame import Frame
 from .frame.vec import Vec
 from .frame.parse import (import_file, parse_csv, parse_files,

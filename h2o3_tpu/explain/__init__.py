@@ -1,0 +1,192 @@
+"""Model explainability — the h2o-py ``h2o/explain`` analog, data-first.
+
+Reference: ``h2o-py/h2o/explain/_explain.py`` builds matplotlib figures
+for PDP/ICE/SHAP-summary/varimp/residuals; here every function returns
+the underlying TABLES (plain dicts of numpy arrays) so they work
+headless and feed any plotting layer.  The compute path batches each
+grid column onto the device through the model's normal scoring stack.
+
+Entry points:
+- ``partial_dependence(model, frame, column, nbins)`` — PDP table
+  (grid value, mean response, stddev, std error), cats use the domain.
+- ``ice(model, frame, column, nbins, sample_rows)`` — per-row ICE
+  curves over the same grid.
+- ``shap_summary(model, frame, top_n)`` — mean |contribution| ranking
+  from TreeSHAP (tree models only).
+- ``residual_analysis(model, frame)`` — residuals + summary stats
+  (regression).
+- ``explain(model, frame)`` — the bundle: varimp, PDPs for the top
+  features, SHAP summary and residuals where applicable.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..frame.frame import Frame
+from ..frame.vec import T_CAT, T_NUM, Vec
+
+__all__ = ["partial_dependence", "ice", "shap_summary",
+           "residual_analysis", "explain"]
+
+
+def _response_col(model, preds: Frame,
+                  target_class: Optional[str] = None) -> np.ndarray:
+    """The scalar response curve: predicted value for regression,
+    P(target_class) for classification.  Binomial defaults the target
+    to the positive (last) class; multinomial defaults to the FIRST
+    class — pass ``target_class`` to pick the class of interest (the
+    reference's pd_plot requires it for multiclass)."""
+    di = model.datainfo
+    domain = getattr(di, "response_domain", None)
+    if not domain:
+        return preds.vec("predict").to_numpy()
+    if target_class is None:
+        target_class = domain[-1] if len(domain) == 2 else domain[0]
+    if target_class not in domain:
+        raise ValueError(f"target_class {target_class!r} not in response "
+                         f"domain {domain}")
+    return preds.vec(target_class).to_numpy()
+
+
+def _grid_for(vec: Vec, nbins: int) -> List:
+    if vec.type == T_CAT:
+        return list(range(len(vec.domain)))
+    x = vec.to_numpy()
+    x = x[np.isfinite(x)]
+    if len(x) == 0:
+        return [0.0]
+    # equally spaced over the observed range, like pd_plot's default
+    return list(np.linspace(float(x.min()), float(x.max()),
+                            min(nbins, max(len(np.unique(x)), 2))))
+
+
+def _with_constant(frame: Frame, column: str, value, vec: Vec) -> Frame:
+    n = frame.nrows
+    if vec.type == T_CAT:
+        arr = np.full(n, int(value), dtype=np.int32)
+        newv = Vec.from_numpy(arr, T_CAT, domain=vec.domain)
+    else:
+        newv = Vec.from_numpy(np.full(n, float(value)), T_NUM)
+    return frame.with_vec(column, newv)
+
+
+def partial_dependence(model, frame: Frame, column: str,
+                       nbins: int = 20,
+                       target_class: Optional[str] = None,
+                       ) -> Dict[str, np.ndarray]:
+    """One-column PDP — h2o.pd_plot / PartialDependence.java analog.
+
+    For each grid value g: score the frame with ``column`` forced to g
+    and average the response.  Returns arrays keyed grid/value labels,
+    mean_response, stddev_response, std_error_mean_response.
+    """
+    vec = frame.vec(column)
+    grid = _grid_for(vec, nbins)
+    means, sds, ses = [], [], []
+    for g in grid:
+        r = _response_col(model, model.predict(
+            _with_constant(frame, column, g, vec)), target_class)
+        means.append(float(np.mean(r)))
+        sds.append(float(np.std(r, ddof=1)) if len(r) > 1 else 0.0)
+        ses.append(sds[-1] / np.sqrt(len(r)) if len(r) > 1 else 0.0)
+    labels = ([vec.domain[int(g)] for g in grid]
+              if vec.type == T_CAT else grid)
+    return {"column": column, "grid": np.asarray(labels, dtype=object),
+            "mean_response": np.asarray(means),
+            "stddev_response": np.asarray(sds),
+            "std_error_mean_response": np.asarray(ses)}
+
+
+def ice(model, frame: Frame, column: str, nbins: int = 20,
+        sample_rows: int = 50, seed: int = 0,
+        target_class: Optional[str] = None) -> Dict[str, np.ndarray]:
+    """Individual Conditional Expectation curves (h2o.ice_plot analog):
+    the PDP decomposed per row, on a row subsample.  The grid comes from
+    the FULL column distribution; only the sampled rows are scored."""
+    vec = frame.vec(column)
+    grid = _grid_for(vec, nbins)
+    rng = np.random.default_rng(seed)
+    rows = (np.sort(rng.choice(frame.nrows, sample_rows, replace=False))
+            if frame.nrows > sample_rows else np.arange(frame.nrows))
+    sub = frame.rows(rows) if len(rows) < frame.nrows else frame
+    subvec = sub.vec(column)
+    curves = np.empty((len(rows), len(grid)))
+    for j, g in enumerate(grid):
+        curves[:, j] = _response_col(model, model.predict(
+            _with_constant(sub, column, g, subvec)), target_class)
+    labels = ([vec.domain[int(g)] for g in grid]
+              if vec.type == T_CAT else grid)
+    return {"column": column, "grid": np.asarray(labels, dtype=object),
+            "rows": rows, "curves": curves,
+            "pdp": curves.mean(axis=0)}
+
+
+def shap_summary(model, frame: Frame, top_n: int = 20) -> Dict[str, np.ndarray]:
+    """Mean |TreeSHAP| ranking — shap_summary_plot's table."""
+    contribs = model.predict_contributions(frame)
+    feats = [c for c in contribs.names if c != "BiasTerm"]
+    M = contribs[feats].to_numpy()          # one host transfer
+    mean_abs = np.abs(M).mean(axis=0)
+    order = np.argsort(-mean_abs)[:top_n]
+    return {"feature": np.asarray([feats[i] for i in order], dtype=object),
+            "mean_abs_contribution": mean_abs[order]}
+
+
+def residual_analysis(model, frame: Frame) -> Dict[str, np.ndarray]:
+    """Residuals vs fitted (regression) — residual_analysis_plot's data."""
+    y = frame.vec(model.params.response_column).to_numpy()
+    fitted = model.predict(frame).vec("predict").to_numpy()
+    resid = y - fitted
+    ok = np.isfinite(resid)
+    return {"fitted": fitted, "residual": resid,
+            "mean": float(np.mean(resid[ok])),
+            "std": float(np.std(resid[ok], ddof=1)) if ok.sum() > 1 else 0.0,
+            "rmse": float(np.sqrt(np.mean(resid[ok] ** 2)))}
+
+
+def explain(model, frame: Frame, top_n: int = 5,
+            nbins: int = 20) -> Dict[str, object]:
+    """The h2o.explain(model, frame) bundle, as data."""
+    out: Dict[str, object] = {}
+    vi: Optional[dict] = None
+    try:
+        vi = model.varimp()
+    except Exception:                       # noqa: BLE001 — not all models
+        # standardized coefficients where available (scale-free, the
+        # reference's GLM varimp basis); raw betas only as a last resort
+        coefs = getattr(model, "coef_norm", None) or             getattr(model, "coef", None)
+        if callable(coefs):
+            coefs = coefs()
+        if isinstance(coefs, dict):
+            c = {k: abs(v) for k, v in coefs.items() if k != "Intercept"}
+            if c:
+                mx = max(c.values()) or 1.0
+                vi = {k: v / mx for k, v in
+                      sorted(c.items(), key=lambda kv: -kv[1])}
+    if vi:
+        out["varimp"] = vi
+    if vi:
+        # fold one-hot coefficient names ("g.b") back onto frame columns
+        cols = []
+        for k in vi:
+            base = k if k in frame.names else k.rsplit(".", 1)[0]
+            if base in frame.names and base not in cols:
+                cols.append(base)
+            if len(cols) == top_n:
+                break
+    else:
+        cols = [c for c in frame.names
+                if c != model.params.response_column][:top_n]
+    out["pdp"] = {c: partial_dependence(model, frame, c, nbins=nbins)
+                  for c in cols}
+    if hasattr(model, "predict_contributions"):
+        try:
+            out["shap_summary"] = shap_summary(model, frame)
+        except Exception:                   # noqa: BLE001 — multinomial etc.
+            pass
+    if not getattr(model.datainfo, "response_domain", None):
+        out["residual_analysis"] = residual_analysis(model, frame)
+    return out

@@ -156,3 +156,56 @@ def test_mojo_contributions_roundtrip(cl, rng, tmp_path):
                           for nm in fr.names}))
     np.testing.assert_allclose(out["contributions"],
                                live.to_numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_partial_dependence_and_ice(cl, rng):
+    import h2o3_tpu
+    from h2o3_tpu import explain as ex
+    from h2o3_tpu.models import GBM
+    n = 500
+    X = rng.normal(size=(n, 2))
+    g = rng.integers(0, 3, n)
+    y = X[:, 0] + 0.8 * (g == 1) + 0.1 * rng.normal(size=n) > 0
+    fr = h2o3_tpu.Frame.from_numpy({
+        "x0": X[:, 0], "x1": X[:, 1],
+        "g": np.array(["a", "b", "c"], object)[g],
+        "y": np.where(y, "YES", "NO").astype(object)})
+    m = GBM(response_column="y", ntrees=5, max_depth=3, seed=1).train(fr)
+    pd = ex.partial_dependence(m, fr, "x0", nbins=8)
+    assert len(pd["grid"]) == 8
+    # response must rise with x0 (the true signal)
+    assert pd["mean_response"][-1] > pd["mean_response"][0] + 0.1
+    assert (pd["std_error_mean_response"] >= 0).all()
+    # categorical grid uses the domain; level b carries the +0.8 signal
+    pdg = ex.partial_dependence(m, fr, "g")
+    assert list(pdg["grid"]) == ["a", "b", "c"]
+    assert pdg["mean_response"][1] == pdg["mean_response"].max()
+    # ICE curves average back to the PDP by construction
+    ic = ex.ice(m, fr, "x0", nbins=5, sample_rows=20, seed=3)
+    assert ic["curves"].shape == (20, 5)
+    np.testing.assert_allclose(ic["pdp"], ic["curves"].mean(axis=0))
+
+
+def test_explain_bundle(cl, rng):
+    import h2o3_tpu
+    from h2o3_tpu import explain as ex
+    from h2o3_tpu.models import GBM, GLM
+    n = 400
+    X = rng.normal(size=(n, 3))
+    yb = X[:, 0] > 0
+    fr = h2o3_tpu.Frame.from_numpy({
+        "x0": X[:, 0], "x1": X[:, 1], "x2": X[:, 2],
+        "y": np.where(yb, "YES", "NO").astype(object)})
+    m = GBM(response_column="y", ntrees=4, max_depth=3, seed=1).train(fr)
+    b = ex.explain(m, fr, top_n=2, nbins=6)
+    assert {"varimp", "pdp", "shap_summary"} <= set(b)
+    assert list(b["shap_summary"]["feature"])[0] == "x0"
+    assert all(len(t["mean_response"]) > 0 for t in b["pdp"].values())
+    # regression GLM: varimp falls back to |coef|, residuals included
+    yr = 2.0 * X[:, 0] + 0.05 * rng.normal(size=n)
+    fr2 = h2o3_tpu.Frame.from_numpy(
+        {"x0": X[:, 0], "x1": X[:, 1], "x2": X[:, 2], "y": yr})
+    glm = GLM(response_column="y", family="gaussian").train(fr2)
+    b2 = ex.explain(glm, fr2, top_n=2)
+    assert list(b2["varimp"])[0] == "x0"
+    assert b2["residual_analysis"]["rmse"] < 0.2
