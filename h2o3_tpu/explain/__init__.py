@@ -17,6 +17,10 @@ Entry points:
   (regression).
 - ``explain(model, frame)`` — the bundle: varimp, PDPs for the top
   features, SHAP summary and residuals where applicable.
+- ``learning_curve(model)`` — scoring-history series.
+- ``varimp_heatmap(models)`` — feature x model importance matrix.
+- ``model_correlation(models, frame)`` — prediction agreement matrix
+  (label-agreement fraction for classifiers, Pearson for regression).
 """
 
 from __future__ import annotations
@@ -29,7 +33,8 @@ from ..frame.frame import Frame
 from ..frame.vec import T_CAT, T_NUM, Vec
 
 __all__ = ["partial_dependence", "ice", "shap_summary",
-           "residual_analysis", "explain"]
+           "residual_analysis", "explain", "learning_curve",
+           "varimp_heatmap", "model_correlation"]
 
 
 def _response_col(model, preds: Frame,
@@ -151,21 +156,7 @@ def explain(model, frame: Frame, top_n: int = 5,
             nbins: int = 20) -> Dict[str, object]:
     """The h2o.explain(model, frame) bundle, as data."""
     out: Dict[str, object] = {}
-    vi: Optional[dict] = None
-    try:
-        vi = model.varimp()
-    except Exception:                       # noqa: BLE001 — not all models
-        # standardized coefficients where available (scale-free, the
-        # reference's GLM varimp basis); raw betas only as a last resort
-        coefs = getattr(model, "coef_norm", None) or             getattr(model, "coef", None)
-        if callable(coefs):
-            coefs = coefs()
-        if isinstance(coefs, dict):
-            c = {k: abs(v) for k, v in coefs.items() if k != "Intercept"}
-            if c:
-                mx = max(c.values()) or 1.0
-                vi = {k: v / mx for k, v in
-                      sorted(c.items(), key=lambda kv: -kv[1])}
+    vi = _varimp_of(model)
     if vi:
         out["varimp"] = vi
     if vi:
@@ -190,3 +181,73 @@ def explain(model, frame: Frame, top_n: int = 5,
     if not getattr(model.datainfo, "response_domain", None):
         out["residual_analysis"] = residual_analysis(model, frame)
     return out
+
+
+def learning_curve(model) -> Dict[str, np.ndarray]:
+    """Scoring-history curves (h2o.learning_curve_plot's table)."""
+    hist = getattr(model, "scoring_history", None) or []
+    if not hist:
+        return {}
+    keys = [k for k in hist[0] if isinstance(hist[0][k], (int, float))]
+    return {k: np.asarray([h.get(k, np.nan) for h in hist]) for k in keys}
+
+
+def _varimp_of(model) -> Optional[dict]:
+    try:
+        return model.varimp()
+    except Exception:                       # noqa: BLE001 — not all models
+        coefs = getattr(model, "coef_norm", None) or \
+            getattr(model, "coef", None)
+        if callable(coefs):
+            coefs = coefs()
+        if isinstance(coefs, dict):
+            c = {k: abs(v) for k, v in coefs.items() if k != "Intercept"}
+            if c:
+                mx = max(c.values()) or 1.0
+                return {k: v / mx for k, v in
+                        sorted(c.items(), key=lambda kv: -kv[1])}
+    return None
+
+
+def varimp_heatmap(models: List) -> Dict[str, np.ndarray]:
+    """Feature x model importance matrix (h2o.varimp_heatmap's table).
+
+    Rows are the union of features (NaN where a model lacks one),
+    ordered by mean importance across models.
+    """
+    vis = [(getattr(m, "key", f"model_{i}"), _varimp_of(m) or {})
+           for i, m in enumerate(models)]
+    feats = sorted({f for _, vi in vis for f in vi},
+                   key=lambda f: -np.mean([vi.get(f, 0.0)
+                                           for _, vi in vis]))
+    M = np.full((len(feats), len(vis)), np.nan)
+    for j, (_, vi) in enumerate(vis):
+        for i, f in enumerate(feats):
+            if f in vi:
+                M[i, j] = vi[f]
+    return {"feature": np.asarray(feats, dtype=object),
+            "model": np.asarray([k for k, _ in vis], dtype=object),
+            "importance": M}
+
+
+def model_correlation(models: List, frame: Frame) -> Dict[str, np.ndarray]:
+    """Pairwise agreement of model predictions on ``frame``
+    (h2o.model_correlation_heatmap's table): for classifiers the
+    fraction of identical predicted labels (the reference's measure for
+    categorical responses), for regression the Pearson correlation."""
+    classify = bool(getattr(models[0].datainfo, "response_domain", None))
+    if classify:
+        labels = [np.asarray(m.predict(frame).vec("predict").to_numpy())
+                  for m in models]
+        k = len(models)
+        C = np.eye(k)
+        for i in range(k):
+            for j in range(i + 1, k):
+                C[i, j] = C[j, i] = float(np.mean(labels[i] == labels[j]))
+    else:
+        P = np.stack([_response_col(m, m.predict(frame)) for m in models])
+        C = np.corrcoef(P)
+    return {"model": np.asarray([getattr(m, "key", f"model_{i}")
+                                 for i, m in enumerate(models)],
+                                dtype=object),
+            "correlation": C}

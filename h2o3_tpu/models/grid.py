@@ -13,6 +13,8 @@ SURVEY.md §7 — entries are embarrassingly parallel.)
 
 from __future__ import annotations
 
+import json
+
 import itertools
 import time
 from typing import Dict, List, Optional, Sequence
@@ -85,6 +87,37 @@ class Grid:
                          self.sort_metric: model_metric(
                              self.models[i], self.sort_metric)})
         return rows
+
+    def save(self, path: str) -> str:
+        """Persist the grid (h2o.save_grid analog): one file per model
+        plus a manifest, under any persist URI prefix."""
+        from .. import persist
+        for i, m in enumerate(self.models):
+            m.save(f"{path}/model_{i}.bin")
+        with persist.open_write(f"{path}/grid.json") as f:
+            f.write(json.dumps(
+                {"key": self.key, "n_models": len(self.models),
+                 "hyper_names": self.hyper_names, "entries": self.entries,
+                 "sort_metric": self.sort_metric,
+                 "decreasing": self.decreasing},
+                # hyper values are often numpy scalars (np.arange grids)
+                default=lambda o: o.item() if hasattr(o, "item") else str(o),
+            ).encode())
+        return path
+
+    @staticmethod
+    def load(path: str) -> "Grid":
+        """h2o.load_grid analog."""
+        from .. import persist
+        with persist.open_read(f"{path}/grid.json") as f:
+            meta = json.loads(f.read().decode())
+        models = [Model.load(f"{path}/model_{i}.bin")
+                  for i in range(meta["n_models"])]
+        return Grid(meta["key"], models,
+                    hyper_names=meta["hyper_names"],
+                    entries=meta["entries"],
+                    sort_metric=meta["sort_metric"],
+                    decreasing=meta["decreasing"])
 
     def __repr__(self):
         return (f"<Grid {self.key}: {len(self.models)} models by "

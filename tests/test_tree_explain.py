@@ -209,3 +209,38 @@ def test_explain_bundle(cl, rng):
     b2 = ex.explain(glm, fr2, top_n=2)
     assert list(b2["varimp"])[0] == "x0"
     assert b2["residual_analysis"]["rmse"] < 0.2
+
+
+def test_explain_extras_and_grid_io(cl, rng, tmp_path, monkeypatch):
+    import h2o3_tpu
+    from h2o3_tpu import explain as ex
+    from h2o3_tpu.models import GBM, GLM
+    from h2o3_tpu.models.grid import Grid, GridSearch
+    n = 300
+    X = rng.normal(size=(n, 2))
+    y = np.where(X[:, 0] > 0, "YES", "NO").astype(object)
+    fr = h2o3_tpu.Frame.from_numpy({"x0": X[:, 0], "x1": X[:, 1], "y": y})
+    m1 = GBM(response_column="y", ntrees=3, max_depth=2, seed=1).train(fr)
+    m2 = GLM(response_column="y", family="binomial").train(fr)
+    # learning curve from scoring history (may be empty for tiny runs)
+    lc = ex.learning_curve(m1)
+    assert isinstance(lc, dict)
+    # varimp heatmap over mixed model types
+    hm = ex.varimp_heatmap([m1, m2])
+    assert hm["importance"].shape == (len(hm["feature"]), 2)
+    assert hm["feature"][0] == "x0"        # strongest for both
+    # model correlation: both models learn the same signal
+    mc = ex.model_correlation([m1, m2], fr)
+    assert mc["correlation"].shape == (2, 2)
+    assert mc["correlation"][0, 1] > 0.7
+    # grid save/load round trip through a persist URI
+    monkeypatch.setenv("H2O3_TPU_GCS_ROOT", str(tmp_path / "gcs"))
+    grid = GridSearch(GBM, {"max_depth": [2, 3]},
+                      response_column="y", ntrees=2, seed=1).train(fr)
+    grid.save("gcs://grids/g1")
+    back = Grid.load("gcs://grids/g1")
+    assert len(back.models) == len(grid.models)
+    assert back.sort_metric == grid.sort_metric
+    p1 = grid.best_model.predict(fr).vec("YES").to_numpy()
+    p2 = back.best_model.predict(fr).vec("YES").to_numpy()
+    np.testing.assert_allclose(p1, p2, rtol=1e-6)
