@@ -21,6 +21,8 @@ Entry points:
 - ``varimp_heatmap(models)`` — feature x model importance matrix.
 - ``model_correlation(models, frame)`` — prediction agreement matrix
   (label-agreement fraction for classifiers, Pearson for regression).
+- ``explain_models(models, frame)`` — the multi-model bundle (AutoML
+  leaderboards): heatmap + agreement + the leader's explain().
 """
 
 from __future__ import annotations
@@ -34,7 +36,7 @@ from ..frame.vec import T_CAT, T_NUM, Vec
 
 __all__ = ["partial_dependence", "ice", "shap_summary",
            "residual_analysis", "explain", "learning_curve",
-           "varimp_heatmap", "model_correlation"]
+           "varimp_heatmap", "model_correlation", "explain_models"]
 
 
 def _response_col(model, preds: Frame,
@@ -251,3 +253,17 @@ def model_correlation(models: List, frame: Frame) -> Dict[str, np.ndarray]:
                                  for i, m in enumerate(models)],
                                 dtype=object),
             "correlation": C}
+
+
+def explain_models(models: List, frame: Frame, top_n: int = 5,
+                   nbins: int = 20) -> Dict[str, object]:
+    """Multi-model explain — the h2o.explain(aml/list) analog: global
+    varimp heatmap + prediction-agreement matrix + the single-model
+    bundle for the leader (first model)."""
+    if not models:
+        return {"varimp_heatmap": varimp_heatmap([])}
+    return {
+        "varimp_heatmap": varimp_heatmap(models),
+        "model_correlation": model_correlation(models, frame),
+        "leader": explain(models[0], frame, top_n=top_n, nbins=nbins),
+    }

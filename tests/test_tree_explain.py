@@ -244,3 +244,19 @@ def test_explain_extras_and_grid_io(cl, rng, tmp_path, monkeypatch):
     p1 = grid.best_model.predict(fr).vec("YES").to_numpy()
     p2 = back.best_model.predict(fr).vec("YES").to_numpy()
     np.testing.assert_allclose(p1, p2, rtol=1e-6)
+
+
+def test_explain_models_bundle(cl, rng):
+    import h2o3_tpu
+    from h2o3_tpu import explain as ex
+    from h2o3_tpu.models import GBM, GLM
+    X = rng.normal(size=(200, 2))
+    y = np.where(X[:, 0] > 0, "Y", "N").astype(object)
+    fr = h2o3_tpu.Frame.from_numpy({"x0": X[:, 0], "x1": X[:, 1], "y": y})
+    ms = [GBM(response_column="y", ntrees=3, max_depth=2, seed=1).train(fr),
+          GLM(response_column="y", family="binomial").train(fr)]
+    b = ex.explain_models(ms, fr, top_n=2)
+    assert {"varimp_heatmap", "model_correlation", "leader"} <= set(b)
+    # classifiers: agreement fraction, symmetric with unit diagonal
+    C = b["model_correlation"]["correlation"]
+    assert C[0, 0] == 1.0 and C[0, 1] == C[1, 0] and 0 <= C[0, 1] <= 1
