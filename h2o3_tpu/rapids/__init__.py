@@ -16,7 +16,8 @@ psum'd over the mesh, merge = binary search against the sorted build side
 """
 
 from .ops import (sort, group_by, merge, rbind, cbind, filter_rows, unique,
-                  table, ifelse, hist, impute, cut, scale, interaction)
+                  table, ifelse, hist, impute, cut, scale, interaction,
+                  var, cor)
 from .strings import (toupper, tolower, trim, lstrip, rstrip, substring,
                       sub, gsub, nchar, strsplit, countmatches)
 from .ast import rapids

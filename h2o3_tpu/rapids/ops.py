@@ -547,3 +547,52 @@ def merge(left: Frame, right: Frame, by: Union[str, Sequence[str]],
                                m, na_mask=ridx < 0)
         out = cbind(out, rsub)
     return out
+
+
+def var(frame: Frame, cols: Optional[Sequence[str]] = None,
+        use: str = "complete.obs") -> Dict[str, np.ndarray]:
+    """Covariance matrix — h2o.var / CovarianceTask analog.
+
+    ``use``: "complete.obs" drops rows with any NA across the selected
+    columns (the reference's default for frames); "everything"
+    propagates NaN like R.  Device path: masked mean-centering, then
+    one X^T X matmul (MXU) over the row-sharded matrix.
+    """
+    cols = list(cols) if cols is not None else \
+        [n for n in frame.names if frame.vec(n).is_numeric]
+    M = frame.matrix(cols)                     # [padded, F]
+    # categorical codes use -1 as the NA sentinel; align with numeric NaN
+    is_cat = np.array([frame.vec(c).type == T_CAT for c in cols])
+    if is_cat.any():
+        M = jnp.where(jnp.asarray(is_cat)[None, :] & (M == -1), jnp.nan, M)
+    valid = frame.valid_mask()
+    finite = jnp.isfinite(M)
+    if use == "complete.obs":
+        row_ok = valid & finite.all(axis=1)
+    elif use == "everything":
+        row_ok = valid
+    else:
+        raise ValueError(f"unknown use={use!r}")
+    n = float(row_ok.sum())
+    if n < 2:                                  # R/h2o return NA here
+        return {"columns": cols,
+                "matrix": np.full((len(cols), len(cols)), np.nan)}
+    Mz = jnp.where(row_ok[:, None], jnp.where(finite, M, jnp.nan), 0.0)
+    # complete.obs rows carry no NaN; "everything" lets NaN propagate
+    # per column pair, matching R's semantics
+    mean = Mz.sum(axis=0) / n
+    D = (Mz - mean) * row_ok.astype(M.dtype)[:, None]
+    C = jnp.einsum("rf,rg->fg", D, D,
+                   precision=jax.lax.Precision.HIGHEST) / (n - 1.0)
+    return {"columns": cols, "matrix": np.asarray(C, dtype=np.float64)}
+
+
+def cor(frame: Frame, cols: Optional[Sequence[str]] = None,
+        use: str = "complete.obs") -> Dict[str, np.ndarray]:
+    """Pearson correlation matrix — h2o.cor analog (from ``var``)."""
+    v = var(frame, cols, use=use)
+    C = v["matrix"]
+    sd = np.sqrt(np.diag(C))
+    with np.errstate(invalid="ignore", divide="ignore"):
+        R = np.clip(C / np.outer(sd, sd), -1.0, 1.0)
+    return {"columns": v["columns"], "matrix": R}

@@ -202,3 +202,51 @@ def test_filter_unique_table_ifelse_hist(cl, rng):
     assert counts.sum() == n
     np_counts, _ = np.histogram(x, bins=edges)
     np.testing.assert_allclose(counts[1:-1], np_counts[1:-1], atol=1)
+
+
+def test_var_cor(cl, rng):
+    import h2o3_tpu
+    from h2o3_tpu.rapids import var, cor
+    n = 400
+    x = rng.normal(size=n)
+    y = 2.0 * x + 0.5 * rng.normal(size=n)
+    z = rng.normal(size=n)
+    x_na = x.copy(); x_na[::50] = np.nan
+    fr = h2o3_tpu.Frame.from_numpy({"x": x_na, "y": y, "z": z})
+    v = var(fr)
+    assert v["columns"] == ["x", "y", "z"]
+    ok = np.isfinite(x_na)
+    expected = np.cov(np.stack([x_na[ok], y[ok], z[ok]]))
+    np.testing.assert_allclose(v["matrix"], expected, rtol=1e-4, atol=1e-4)
+    c = cor(fr)
+    exp_c = np.corrcoef(np.stack([x_na[ok], y[ok], z[ok]]))
+    np.testing.assert_allclose(c["matrix"], exp_c, rtol=1e-4, atol=1e-4)
+    assert c["matrix"][0, 1] > 0.9
+    # "everything": NaN propagates to pairs involving the NA column
+    ce = cor(fr, use="everything")["matrix"]
+    assert np.isnan(ce[0, 1]) and np.isfinite(ce[1, 2])
+
+
+def test_var_cor_edges(cl):
+    import h2o3_tpu
+    from h2o3_tpu.rapids import var, cor
+    # all rows incomplete -> NaN matrix, not fabricated values
+    fr = h2o3_tpu.Frame.from_numpy({
+        "a": np.array([1.0, np.nan, 3.0]),
+        "b": np.array([np.nan, 2.0, np.nan])})
+    assert np.isnan(var(fr)["matrix"]).all()
+    # categorical NA codes (-1) are NA, not the value -1
+    g = np.array(["x", "y", None, "x", "y", "x"], dtype=object)
+    fr2 = h2o3_tpu.Frame.from_numpy(
+        {"g": g, "v": np.arange(6.0)},
+        types={"g": "cat"}, domains={"g": ["x", "y"]})
+    v = var(fr2, cols=["g", "v"])
+    codes = np.array([0.0, 1.0, 0.0, 1.0, 0.0])
+    vals = np.array([0.0, 1.0, 3.0, 4.0, 5.0])
+    np.testing.assert_allclose(
+        v["matrix"], np.cov(np.stack([codes, vals])), rtol=1e-5, atol=1e-5)
+    # correlation is clipped into [-1, 1] even for perfect pairs
+    x = np.arange(20.0)
+    fr3 = h2o3_tpu.Frame.from_numpy({"x": x, "y": -x})
+    c = cor(fr3)["matrix"]
+    assert c[0, 1] == -1.0 and abs(c[0, 0]) <= 1.0
