@@ -1,0 +1,195 @@
+"""scikit-learn adapter layer — the h2o-py ``h2o/sklearn`` analog.
+
+Reference: ``h2o-py/h2o/sklearn/__init__.py`` wraps every estimator in
+sklearn-compatible classes so they compose with Pipeline/GridSearchCV.
+Here a small duck-typed base implements the sklearn estimator protocol
+(get_params/set_params/fit/predict/predict_proba/score — enough for
+clone() and Pipeline) around any builder class; numpy X/y round-trip
+through a device Frame.  No hard scikit-learn dependency: the classes
+work standalone, and pass sklearn.base.clone when sklearn is present.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .frame.frame import Frame
+
+__all__ = [
+    "H2OGradientBoostingClassifier", "H2OGradientBoostingRegressor",
+    "H2ORandomForestClassifier", "H2ORandomForestRegressor",
+    "H2OXGBoostClassifier", "H2OXGBoostRegressor",
+    "H2OGLMClassifier", "H2OGLMRegressor",
+    "H2ODeepLearningClassifier", "H2ODeepLearningRegressor",
+    "H2OKMeans",
+]
+
+_RESPONSE = "_sklearn_target"
+
+
+class _Base:
+    """sklearn estimator protocol around one builder class."""
+
+    _builder_name: str = ""
+    _classifier: bool = False
+    _extra_params: Dict[str, object] = {}
+
+    def __init__(self, **params):
+        # fitted-state attributes (model_, classes_, n_features_in_) are
+        # NOT pre-created: sklearn's check_is_fitted keys on their absence
+        self._params = dict(params)
+
+    # ------------------------------------------------- sklearn protocol
+    def get_params(self, deep: bool = True) -> dict:
+        return dict(self._params)
+
+    def set_params(self, **params) -> "_Base":
+        self._params.update(params)
+        return self
+
+    def __repr__(self):
+        args = ", ".join(f"{k}={v!r}" for k, v in self._params.items())
+        return f"{type(self).__name__}({args})"
+
+    def __sklearn_tags__(self):
+        # sklearn >= 1.6 Pipeline/clone consult estimator tags; build the
+        # default set lazily so scikit-learn stays an optional dependency
+        from sklearn.utils import (Tags, TargetTags, ClassifierTags,
+                                   RegressorTags)
+        if self._classifier:
+            return Tags(estimator_type="classifier",
+                        target_tags=TargetTags(required=True),
+                        classifier_tags=ClassifierTags())
+        return Tags(estimator_type="regressor",
+                    target_tags=TargetTags(required=False),
+                    regressor_tags=RegressorTags())
+
+    # -------------------------------------------------------- plumbing
+    def _builder(self, **kw):
+        from . import models
+        cls = getattr(models, self._builder_name)
+        return cls(**{**self._extra_params, **self._params, **kw})
+
+    def _frame(self, X, y=None) -> Frame:
+        X = np.asarray(X, dtype=np.float64)
+        if X.ndim != 2:
+            raise ValueError(
+                f"expected 2-D X, got shape {X.shape}; reshape a single "
+                "feature with X.reshape(-1, 1)")
+        cols = {f"x{j}": X[:, j] for j in range(X.shape[1])}
+        if y is not None:
+            if self._classifier:
+                y = np.asarray(y)
+                self.classes_ = np.unique(y)
+                cols[_RESPONSE] = np.asarray(
+                    [str(v) for v in y], dtype=object)
+            else:
+                cols[_RESPONSE] = np.asarray(y, dtype=np.float64)
+            self.n_features_in_ = X.shape[1]
+        return Frame.from_numpy(cols)
+
+    def _check_fitted(self):
+        if getattr(self, "model_", None) is None:
+            raise RuntimeError(
+                f"{type(self).__name__} is not fitted; call fit(X, y)")
+
+    # ------------------------------------------------------------- api
+    def _fit_overrides(self) -> dict:
+        return {}
+
+    def fit(self, X, y=None) -> "_Base":
+        from .runtime.cluster import cluster
+        cluster()                        # boots the mesh on first use
+        fr = self._frame(X, y)
+        self.model_ = self._builder(response_column=_RESPONSE,
+                                    **self._fit_overrides()).train(fr)
+        return self
+
+    def predict(self, X) -> np.ndarray:
+        self._check_fitted()
+        preds = self.model_.predict(self._frame(X))
+        if self._classifier:
+            labels = preds.vec("predict").decoded()
+            lut = {str(c): c for c in self.classes_}
+            return np.asarray([lut.get(str(v), v) for v in labels])
+        return preds.vec("predict").to_numpy()
+
+    def score(self, X, y) -> float:
+        yhat = self.predict(X)
+        y = np.asarray(y)
+        if self._classifier:
+            return float(np.mean(yhat == y))
+        ss_res = float(np.sum((y - yhat) ** 2))
+        ss_tot = float(np.sum((y - np.mean(y)) ** 2)) or 1.0
+        return 1.0 - ss_res / ss_tot
+
+
+def _predict_proba(self, X) -> np.ndarray:
+    self._check_fitted()
+    preds = self.model_.predict(self._frame(X))
+    return np.stack([preds.vec(str(c)).to_numpy()
+                     for c in self.classes_], axis=1)
+
+
+def _make(name: str, builder: str, classifier: bool,
+          extra: Optional[dict] = None) -> type:
+    ns = {
+        "_builder_name": builder,
+        "_classifier": classifier,
+        "_extra_params": extra or {},
+        "__doc__": f"sklearn-style wrapper over models.{builder} "
+                   f"({'classification' if classifier else 'regression'}).",
+    }
+    if classifier:
+        # only classifiers expose predict_proba: sklearn utilities probe
+        # with hasattr, so regressors must not carry the method at all
+        ns["predict_proba"] = _predict_proba
+    cls = type(name, (_Base,), ns)
+    cls.__module__ = __name__
+    return cls
+
+
+H2OGradientBoostingClassifier = _make(
+    "H2OGradientBoostingClassifier", "GBM", True)
+H2OGradientBoostingRegressor = _make(
+    "H2OGradientBoostingRegressor", "GBM", False)
+H2ORandomForestClassifier = _make("H2ORandomForestClassifier", "DRF", True)
+H2ORandomForestRegressor = _make("H2ORandomForestRegressor", "DRF", False)
+H2OXGBoostClassifier = _make("H2OXGBoostClassifier", "XGBoost", True)
+H2OXGBoostRegressor = _make("H2OXGBoostRegressor", "XGBoost", False)
+class H2OGLMClassifier(_make("H2OGLMClassifier", "GLM", True)):
+    """GLM classifier; family follows the class count (h2o-py does the
+    same) unless the user passes family explicitly."""
+
+    def _fit_overrides(self) -> dict:
+        if "family" in self._params:
+            return {}
+        return {"family": "binomial" if len(self.classes_) == 2
+                else "multinomial"}
+H2OGLMRegressor = _make("H2OGLMRegressor", "GLM", False,
+                        {"family": "gaussian"})
+H2ODeepLearningClassifier = _make(
+    "H2ODeepLearningClassifier", "DeepLearning", True)
+H2ODeepLearningRegressor = _make(
+    "H2ODeepLearningRegressor", "DeepLearning", False)
+
+
+class H2OKMeans(_Base):
+    """sklearn-style KMeans (fit/predict = cluster labels)."""
+
+    _builder_name = "KMeans"
+
+    def fit(self, X, y=None) -> "H2OKMeans":
+        from .runtime.cluster import cluster
+        cluster()
+        fr = self._frame(X)
+        self.n_features_in_ = fr.ncols
+        self.model_ = self._builder().train(fr)
+        return self
+
+    def predict(self, X) -> np.ndarray:
+        self._check_fitted()
+        return self.model_.predict(self._frame(X)) \
+            .vec("predict").to_numpy().astype(int)

@@ -1,0 +1,82 @@
+"""sklearn adapter tests — wrapper protocol, clone/Pipeline compat.
+
+Mirrors the reference's h2o-py/tests_sklearn smoke coverage.
+"""
+
+import numpy as np
+
+import h2o3_tpu  # noqa: F401  (cl fixture boots the mesh)
+
+
+def test_classifier_protocol(cl, rng):
+    from h2o3_tpu.sklearn import H2OGradientBoostingClassifier
+    X = rng.normal(size=(300, 3))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(int)
+    est = H2OGradientBoostingClassifier(ntrees=8, max_depth=3, seed=1)
+    assert est.get_params() == {"ntrees": 8, "max_depth": 3, "seed": 1}
+    est.fit(X, y)
+    yhat = est.predict(X)
+    assert yhat.dtype.kind in "il" and set(yhat) <= {0, 1}
+    assert est.score(X, y) > 0.9
+    proba = est.predict_proba(X)
+    assert proba.shape == (300, 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-5)
+    # column order follows classes_; labels use the model's own
+    # threshold (max-F1, like the reference), so compare rank agreement
+    assert list(est.classes_) == [0, 1]
+    assert np.mean((proba[:, 1] > 0.5).astype(int) == yhat) > 0.95
+
+
+def test_regressor_and_kmeans(cl, rng):
+    from h2o3_tpu.sklearn import H2OGLMRegressor, H2OKMeans
+    X = rng.normal(size=(300, 3))
+    y = X @ [1.0, -2.0, 0.5] + 0.05 * rng.normal(size=300)
+    r = H2OGLMRegressor().fit(X, y)
+    assert r.score(X, y) > 0.98
+    km = H2OKMeans(k=3, seed=1).fit(X)
+    labels = km.predict(X)
+    assert labels.shape == (300,) and len(set(labels)) <= 3
+
+
+def test_sklearn_clone_and_pipeline(cl, rng):
+    from sklearn.base import clone
+    from sklearn.pipeline import Pipeline
+    from sklearn.preprocessing import StandardScaler
+    from h2o3_tpu.sklearn import H2OGLMClassifier
+    X = rng.normal(size=(200, 2))
+    y = np.where(X[:, 0] > 0, "pos", "neg")
+    est = H2OGLMClassifier(lambda_=0.0)
+    c = clone(est)
+    assert c is not est and c.get_params() == est.get_params()
+    pipe = Pipeline([("scale", StandardScaler()),
+                     ("glm", H2OGLMClassifier())])
+    pipe.fit(X, y)
+    acc = float(np.mean(pipe.predict(X) == y))
+    assert acc > 0.9
+
+
+def test_sklearn_edge_contracts(cl, rng):
+    from h2o3_tpu.sklearn import (H2OGLMClassifier,
+                                  H2OGradientBoostingRegressor)
+    import pytest
+    X = rng.normal(size=(240, 2))
+    # multinomial auto-family from the class count
+    y3 = np.array(["a", "b", "c"], dtype=object)[
+        np.clip((X[:, 0] > -0.4).astype(int) + (X[:, 0] > 0.4), 0, 2)]
+    est = H2OGLMClassifier().fit(X, y3)
+    assert est.predict_proba(X).shape == (240, 3)
+    assert est.score(X, y3) > 0.8
+    # regressors carry no predict_proba at all (sklearn hasattr probes)
+    assert not hasattr(H2OGradientBoostingRegressor(), "predict_proba")
+    # unfitted state: fitted attributes absent, clear error on predict
+    fresh = H2OGLMClassifier()
+    assert not hasattr(fresh, "model_") and not hasattr(fresh, "classes_")
+    with pytest.raises(RuntimeError, match="not fitted"):
+        fresh.predict(X)
+    # 1-D X rejected with guidance
+    with pytest.raises(ValueError, match="2-D"):
+        H2OGLMClassifier().fit(X[:, 0], y3)
+    # n_features_in_ reflects fit data and survives predict calls
+    assert est.n_features_in_ == 2
+    est.predict(X)
+    assert est.n_features_in_ == 2
