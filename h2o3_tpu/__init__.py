@@ -19,7 +19,7 @@ from .frame.frame import Frame
 from .frame.vec import Vec
 from .frame.parse import (import_file, parse_csv, parse_files,
                           parse_svmlight, parse_arff, export_file,
-                          upload_string)
+                          upload_string, from_pandas, H2OFrame)
 from .frame.sql import import_sql_table, import_sql_select
 from .export.mojo import import_mojo
 

@@ -635,3 +635,80 @@ def export_file(frame: Frame, uri: str, header: bool = True) -> str:
 
 def upload_string(text: str, **kw) -> Frame:
     return parse_csv(io.StringIO(text), **kw)
+
+
+def from_pandas(df, destination_frame: Optional[str] = None) -> Frame:
+    """Build a Frame from a pandas DataFrame — the h2o.H2OFrame(df) path.
+
+    dtype mapping: numeric/bool -> num (bool as 0/1), datetime64 ->
+    time, pandas categorical -> cat preserving the category order,
+    object/string -> the parser's type guesser (_column_to_vec), so
+    mixed string columns come out num/time/cat/str exactly like a CSV
+    import of the same data.
+    """
+    import pandas as pd
+    names, vecs = [], []
+    for c in df.columns:
+        s = df[c]
+        name = str(c)
+        if isinstance(s.dtype, pd.CategoricalDtype):
+            domain = [str(v) for v in s.cat.categories]
+            # pandas already stores int codes with -1 = NA: pass through
+            vec = Vec.from_numpy(s.cat.codes.to_numpy(np.int32), T_CAT,
+                                 domain=domain)
+        elif s.dtype.kind == "b":
+            vec = Vec.from_numpy(
+                s.to_numpy(dtype=np.float64, na_value=np.nan), T_NUM)
+        elif s.dtype.kind in "iuf":
+            vec = Vec.from_numpy(s.to_numpy(dtype=np.float64,
+                                            na_value=np.nan), T_NUM)
+        elif s.dtype.kind == "M":
+            vec = _column_to_vec(s.to_numpy(), name)
+        else:
+            vals = np.asarray(["" if v is None or v is pd.NA else v
+                               for v in s.to_numpy()], dtype=object)
+            vec = _column_to_vec(vals, name)
+        names.append(name)
+        vecs.append(vec)
+    return Frame(names, vecs,
+                 key=destination_frame or dkv.make_key("pandas"))
+
+
+def H2OFrame(python_obj, destination_frame: Optional[str] = None) -> Frame:
+    """h2o.H2OFrame constructor analog: accepts a pandas DataFrame, a
+    dict of columns, a list of rows (first row = header if strings),
+    or a 2-D numpy array."""
+    try:
+        import pandas as pd
+        if isinstance(python_obj, pd.DataFrame):
+            return from_pandas(python_obj, destination_frame)
+    except ImportError:
+        pass
+    if isinstance(python_obj, dict):
+        names, vecs = [], []
+        for k, v in python_obj.items():
+            arr = np.asarray(v)
+            if arr.dtype == object:
+                arr = np.asarray(["" if x is None else x for x in arr],
+                                 dtype=object)
+            names.append(str(k))
+            vecs.append(_column_to_vec(arr, str(k)))
+        return Frame(names, vecs,
+                     key=destination_frame or dkv.make_key("pyobj"))
+    arr = np.asarray(python_obj, dtype=object)
+    one_d = arr.ndim == 1
+    if one_d:
+        arr = arr[:, None]
+    # header heuristic only for 2-D input: a 1-D list is pure data
+    if not one_d and arr.shape[0] and             all(isinstance(v, str) for v in arr[0]):
+        header, body = [str(v) for v in arr[0]], arr[1:]
+    else:
+        header, body = [f"C{j + 1}" for j in range(arr.shape[1])], arr
+    names, vecs = [], []
+    for j, name in enumerate(header):
+        vals = np.asarray(["" if v is None else v for v in body[:, j]],
+                          dtype=object)
+        names.append(name)
+        vecs.append(_column_to_vec(vals, name))
+    return Frame(names, vecs,
+                 key=destination_frame or dkv.make_key("pyobj"))

@@ -169,3 +169,57 @@ def test_sql_import(cl, tmp_path):
     assert fr2.nrows == 29 and fr2.names == ["age", "income"]
     with pytest.raises(NotImplementedError, match="DB-API"):
         h2o3_tpu.import_sql_table("jdbc:postgresql://x/y", "users")
+
+
+def test_from_pandas_and_h2oframe(cl):
+    import pandas as pd
+    df = pd.DataFrame({
+        "num": [1.5, 2.5, None],
+        "i": [1, 2, 3],
+        "b": [True, False, True],
+        "cat": pd.Categorical(["lo", "hi", None],
+                              categories=["lo", "mid", "hi"]),
+        "s": ["x", "y", "zzz-long-un1que"],
+        "t": pd.to_datetime(["2020-01-01", "2020-06-01", "2021-01-01"]),
+        "mixed": ["1", "2", "oops"],
+    })
+    fr = h2o3_tpu.from_pandas(df)
+    t = fr.types()
+    assert t["num"] == "num" and t["i"] == "num" and t["b"] == "num"
+    assert t["cat"] == "cat" and t["t"] == "time"
+    assert fr.vec("cat").domain == ["lo", "mid", "hi"]
+    x = fr.vec("num").to_numpy()
+    assert x[1] == 2.5 and np.isnan(x[2])
+    np.testing.assert_array_equal(fr.vec("b").to_numpy(), [1.0, 0.0, 1.0])
+    codes = fr.vec("cat").data
+    assert int(np.asarray(codes)[2]) == -1          # NaN category -> NA
+    assert t["mixed"] in ("cat", "str")             # not numeric
+    # H2OFrame: dict, list-of-rows with header, 2-D array
+    f2 = h2o3_tpu.H2OFrame({"a": [1.0, 2.0], "g": ["u", "v"]})
+    assert f2.shape == (2, 2) and f2.types()["g"] == "cat"
+    f3 = h2o3_tpu.H2OFrame([["a", "b"], [1, 2], [3, 4]])
+    assert f3.names == ["a", "b"] and f3.nrows == 2
+    np.testing.assert_array_equal(f3.vec("a").to_numpy(), [1.0, 3.0])
+    f4 = h2o3_tpu.H2OFrame(np.arange(6.0).reshape(3, 2))
+    assert f4.names == ["C1", "C2"] and f4.nrows == 3
+    # pandas round trip
+    back = fr.to_pandas()
+    assert list(back.columns) == list(df.columns)
+
+
+def test_h2oframe_edges(cl):
+    import pandas as pd
+    # nullable boolean with NA
+    fb = h2o3_tpu.from_pandas(pd.DataFrame(
+        {"b": pd.Series([True, None, False], dtype="boolean")}))
+    x = fb.vec("b").to_numpy()
+    assert x[0] == 1.0 and np.isnan(x[1]) and x[2] == 0.0
+    # dict with None stays numeric with NaN (no "None" category)
+    f = h2o3_tpu.H2OFrame({"a": [1.0, 2.0, None]})
+    assert f.types()["a"] == "num"
+    a = f.vec("a").to_numpy()
+    assert a[1] == 2.0 and np.isnan(a[2])
+    assert f.key is not None                 # registered in the DKV
+    # 1-D string list is data, not a header
+    f1 = h2o3_tpu.H2OFrame(["a", "b", "c"])
+    assert f1.nrows == 3 and f1.names == ["C1"]
