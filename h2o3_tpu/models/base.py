@@ -226,6 +226,20 @@ class ModelBuilder:
         """The full training driver (CV, post-fit hooks, checkpoint export)
         shared by the blocking and async entry points."""
         def _driver(job: Job) -> Model:
+            from ..runtime import recovery
+            journal = recovery.journal_start(self, frame, job)
+            try:
+                return self._driver_body(job, frame, di, valid, journal)
+            except BaseException as e:
+                # cancelled / deterministically failing jobs must not be
+                # resurrected as if the process had died
+                recovery.journal_fail(journal, repr(e))
+                raise
+        return _driver
+
+    def _driver_body(self, job: "Job", frame: Frame, di: DataInfo,
+                     valid: Optional[Frame], journal) -> Model:
+            from ..runtime import recovery
             t0 = time.time()
             if self.params.nfolds and self.params.nfolds > 1:
                 model = self._train_cv(job, frame, di, valid)
@@ -239,8 +253,8 @@ class ModelBuilder:
                 os.makedirs(self.params.export_checkpoints_dir, exist_ok=True)
                 model.save(os.path.join(self.params.export_checkpoints_dir,
                                         model.key + ".bin"))
+            recovery.journal_done(journal)
             return model
-        return _driver
 
     def _post_fit(self, model: Model, frame: Frame,
                   valid: Optional[Frame]) -> None:

@@ -1,0 +1,145 @@
+"""Generic job resurrection — training-job journal + resume.
+
+Reference: h2o's auto-recovery (AutoML's recovery dir generalized):
+interrupted training should be re-runnable after a cluster restart.
+When ``H2O3_TPU_RECOVERY_DIR`` is set (any persist URI), every
+ModelBuilder.train writes a journal entry (algo, params, frame key)
+before fitting and marks it done after; ``resume()`` re-trains every
+entry still marked running, provided its training frame has been
+re-imported under the same key (the reference's contract too — data is
+not journaled, only the work description).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import List, Optional
+
+
+def _dir() -> Optional[str]:
+    return os.environ.get("H2O3_TPU_RECOVERY_DIR") or None
+
+
+def _entry_uri(base: str, job_key: str) -> str:
+    return f"{base.rstrip('/')}/job_{job_key}.json"
+
+
+def _write_entry(uri: str, entry: dict) -> None:
+    from .. import persist
+    with persist.open_write(uri) as f:
+        f.write(json.dumps(entry).encode())
+
+
+def journal_start(builder, frame, job=None) -> Optional[str]:
+    """Record a training job about to run; returns the entry URI."""
+    base = _dir()
+    if not base:
+        return None
+    from .observability import log
+    # only JSON-clean params are journaled: a repr-stringified callable
+    # or array would resume into a silently broken builder
+    params, skipped = {}, []
+    for k, v in dataclasses.asdict(builder.params).items():
+        if hasattr(v, "item"):
+            v = v.item()
+        try:
+            json.dumps(v)
+            params[k] = v
+        except TypeError:
+            skipped.append(k)
+    entry = {
+        "algo": type(builder).__name__,
+        "params": params,
+        "skipped_params": skipped,
+        "frame_key": getattr(frame, "key", None),
+        "status": "running",
+    }
+    job = job or builder.job
+    uri = _entry_uri(base, job.key if job else "unkeyed")
+    try:
+        _write_entry(uri, entry)
+        if skipped:
+            log.warning("recovery journal for %s skips non-serializable "
+                        "params %s", entry["algo"], skipped)
+        return uri
+    except Exception as e:                     # noqa: BLE001 — best-effort
+        log.warning("recovery journal write failed: %r", e)
+        return None
+
+
+def journal_done(uri: Optional[str]) -> None:
+    """Mark a journal entry finished (entry removed — job completed)."""
+    if not uri:
+        return
+    from .. import persist
+    try:
+        persist.delete(uri)
+    except Exception:                          # noqa: BLE001
+        pass
+
+
+def journal_fail(uri: Optional[str], error: str) -> None:
+    """Re-mark an entry failed: cancelled or deterministically failing
+    jobs must NOT be resurrected — only process-death leaves 'running'."""
+    if not uri:
+        return
+    from .. import persist
+    try:
+        with persist.open_read(uri) as f:
+            entry = json.loads(f.read().decode())
+        entry["status"] = "failed"
+        entry["error"] = error[:500]
+        _write_entry(uri, entry)
+    except Exception:                          # noqa: BLE001
+        pass
+
+
+def resume(recovery_dir: Optional[str] = None) -> List[str]:
+    """Re-train every journaled job still marked running.
+
+    The training frame must already be back in the DKV under its
+    original key (re-import with the same destination_frame).  Returns
+    the keys of the models produced; entries whose frame is missing are
+    left in the journal and reported via the log.
+    """
+    from .. import persist
+    from . import dkv
+    from .observability import log
+    base = recovery_dir or _dir()
+    if not base:
+        return []
+    import h2o3_tpu.models as models
+    done: List[str] = []
+    for uri in persist.list_uris(f"{base.rstrip('/')}/job_*.json"):
+        try:
+            with persist.open_read(uri) as f:
+                entry = json.loads(f.read().decode())
+        except Exception as e:                 # noqa: BLE001
+            log.warning("recovery: unreadable journal entry %s: %r", uri, e)
+            continue
+        if entry.get("status") != "running":
+            continue
+        frame = dkv.get(entry.get("frame_key") or "")
+        if frame is None:
+            log.warning("recovery: frame %r not re-imported; skipping %s",
+                        entry.get("frame_key"), uri)
+            continue
+        cls = getattr(models, entry["algo"], None)
+        if cls is None:
+            log.warning("recovery: unknown algo %r in %s",
+                        entry["algo"], uri)
+            continue
+        params = {k: v for k, v in entry["params"].items()
+                  if v is not None}
+        try:
+            model = cls(**params).train(frame)
+        except Exception as e:                 # noqa: BLE001
+            log.warning("recovery: resumed %s failed (%r); marking "
+                        "failed", uri, e)
+            journal_fail(uri, repr(e))
+            continue
+        done.append(model.key)
+        persist.delete(uri)
+    return done

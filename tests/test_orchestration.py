@@ -169,3 +169,63 @@ def _frame_for_sched(X, rng):
     from h2o3_tpu import Frame as _F
     y = X @ [1.0, -1.0, 2.0] + 0.01 * rng.normal(size=len(X))
     return _F.from_numpy({**{f"x{j}": X[:, j] for j in range(3)}, "y": y})
+
+
+def test_job_resurrection(cl, rng, tmp_path, monkeypatch):
+    """Interrupted training journals survive and resume() re-trains them
+    once the frame is back under its original key."""
+    import json
+    import h2o3_tpu
+    from h2o3_tpu.runtime import recovery
+    from h2o3_tpu.models import GLM
+    rec = str(tmp_path / "recovery")
+    monkeypatch.setenv("H2O3_TPU_RECOVERY_DIR", rec)
+    n = 300
+    X = rng.normal(size=(n, 2))
+    y = X @ [1.0, -1.0] + 0.05 * rng.normal(size=n)
+    fr = h2o3_tpu.Frame.from_numpy(
+        {"x0": X[:, 0], "x1": X[:, 1], "y": y}, key="rec_frame")
+    # completed training removes its journal entry
+    GLM(response_column="y", family="gaussian").train(fr)
+    import glob
+    assert glob.glob(f"{rec}/job_*.json") == []
+    # simulate an interrupted run: hand-write a running entry
+    entry = {"algo": "GLM",
+             "params": {"response_column": "y", "family": "gaussian"},
+             "frame_key": "rec_frame", "status": "running"}
+    (tmp_path / "recovery" / "job_dead.json").write_text(json.dumps(entry))
+    keys = recovery.resume()
+    assert len(keys) == 1
+    m = h2o3_tpu.get_model(keys[0])
+    p = m.predict(fr).vec("predict").to_numpy()
+    assert np.corrcoef(p, y)[0, 1] > 0.99
+    assert glob.glob(f"{rec}/job_*.json") == []       # consumed
+    # missing frame -> entry kept, not crashed
+    entry["frame_key"] = "gone_frame"
+    (tmp_path / "recovery" / "job_dead2.json").write_text(json.dumps(entry))
+    assert recovery.resume() == []
+    assert glob.glob(f"{rec}/job_*.json") != []
+    h2o3_tpu.remove("rec_frame")
+
+
+def test_failed_jobs_not_resurrected(cl, rng, tmp_path, monkeypatch):
+    import glob
+    import json
+    import pytest
+    import h2o3_tpu
+    from h2o3_tpu.runtime import recovery
+    from h2o3_tpu.models import GLM
+    rec = str(tmp_path / "rec2")
+    monkeypatch.setenv("H2O3_TPU_RECOVERY_DIR", rec)
+    fr = h2o3_tpu.Frame.from_numpy(
+        {"x": rng.normal(size=50), "y": rng.normal(size=50)},
+        key="rec2_frame")
+    # a deterministic failure marks its entry failed instead of running
+    with pytest.raises(Exception):
+        GLM(response_column="nope", family="gaussian").train(fr)
+    entries = glob.glob(f"{rec}/job_*.json")
+    assert len(entries) == 0 or all(
+        json.loads(open(p).read())["status"] == "failed" for p in entries)
+    # resume() ignores failed entries entirely
+    assert recovery.resume() == []
+    h2o3_tpu.remove("rec2_frame")
