@@ -187,6 +187,39 @@ class Frame:
         import pandas as pd
         return pd.DataFrame({n: v.decoded() for n, v in zip(self.names, self.vecs)})
 
+    # ------------------------------------------------- munging sugar
+    # h2o-py's H2OFrame carries the munging verbs as methods; the device
+    # implementations live in rapids/ops.py and these delegate.
+    def sort(self, by, ascending=True) -> "Frame":
+        from ..rapids import ops
+        return ops.sort(self, by, ascending=ascending)
+
+    def merge(self, other: "Frame", by, how: str = "inner") -> "Frame":
+        from ..rapids import ops
+        return ops.merge(self, other, by, how=how)
+
+    def group_by(self, by, aggs) -> "Frame":
+        from ..rapids import ops
+        return ops.group_by(self, by, aggs)
+
+    def impute(self, column: str, method: str = "mean",
+               combine_method: str = "interpolate") -> "Frame":
+        from ..rapids import ops
+        return ops.impute(self, column, method=method,
+                          combine_method=combine_method)
+
+    def scale(self, center: bool = True, scale: bool = True) -> "Frame":
+        from ..rapids import ops
+        return ops.scale(self, center=center, scale_=scale)
+
+    def cor(self, cols=None, use: str = "complete.obs"):
+        from ..rapids import ops
+        return ops.cor(self, cols, use=use)
+
+    def var(self, cols=None, use: str = "complete.obs"):
+        from ..rapids import ops
+        return ops.var(self, cols, use=use)
+
     def spill(self) -> int:
         """Evict all device payloads to host RAM (Cleaner analog)."""
         freed = sum(int(m.nbytes) for m in self._matrix_cache.values())

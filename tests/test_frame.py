@@ -189,3 +189,25 @@ def test_spill_and_transparent_restore(cl, rng):
     assert got > 0 and fr.vec("a").is_spilled
     assert not fr2.vec("b").is_spilled
     dkv.remove("spillme"); dkv.remove("hot")
+
+
+def test_frame_munging_sugar(cl):
+    left = h2o3_tpu.Frame.from_numpy({
+        "k": np.array([3.0, 1.0, 2.0]), "v": np.array([30.0, 10.0, 20.0])})
+    right = h2o3_tpu.Frame.from_numpy({
+        "k": np.array([1.0, 2.0]), "w": np.array([100.0, 200.0])})
+    s = left.sort("k")
+    np.testing.assert_array_equal(s.vec("k").to_numpy(), [1.0, 2.0, 3.0])
+    m = left.merge(right, "k")
+    assert m.nrows == 2 and "w" in m.names
+    g = left.group_by("k", {"v": ["sum"]})
+    assert g.nrows == 3
+    c = left.cor(["k", "v"])
+    assert abs(c["matrix"][0, 1] - 1.0) < 1e-6   # v = 10*k exactly
+    sc = left.scale()
+    assert abs(float(np.mean(sc.vec("v").to_numpy()))) < 1e-6
+    v = left.var(["k", "v"])
+    assert abs(v["matrix"][0, 0] - 1.0) < 1e-6   # var of 1,2,3
+    na = h2o3_tpu.Frame.from_numpy({"a": np.array([1.0, np.nan, 3.0])})
+    imp = na.impute("a", method="median", combine_method="lo")
+    assert np.isfinite(imp.vec("a").to_numpy()).all()
