@@ -66,3 +66,29 @@ def remove_all() -> None:
 
 def cluster_status() -> dict:
     return cluster().describe()
+
+
+def assign(frame: Frame, key: str) -> Frame:
+    """h2o.assign analog: rebind a frame under a new DKV key (the vecs
+    are shared — Frames are immutable views, so no copy is needed)."""
+    out = Frame(frame.names, frame.vecs, key=key)
+    return out
+
+
+def deep_copy(frame: Frame, key: str) -> Frame:
+    """h2o.deep_copy analog: materialize independent column payloads."""
+    import numpy as np
+    from .frame.vec import Vec, T_STR, T_UUID
+    vecs = []
+    for v in frame.vecs:
+        if v.type in (T_STR, T_UUID):
+            vecs.append(Vec(None, v.type, v.nrows,
+                            host_data=np.array(v.host_data, dtype=object)))
+        else:
+            nv = Vec(v.data + 0 if v.data is not None else None, v.type,
+                     v.nrows, domain=v.domain,
+                     host_data=None if v.host_data is None
+                     else np.array(v.host_data),
+                     time_base=v.time_base)
+            vecs.append(nv)
+    return Frame(frame.names, vecs, key=key)

@@ -211,3 +211,14 @@ def test_frame_munging_sugar(cl):
     na = h2o3_tpu.Frame.from_numpy({"a": np.array([1.0, np.nan, 3.0])})
     imp = na.impute("a", method="median", combine_method="lo")
     assert np.isfinite(imp.vec("a").to_numpy()).all()
+
+
+def test_assign_and_deep_copy(cl):
+    fr = h2o3_tpu.Frame.from_numpy({"a": np.arange(4.0)})
+    h2o3_tpu.assign(fr, "alias1")
+    assert "alias1" in h2o3_tpu.ls()
+    cp = h2o3_tpu.deep_copy(fr, "copy_x")
+    assert cp.vec("a").data is not fr.vec("a").data
+    np.testing.assert_array_equal(cp.vec("a").to_numpy(),
+                                  fr.vec("a").to_numpy())
+    h2o3_tpu.remove("alias1"); h2o3_tpu.remove("copy_x")
