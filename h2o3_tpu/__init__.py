@@ -69,14 +69,24 @@ def cluster_status() -> dict:
 
 
 def assign(frame: Frame, key: str) -> Frame:
-    """h2o.assign analog: rebind a frame under a new DKV key (the vecs
-    are shared — Frames are immutable views, so no copy is needed)."""
-    out = Frame(frame.names, frame.vecs, key=key)
-    return out
+    """h2o.assign analog: REBIND the frame to ``key`` — the old DKV
+    binding is released, matching h2o-py's in-place id change."""
+    old = frame.key
+    frame.key = key
+    dkv.put(key, frame)
+    if old and old != key:
+        dkv.remove(old)
+    return frame
 
 
 def deep_copy(frame: Frame, key: str) -> Frame:
-    """h2o.deep_copy analog: materialize independent column payloads."""
+    """h2o.deep_copy analog: an independently-bound copy.
+
+    Device payloads are IMMUTABLE jax.Arrays, so they are shared —
+    only fresh Vec wrappers (independent spill/rollup/LRU state) and
+    copies of the mutable host-side object arrays are made; spilled
+    columns stay spilled rather than being pulled back onto HBM.
+    """
     import numpy as np
     from .frame.vec import Vec, T_STR, T_UUID
     vecs = []
@@ -84,11 +94,13 @@ def deep_copy(frame: Frame, key: str) -> Frame:
         if v.type in (T_STR, T_UUID):
             vecs.append(Vec(None, v.type, v.nrows,
                             host_data=np.array(v.host_data, dtype=object)))
-        else:
-            nv = Vec(v.data + 0 if v.data is not None else None, v.type,
-                     v.nrows, domain=v.domain,
-                     host_data=None if v.host_data is None
-                     else np.array(v.host_data),
-                     time_base=v.time_base)
-            vecs.append(nv)
+            continue
+        nv = Vec(v._device, v.type, v.nrows, domain=v.domain,
+                 host_data=None if v.host_data is None
+                 else np.array(v.host_data),
+                 time_base=v.time_base)
+        if v._spill is not None:
+            nv._spill = v._spill          # host copy shared: numpy is
+            nv._device = None             # only rebound, never mutated
+        vecs.append(nv)
     return Frame(frame.names, vecs, key=key)

@@ -214,11 +214,21 @@ def test_frame_munging_sugar(cl):
 
 
 def test_assign_and_deep_copy(cl):
-    fr = h2o3_tpu.Frame.from_numpy({"a": np.arange(4.0)})
-    h2o3_tpu.assign(fr, "alias1")
-    assert "alias1" in h2o3_tpu.ls()
+    fr = h2o3_tpu.Frame.from_numpy({"a": np.arange(4.0)}, key="orig_k")
+    out = h2o3_tpu.assign(fr, "alias1")
+    # true rebind: same frame object, old binding released
+    assert out is fr and fr.key == "alias1"
+    assert "alias1" in h2o3_tpu.ls() and "orig_k" not in h2o3_tpu.ls()
     cp = h2o3_tpu.deep_copy(fr, "copy_x")
-    assert cp.vec("a").data is not fr.vec("a").data
+    # device payloads are immutable and shared; wrappers independent
+    assert cp.vec("a") is not fr.vec("a")
     np.testing.assert_array_equal(cp.vec("a").to_numpy(),
                                   fr.vec("a").to_numpy())
-    h2o3_tpu.remove("alias1"); h2o3_tpu.remove("copy_x")
+    # spilled columns stay spilled through deep_copy (no HBM restore)
+    fr.spill()
+    cp2 = h2o3_tpu.deep_copy(fr, "copy_y")
+    assert fr.vec("a").is_spilled and cp2.vec("a").is_spilled
+    np.testing.assert_array_equal(cp2.vec("a").to_numpy(),
+                                  np.arange(4.0))
+    h2o3_tpu.remove("alias1")
+    h2o3_tpu.remove("copy_x"); h2o3_tpu.remove("copy_y")
