@@ -144,11 +144,19 @@ _UNARY = {
     "is.na": jnp.isnan,
 }
 
+_STRING = {
+    "toupper": "toupper", "tolower": "tolower", "trim": "trim",
+    "lstrip": "lstrip", "rstrip": "rstrip", "substring": "substring",
+    "replacefirst": "sub", "replaceall": "gsub", "nchar": "nchar",
+    "countmatches": "countmatches",
+}
+
 _AGG = {
     "sum": jnp.nansum, "mean": jnp.nanmean, "max": jnp.nanmax,
     "min": jnp.nanmin, "sd": lambda x: jnp.nanstd(x, ddof=1),
     "var": lambda x: jnp.nanvar(x, ddof=1), "median": jnp.nanmedian,
 }
+_AGG["cor"] = None  # matrix-only: handled before the scalar reduction
 
 
 class Session:
@@ -170,6 +178,13 @@ class Session:
         if isinstance(node, tuple) and node[0] == "str":
             return node[1]
         if isinstance(node, str):
+            # boolean tokens (Rapids.java parses these as 1/0)
+            if node in ("TRUE", "True", "true"):
+                return 1.0
+            if node in ("FALSE", "False", "false"):
+                return 0.0
+            if node in ("NA", "NaN", "nan"):
+                return float("nan")
             # bare identifier: a DKV key
             return self._frame(node)
         if not isinstance(node, list):
@@ -204,7 +219,41 @@ class Session:
             return Frame(fr.names, [Vec(out[:, j], T_NUM, fr.nrows)
                                     for j in range(out.shape[1])])
         if op in _AGG:
-            fr = _vecframe(ev(args[0]))
+            if op in ("var", "cor"):
+                # frame form -> covariance/correlation MATRIX
+                # (AstVariance); single column falls through to the
+                # scalar reduction.  Optional args: y frame (cross
+                # block via cbind) and the use mode string.
+                probe = ev(args[0])
+                rest = [ev(a) for a in args[1:]]
+                y = next((r for r in rest if isinstance(r, Frame)), None)
+                use = next((r for r in rest if isinstance(r, str)),
+                           "complete.obs")
+                if use == "all.obs":
+                    use = "complete.obs"
+                if isinstance(probe, Frame) and (probe.ncols > 1
+                                                 or y is not None):
+                    if y is not None and y is not probe:
+                        joint = ops.cbind(
+                            probe, y.rename(
+                                {n: f"__y_{n}" for n in y.names}))
+                        res = (ops.var if op == "var" else ops.cor)(
+                            joint, use=use)
+                        M = res["matrix"][:probe.ncols, probe.ncols:]
+                        return Frame(y.names,
+                                     [Vec.from_numpy(M[:, j], T_NUM)
+                                      for j in range(M.shape[1])])
+                    res = (ops.var if op == "var" else ops.cor)(
+                        probe, use=use)
+                    M = res["matrix"]
+                    return Frame(res["columns"],
+                                 [Vec.from_numpy(M[:, j], T_NUM)
+                                  for j in range(M.shape[1])])
+                if op == "cor":
+                    raise ValueError("cor needs a multi-column frame")
+                args = [probe] + list(args[1:])
+            fr = _vecframe(ev(args[0]) if not isinstance(args[0], (Frame, Vec))
+                           else args[0])
             X = _numeric(fr)[: None]
             mask = jnp.arange(X.shape[0]) < fr.nrows
             Xv = jnp.where(mask[:, None], X, jnp.nan)
@@ -308,6 +357,57 @@ class Session:
             fr = ev(args[0])
             probs = [float(p) for p in ev(args[1])]
             return quantile(fr, probs)
+        if op in _STRING:
+            from . import strings as _str
+            from ..frame.vec import T_STR
+            from ..frame.vec import T_CAT as _TC
+            fn = getattr(_str, _STRING[op])
+            vals = [ev(a) for a in args]
+            # h2o-py sends replacefirst/replaceall as (pattern,
+            # replacement, frame, ignore_case); everything else frame-first
+            fi = next(i for i, v in enumerate(vals)
+                      if isinstance(v, (Frame, Vec)))
+            target = vals[fi]
+            extra = [v for i, v in enumerate(vals) if i != fi]
+            if extra and isinstance(extra[-1], float) and \
+                    op in ("replacefirst", "replaceall"):
+                extra = extra[:-1]            # ignore_case flag: unused
+            # Rapids numeric tokens are floats; string fns take ints
+            extra = [int(v) if isinstance(v, float) and
+                     float(v).is_integer() else v for v in extra]
+            if isinstance(target, Vec):
+                return _vecframe(fn(target, *extra))
+            # frame form: transform every string column, preserve names
+            # (AstToUpper & co. apply per string column)
+            vecs = [fn(v, *extra) if v.type in (T_STR, _TC) else v
+                    for v in target.vecs]
+            return Frame(target.names, vecs)
+        if op == "scale":
+            fr = ev(args[0])
+            center = ev(args[1]) if len(args) > 1 else True
+            sc = ev(args[2]) if len(args) > 2 else True
+            if isinstance(center, list) or isinstance(sc, list):
+                raise NotImplementedError(
+                    "scale: per-column center/scale lists not supported; "
+                    "pass booleans")
+            return ops.scale(fr, center=bool(center), scale_=bool(sc))
+        if op in ("h2o.impute", "impute"):
+            fr = ev(args[0])
+            col = ev(args[1])
+            method = ev(args[2]) if len(args) > 2 else "mean"
+            combine = ev(args[3]) if len(args) > 3 else "interpolate"
+            if isinstance(col, float) and int(col) == -1:
+                # h2o-py sentinel: impute every numeric column with NAs
+                for name in fr.names:
+                    v = fr.vec(name)
+                    if v.is_numeric and v.rollups().nmissing:
+                        fr = ops.impute(fr, name, method=method,
+                                        combine_method=combine)
+                return fr
+            if not isinstance(col, str):
+                col = fr.names[int(col)]
+            return ops.impute(fr, col, method=method,
+                              combine_method=combine)
         raise ValueError(f"unknown rapids op {op!r}")
 
     def _col_names(self, fr: Frame, sel) -> List[str]:

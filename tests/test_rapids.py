@@ -250,3 +250,34 @@ def test_var_cor_edges(cl):
     fr3 = h2o3_tpu.Frame.from_numpy({"x": x, "y": -x})
     c = cor(fr3)["matrix"]
     assert c[0, 1] == -1.0 and abs(c[0, 0]) <= 1.0
+
+
+def test_rapids_ast_extended_ops(cl):
+    import h2o3_tpu
+    from h2o3_tpu.rapids import rapids
+    fr = h2o3_tpu.Frame.from_numpy(
+        {"s": np.array(["ab", "CD", " e "], object),
+         "x": np.array([1.0, 2.0, np.nan]),
+         "y": np.array([2.0, 4.0, 6.0])}, key="ast_ext")
+    up = rapids('(toupper (cols ast_ext "s"))')
+    assert list(up.vecs[0].decoded()) == ["AB", "CD", " E "]
+    assert list(rapids('(nchar (cols ast_ext "s"))')
+                .vecs[0].to_numpy()) == [2.0, 2.0, 3.0]
+    imp = rapids('(h2o.impute ast_ext "x" "median")')
+    assert np.isfinite(imp.vec("x").to_numpy()).all()
+    v = rapids('(var ast_ext)')
+    assert v.names == ["x", "y"]
+    c = rapids('(cor ast_ext)')
+    assert abs(c.vec("y").to_numpy()[1] - 1.0) < 1e-6    # cor(y,y)=1
+    sc = rapids('(scale ast_ext TRUE TRUE)')       # boolean tokens
+    assert abs(float(np.nanmean(sc.vec("y").to_numpy()))) < 1e-6
+    # client-order replaceall: (pattern, replacement, frame, ignore_case)
+    rep = rapids('(replaceall "a" "z" (cols ast_ext "s") FALSE)')
+    assert list(rep.vecs[0].decoded())[0] == "zb"
+    # substring numeric args arrive as floats; coerced to ints
+    sub = rapids('(substring (cols ast_ext "s") 0 1)')
+    assert list(sub.vecs[0].decoded()) == ["a", "C", " "]
+    # impute -1 sentinel fills every numeric column
+    allimp = rapids('(h2o.impute ast_ext -1 "mean")')
+    assert np.isfinite(allimp.vec("x").to_numpy()).all()
+    h2o3_tpu.remove("ast_ext")
