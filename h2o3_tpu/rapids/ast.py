@@ -44,11 +44,14 @@ class _Tok:
         if c in "'\"":
             q = c
             j = self.i + 1
+            out = []
             while j < len(self.text) and self.text[j] != q:
+                if self.text[j] == "\\" and j + 1 < len(self.text):
+                    j += 1                 # backslash escape (h2o-py _quote)
+                out.append(self.text[j])
                 j += 1
-            tok = self.text[self.i + 1: j]
             self.i = j + 1
-            return ("str", tok)
+            return ("str", "".join(out))
         j = self.i
         while j < len(self.text) and not self.text[j].isspace() \
                 and self.text[j] not in "()[]":

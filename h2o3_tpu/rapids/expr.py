@@ -228,6 +228,52 @@ class LazyFrame:
     def rbind(self, other: "LazyFrame") -> "LazyFrame":
         return self._op("rbind", other)
 
+    # -------------------------------------------------- string verbs
+    def toupper(self) -> "LazyFrame":
+        return self._op("toupper")
+
+    def tolower(self) -> "LazyFrame":
+        return self._op("tolower")
+
+    def trim(self) -> "LazyFrame":
+        return self._op("trim")
+
+    def nchar(self) -> "LazyFrame":
+        return self._op("nchar")
+
+    def substring(self, start: int, end=None) -> "LazyFrame":
+        return self._op("substring", start) if end is None else             self._op("substring", start, end)
+
+    def sub(self, pattern: str, replacement: str) -> "LazyFrame":
+        """Replace first match (client arg order, like h2o-py)."""
+        return LazyFrame(f"(replacefirst {_lit(pattern)} "
+                         f"{_lit(replacement)} {self.ast()} FALSE)",
+                         self._backend)
+
+    def gsub(self, pattern: str, replacement: str) -> "LazyFrame":
+        return LazyFrame(f"(replaceall {_lit(pattern)} "
+                         f"{_lit(replacement)} {self.ast()} FALSE)",
+                         self._backend)
+
+    def countmatches(self, pattern: str) -> "LazyFrame":
+        return self._op("countmatches", pattern)
+
+    # -------------------------------------------------- stats verbs
+    def scale(self, center: bool = True, scale: bool = True) -> "LazyFrame":
+        return self._op("scale", center, scale)
+
+    def impute(self, column, method: str = "mean") -> "LazyFrame":
+        return self._op("h2o.impute", column, method)
+
+    def var(self, use: str = "complete.obs"):
+        """Covariance matrix Frame for multi-column frames; a float
+        (like sd()/mean()) when the frame has a single column."""
+        out = self._backend.rapids(f'(var {self.ast()} {_quote(use)})')
+        return out if not isinstance(out, (int, float)) else float(out)
+
+    def cor(self, use: str = "complete.obs"):
+        return self._backend.rapids(f'(cor {self.ast()} {_quote(use)})')
+
     def cbind(self, other: "LazyFrame") -> "LazyFrame":
         return self._op("cbind", other)
 

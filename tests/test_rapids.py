@@ -281,3 +281,29 @@ def test_rapids_ast_extended_ops(cl):
     allimp = rapids('(h2o.impute ast_ext -1 "mean")')
     assert np.isfinite(allimp.vec("x").to_numpy()).all()
     h2o3_tpu.remove("ast_ext")
+
+
+def test_lazyframe_string_stats_verbs(cl):
+    import h2o3_tpu
+    from h2o3_tpu.rapids import lazy
+    fr = h2o3_tpu.Frame.from_numpy(
+        {"s": np.array(["aa", "ba"], object),
+         "x": np.array([1.0, 3.0]), "y": np.array([2.0, 6.0])},
+        key="lazy_sv")
+    lf = lazy("lazy_sv")
+    up = lf[["s"]].toupper().frame()
+    assert list(up.vecs[0].decoded()) == ["AA", "BA"]
+    g = lf[["s"]].gsub("a", "z").frame()
+    assert list(g.vecs[0].decoded()) == ["zz", "bz"]
+    n = lf[["s"]].nchar().frame()
+    assert list(n.vecs[0].to_numpy()) == [2.0, 2.0]
+    c = lf[["x", "y"]].cor()          # matrix Frame directly
+    assert abs(c.vec("y").to_numpy()[0] - 1.0) < 1e-6
+    assert isinstance(lf[["x"]].var(), float)   # scalar like sd()
+    # quoted pattern containing a single quote round-trips the tokenizer
+    esc = lf[["s"]].gsub("a", "d'z").frame()
+    assert list(esc.vecs[0].decoded()) == ["d'zd'z", "bd'z"]
+
+    sc = lf.scale().frame()
+    assert abs(float(np.mean(sc.vec("x").to_numpy()))) < 1e-6
+    h2o3_tpu.remove("lazy_sv")
