@@ -66,6 +66,7 @@ class AdaBoost(SharedTree):
         y = di.response(frame)
         w0 = di.weights(frame)
         binned = fit_bins(frame, [s.name for s in di.specs], nbins=p.nbins,
+                          histogram_type=p.histogram_type,
                           seed=p.effective_seed())
         codes = binned.codes
         edges_mat = jnp.asarray(edges_matrix(binned.edges, p.nbins),

@@ -65,14 +65,30 @@ class BinnedFrame:
 
 def fit_bins(frame: Frame, features: List[str], nbins: int = 64,
              sample: int = 1_000_000, seed: int = 0,
-             weights=None) -> BinnedFrame:
-    """Quantile-sketch each feature and encode the frame as bin codes.
+             weights=None,
+             histogram_type: str = "quantiles_global") -> BinnedFrame:
+    """Sketch each feature's bin edges and encode the frame as bin codes.
 
-    The sketch runs on a host-side row sample (XGBoost's approx sketch does
-    the same); the encode step is one fused device pass per call.
-    ``weights`` (host or device [>=nrows]) restricts the sketch to rows with
-    weight > 0 — keeps CV's zero-weight holdout rows out of the bin edges.
+    ``histogram_type`` (SharedTree histogram_type analog, hex/tree
+    DHistogram): "quantiles_global" (default; XGBoost's approx sketch),
+    "uniform_adaptive" (equal-width over the observed range) or
+    "random" (uniform-random split points; drawn ONCE per model — the
+    frame is encoded a single time, so unlike the reference's per-tree
+    redraw, ensembles share these edges; vary ``seed`` for diversity
+    across models).  The sketch runs on a host-side row sample; the encode
+    step is one fused device pass per call.  ``weights`` (host or
+    device [>=nrows]) restricts the sketch to rows with weight > 0 —
+    keeps CV's zero-weight holdout rows out of the bin edges.
     """
+    htype = histogram_type.lower().replace("_", "")
+    if htype in ("auto", "quantilesglobal"):
+        htype = "quantiles"
+    elif htype == "uniformadaptive":
+        htype = "uniform"
+    elif htype != "random":
+        raise ValueError(
+            f"unknown histogram_type {histogram_type!r}: use "
+            "QuantilesGlobal, UniformAdaptive or Random")
     from ...runtime.cluster import fetch
     rng = np.random.default_rng(seed)
     n = frame.nrows
@@ -107,6 +123,14 @@ def fit_bins(frame: Frame, features: List[str], nbins: int = 64,
             col = col[np.isfinite(col)]
             if len(col) == 0:
                 edges = np.zeros(0, dtype=np.float32)
+            elif htype == "uniform":
+                lo, hi = float(col.min()), float(col.max())
+                edges = np.unique(np.linspace(lo, hi, nbins + 1)[1:-1]
+                                  .astype(np.float32))
+            elif htype == "random":
+                lo, hi = float(col.min()), float(col.max())
+                edges = np.unique(np.sort(
+                    rng.uniform(lo, hi, nbins - 1)).astype(np.float32))
             else:
                 qs = np.linspace(0, 1, nbins + 1)[1:-1]
                 edges = np.unique(np.quantile(col, qs).astype(np.float32))

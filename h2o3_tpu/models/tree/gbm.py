@@ -101,7 +101,8 @@ class GBM(SharedTree):
         else:
             binned = fit_bins(frame, [s.name for s in di.specs],
                               nbins=p.nbins, seed=p.effective_seed(),
-                              weights=w if p.weights_column else None)
+                              weights=w if p.weights_column else None,
+                              histogram_type=p.histogram_type)
         codes = binned.codes
         edges_mat = jnp.asarray(
             edges_matrix(binned.edges, p.nbins), jnp.float32)

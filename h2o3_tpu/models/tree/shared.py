@@ -42,6 +42,7 @@ class SharedTreeParameters(Parameters):
     max_depth: int = 5
     min_rows: float = 10.0
     nbins: int = 64                  # quantile-sketch bins (ref nbins=20)
+    histogram_type: str = "QuantilesGlobal"   # UniformAdaptive | Random
     learn_rate: float = 0.1
     sample_rate: float = 1.0
     col_sample_rate: float = 1.0         # per split (mtries analog)
@@ -763,7 +764,8 @@ def resolve_checkpoint(params, di, algo: str):
         raise ValueError(f"checkpoint {ckpt!r} not found in DKV")
     if prior.algo != algo:
         raise ValueError(f"checkpoint algo {prior.algo!r} != {algo!r}")
-    for attr in ("max_depth", "nbins", "distribution", "response_column"):
+    for attr in ("max_depth", "nbins", "distribution", "response_column",
+                 "histogram_type"):
         a, b = getattr(prior.params, attr, None), getattr(params, attr, None)
         if a != b:
             raise ValueError(

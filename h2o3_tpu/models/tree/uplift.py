@@ -177,6 +177,7 @@ class UpliftDRF(SharedTree):
         else:
             treat = (jnp.nan_to_num(tvec.data) > 0).astype(jnp.float32)
         binned = fit_bins(frame, [s.name for s in di.specs], nbins=p.nbins,
+                          histogram_type=p.histogram_type,
                           seed=p.effective_seed())
         codes = binned.codes
         edges_mat = jnp.asarray(edges_matrix(binned.edges, p.nbins),

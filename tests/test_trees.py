@@ -157,3 +157,34 @@ def test_tree_save_load_predict(cl, rng, tmp_path):
     m2 = Model.load(path)
     p2 = m2.predict(fr).vec("y").to_numpy()
     np.testing.assert_allclose(p1, p2, rtol=1e-5)
+
+
+def test_histogram_types(cl, rng):
+    import h2o3_tpu
+    from h2o3_tpu.models import GBM
+    from h2o3_tpu.models.tree.binning import fit_bins
+    import pytest
+    n = 500
+    x = rng.normal(size=n) ** 3          # skewed: quantile != uniform
+    y = np.where(x > 0, "Y", "N").astype(object)
+    fr = h2o3_tpu.Frame.from_numpy({"x": x, "y": y})
+    edges = {}
+    for ht in ("QuantilesGlobal", "UniformAdaptive", "Random"):
+        b = fit_bins(fr, ["x"], nbins=16, seed=1, histogram_type=ht)
+        edges[ht] = b.edges[0]
+        m = GBM(response_column="y", ntrees=10, max_depth=3,
+                learn_rate=0.3, histogram_type=ht, seed=1).train(fr)
+        p = m.predict(fr).vec("Y").to_numpy()
+        assert np.isfinite(p).all()
+        # quantile edges resolve the skewed sign boundary well;
+        # uniform/random are legitimately coarser near 0 on x**3 data
+        floor = 0.95 if ht == "QuantilesGlobal" else 0.75
+        assert np.mean((p > 0.5) == (x > 0)) > floor
+    assert not np.array_equal(edges["QuantilesGlobal"],
+                              edges["UniformAdaptive"])
+    assert not np.array_equal(edges["UniformAdaptive"], edges["Random"])
+    # uniform edges are equally spaced
+    du = np.diff(edges["UniformAdaptive"])
+    np.testing.assert_allclose(du, du[0], rtol=1e-4)
+    with pytest.raises(ValueError, match="histogram_type"):
+        fit_bins(fr, ["x"], histogram_type="nope")
