@@ -51,6 +51,11 @@ class Parameters:
     # checkpointing (hex/Model.java:521,543)
     checkpoint: Optional[str] = None
     export_checkpoints_dir: Optional[str] = None
+    # class balancing (hex/Model.Parameters _balance_classes): applied
+    # as per-class weights (deterministic equivalent of the reference's
+    # oversampling) folded into the weights column for training+metrics
+    balance_classes: bool = False
+    class_sampling_factors: Optional[Sequence[float]] = None
     # cross-validation
     nfolds: int = 0
     fold_column: Optional[str] = None
@@ -214,27 +219,101 @@ class ModelBuilder:
         raise NotImplementedError
 
     # -- driver --------------------------------------------------------------
+    def _apply_balance(self, frame: Frame):
+        """balance_classes as per-class weights: returns (frame,
+        params_override or None).  The override is installed only for
+        the duration of the run (xgboost's _xgb_w_ pattern) and the
+        fitted model's DataInfo keeps the USER's weights column so
+        scoring new frames honors their weights, not the synthetic
+        training column."""
+        p = self.params
+        if not getattr(p, "balance_classes", False) or not self.supervised:
+            return frame, None
+        rvec = frame.vec(p.response_column)
+        if rvec.type != T_CAT:
+            return frame, None              # regression: nothing to balance
+        k = rvec.cardinality
+        if k <= 0:
+            raise ValueError(
+                "balance_classes needs a categorical response with a "
+                "domain (got a cat column without one)")
+        codes = np.asarray(rvec.data)[: frame.nrows]
+        counts = np.bincount(codes[codes >= 0], minlength=k).astype(float)
+        counts[counts == 0] = 1.0
+        if p.class_sampling_factors is not None:
+            factors = np.asarray(p.class_sampling_factors, float)
+        else:
+            factors = counts.sum() / (k * counts)
+        if len(factors) != k:
+            raise ValueError(
+                f"class_sampling_factors needs {k} entries, got "
+                f"{len(factors)}")
+        w = np.where(codes >= 0, factors[np.clip(codes, 0, k - 1)], 0.0)
+        if p.weights_column:
+            w = w * frame.vec(p.weights_column).to_numpy()
+        out = frame.with_vec("_balance_weights_",
+                             Vec.from_numpy(w.astype(np.float64), T_NUM))
+        return out, dataclasses.replace(
+            p, weights_column="_balance_weights_")
+
+    def _balance_valid(self, valid, orig):
+        """Mirror the synthetic weights name onto the validation frame
+        with the USER's weights (or ones): validation metrics are never
+        class-balanced, matching the reference."""
+        if valid is None or "_balance_weights_" in valid.names:
+            return valid
+        uv = valid.vec(orig.weights_column).to_numpy() \
+            if orig.weights_column else np.ones(valid.nrows)
+        return valid.with_vec(
+            "_balance_weights_",
+            Vec.from_numpy(np.asarray(uv, np.float64), T_NUM))
+
     def train(self, frame: Frame, valid: Optional[Frame] = None) -> Model:
         """Blocking train — the trainModel/Driver.computeImpl path."""
         self._validate(frame)
-        di = self._make_datainfo(frame)
-        self.job = Job(f"{self.algo} train", dest_key=dkv.make_key(self.algo))
-        return self.job.run(self._make_driver(frame, di, valid))
+        frame, bal = self._apply_balance(frame)
+        orig = self.params
+        if bal is not None:
+            self.params = bal
+            valid = self._balance_valid(valid, orig)
+        try:
+            di = self._make_datainfo(frame)
+            self.job = Job(f"{self.algo} train",
+                           dest_key=dkv.make_key(self.algo))
+            return self.job.run(self._make_driver(
+                frame, di, valid,
+                orig_params=orig if bal is not None else None))
+        finally:
+            self.params = orig
 
     def _make_driver(self, frame: Frame, di: DataInfo,
-                     valid: Optional[Frame]):
+                     valid: Optional[Frame], orig_params=None):
         """The full training driver (CV, post-fit hooks, checkpoint export)
-        shared by the blocking and async entry points."""
+        shared by the blocking and async entry points.  ``orig_params``
+        is set when balance_classes installed a temporary params
+        override: the driver restores it when done and journals/scores
+        with the user's own parameters."""
         def _driver(job: Job) -> Model:
             from ..runtime import recovery
-            journal = recovery.journal_start(self, frame, job)
+            journal = recovery.journal_start(
+                self, frame, job, params=orig_params)
             try:
-                return self._driver_body(job, frame, di, valid, journal)
+                model = self._driver_body(job, frame, di, valid, journal)
             except BaseException as e:
                 # cancelled / deterministically failing jobs must not be
                 # resurrected as if the process had died
                 recovery.journal_fail(journal, repr(e))
                 raise
+            finally:
+                if orig_params is not None:
+                    self.params = orig_params
+            if orig_params is not None:
+                # scoring frames carry the USER's weights column (if
+                # any), never the synthetic training-only balance column
+                model.datainfo = dataclasses.replace(
+                    model.datainfo,
+                    weights_column=orig_params.weights_column)
+            return model
         return _driver
 
     def _driver_body(self, job: "Job", frame: Frame, di: DataInfo,
@@ -270,11 +349,19 @@ class ModelBuilder:
         """
         from ..runtime.job import scheduler, JobScheduler
         self._validate(frame)
+        frame, bal = self._apply_balance(frame)
+        if bal is not None:
+            # stays installed while the queued driver runs; the driver's
+            # finally restores it (concurrent reuse of one builder with
+            # balance_classes is not supported)
+            orig_async = self.params
+            self.params = bal
+            valid = self._balance_valid(valid, orig_async)
         di = self._make_datainfo(frame)
         self.job = Job(f"{self.algo} train",
                        dest_key=dkv.make_key(self.algo))
         return scheduler().submit(
-            self.job, self._make_driver(frame, di, valid),
+            self.job, self._make_driver(frame, di, valid, orig_params=orig_async if bal is not None else None),
             priority=JobScheduler.PRIORITY_BUILD
             if priority is None else priority)
 

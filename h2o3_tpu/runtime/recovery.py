@@ -32,7 +32,7 @@ def _write_entry(uri: str, entry: dict) -> None:
         f.write(json.dumps(entry).encode())
 
 
-def journal_start(builder, frame, job=None) -> Optional[str]:
+def journal_start(builder, frame, job=None, params=None) -> Optional[str]:
     """Record a training job about to run; returns the entry URI."""
     base = _dir()
     if not base:
@@ -41,7 +41,7 @@ def journal_start(builder, frame, job=None) -> Optional[str]:
     # only JSON-clean params are journaled: a repr-stringified callable
     # or array would resume into a silently broken builder
     params, skipped = {}, []
-    for k, v in dataclasses.asdict(builder.params).items():
+    for k, v in dataclasses.asdict(params or builder.params).items():
         if hasattr(v, "item"):
             v = v.item()
         try:

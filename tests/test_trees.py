@@ -188,3 +188,47 @@ def test_histogram_types(cl, rng):
     np.testing.assert_allclose(du, du[0], rtol=1e-4)
     with pytest.raises(ValueError, match="histogram_type"):
         fit_bins(fr, ["x"], histogram_type="nope")
+
+
+def test_balance_classes(cl, rng):
+    import h2o3_tpu
+    from h2o3_tpu.models import GBM
+    n = 600
+    x = rng.normal(size=n)
+    # 95/5 imbalance with a learnable boundary
+    rare = rng.random(n) < 0.05
+    y = np.where(rare, "POS", "NEG").astype(object)
+    x = np.where(rare, x + 2.0, x)
+    fr = h2o3_tpu.Frame.from_numpy({"x": x, "y": y})
+    plain = GBM(response_column="y", ntrees=10, max_depth=3,
+                seed=1).train(fr)
+    bal = GBM(response_column="y", ntrees=10, max_depth=3,
+              balance_classes=True, seed=1).train(fr)
+    p0 = plain.predict(fr).vec("POS").to_numpy()
+    p1 = bal.predict(fr).vec("POS").to_numpy()
+    # balancing must push minority-class probabilities up overall
+    assert p1[rare].mean() > p0[rare].mean()
+    # recall of the rare class improves at the 0.5 threshold
+    assert (p1[rare] > 0.5).mean() >= (p0[rare] > 0.5).mean()
+    assert (p1[rare] > 0.5).mean() > 0.5
+    # validation frame without the synthetic weights column still scores
+    m = bal.model_performance(fr)
+    assert m is not None
+    # scoring DataInfo keeps the user's weights (None here), and the
+    # builder params are restored so retraining on the raw frame works
+    assert bal.datainfo.weights_column is None
+    from h2o3_tpu.models import GBM as _G
+    b2 = _G(response_column="y", ntrees=2, max_depth=2,
+            balance_classes=True, seed=1)
+    b2.train(fr)
+    b2.train(fr)                       # second run must not KeyError
+    assert b2.params.weights_column is None
+    # in-training validation scoring works under balancing
+    tr, va = fr.split_frame([0.7], seed=3)
+    GBM(response_column="y", ntrees=3, max_depth=2, balance_classes=True,
+        seed=1, score_tree_interval=1).train(tr, va)
+    # explicit factors are honored and validated
+    import pytest
+    with pytest.raises(ValueError, match="class_sampling_factors"):
+        GBM(response_column="y", balance_classes=True,
+            class_sampling_factors=[1.0], ntrees=2).train(fr)
