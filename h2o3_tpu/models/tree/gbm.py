@@ -94,7 +94,11 @@ class GBM(SharedTree):
         y = di.response(frame)
         w = di.weights(frame)
         from .shared import (resolve_checkpoint, checkpoint_binned,
-                             prior_stacked)
+                             prior_stacked, resolve_mono)
+        mono = resolve_mono(p, di)
+        if mono is not None and multinomial:
+            raise ValueError(
+                "monotone_constraints: multinomial is not supported")
         prior = resolve_checkpoint(p, di, self.algo)
         if prior is not None:
             binned = checkpoint_binned(frame, di, prior, p.nbins)
@@ -246,8 +250,8 @@ class GBM(SharedTree):
                 dist.name, p.tweedie_power, p.quantile_alpha, p.huber_alpha,
                 p.max_depth, p.nbins, binned.nfeatures, N, p.effective_hist_precision,
                 p.sample_rate, p.col_sample_rate_per_tree,
-                hier=use_hier_split_search(p, N),
-                bin_counts=binned.bin_counts)
+                hier=use_hier_split_search(p, N) and mono is None,
+                bin_counts=binned.bin_counts, mono=mono)
             scalars = (p.reg_lambda, p.min_rows, p.min_split_improvement,
                        p.learn_rate, p.col_sample_rate, p.reg_alpha, p.gamma,
                        p.min_child_weight)
@@ -357,9 +361,9 @@ class GBM(SharedTree):
                     p.max_depth, p.reg_lambda, p.min_rows,
                     p.min_split_improvement, lr_build, kc,
                     p.col_sample_rate, tree_mask,
-                    p.reg_alpha, p.gamma, p.min_child_weight,
+                    p.reg_alpha, p.gamma, p.min_child_weight, mono=mono,
                     hist_precision=p.effective_hist_precision,
-                    hier=use_hier_split_search(p, N))
+                    hier=use_hier_split_search(p, N) and mono is None)
                 tree.values = tree.values * b_scale
                 trees.append(tree)
                 from .hist import table_lookup

@@ -587,11 +587,19 @@ def _score(G, H, lam, alpha=0.0):
     return Gt * Gt / (H + lam)
 
 
+def newton_value(g, h, reg_lambda: float, reg_alpha: float):
+    """Soft-thresholded Newton node value — the ONE formula shared by
+    split rejection, bound propagation and leaf fitting (they must stay
+    numerically identical for monotone enforcement to be consistent)."""
+    num = jnp.sign(g) * jnp.maximum(jnp.abs(g) - reg_alpha, 0.0)
+    return -num / (h + reg_lambda + 1e-12)
+
+
 @functools.partial(jax.jit, static_argnames=("nbins",))
 def best_splits(Hist, nbins: int, reg_lambda: float, min_rows: float,
                 min_split_improvement: float, feat_mask=None,
                 reg_alpha: float = 0.0, gamma: float = 0.0,
-                min_child_weight: float = 0.0):
+                min_child_weight: float = 0.0, mono=None):
     """Best split per leaf from H[3, L, F, B] (B = nbins regular + 1 NA bin).
 
     Tries NA-left and NA-right (XGBoost's sparsity-aware default direction;
@@ -628,6 +636,13 @@ def best_splits(Hist, nbins: int, reg_lambda: float, min_rows: float,
                    - parent[..., None]) - gamma
         ok = (cl >= min_rows) & (cr >= min_rows) & \
             (hl >= min_child_weight) & (hr >= min_child_weight)
+        if mono is not None:
+            # monotone constraints (XGBoost split_evaluator order test):
+            # reject candidates whose child values break the direction
+            vl = newton_value(gl, hl, reg_lambda, reg_alpha)
+            vr = newton_value(gr, hr, reg_lambda, reg_alpha)
+            c = mono[None, :, None]
+            ok = ok & ~(((c > 0) & (vl > vr)) | ((c < 0) & (vl < vr)))
         return jnp.where(ok, g, -jnp.inf)
 
     gain_naL = gain_with_na(GL + g_na[..., None], HL + h_na[..., None],

@@ -43,6 +43,10 @@ class SharedTreeParameters(Parameters):
     min_rows: float = 10.0
     nbins: int = 64                  # quantile-sketch bins (ref nbins=20)
     histogram_type: str = "QuantilesGlobal"   # UniformAdaptive | Random
+    # {column: 1|-1} — numeric features, binomial/regression only
+    # (hex/tree/gbm monotone_constraints; enforced via split rejection +
+    # propagated value-bound clamping, the XGBoost mechanism)
+    monotone_constraints: Optional[dict] = None
     learn_rate: float = 0.1
     sample_rate: float = 1.0
     col_sample_rate: float = 1.0         # per split (mtries analog)
@@ -286,7 +290,7 @@ traverse_jit = jax.jit(traverse)
 @functools.lru_cache(maxsize=None)
 def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
                        hist_precision: str = "bf16", hier: bool = False,
-                       fine_k: int = 2, bin_counts=None):
+                       fine_k: int = 2, bin_counts=None, mono=None):
     """One compiled program that grows a whole tree on device.
 
     The level loop (SharedTree.buildLayer) is unrolled inside a single jit:
@@ -308,6 +312,9 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
     (split_search="auto" gate) or on request.
     """
     B = nbins + 1
+    if mono is not None and hier:
+        raise ValueError("monotone constraints are not supported with "
+                         "the hierarchical split search")
     from ...runtime.cluster import cluster
     # per-feature packed bins (DHistogram-style): only the TPU Pallas path
     # has the ragged kernel; dense einsum covers CPU tests.  The packed
@@ -345,6 +352,10 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
         leaf = jnp.zeros(N, jnp.int32)
         levels = []
         keys = jax.random.split(rng_key, max_depth)
+        if mono is not None:
+            mono_arr = jnp.asarray(mono, jnp.float32)        # [F] in {-1,0,1}
+            lo = jnp.full((1,), -jnp.inf)                    # per-node value
+            hi = jnp.full((1,), jnp.inf)                     # bounds
         H_prev = None
         if hier:
             ccodes = jnp.where(codes >= nbins, S, codes // W)
@@ -393,7 +404,25 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
                 H_prev = H
                 feat, bin_, na_left, gain, valid, children = best_splits(
                     H, nbins, reg_lambda, min_rows, min_split_improvement,
-                    mask, reg_alpha, gamma, min_child_weight)
+                    mask, reg_alpha, gamma, min_child_weight,
+                    mono=mono_arr if mono is not None else None)
+            if mono is not None:
+                # propagate value bounds to the children (the clamp at the
+                # leaves is what guarantees global monotonicity, exactly
+                # XGBoost's interaction of bounds + mid-point split)
+                from .hist import newton_value
+                vL = jnp.clip(newton_value(children[:, 0], children[:, 1],
+                                           reg_lambda, reg_alpha), lo, hi)
+                vR = jnp.clip(newton_value(children[:, 3], children[:, 4],
+                                           reg_lambda, reg_alpha), lo, hi)
+                mid = 0.5 * (vL + vR)
+                c = mono_arr[feat] * valid.astype(jnp.float32)
+                hi_l = jnp.where(c > 0, jnp.minimum(hi, mid), hi)
+                lo_l = jnp.where(c < 0, jnp.maximum(lo, mid), lo)
+                hi_r = jnp.where(c < 0, jnp.minimum(hi, mid), hi)
+                lo_r = jnp.where(c > 0, jnp.maximum(lo, mid), lo)
+                lo = jnp.stack([lo_l, lo_r], axis=1).reshape(-1)
+                hi = jnp.stack([hi_l, hi_r], axis=1).reshape(-1)
             thr = edges_mat[feat, jnp.clip(bin_, 0, nbins - 1)]
             leaf = partition(codes, leaf, feat, bin_, na_left, valid,
                              jnp.int32(nbins))
@@ -403,13 +432,19 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
         gl, hl, cl = children[:, 0], children[:, 1], children[:, 2]
         gr, hr, cr = children[:, 3], children[:, 4], children[:, 5]
 
+        from .hist import newton_value
+
         def newton(gc, hc, cc):
-            num = jnp.sign(gc) * jnp.maximum(jnp.abs(gc) - reg_alpha, 0.0)
             return jnp.where(cc > 0,
-                             -num / (hc + reg_lambda + 1e-12) * learn_rate,
+                             newton_value(gc, hc, reg_lambda, reg_alpha),
                              0.0)
         vals = jnp.stack([newton(gl, hl, cl), newton(gr, hr, cr)],
-                         axis=1).reshape(-1).astype(jnp.float32)
+                         axis=1).reshape(-1)
+        if mono is not None:
+            # lo/hi were interleaved (left, right) per parent at the last
+            # level — the same layout vals was just reshaped into
+            vals = jnp.clip(vals, lo, hi)
+        vals = (vals * learn_rate).astype(jnp.float32)
         # leaf covers (weighted row counts) from the same child sums — the
         # per-node weights TreeSHAP needs (PredictTreeSHAPTask reads them
         # from the compressed tree the same way)
@@ -417,6 +452,30 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
         return levels, vals, cover, leaf
 
     return jax.jit(build)
+
+
+def resolve_mono(params, di) -> Optional[tuple]:
+    """monotone_constraints dict -> per-feature tuple in di.specs order."""
+    mc = getattr(params, "monotone_constraints", None)
+    if not mc:
+        return None
+    names = [s.name for s in di.specs]
+    vec = [0.0] * len(names)
+    for col, direction in mc.items():
+        if col not in names:
+            raise ValueError(f"monotone_constraints: unknown column "
+                             f"{col!r}")
+        spec = di.specs[names.index(col)]
+        if getattr(spec, "type", None) == T_CAT:
+            raise ValueError(f"monotone_constraints: {col!r} is "
+                             "categorical; numeric features only")
+        if direction not in (1, -1, 0):
+            raise ValueError(f"monotone_constraints[{col!r}] must be "
+                             f"1, -1 or 0, got {direction!r}")
+        vec[names.index(col)] = float(direction)
+    if not any(vec):
+        return None                      # all zeros: unconstrained
+    return tuple(vec)
 
 
 def use_hier_split_search(params, n_padded: int) -> bool:
@@ -442,7 +501,7 @@ def make_tree_scan_fn(mode: str, tweedie_power: float, quantile_alpha: float,
                       huber_alpha: float, max_depth: int, nbins: int, F: int,
                       n_padded: int, hist_precision: str, sample_rate: float,
                       col_sample_rate_per_tree: float, hier: bool = False,
-                      bin_counts=None):
+                      bin_counts=None, mono=None):
     """Scan a CHUNK of boosting/bagging rounds in ONE device dispatch.
 
     The per-tree driver loop (gradients -> row/column sample -> grow ->
@@ -462,7 +521,7 @@ def make_tree_scan_fn(mode: str, tweedie_power: float, quantile_alpha: float,
             tweedie_power=tweedie_power, quantile_alpha=quantile_alpha,
             huber_alpha=huber_alpha)
     bt_fn = make_build_tree_fn(max_depth, nbins, F, n_padded, hist_precision,
-                               hier=hier, bin_counts=bin_counts)
+                               hier=hier, bin_counts=bin_counts, mono=mono)
 
     def scan_fn(codes, y, w, F0, edges_mat, keys, reg_lambda, min_rows,
                 min_split_improvement, learn_rate, col_sample_rate,
@@ -589,7 +648,7 @@ def build_tree(codes, g, h, w, edges, nbins: int, max_depth: int,
                tree_col_mask: Optional[np.ndarray] = None,
                reg_alpha: float = 0.0, gamma: float = 0.0,
                min_child_weight: float = 0.0, hist_precision: str = "bf16",
-               hier: bool = False):
+               hier: bool = False, mono=None):
     """Grow one tree — convenience wrapper around make_build_tree_fn.
 
     ``edges`` may be the per-feature edge list (converted to the dense
@@ -605,7 +664,7 @@ def build_tree(codes, g, h, w, edges, nbins: int, max_depth: int,
     tm = jnp.asarray(tree_col_mask, bool) if tree_col_mask is not None \
         else jnp.ones(F, bool)
     fn = make_build_tree_fn(max_depth, nbins, F, N, hist_precision,
-                            hier=hier)
+                            hier=hier, mono=mono)
     levels, vals, cover, leaf = fn(codes, g, h, w, edges_mat, rng_key,
                                    reg_lambda, min_rows,
                                    min_split_improvement, learn_rate,
@@ -815,6 +874,11 @@ class SharedTree(ModelBuilder):
 
     def _validate(self, frame) -> None:
         super()._validate(frame)
+        if getattr(self.params, "monotone_constraints", None) and \
+                self.algo not in ("gbm", "xgboost"):
+            raise ValueError(
+                "monotone_constraints is only enforced for GBM/XGBoost; "
+                f"{self.algo} would silently ignore it")
         p = self.params
         if getattr(p, "calibrate_model", False):
             # fail BEFORE training, not after (CalibrationHelper checks)

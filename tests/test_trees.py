@@ -232,3 +232,56 @@ def test_balance_classes(cl, rng):
     with pytest.raises(ValueError, match="class_sampling_factors"):
         GBM(response_column="y", balance_classes=True,
             class_sampling_factors=[1.0], ntrees=2).train(fr)
+
+
+def test_monotone_constraints(cl, rng):
+    import h2o3_tpu
+    import pytest
+    from h2o3_tpu.models import GBM, XGBoost
+    n = 800
+    x = rng.uniform(-3, 3, n)
+    z = rng.normal(size=n)
+    # noisy, non-monotone-looking sample of a monotone-increasing truth
+    y = 2.0 * x + z * 2.0 + 1.5 * np.sin(2.5 * x)
+    fr = h2o3_tpu.Frame.from_numpy({"x": x, "z": z, "y": y})
+    grid = np.linspace(-3, 3, 60)
+    probe = h2o3_tpu.Frame.from_numpy(
+        {"x": grid, "z": np.zeros_like(grid)})
+    for cls in (GBM, XGBoost):
+        m = cls(response_column="y", ntrees=40, max_depth=4,
+                learn_rate=0.2, monotone_constraints={"x": 1},
+                seed=1).train(fr)
+        p = m.predict(probe).vec("predict").to_numpy()
+        assert (np.diff(p) >= -1e-5).all(), \
+            f"{cls.__name__} predictions not monotone in x"
+        # the unconstrained model on this noisy data is NOT monotone
+        # (otherwise the assertion above is vacuous)
+        m0 = cls(response_column="y", ntrees=40, max_depth=4,
+                 learn_rate=0.2, seed=1).train(fr)
+        p0 = m0.predict(probe).vec("predict").to_numpy()
+        assert (np.diff(p0) < -1e-5).any()
+        # decreasing constraint mirrors
+        md = cls(response_column="y", ntrees=10, max_depth=3,
+                 monotone_constraints={"x": -1}, seed=1).train(fr)
+        pd_ = md.predict(probe).vec("predict").to_numpy()
+        assert (np.diff(pd_) <= 1e-5).all()
+    with pytest.raises(ValueError, match="categorical|unknown"):
+        fr2 = h2o3_tpu.Frame.from_numpy({
+            "g": np.array(["a", "b"] * 50, object),
+            "y": rng.normal(size=100)})
+        GBM(response_column="y", ntrees=2,
+            monotone_constraints={"g": 1}).train(fr2)
+
+
+def test_monotone_rejected_outside_gbm(cl, rng):
+    import h2o3_tpu
+    import pytest
+    from h2o3_tpu.models import DRF, GBM
+    fr = h2o3_tpu.Frame.from_numpy({"x": rng.normal(size=60),
+                                    "y": rng.normal(size=60)})
+    with pytest.raises(ValueError, match="only enforced"):
+        DRF(response_column="y", ntrees=2,
+            monotone_constraints={"x": 1}).train(fr)
+    # 0 means unconstrained (reference semantics) — trains fine
+    GBM(response_column="y", ntrees=2,
+        monotone_constraints={"x": 0}).train(fr)
