@@ -36,7 +36,8 @@ from ..frame.vec import T_CAT, T_NUM, Vec
 
 __all__ = ["partial_dependence", "ice", "shap_summary",
            "residual_analysis", "explain", "learning_curve",
-           "varimp_heatmap", "model_correlation", "explain_models"]
+           "varimp_heatmap", "model_correlation", "explain_models",
+           "permutation_importance"]
 
 
 def _response_col(model, preds: Frame,
@@ -267,3 +268,55 @@ def explain_models(models: List, frame: Frame, top_n: int = 5,
         "model_correlation": model_correlation(models, frame),
         "leader": explain(models[0], frame, top_n=top_n, nbins=nbins),
     }
+
+
+def permutation_importance(model, frame: Frame, metric: str = "auto",
+                           n_repeats: int = 1,
+                           seed: int = 0) -> Dict[str, np.ndarray]:
+    """Permutation variable importance — h2o.permutation_varimp analog.
+
+    Shuffles one model feature at a time (from the model's own DataInfo
+    specs, so ignored/weights/offset columns are excluded) and reports
+    the scoring-metric degradation through the model's metrics stack —
+    observation weights are honored.  ``metric``: "auto" (logloss for
+    classifiers, mse for regression), or an explicit metric attribute
+    ("logloss", "mse", "rmse", "mae").  Importance = scrambled score -
+    baseline (bigger = more important), averaged over ``n_repeats``.
+    ``relative_importance`` is NaN when no feature degrades the score.
+    """
+    rng = np.random.default_rng(seed)
+    classifier = bool(getattr(model.datainfo, "response_domain", None))
+    key = metric
+    if metric == "auto":
+        key = "logloss" if classifier else "mse"
+    perf0 = model.model_performance(frame)
+    if not hasattr(perf0, key):
+        raise ValueError(
+            f"metric {metric!r} not available for this model "
+            f"(have: {sorted(perf0.describe())})")
+
+    def score(fr) -> float:
+        return float(getattr(model.model_performance(fr), key))
+    base = float(getattr(perf0, key))
+    feats = [sp.name for sp in model.datainfo.specs
+             if sp.name in frame.names]
+    imp = np.zeros(len(feats))
+    for i, col in enumerate(feats):
+        v = frame.vec(col)
+        vals = v.to_numpy()
+        deltas = []
+        for _ in range(n_repeats):
+            perm = vals[rng.permutation(len(vals))]
+            if v.type == T_CAT:
+                pv = Vec.from_numpy(perm.astype(np.int32), T_CAT,
+                                    domain=v.domain)
+            else:
+                pv = Vec.from_numpy(perm, v.type)
+            deltas.append(score(frame.with_vec(col, pv)) - base)
+        imp[i] = float(np.mean(deltas))
+    order = np.argsort(-imp)
+    rel = imp / imp[order[0]] if imp[order[0]] > 0 else         np.full_like(imp, np.nan)
+    return {"feature": np.asarray([feats[i] for i in order], dtype=object),
+            "importance": imp[order],
+            "relative_importance": rel[order],
+            "baseline_score": base}

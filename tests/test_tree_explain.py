@@ -260,3 +260,37 @@ def test_explain_models_bundle(cl, rng):
     # classifiers: agreement fraction, symmetric with unit diagonal
     C = b["model_correlation"]["correlation"]
     assert C[0, 0] == 1.0 and C[0, 1] == C[1, 0] and 0 <= C[0, 1] <= 1
+
+
+def test_permutation_importance(cl, rng):
+    import h2o3_tpu
+    from h2o3_tpu import explain as ex
+    from h2o3_tpu.models import GBM, GLM
+    n = 400
+    X = rng.normal(size=(n, 3))
+    g = rng.integers(0, 2, n)
+    yb = X[:, 0] + 0.3 * X[:, 1] + 0.8 * g > 0.4
+    fr = h2o3_tpu.Frame.from_numpy({
+        "x0": X[:, 0], "x1": X[:, 1], "noise": X[:, 2],
+        "cat": np.array(["a", "b"], object)[g], "id": np.arange(n) * 1.0,
+        "y": np.where(yb, "Y", "N").astype(object)})
+    m = GBM(response_column="y", ntrees=10, max_depth=3, seed=1,
+            ignored_columns=("id",)).train(fr)
+    pi = ex.permutation_importance(m, fr, seed=2)
+    assert pi["feature"][0] == "x0"              # dominant signal first
+    assert "id" not in pi["feature"]             # ignored cols excluded
+    assert pi["relative_importance"][0] == 1.0
+    x0_imp = dict(zip(pi["feature"], pi["importance"]))
+    assert x0_imp["x0"] > x0_imp["noise"]
+    assert x0_imp["cat"] > x0_imp["noise"]       # cat permute is real
+    assert x0_imp["x0"] > 0.05                   # real logloss degradation
+    import pytest
+    with pytest.raises(ValueError, match="metric"):
+        ex.permutation_importance(m, fr, metric="rsme")
+    # regression path with rmse
+    yr = 2.0 * X[:, 0] + 0.05 * rng.normal(size=n)
+    fr2 = h2o3_tpu.Frame.from_numpy(
+        {"x0": X[:, 0], "x1": X[:, 1], "y": yr})
+    g = GLM(response_column="y", family="gaussian").train(fr2)
+    pr = ex.permutation_importance(g, fr2, metric="rmse", n_repeats=3)
+    assert pr["feature"][0] == "x0" and pr["baseline_score"] < 0.1
