@@ -1,0 +1,118 @@
+"""Tree inspection API — the h2o.tree.H2OTree analog.
+
+Reference: ``h2o-py/h2o/tree/tree.py`` exposes a fitted tree's node
+structure (children, thresholds, split features, NA directions, leaf
+predictions) for inspection and plotting.  Here the source of truth is
+the level-wise ``Tree`` arrays (models/tree/shared.py Tree): a node at
+level d, index i has children (d+1, 2i) and (d+1, 2i+1); a node whose
+``valid`` flag is False is terminal, predicting the value of the
+left-most leaf its rows fall through to (the partition convention for
+un-split nodes).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["H2OTree", "tree_from_model"]
+
+
+class H2OTree:
+    """Flattened node arrays in h2o.tree conventions: index 0 is the
+    root; ``left_children``/``right_children`` hold node ids (-1 = no
+    child); leaves carry ``predictions``; decision nodes carry
+    ``features``/``thresholds``/``na_directions`` ("LEFT"/"RIGHT")."""
+
+    def __init__(self, tree, feature_names: Sequence[str],
+                 tree_number: int = 0, tree_class: Optional[str] = None):
+        self.tree_number = tree_number
+        self.tree_class = tree_class
+        self.left_children: List[int] = []
+        self.right_children: List[int] = []
+        self.features: List[Optional[str]] = []
+        self.thresholds: List[float] = []
+        self.na_directions: List[Optional[str]] = []
+        self.predictions: List[Optional[float]] = []
+        self.covers: List[Optional[float]] = []
+        feat = [np.asarray(f) for f in tree.feat]
+        thr = [np.asarray(t) for t in tree.thr]
+        nal = [np.asarray(n) for n in tree.na_left]
+        valid = [np.asarray(v) for v in tree.valid]
+        values = np.asarray(tree.values)
+        cover = None if tree.cover is None else np.asarray(tree.cover)
+        depth = len(feat)
+
+        def add(d: int, i: int) -> int:
+            nid = len(self.features)
+            for lst in (self.left_children, self.right_children,
+                        self.features, self.thresholds,
+                        self.na_directions, self.predictions, self.covers):
+                lst.append(None)
+            self.thresholds[nid] = float("nan")
+            self.left_children[nid] = -1
+            self.right_children[nid] = -1
+            if d == depth or not bool(valid[d][i]):
+                leftmost = i << (depth - d)
+                self.predictions[nid] = float(values[leftmost])
+                if cover is not None:
+                    span = cover[leftmost: (i + 1) << (depth - d)]
+                    self.covers[nid] = float(span.sum())
+                return nid
+            self.features[nid] = feature_names[int(feat[d][i])]
+            self.thresholds[nid] = float(thr[d][i])
+            self.na_directions[nid] = "LEFT" if bool(nal[d][i]) else "RIGHT"
+            self.left_children[nid] = add(d + 1, 2 * i)
+            self.right_children[nid] = add(d + 1, 2 * i + 1)
+            return nid
+
+        add(0, 0)
+
+    def __len__(self) -> int:
+        return len(self.features)
+
+    @property
+    def root_node_id(self) -> int:
+        return 0
+
+    def to_dot(self) -> str:
+        """Graphviz DOT rendering (h2o's tree plotting feed)."""
+        def esc(s: str) -> str:
+            return s.replace("\\", "\\\\").replace('"', '\\"')
+        lines = ["digraph tree {", "  node [shape=box];"]
+        for n in range(len(self)):
+            if self.features[n] is not None:
+                lines.append(
+                    f'  n{n} [label="{esc(self.features[n])} < '
+                    f'{self.thresholds[n]:.6g}\\nNA -> '
+                    f'{self.na_directions[n]}"];')
+                lines.append(f"  n{n} -> n{self.left_children[n]} "
+                             f'[label="<"];')
+                lines.append(f"  n{n} -> n{self.right_children[n]} "
+                             f'[label=">="];')
+            else:
+                cov = "" if self.covers[n] is None else \
+                    f"\\ncover={self.covers[n]:.6g}"
+                lines.append(
+                    f'  n{n} [label="{self.predictions[n]:.6g}{cov}", '
+                    "style=rounded];")
+        lines.append("}")
+        return "\n".join(lines)
+
+
+def tree_from_model(model, tree_number: int = 0,
+                    tree_class: Optional[str] = None) -> H2OTree:
+    """h2o.tree.H2OTree(model, tree_number, tree_class) analog."""
+    trees = model.output["trees"]
+    names = [s.name for s in model.datainfo.specs]
+    t = trees[tree_number]
+    if isinstance(t, (list, tuple)):        # multinomial: one per class
+        domain = model.datainfo.response_domain
+        k = domain.index(tree_class) if tree_class is not None else 0
+        t = t[k]
+        tree_class = domain[k]
+    elif tree_class is not None:
+        raise ValueError("tree_class is only valid for multinomial models")
+    return H2OTree(t, names, tree_number=tree_number,
+                   tree_class=tree_class)

@@ -294,3 +294,52 @@ def test_permutation_importance(cl, rng):
     g = GLM(response_column="y", family="gaussian").train(fr2)
     pr = ex.permutation_importance(g, fr2, metric="rmse", n_repeats=3)
     assert pr["feature"][0] == "x0" and pr["baseline_score"] < 0.1
+
+
+def test_tree_api(cl, rng):
+    import h2o3_tpu
+    from h2o3_tpu.export.tree_api import tree_from_model
+    from h2o3_tpu.models import GBM
+    n = 400
+    X = rng.normal(size=(n, 2))
+    y = np.where(X[:, 0] > 0, "Y", "N").astype(object)
+    fr = h2o3_tpu.Frame.from_numpy({"x0": X[:, 0], "x1": X[:, 1], "y": y})
+    m = GBM(response_column="y", ntrees=3, max_depth=3, seed=1).train(fr)
+    t = tree_from_model(m, 0)
+    assert t.features[t.root_node_id] == "x0"        # dominant split
+    assert abs(t.thresholds[0]) < 0.6                 # near the boundary
+    # structural invariants: leaves have predictions, decisions children
+    for n_ in range(len(t)):
+        if t.features[n_] is None:
+            assert t.predictions[n_] is not None
+            assert t.left_children[n_] == -1
+        else:
+            assert t.left_children[n_] > n_ and t.right_children[n_] > n_
+            assert t.na_directions[n_] in ("LEFT", "RIGHT")
+    # hand-traverse rows through the H2OTree and match the engine's
+    # per-tree contribution (model F starts at the prior; tree 0's delta
+    # equals the traversed leaf value)
+    def route(row):
+        n_ = 0
+        while t.features[n_] is not None:
+            x = row[t.features[n_]]
+            go_left = (x < t.thresholds[n_]) if np.isfinite(x) else \
+                (t.na_directions[n_] == "LEFT")
+            n_ = t.left_children[n_] if go_left else t.right_children[n_]
+        return t.predictions[n_]
+    from h2o3_tpu.models.tree.shared import stack_trees
+    lv, vals = stack_trees([m.output["trees"][0]])
+    from h2o3_tpu.models.tree.shared import traverse_jit
+    eng = np.asarray(traverse_jit(lv, vals, fr.matrix(["x0", "x1"])))
+    for r in (0, 7, 123):
+        row = {"x0": X[r, 0], "x1": X[r, 1]}
+        np.testing.assert_allclose(route(row), eng[r], rtol=1e-6)
+    dot = t.to_dot()
+    assert dot.startswith("digraph") and "x0 <" in dot
+    # multinomial: per-class trees addressable by label
+    y3 = np.array(["a", "b", "c"], object)[
+        rng.integers(0, 3, n)]
+    fr3 = h2o3_tpu.Frame.from_numpy({"x0": X[:, 0], "y": y3})
+    m3 = GBM(response_column="y", ntrees=2, max_depth=2, seed=1).train(fr3)
+    tb = tree_from_model(m3, 0, tree_class="b")
+    assert tb.tree_class == "b" and len(tb) >= 1
