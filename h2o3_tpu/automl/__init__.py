@@ -413,6 +413,13 @@ class AutoML:
         self.leaderboard = Leaderboard(self.models, p.sort_metric)
         return self.leaderboard.leader
 
+    def explain(self, frame, top_n: int = 5) -> dict:
+        """h2o.explain(aml, frame) analog over the leaderboard models."""
+        from ..explain import explain_models
+        if self.leaderboard is None:
+            raise RuntimeError("train() the AutoML run first")
+        return explain_models(self.leaderboard.models, frame, top_n=top_n)
+
     @property
     def leader(self) -> Model:
         if self.leaderboard is None:

@@ -229,3 +229,17 @@ def test_failed_jobs_not_resurrected(cl, rng, tmp_path, monkeypatch):
     # resume() ignores failed entries entirely
     assert recovery.resume() == []
     h2o3_tpu.remove("rec2_frame")
+
+
+def test_automl_explain(cl, rng):
+    import h2o3_tpu
+    from h2o3_tpu.automl import AutoML
+    X = rng.normal(size=(200, 2))
+    y = np.where(X[:, 0] > 0, "Y", "N").astype(object)
+    fr = h2o3_tpu.Frame.from_numpy({"x0": X[:, 0], "x1": X[:, 1], "y": y})
+    aml = AutoML(response_column="y", max_models=2, seed=1)
+    aml.train(fr)
+    b = aml.explain(fr, top_n=1)
+    assert {"leader", "model_correlation", "varimp_heatmap"} <= set(b)
+    assert b["varimp_heatmap"]["importance"].shape[1] == \
+        len(aml.leaderboard.models)
