@@ -418,7 +418,8 @@ class AutoML:
         from ..explain import explain_models
         if self.leaderboard is None:
             raise RuntimeError("train() the AutoML run first")
-        return explain_models(self.leaderboard.models, frame, top_n=top_n)
+        return explain_models(self.leaderboard.sorted_models(), frame,
+                              top_n=top_n)
 
     @property
     def leader(self) -> Model:

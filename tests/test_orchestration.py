@@ -243,3 +243,6 @@ def test_automl_explain(cl, rng):
     assert {"leader", "model_correlation", "varimp_heatmap"} <= set(b)
     assert b["varimp_heatmap"]["importance"].shape[1] == \
         len(aml.leaderboard.models)
+    # the "leader" bundle explains the metric-ranked leader, and the
+    # heatmap's first model column is the leader too
+    assert b["varimp_heatmap"]["model"][0] == aml.leader.key
