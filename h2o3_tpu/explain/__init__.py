@@ -37,7 +37,8 @@ from ..frame.vec import T_CAT, T_NUM, Vec
 __all__ = ["partial_dependence", "ice", "shap_summary",
            "residual_analysis", "explain", "learning_curve",
            "varimp_heatmap", "model_correlation", "explain_models",
-           "permutation_importance"]
+           "permutation_importance", "partial_dependence_2d",
+           "partial_dependence_multi"]
 
 
 def _response_col(model, preds: Frame,
@@ -320,3 +321,49 @@ def permutation_importance(model, frame: Frame, metric: str = "auto",
             "importance": imp[order],
             "relative_importance": rel[order],
             "baseline_score": base}
+
+
+def partial_dependence_2d(model, frame: Frame, col1: str, col2: str,
+                          nbins: int = 10,
+                          target_class: Optional[str] = None,
+                          ) -> Dict[str, np.ndarray]:
+    """Two-way PDP — the reference's col_pairs_2dpdp table: the mean
+    response over the grid product of two columns."""
+    if col1 == col2:
+        raise ValueError("partial_dependence_2d needs two distinct columns")
+    v1, v2 = frame.vec(col1), frame.vec(col2)
+    g1, g2 = _grid_for(v1, nbins), _grid_for(v2, nbins)
+    M = np.empty((len(g1), len(g2)))
+    for i, a in enumerate(g1):
+        fa = _with_constant(frame, col1, a, v1)
+        for j, b in enumerate(g2):
+            r = _response_col(model, model.predict(
+                _with_constant(fa, col2, b, v2)), target_class)
+            M[i, j] = float(np.mean(r))
+    lab1 = ([v1.domain[int(g)] for g in g1] if v1.type == T_CAT else g1)
+    lab2 = ([v2.domain[int(g)] for g in g2] if v2.type == T_CAT else g2)
+    return {"col1": col1, "col2": col2,
+            "grid1": np.asarray(lab1, dtype=object),
+            "grid2": np.asarray(lab2, dtype=object),
+            "mean_response": M}
+
+
+def partial_dependence_multi(models: List, frame: Frame, column: str,
+                             nbins: int = 20,
+                             target_class: Optional[str] = None,
+                             ) -> Dict[str, object]:
+    """Multi-model PDP overlay — h2o.pd_multi_plot's table: every
+    model's mean-response curve over ONE shared grid (the grid is a
+    deterministic function of frame/column/nbins, so per-model calls to
+    partial_dependence line up).  Returns positional parallel arrays so
+    duplicate model keys are preserved, like varimp_heatmap."""
+    tables = [partial_dependence(m, frame, column, nbins=nbins,
+                                 target_class=target_class)
+              for m in models]
+    grid = tables[0]["grid"] if tables else np.asarray([], dtype=object)
+    return {"column": column, "grid": grid,
+            "model": np.asarray(
+                [getattr(m, "key", f"model_{i}")
+                 for i, m in enumerate(models)], dtype=object),
+            "curves": np.stack([t["mean_response"] for t in tables])
+            if tables else np.zeros((0, 0))}

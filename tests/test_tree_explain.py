@@ -343,3 +343,33 @@ def test_tree_api(cl, rng):
     m3 = GBM(response_column="y", ntrees=2, max_depth=2, seed=1).train(fr3)
     tb = tree_from_model(m3, 0, tree_class="b")
     assert tb.tree_class == "b" and len(tb) >= 1
+
+
+def test_pdp_2d_and_multi(cl, rng):
+    import h2o3_tpu
+    from h2o3_tpu import explain as ex
+    from h2o3_tpu.models import GBM, GLM
+    n = 400
+    X = rng.normal(size=(n, 2))
+    y = X[:, 0] + 0.5 * X[:, 1] + 0.1 * rng.normal(size=n)
+    fr = h2o3_tpu.Frame.from_numpy({"x0": X[:, 0], "x1": X[:, 1], "y": y})
+    m = GBM(response_column="y", ntrees=10, max_depth=3, seed=1).train(fr)
+    p2 = ex.partial_dependence_2d(m, fr, "x0", "x1", nbins=5)
+    assert p2["mean_response"].shape == (5, 5)
+    # response rises along both grid axes (additive increasing truth)
+    M = p2["mean_response"]
+    assert M[-1, -1] > M[0, 0]
+    assert (np.diff(M.mean(axis=1)) >= -0.05).all()   # along x0
+    assert (np.diff(M.mean(axis=0)) >= -0.05).all()   # along x1
+    glm = GLM(response_column="y", family="gaussian").train(fr)
+    pm = ex.partial_dependence_multi([m, glm], fr, "x0", nbins=6)
+    assert list(pm["model"]) == [m.key, glm.key]
+    assert pm["curves"].shape == (2, 6)
+    for c in pm["curves"]:
+        assert c[-1] > c[0]
+    # duplicate models keep one curve each (positional, not dict-keyed)
+    dup = ex.partial_dependence_multi([m, m], fr, "x0", nbins=4)
+    assert dup["curves"].shape == (2, 4)
+    import pytest
+    with pytest.raises(ValueError, match="distinct"):
+        ex.partial_dependence_2d(m, fr, "x0", "x0")
