@@ -21,6 +21,7 @@ from .frame.parse import (import_file, parse_csv, parse_files,
                           parse_svmlight, parse_arff, export_file,
                           upload_string, from_pandas, H2OFrame)
 from .frame.sql import import_sql_table, import_sql_select
+from .datasets import load_dataset
 from .export.mojo import import_mojo
 
 

@@ -1,0 +1,75 @@
+"""Built-in demo datasets — the h2o.demo() / smalldata starter analog.
+
+The reference ships starter datasets for examples and docs; here the
+classic small tables come from scikit-learn's bundled data (no
+download) and arrive as ready-to-model Frames.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .frame.frame import Frame
+
+__all__ = ["load_dataset"]
+
+_LOADERS = {}
+
+
+def _register(name):
+    def deco(fn):
+        _LOADERS[name] = fn
+        return fn
+    return deco
+
+
+@_register("iris")
+def _iris() -> Frame:
+    from sklearn.datasets import load_iris
+    d = load_iris()
+    cols = {n.replace(" (cm)", "").replace(" ", "_"): d.data[:, j]
+            for j, n in enumerate(d.feature_names)}
+    cols["class"] = np.asarray(
+        [d.target_names[t] for t in d.target], dtype=object)
+    return Frame.from_numpy(cols)
+
+
+@_register("wine")
+def _wine() -> Frame:
+    from sklearn.datasets import load_wine
+    d = load_wine()
+    cols = {n: d.data[:, j] for j, n in enumerate(d.feature_names)}
+    cols["class"] = np.asarray(
+        [d.target_names[t] for t in d.target], dtype=object)
+    return Frame.from_numpy(cols)
+
+
+@_register("breast_cancer")
+def _bc() -> Frame:
+    from sklearn.datasets import load_breast_cancer
+    d = load_breast_cancer()
+    cols = {n.replace(" ", "_"): d.data[:, j]
+            for j, n in enumerate(d.feature_names)}
+    cols["diagnosis"] = np.asarray(
+        [d.target_names[t] for t in d.target], dtype=object)
+    return Frame.from_numpy(cols)
+
+
+@_register("diabetes")
+def _diabetes() -> Frame:
+    from sklearn.datasets import load_diabetes
+    d = load_diabetes()
+    cols = {n: d.data[:, j] for j, n in enumerate(d.feature_names)}
+    cols["progression"] = d.target.astype(np.float64)
+    return Frame.from_numpy(cols)
+
+
+def load_dataset(name: str) -> Frame:
+    """Load a bundled demo dataset by name (h2o demo-data analog).
+
+    Available: iris, wine, breast_cancer, diabetes.
+    """
+    if name not in _LOADERS:
+        raise ValueError(
+            f"unknown dataset {name!r}; available: {sorted(_LOADERS)}")
+    return _LOADERS[name]()

@@ -232,3 +232,17 @@ def test_assign_and_deep_copy(cl):
                                   np.arange(4.0))
     h2o3_tpu.remove("alias1")
     h2o3_tpu.remove("copy_x"); h2o3_tpu.remove("copy_y")
+
+
+def test_load_dataset(cl):
+    import pytest
+    iris = h2o3_tpu.load_dataset("iris")
+    assert iris.shape == (150, 5)
+    assert iris.vec("class").domain is not None
+    assert len(iris.vec("class").domain) == 3
+    from h2o3_tpu.models import GBM
+    m = GBM(response_column="class", ntrees=3, max_depth=3,
+            seed=1).train(iris)
+    assert m.training_metrics is not None
+    with pytest.raises(ValueError, match="available"):
+        h2o3_tpu.load_dataset("nope")
