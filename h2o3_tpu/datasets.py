@@ -64,7 +64,7 @@ def _diabetes() -> Frame:
     return Frame.from_numpy(cols)
 
 
-def load_dataset(name: str) -> Frame:
+def load_dataset(name: str, destination_frame=None) -> Frame:
     """Load a bundled demo dataset by name (h2o demo-data analog).
 
     Available: iris, wine, breast_cancer, diabetes.
@@ -72,4 +72,13 @@ def load_dataset(name: str) -> Frame:
     if name not in _LOADERS:
         raise ValueError(
             f"unknown dataset {name!r}; available: {sorted(_LOADERS)}")
-    return _LOADERS[name]()
+    try:
+        fr = _LOADERS[name]()
+    except ImportError as e:
+        raise ImportError(
+            "load_dataset needs scikit-learn for the bundled data "
+            "(pip install scikit-learn)") from e
+    from .runtime import dkv
+    fr.key = destination_frame or dkv.make_key(name)
+    dkv.put(fr.key, fr)
+    return fr

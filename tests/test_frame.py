@@ -236,10 +236,12 @@ def test_assign_and_deep_copy(cl):
 
 def test_load_dataset(cl):
     import pytest
+    pytest.importorskip("sklearn")
     iris = h2o3_tpu.load_dataset("iris")
     assert iris.shape == (150, 5)
     assert iris.vec("class").domain is not None
     assert len(iris.vec("class").domain) == 3
+    assert iris.key in h2o3_tpu.ls()          # DKV-registered like loaders
     from h2o3_tpu.models import GBM
     m = GBM(response_column="class", ntrees=3, max_depth=3,
             seed=1).train(iris)
