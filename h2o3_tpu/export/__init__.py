@@ -2,4 +2,4 @@
 
 from .mojo import export_mojo, import_mojo
 from .scoring import ScoringModel
-from .tree_api import H2OTree, tree_from_model
+from .tree_api import H2OTree, tree_from_model, feature_interactions

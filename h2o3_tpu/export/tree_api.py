@@ -16,7 +16,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
-__all__ = ["H2OTree", "tree_from_model"]
+__all__ = ["H2OTree", "tree_from_model", "feature_interactions"]
 
 
 class H2OTree:
@@ -53,12 +53,14 @@ class H2OTree:
             self.thresholds[nid] = float("nan")
             self.left_children[nid] = -1
             self.right_children[nid] = -1
-            if d == depth or not bool(valid[d][i]):
+            if cover is not None:
+                # node cover = its subtree's leaf-cover span (leaves AND
+                # decision nodes — feature_interactions reads both)
                 leftmost = i << (depth - d)
-                self.predictions[nid] = float(values[leftmost])
-                if cover is not None:
-                    span = cover[leftmost: (i + 1) << (depth - d)]
-                    self.covers[nid] = float(span.sum())
+                span = cover[leftmost: (i + 1) << (depth - d)]
+                self.covers[nid] = float(span.sum())
+            if d == depth or not bool(valid[d][i]):
+                self.predictions[nid] = float(values[i << (depth - d)])
                 return nid
             self.features[nid] = feature_names[int(feat[d][i])]
             self.thresholds[nid] = float(thr[d][i])
@@ -116,3 +118,65 @@ def tree_from_model(model, tree_number: int = 0,
         raise ValueError("tree_class is only valid for multinomial models")
     return H2OTree(t, names, tree_number=tree_number,
                    tree_class=tree_class)
+
+
+def feature_interactions(model, max_trees: Optional[int] = None):
+    """Split-interaction statistics — the h2o.feature_interaction analog.
+
+    Walks every tree's node structure and aggregates, for single
+    features and parent-child feature pairs along root-to-leaf paths,
+    the split count and summed cover (weighted rows through the split).
+    The reference's XGBoost table also reports per-node gain, which the
+    compressed level-wise trees do not retain — counts and covers are
+    the retained, exactly-reconstructable statistics.
+
+    Returns {"singles": {feature, count, cover}, "pairs":
+    {feature_pair, count, cover}} sorted by count descending.
+    """
+    from collections import defaultdict
+    trees = model.output["trees"]
+    names = [s.name for s in model.datainfo.specs]
+    first = trees[0]
+    probe = first[0] if isinstance(first, (list, tuple)) else first
+    if probe.cover is None:
+        raise ValueError(
+            "model's trees carry no recorded covers; retrain with a "
+            "builder that records them (GBM/DRF/XGBoost do)")
+    singles = defaultdict(lambda: [0, 0.0])
+    pairs = defaultdict(lambda: [0, 0.0])
+
+    def walk(t):
+        ht = H2OTree(t, names)
+
+        def visit(nid, parent_feat):
+            f = ht.features[nid]
+            if f is None:
+                return
+            cov = float(ht.covers[nid])
+            s = singles[f]
+            s[0] += 1
+            s[1] += cov
+            if parent_feat is not None and parent_feat != f:
+                key = "|".join(sorted((parent_feat, f)))
+                p = pairs[key]
+                p[0] += 1
+                p[1] += cov
+            visit(ht.left_children[nid], f)
+            visit(ht.right_children[nid], f)
+
+        visit(0, None)
+
+    flat = []
+    for t in trees if max_trees is None else list(trees)[:max_trees]:
+        flat.extend(t if isinstance(t, (list, tuple)) else [t])
+    for t in flat:
+        walk(t)
+
+    def table(d, key_name):
+        items = sorted(d.items(), key=lambda kv: -kv[1][0])
+        return {key_name: np.asarray([k for k, _ in items], dtype=object),
+                "count": np.asarray([v[0] for _, v in items]),
+                "cover": np.asarray([v[1] for _, v in items])}
+    return {"singles": table(singles, "feature"),
+            "pairs": table(pairs, "feature_pair")}
+

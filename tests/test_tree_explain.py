@@ -373,3 +373,26 @@ def test_pdp_2d_and_multi(cl, rng):
     import pytest
     with pytest.raises(ValueError, match="distinct"):
         ex.partial_dependence_2d(m, fr, "x0", "x0")
+
+
+def test_feature_interactions(cl, rng):
+    import h2o3_tpu
+    from h2o3_tpu.export import feature_interactions
+    from h2o3_tpu.models import GBM
+    n = 600
+    X = rng.normal(size=(n, 3))
+    # XOR-ish: y needs x0 AND x1 together; x2 is noise
+    y = np.where((X[:, 0] > 0) ^ (X[:, 1] > 0), "Y", "N").astype(object)
+    fr = h2o3_tpu.Frame.from_numpy({
+        "x0": X[:, 0], "x1": X[:, 1], "x2": X[:, 2], "y": y})
+    m = GBM(response_column="y", ntrees=10, max_depth=3, seed=1).train(fr)
+    fi = feature_interactions(m)
+    singles = dict(zip(fi["singles"]["feature"], fi["singles"]["count"]))
+    assert singles["x0"] > singles.get("x2", 0)
+    assert fi["pairs"]["feature_pair"][0] == "x0|x1"     # the interaction
+    assert (fi["singles"]["cover"] > 0).all()
+    # counts are sorted descending
+    assert (np.diff(fi["singles"]["count"]) <= 0).all()
+    # max_trees truncation reduces counts
+    fi1 = feature_interactions(m, max_trees=1)
+    assert fi1["singles"]["count"].sum() < fi["singles"]["count"].sum()
