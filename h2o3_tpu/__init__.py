@@ -69,6 +69,30 @@ def cluster_status() -> dict:
     return cluster().describe()
 
 
+_pre_quiet_level = None
+
+
+def no_progress() -> None:
+    """h2o.no_progress analog: quiet the package's INFO chatter (jobs
+    record progress in the DKV rather than logging, so this raises the
+    'h2o3_tpu' logger to WARNING — spill/extension notices included)."""
+    global _pre_quiet_level
+    import logging
+    lg = logging.getLogger("h2o3_tpu")
+    if _pre_quiet_level is None:
+        _pre_quiet_level = lg.level
+    lg.setLevel(logging.WARNING)
+
+
+def show_progress() -> None:
+    """h2o.show_progress analog: restore the level no_progress saved."""
+    global _pre_quiet_level
+    import logging
+    if _pre_quiet_level is not None:
+        logging.getLogger("h2o3_tpu").setLevel(_pre_quiet_level)
+        _pre_quiet_level = None
+
+
 def assign(frame: Frame, key: str) -> Frame:
     """h2o.assign analog: REBIND the frame to ``key`` — the old DKV
     binding is released, matching h2o-py's in-place id change."""

@@ -111,7 +111,8 @@ def partial_dependence(model, frame: Frame, column: str,
 
 def ice(model, frame: Frame, column: str, nbins: int = 20,
         sample_rows: int = 50, seed: int = 0,
-        target_class: Optional[str] = None) -> Dict[str, np.ndarray]:
+        target_class: Optional[str] = None,
+        centered: bool = False) -> Dict[str, np.ndarray]:
     """Individual Conditional Expectation curves (h2o.ice_plot analog):
     the PDP decomposed per row, on a row subsample.  The grid comes from
     the FULL column distribution; only the sampled rows are scored."""
@@ -126,6 +127,9 @@ def ice(model, frame: Frame, column: str, nbins: int = 20,
     for j, g in enumerate(grid):
         curves[:, j] = _response_col(model, model.predict(
             _with_constant(sub, column, g, subvec)), target_class)
+    if centered:
+        # h2o ice_plot centered=True: subtract each curve's first value
+        curves = curves - curves[:, :1]
     labels = ([vec.domain[int(g)] for g in grid]
               if vec.type == T_CAT else grid)
     return {"column": column, "grid": np.asarray(labels, dtype=object),

@@ -233,6 +233,10 @@ class Frame:
     def head(self, n: int = 10):
         return self.to_pandas().head(n)
 
+    def describe(self) -> "Dict[str, dict]":
+        """h2o-py H2OFrame.describe() alias for summary()."""
+        return self.summary()
+
     def summary(self) -> Dict[str, dict]:
         out = {}
         for name, v in zip(self.names, self.vecs):

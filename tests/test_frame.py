@@ -248,3 +248,15 @@ def test_load_dataset(cl):
     assert m.training_metrics is not None
     with pytest.raises(ValueError, match="available"):
         h2o3_tpu.load_dataset("nope")
+
+
+def test_describe_and_progress_toggles(cl):
+    import logging
+    fr = h2o3_tpu.Frame.from_numpy({"a": np.arange(5.0)})
+    assert fr.describe() == fr.summary()
+    lg = logging.getLogger("h2o3_tpu")
+    before = lg.level
+    h2o3_tpu.no_progress()
+    assert lg.level == logging.WARNING
+    h2o3_tpu.show_progress()
+    assert lg.level == before        # restores the PRIOR level exactly

@@ -396,3 +396,16 @@ def test_feature_interactions(cl, rng):
     # max_trees truncation reduces counts
     fi1 = feature_interactions(m, max_trees=1)
     assert fi1["singles"]["count"].sum() < fi["singles"]["count"].sum()
+
+
+def test_ice_centered(cl, rng):
+    import h2o3_tpu
+    from h2o3_tpu import explain as ex
+    from h2o3_tpu.models import GLM
+    X = rng.normal(size=(200, 1))
+    y = 2.0 * X[:, 0] + 0.05 * rng.normal(size=200)
+    fr = h2o3_tpu.Frame.from_numpy({"x0": X[:, 0], "y": y})
+    m = GLM(response_column="y", family="gaussian").train(fr)
+    ic = ex.ice(m, fr, "x0", nbins=5, sample_rows=10, centered=True)
+    np.testing.assert_allclose(ic["curves"][:, 0], 0.0, atol=1e-9)
+    assert (ic["curves"][:, -1] > 0).all()   # increasing truth
