@@ -16,10 +16,19 @@ Secondary metrics (BASELINE.md):
 
 Prints ONE JSON line: the headline record with an "extra" dict carrying the
 secondary metrics.
+
+Robustness contract (BENCH_r02 post-mortem): the measured region runs in a
+*worker subprocess*; the parent orchestrator enforces a wall-clock timeout and,
+on ANY worker failure — hung accelerator tunnel, mid-run backend death
+(`RuntimeError: Unable to initialize backend 'axon'`), crash — retries the
+whole suite on CPU with a reduced shape.  The orchestrator always prints a
+JSON record and exits 0.
 """
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -131,8 +140,6 @@ def _devices_reachable(timeout_s: float = 150.0) -> bool:
     """Probe device init in a subprocess so a dead accelerator tunnel
     (hung jax.devices(), observed with the axon plugin) cannot hang the
     whole bench — the probe is killed and we fall back to CPU."""
-    import subprocess
-    import sys
     try:
         r = subprocess.run(
             [sys.executable, "-c",
@@ -143,14 +150,15 @@ def _devices_reachable(timeout_s: float = 150.0) -> bool:
         return False
 
 
-def main():
+def worker_main():
     if (not os.environ.get("JAX_PLATFORMS")
             and not os.environ.get("H2O3_BENCH_SKIP_PROBE")
             and not _devices_reachable()):
-        import sys
-        print("bench: device init unreachable; falling back to CPU",
-              file=sys.stderr, flush=True)
-        os.environ["JAX_PLATFORMS"] = "cpu"
+        # The orchestrator owns the fallback (reduced-shape CPU retry with
+        # an annotated record) — exit non-zero rather than silently running
+        # the full 10M-row shape on CPU here.
+        print("bench: device init unreachable", file=sys.stderr, flush=True)
+        sys.exit(3)
     if os.environ.get("JAX_PLATFORMS"):
         # the image pre-imports jax with a baked-in platform; the env var
         # must win (lets CI smoke-run this on CPU, and backs the dead-
@@ -165,7 +173,8 @@ def main():
 
     h2o3_tpu.init()
     import jax
-    extra = {"platform": jax.devices()[0].platform}
+    extra = {"platform": jax.devices()[0].platform,
+             "rows": N_ROWS, "trees": N_TREES}
     tps = bench_trees(Frame, T_CAT, XGBoost)
     try:
         sps = bench_deeplearning(Frame, DeepLearning)
@@ -188,8 +197,67 @@ def main():
         "unit": "trees/sec",
         "vs_baseline": round(tps / REFERENCE_TREES_PER_SEC, 3),
         "extra": extra,
-    }))
+    }), flush=True)
+
+
+def _attempt(env_overrides, timeout_s):
+    """Run the bench worker in a subprocess; return (record, error)."""
+    env = os.environ.copy()
+    env.update(env_overrides)
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--worker"],
+            env=env, timeout=timeout_s, capture_output=True, text=True)
+    except subprocess.TimeoutExpired as e:
+        tail = ""
+        for stream in (e.stderr, e.stdout):
+            if stream:
+                if isinstance(stream, bytes):
+                    stream = stream.decode("utf-8", "replace")
+                tail = stream[-400:]
+                break
+        return None, f"worker timed out after {timeout_s}s; tail: {tail}"
+    except Exception as e:                               # pragma: no cover
+        return None, repr(e)[:400]
+    if r.stderr:
+        sys.stderr.write(r.stderr[-4000:])
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            return rec, None
+    tail = (r.stderr or r.stdout or "")[-400:]
+    return None, f"worker rc={r.returncode}, no JSON record; tail: {tail}"
+
+
+def orchestrate():
+    """Always emit one JSON record and exit 0, whatever the hardware does."""
+    errors = {}
+    timeout_s = int(os.environ.get("H2O3_BENCH_TIMEOUT", 2700))
+    rec, err = _attempt({}, timeout_s)
+    if rec is None:
+        errors["primary_attempt"] = err
+        print(f"bench: primary attempt failed ({err}); re-running on CPU",
+              file=sys.stderr, flush=True)
+        cpu_rows = min(N_ROWS, int(os.environ.get(
+            "H2O3_BENCH_CPU_ROWS", 1_000_000)))
+        rec, err = _attempt(
+            {"JAX_PLATFORMS": "cpu", "H2O3_BENCH_SKIP_PROBE": "1",
+             "H2O3_BENCH_ROWS": str(cpu_rows)}, timeout_s)
+        if rec is None:
+            errors["cpu_attempt"] = err
+            rec = {"metric": "xgboost_trees_per_sec_airlines10m_shape",
+                   "value": 0.0, "unit": "trees/sec", "vs_baseline": 0.0,
+                   "extra": {"platform": "none"}}
+    if errors:
+        rec.setdefault("extra", {})["fallback_errors"] = errors
+    print(json.dumps(rec), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    if "--worker" in sys.argv:
+        worker_main()
+    else:
+        orchestrate()

@@ -45,6 +45,23 @@ def rng():
     return np.random.default_rng(42)
 
 
+# Modules dominated by compile-heavy tree/NN builds or multi-process spawns.
+# The smoke tier (`pytest -m "not slow"`) skips these and finishes in ~2 min;
+# the full suite remains the merge gate.
+_SLOW_MODULES = {
+    "test_trees", "test_trees_ext", "test_hist_kernel", "test_multiprocess",
+    "test_deeplearning", "test_tree_explain", "test_orchestration",
+    "test_algos3",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        mod = item.nodeid.split("::")[0].rsplit("/", 1)[-1].removesuffix(".py")
+        if mod in _SLOW_MODULES:
+            item.add_marker(pytest.mark.slow)
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _release_compiled_programs():
     """Drop compiled XLA programs between test modules.
