@@ -106,6 +106,20 @@ def _column_to_vec(values: np.ndarray, name: str,
     return Vec.from_numpy(codes, T_CAT, domain=[str(u) for u in uniq])
 
 
+def _decode_text_column(body: bytes, offs: np.ndarray, j: int) -> np.ndarray:
+    """Decode one column's raw cell bytes (native tokenizer offsets) to
+    Python strings, applying RFC-4180 quote unescaping."""
+    nrows = len(offs)
+    col = np.empty(nrows, dtype=object)
+    for i in range(nrows):
+        s, e = offs[i, j]
+        cell = body[s:e].decode(errors="replace")
+        if '""' in cell:
+            cell = cell.replace('""', '"')
+        col[i] = cell
+    return col
+
+
 def _parse_csv_native(path_or_buf, header, sep, col_names):
     """Native tokenizer path (h2o3_tpu/native/fastcsv.cpp via ctypes).
 
@@ -158,15 +172,8 @@ def _parse_csv_native(path_or_buf, header, sep, col_names):
     cols = {}
     for j, name in enumerate(names):
         if flags[:, j].any():
-            col = np.empty(nrows, dtype=object)
-            for i in range(nrows):
-                s, e2 = offs[i, j]
-                cell = body[s:e2].decode(errors="replace")
-                if '""' in cell:                 # RFC-4180 escaped quotes
-                    cell = cell.replace('""', '"')
-                col[i] = cell
             # numeric cells keep their text form for uniform type guessing
-            cols[name] = col
+            cols[name] = _decode_text_column(body, offs, j)
         else:
             cols[name] = vals[:, j]
     return names, cols
@@ -585,6 +592,16 @@ def import_file(path, destination_frame: Optional[str] = None,
         raise NotImplementedError(
             "avro import needs the fastavro library, which is not in this "
             "build; convert to parquet/orc/csv or install fastavro")
+    import jax
+    if (jax.process_count() > 1
+            and all("://" not in p and not p.lower().endswith(
+                (".gz", ".zip", ".bz2", ".xz")) for p in paths)):
+        # pod-scale ingest: tokenize on the hosts that own the byte ranges
+        # (MultiFileParseTask analog) instead of replicating the full
+        # tokenization on every process
+        from .dparse import parse_files_distributed
+        return parse_files_distributed(
+            paths, destination_frame=destination_frame, **kw)
     if len(paths) == 1 and "://" not in paths[0] \
             and not any(paths[0].lower().endswith(e)
                         for e in (".gz", ".zip", ".bz2", ".xz")):

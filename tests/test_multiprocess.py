@@ -85,6 +85,170 @@ with open(out_path, "w") as f:
 """
 
 
+WORKER_PARSE = r"""
+import glob, json, os, sys
+
+pid = int(sys.argv[1])
+nproc = int(sys.argv[2])
+coord = sys.argv[3]
+out_path = sys.argv[4]
+data_glob = sys.argv[5]
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address=coord, num_processes=nproc,
+                           process_id=pid)
+
+import numpy as np
+import h2o3_tpu
+from h2o3_tpu.frame import dparse
+from h2o3_tpu.models import GLM
+
+cl = h2o3_tpu.init(coordinator=coord, num_processes=nproc, process_id=pid)
+
+fr = h2o3_tpu.import_file(data_glob, destination_frame="airlines_mp")
+mean_num = fr.vec("num").mean()                 # rides a cross-process psum
+span_stats = dict(dparse.last_stats)
+glm = GLM(response_column="resp", family="binomial", lambda_=0.0,
+          seed=1).train(fr)
+auc = glm.training_metrics.describe()["auc"]
+
+cat_codes = fr.vec("cat").to_numpy()            # process_allgather round-trip
+
+# quoted-newline file: the byte split is unsafe -> replicated fallback
+qpath = os.path.join(os.path.dirname(data_glob), "qdata.csv")
+fq = h2o3_tpu.import_file(qpath, destination_frame="quoted_mp")
+q_stats = dict(dparse.last_stats)
+
+with open(out_path, "w") as f:
+    json.dump({"pid": pid, "shape": list(fr.shape), "types": fr.types(),
+               "mean_num": float(mean_num), "auc": float(auc),
+               "domain": fr.vec("cat").domain,
+               "mixed_domain": fr.vec("mixedcat").domain,
+               "cat_head": [int(v) for v in cat_codes[:5]],
+               "txt_head": [str(v) for v in fr.vec("txt").to_numpy()[:3]],
+               "stats": span_stats,
+               "q_shape": list(fq.shape),
+               "q_cell": str(fq.vec("note").to_numpy()[250]),
+               "q_suspect": bool(q_stats.get("suspect"))}, f)
+"""
+
+
+def _write_parse_files(tmp_path, nrows_list=(3000, 800, 4200)):
+    """Uneven CSV shards; cat levels differ per file to force domain merge.
+
+    ``mixedcat`` holds numeric-looking tokens ("3", "007") everywhere except
+    the tail of the last file ("x9") — process 0's spans tokenize it as pure
+    float while process 1 sees text, forcing the supplemental raw-token
+    domain round (source spellings must survive, no "3.0" float round-trip).
+    """
+    import numpy as np
+    rng = np.random.default_rng(7)
+    total_rows = 0
+    last = len(nrows_list) - 1
+    for k, nrows in enumerate(nrows_list):
+        with open(tmp_path / f"part{k}.csv", "w") as f:
+            f.write("num,cat,mixedcat,txt,resp\n")
+            for i in range(nrows):
+                num = "" if i % 131 == 0 else f"{rng.normal():.4f}"
+                cat = f"lvl{k}_{i % (3 + k)}"
+                if k == last and i >= nrows - 200:
+                    mixed = "x9"
+                else:
+                    mixed = "007" if i % 2 else "3"
+                y = "Y" if rng.random() < 0.5 else "N"
+                f.write(f"{num},{cat},{mixed},id_{k}_{i},{y}\n")
+        total_rows += nrows
+    # quoted-newline dataset: one RFC-4180 field with embedded linebreaks
+    # sized to straddle the 2-process byte midpoint, so a span boundary
+    # lands inside the quotes and the split MUST be detected as unsafe
+    blob = "\n".join(f"wrapped line {j}" for j in range(120))
+    with open(tmp_path / "qdata.csv", "w") as f:
+        f.write('id,note\n')
+        for i in range(500):
+            if i == 250:
+                f.write(f'{i},"{blob}"\n')
+            else:
+                f.write(f'{i},plain_{i}\n')
+    return total_rows
+
+
+def test_distributed_parse_two_processes(tmp_path):
+    """2 processes parse a multi-file CSV, each tokenizing only its own
+    byte ranges (ParseDataset.java:688 MultiFileParseTask analog), then
+    train on the result."""
+    nproc = 2
+    total_rows = _write_parse_files(tmp_path)
+    port = _free_port()
+    coord = f"127.0.0.1:{port}"
+    worker_py = tmp_path / "worker_parse.py"
+    worker_py.write_text(WORKER_PARSE)
+    procs, outs = [], []
+    for pid in range(nproc):
+        out = tmp_path / f"pout_{pid}.json"
+        outs.append(out)
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "host_platform_device_count" not in f]
+        flags.append("--xla_force_host_platform_device_count=4")
+        env["XLA_FLAGS"] = " ".join(flags)
+        ambient = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                   if p and "axon" not in p]
+        env["PYTHONPATH"] = os.pathsep.join([ROOT] + ambient)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(worker_py), str(pid), str(nproc), coord,
+             str(out), str(tmp_path / "part*.csv")],
+            env=env, cwd=ROOT, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
+    logs = []
+    for p in procs:
+        try:
+            stdout, _ = p.communicate(timeout=540)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        logs.append(stdout)
+    for pid, p in enumerate(procs):
+        assert p.returncode == 0, f"worker {pid} failed:\n{logs[pid][-4000:]}"
+    results = [json.loads(o.read_text()) for o in outs]
+    r0, r1 = results
+    assert r0["shape"] == [total_rows, 5]
+    assert r0["shape"] == r1["shape"]
+    assert r0["types"] == {"num": "num", "cat": "cat", "mixedcat": "cat",
+                           "txt": "str", "resp": "cat"}
+    # SPMD: identical global results on every process
+    assert abs(r0["mean_num"] - r1["mean_num"]) < 1e-6
+    assert abs(r0["auc"] - r1["auc"]) < 1e-6
+    assert r0["domain"] == r1["domain"]
+    assert r0["cat_head"] == r1["cat_head"]
+    assert r0["txt_head"] == ["id_0_0", "id_0_1", "id_0_2"]
+    # domain merge saw every file's distinct levels (3 + 4 + 5)
+    assert len(r0["domain"]) == 12
+    # mixed numeric/text column keeps SOURCE token spellings in the merged
+    # domain — never float round-trips like "3.0"/"7.0"
+    assert sorted(r0["mixed_domain"]) == ["007", "3", "x9"]
+    assert r0["mixed_domain"] == r1["mixed_domain"]
+    # quoted-newline input: at least one process detected the unsafe split
+    # (the boundary lands inside the quoted blob) and ALL fell back to the
+    # replicated parse, which handles the quoting correctly
+    assert r0["q_suspect"] or r1["q_suspect"]
+    assert r0["q_shape"] == [500, 2] and r1["q_shape"] == [500, 2]
+    expected_blob = "\n".join(f"wrapped line {j}" for j in range(120))
+    assert r0["q_cell"] == expected_blob == r1["q_cell"]
+    # NO single-host tokenization: each process touched only its byte span
+    total = r0["stats"]["total_bytes"]
+    for r in results:
+        st = r["stats"]
+        assert st["total_bytes"] == total
+        assert 0 < st["bytes_tokenized"] < 0.7 * total, st
+        assert 0 < st["rows_local"] < total_rows, st
+    combined = sum(r["stats"]["bytes_tokenized"] for r in results)
+    assert combined >= 0.9 * total             # headers/partial lines only
+    assert sum(r["stats"]["rows_local"] for r in results) == total_rows
+
+
 def _free_port():
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
