@@ -221,22 +221,29 @@ def _local_column(spans: List[_Span], names: List[str], name: str,
 
 # ------------------------------------------------------------ type evidence
 
-def _evidence(arr: np.ndarray):
+def _evidence(arr: np.ndarray, want: Optional[str]):
     """Per-process type evidence for one column (ParseSetup analog).
 
     Returns (evidence dict, cached time-parse result or None).  ``obj``
-    records whether this process holds raw tokens (object dtype) — numeric-
-    dtype evidence carries float-string uniques, which are only usable for
-    a domain when NO process saw text."""
+    records whether this process holds raw tokens (object dtype).  Numeric-
+    dtype arrays skip unique collection unless the caller forces T_CAT
+    (their float-string uniques are only ever used for forced-cat domains);
+    ``n_uniq`` is the exact LOCAL cardinality, so the global merge can
+    estimate cardinality beyond the per-process ``_UNIQ_CAP`` shipping cap.
+    """
     arr = np.asarray(arr)
     if arr.dtype.kind in "ifb":
         vals = arr.astype(np.float64)
         ok = np.isfinite(vals)
-        su = np.unique(vals[ok])
+        uniq, n_uniq, over = [], 0, False
+        if want == T_CAT:
+            su = np.unique(vals[ok])
+            n_uniq = len(su)
+            over = n_uniq > _UNIQ_CAP
+            uniq = [str(v) for v in su[:_UNIQ_CAP]]
         return {"numeric": True, "time": False, "obj": False,
-                "nonna": int(ok.sum()),
-                "uniq": [str(v) for v in su[:_UNIQ_CAP]],
-                "over_cap": bool(len(su) > _UNIQ_CAP), "ms_min": None}, None
+                "nonna": int(ok.sum()), "uniq": uniq, "n_uniq": n_uniq,
+                "over_cap": over, "ms_min": None}, None
     svals = arr.astype(str)
     na = np.isin(svals, list(_NA))
     nz = svals[~na]
@@ -254,7 +261,8 @@ def _evidence(arr: np.ndarray):
     su = np.unique(nz)
     return {"numeric": numeric, "time": ms is not None, "obj": True,
             "nonna": int(len(nz)), "uniq": su[:_UNIQ_CAP].tolist(),
-            "over_cap": bool(len(su) > _UNIQ_CAP), "ms_min": ms_min}, ms
+            "n_uniq": int(len(su)), "over_cap": bool(len(su) > _UNIQ_CAP),
+            "ms_min": ms_min}, ms
 
 
 def _resolve_type(evs: List[dict], want: Optional[str]):
@@ -262,7 +270,10 @@ def _resolve_type(evs: List[dict], want: Optional[str]):
 
     ``needs_raw`` marks cat/str columns where at least one process holds
     raw text tokens — numeric-dtype processes must then re-extract raw
-    tokens so domains/values agree with the source bytes."""
+    tokens so domains/values agree with the source bytes.  Cardinality for
+    the cat-vs-str heuristic uses the sum of exact local counts (an upper
+    bound — duplicates across processes overcount, which only matters for
+    contrived heavy-overlap near-unique columns)."""
     active = [e for e in evs if e["nonna"] > 0]
     if not active:
         return (want if want in (T_CAT, T_STR, T_TIME) else T_NUM), False
@@ -271,12 +282,11 @@ def _resolve_type(evs: List[dict], want: Optional[str]):
     if want in (None, T_TIME) and all(e["time"] for e in active):
         return T_TIME, False
     needs_raw = any(e["obj"] for e in active)
-    over = any(e["over_cap"] for e in evs)
-    merged = set().union(*[set(e["uniq"]) for e in evs])
+    card_est = sum(e["n_uniq"] for e in evs)
     total_nonna = sum(e["nonna"] for e in evs)
-    if want != T_CAT and (want == T_STR or over or (
-            len(merged) >= _STR_MIN_CARD
-            and len(merged) > _STR_UNIQUE_RATIO * total_nonna)):
+    if want != T_CAT and (want == T_STR or (
+            card_est >= _STR_MIN_CARD
+            and card_est > _STR_UNIQUE_RATIO * total_nonna)):
         return T_STR, needs_raw
     return T_CAT, needs_raw
 
@@ -417,7 +427,12 @@ def parse_files_distributed(paths: Sequence[str],
     # (every process reads the same few bytes — no communication needed).
     with open(paths[0], "rb") as f:
         first = f.readline().decode(errors="replace").rstrip("\r\n")
-    head_cells = [c.strip().strip('"') for c in first.split(sepc)]
+    import csv as _csv
+    try:
+        head_cells = [c.strip() for c in
+                      next(_csv.reader([first], delimiter=sepc))]
+    except (StopIteration, _csv.Error):
+        head_cells = [c.strip().strip('"') for c in first.split(sepc)]
     has_header = (not _guess_numeric(head_cells)) if header is None \
         else bool(header)
     if col_names:
@@ -458,7 +473,7 @@ def parse_files_distributed(paths: Sequence[str],
         for n in names:
             raw_cols[n] = _local_column(spans, names, n, sepc,
                                         force_raw=False)
-            ev, ms = _evidence(raw_cols[n])
+            ev, ms = _evidence(raw_cols[n], col_types.get(n))
             ev_payload[n] = ev
             ms_cache[n] = ms
     meta_key = f"{job}/meta/{me}"
@@ -485,7 +500,6 @@ def parse_files_distributed(paths: Sequence[str],
     need = _needed_ranges(padded)
 
     resolved: Dict[str, list] = {}
-    supp_needed = False
     for n in names:
         evs = [m["ev"][n] for m in metas]
         vtype, needs_raw = _resolve_type(evs, col_types.get(n))
@@ -494,25 +508,29 @@ def parse_files_distributed(paths: Sequence[str],
             # another process saw text; my float tokens must become raw
             raw_cols[n] = _local_column(spans, names, n, sepc,
                                         force_raw=True)
-            if vtype == T_CAT:
-                supp_needed = True
         resolved[n] = [vtype, needs_raw, None]
 
-    # ---- round 1.5 (only when a cat column mixes float/text processes):
-    # republish raw-token uniques so the merged domain uses source tokens
-    supp_any = any(
-        v[0] == T_CAT and v[1]
-        and any(not m["ev"][n]["obj"] and m["ev"][n]["nonna"] > 0
-                for m in metas)
-        for n, v in resolved.items())
-    if supp_any:
+    # ---- round 1.5: a cat column needs a supplemental FULL-unique round
+    # from process p when (a) p held float tokens but another process saw
+    # text (domain must use source spellings, not float round-trips), or
+    # (b) p's uniques overflowed the _UNIQ_CAP shipping cap (a capped
+    # domain would silently map dropped levels to NA).
+    def _republishes(p: int, n: str) -> bool:
+        vtype, needs_raw, _ = resolved[n]
+        if vtype != T_CAT:
+            return False
+        e = metas[p]["ev"][n]
+        if e["nonna"] == 0:
+            return False
+        return (needs_raw and not e["obj"]) or e["over_cap"]
+
+    if any(_republishes(p, n) for p in range(nproc) for n in names):
         supp = {}
-        for n, (vtype, needs_raw, _) in resolved.items():
-            if vtype == T_CAT and needs_raw and not ev_payload[n]["obj"]:
-                arr = raw_cols[n]
-                svals = arr.astype(str)
+        for n in names:
+            if _republishes(me, n):
+                svals = raw_cols[n].astype(str)
                 nz = svals[~np.isin(svals, list(_NA))]
-                supp[n] = np.unique(nz)[:_UNIQ_CAP].tolist()
+                supp[n] = np.unique(nz).tolist()
         k = f"{job}/supp/{me}"
         dkv.put(k, supp)
         published.append(k)
@@ -526,11 +544,10 @@ def parse_files_distributed(paths: Sequence[str],
         if vtype == T_CAT:
             dom: set = set()
             for p, m in enumerate(metas):
-                e = m["ev"][n]
-                if needs_raw and not e["obj"]:
+                if _republishes(p, n):
                     dom.update(supps[p].get(n, ()))
                 else:
-                    dom.update(e["uniq"])
+                    dom.update(m["ev"][n]["uniq"])
             resolved[n][2] = sorted(dom)
         elif vtype == T_TIME:
             mins = [m["ev"][n]["ms_min"] for m in metas

@@ -28,7 +28,7 @@ import numpy as np
 from ..frame.frame import Frame
 from ..frame.vec import Vec, T_CAT, T_NUM
 from ..runtime import dkv
-from ..runtime.job import Job
+from ..runtime.job import Job, JobCancelled
 from .datainfo import DataInfo, MEAN_IMPUTATION
 
 
@@ -301,8 +301,14 @@ class ModelBuilder:
                 model = self._driver_body(job, frame, di, valid, journal)
             except BaseException as e:
                 # cancelled / deterministically failing jobs must not be
-                # resurrected as if the process had died
-                recovery.journal_fail(journal, repr(e))
+                # resurrected as if the process had died — but a failure
+                # caused by a dead/dying member stays 'running' in the
+                # journal so recovery.resume() resurrects it after restart
+                from ..runtime import failure
+                if isinstance(e, JobCancelled) or not (
+                        isinstance(e, failure.NodeFailedError)
+                        or failure.cluster_degraded()):
+                    recovery.journal_fail(journal, repr(e))
                 raise
             finally:
                 if orig_params is not None:

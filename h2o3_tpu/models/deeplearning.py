@@ -269,7 +269,9 @@ class DeepLearning(ModelBuilder):
         seen = 0
         import time as _time
         t0 = _time.time()
+        from ..runtime import failure
         for it in range(n_iters):
+            failure.maybe_inject("dl_iter")
             rng, k = jax.random.split(rng)
             params, opt_state, mean_loss = train_steps(params, opt_state, k)
             seen += steps_per_iter * batch

@@ -136,9 +136,10 @@ def init(devices=None, model_axis: int = 1, coordinator: str | None = None,
         dev_grid = np.array(devices).reshape(n // model_axis, model_axis)
         mesh = Mesh(dev_grid, (ROW_AXIS, MODEL_AXIS))
         _cluster = Cluster(mesh=mesh)
-    from . import extensions, heartbeat
+    from . import extensions, failure, heartbeat
     extensions.load_all()
     heartbeat.start()
+    failure.start()                 # dead-member watchdog: detection ACTS
     return _cluster
 
 
@@ -251,7 +252,8 @@ def cluster() -> Cluster:
 def shutdown() -> None:
     global _cluster
     with _lock:
-        from . import dkv, heartbeat
+        from . import dkv, failure, heartbeat
+        failure.stop()
         heartbeat.stop()
         dkv.detach()        # stop the DKV service / forget the coordinator
         _cluster = None

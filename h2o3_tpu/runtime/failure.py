@@ -1,0 +1,164 @@
+"""Failure detection that ACTS, plus deliberate fault injection.
+
+Reference: ``water/HeartBeatThread.java:145`` detects a "dirt-napping"
+node (missed heartbeats) but only *reports* it; the data plane cannot
+survive member loss (Paxos.java:31-33) and recovery is job-level via
+``hex/faulttolerance/Recovery.java:72-81`` after a full cluster restart.
+
+TPU-native design: same two tiers, but the detector acts.  A watchdog
+thread polls the heartbeat view; when a member decays to ``dead`` it
+
+1. records a ``node_dead`` timeline event and a ``!failures/<node>`` DKV
+   record (visible to REST/tooling),
+2. aborts every RUNNING local job with :class:`NodeFailedError` — the
+   SPMD collectives that job is blocked in can never complete once a
+   gang member is gone, so joiners are released immediately with a clear
+   error instead of hanging,
+3. leaves the job's recovery-journal entry in ``running`` state, so
+   ``runtime.recovery.resume()`` resurrects it after the cluster
+   restarts (the reference's auto-recovery contract).
+
+Fault injection (SURVEY.md §5 explicitly asks the rebuild to add hooks
+the reference lacks): set ``H2O3_TPU_FAULT_INJECT="point:proc:nth"`` to
+hard-kill (``os._exit(137)``) process index ``proc`` at the ``nth`` hit
+of the named injection point.  Training loops call
+``maybe_inject("tree_chunk")`` / ``maybe_inject("dl_iter")``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+from . import dkv, heartbeat
+
+FAILURES_PREFIX = "!failures/"
+
+
+class NodeFailedError(RuntimeError):
+    """A cluster member stopped heartbeating mid-job."""
+
+
+_thread: Optional[threading.Thread] = None
+_stop = threading.Event()
+_handled: set = set()
+_inject_counts: Dict[str, int] = {}
+
+
+def start(poll: float = 2.0, hb_interval: float = 5.0) -> None:
+    """Start the watchdog thread (idempotent)."""
+    global _thread
+    stop()
+    _stop.clear()
+
+    def _run():
+        while not _stop.wait(poll):
+            try:
+                check(hb_interval)
+            except Exception:        # noqa: BLE001 — watchdog must not die
+                pass
+
+    _thread = threading.Thread(target=_run, name="failure-watchdog",
+                               daemon=True)
+    _thread.start()
+
+
+def stop() -> None:
+    global _thread
+    _stop.set()
+    if _thread is not None:
+        _thread.join(timeout=2.0)
+        _thread = None
+
+
+def check(hb_interval: float = 5.0) -> list:
+    """One watchdog sweep; returns newly dead node names (also callable
+    directly from tests / REST handlers without the thread)."""
+    newly_dead = []
+    for node, info in heartbeat.members(interval=hb_interval).items():
+        if info.get("status") == "dead" and node not in _handled:
+            _handled.add(node)
+            newly_dead.append(node)
+            _on_dead(node, info)
+    return newly_dead
+
+
+def any_dead() -> bool:
+    """Has this process observed any member death (watchdog or sweep)?"""
+    return bool(_handled)
+
+
+def cluster_degraded(hb_interval: float = 5.0) -> bool:
+    """True when any member is not (yet) fully alive.
+
+    Used when a training collective dies with a raw runtime error: a peer
+    may have crashed moments ago and not yet aged to ``dead`` — a stale
+    (suspect) stamp at failure time is treated as a node failure, so the
+    recovery journal keeps the job resumable instead of marking it
+    deterministically failed."""
+    if _handled:
+        return True
+    try:
+        return any(m.get("status") != "alive"
+                   for m in heartbeat.members(interval=hb_interval).values())
+    except Exception:                # noqa: BLE001 — coordinator gone ⇒ yes
+        return True
+
+
+def _on_dead(node: str, info: dict) -> None:
+    from .observability import record, log
+    age = float(info.get("age", 0.0))
+    record("node_dead", node=node, age=age)
+    log.error("worker %s declared dead (no heartbeat for %.1fs); "
+              "aborting running jobs", node, age)
+    try:
+        dkv.put(FAILURES_PREFIX + node,
+                {"ts": time.time(), "age": age, "pid": info.get("pid")})
+    except Exception:                # noqa: BLE001 — coordinator may be gone
+        pass
+    from .job import list_jobs
+    err = NodeFailedError(
+        f"worker {node} lost mid-job (heartbeat dead for {age:.1f}s); "
+        "collectives cannot complete — restart the cluster, re-import "
+        "frames, then runtime.recovery.resume() to resurrect the job")
+    for job in list_jobs():
+        if job is not None and getattr(job, "is_running", False):
+            job.fail(err)
+
+
+def reset() -> None:
+    """Forget handled deaths + injection counts (tests)."""
+    _handled.clear()
+    _inject_counts.clear()
+
+
+# ------------------------------------------------------------ fault injection
+
+def maybe_inject(point: str) -> None:
+    """Kill THIS process at the configured injection point.
+
+    ``H2O3_TPU_FAULT_INJECT="<point>:<process_index>:<nth>"`` — exits
+    with status 137 (SIGKILL convention) at the nth hit of ``point`` on
+    the named process.  No-op otherwise; costs one env lookup.
+    """
+    spec = os.environ.get("H2O3_TPU_FAULT_INJECT")
+    if not spec:
+        return
+    try:
+        pt, pidx, nth = spec.split(":")
+        pidx, nth = int(pidx), int(nth)
+    except ValueError:
+        return
+    if pt != point:
+        return
+    import jax
+    if jax.process_index() != pidx:
+        return
+    _inject_counts[point] = _inject_counts.get(point, 0) + 1
+    if _inject_counts[point] >= nth:
+        from .observability import log
+        log.error("FAULT INJECTION: killing process %d at %s #%d",
+                  pidx, point, nth)
+        os._exit(137)

@@ -60,16 +60,19 @@ class Job:
         record("job_start", job=self.key, description=self.description)
         try:
             self.result = fn(self)
-            self.status = DONE
-            self.progress = 1.0
+            if self.status == RUNNING:   # an external fail() wins the race
+                self.status = DONE
+                self.progress = 1.0
             return self.result
         except JobCancelled:
-            self.status = CANCELLED
+            if self.status == RUNNING:
+                self.status = CANCELLED
             raise
         except BaseException as e:
-            self.status = FAILED
-            self.exception = e
-            self.traceback = traceback.format_exc()
+            if self.status == RUNNING:
+                self.status = FAILED
+                self.exception = e
+                self.traceback = traceback.format_exc()
             raise
         finally:
             self.end_time = time.time()
@@ -91,10 +94,12 @@ class Job:
     def join(self, timeout: Optional[float] = None) -> Any:
         """Wait for completion (threaded OR scheduler-queued runs).
 
-        A job that was never started or queued returns immediately."""
-        if self._thread is not None:
-            self._thread.join(timeout)
-        elif self._queued or self.status != CREATED:
+        Waits on the completion event, never the worker thread: an
+        external ``fail()`` (failure watchdog) must release joiners even
+        while the worker thread stays wedged in a dead collective.  A job
+        that was never started or queued returns immediately."""
+        if self._thread is not None or self._queued \
+                or self.status != CREATED:
             self._done.wait(timeout)
         if self.status == FAILED:
             raise self.exception
@@ -111,6 +116,19 @@ class Job:
 
     def cancel(self) -> None:
         self._cancel_requested.set()
+
+    def fail(self, exc: BaseException) -> None:
+        """Externally abort a job (failure watchdog): mark FAILED and
+        release joiners NOW.  The worker thread may stay blocked in a
+        collective that can never complete (gang member lost) — it is a
+        daemon thread and its eventual outcome is ignored."""
+        if self.status not in (CREATED, RUNNING):
+            return
+        self.status = FAILED
+        self.exception = exc
+        self.traceback = "".join(traceback.format_exception(exc))
+        self.end_time = time.time()
+        self._done.set()
 
     @property
     def is_running(self) -> bool:

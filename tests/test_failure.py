@@ -1,0 +1,107 @@
+"""Failure detection acts: watchdog aborts jobs, injection kills, recovery
+resumes.  (Chaos/multi-process variant lives in test_multiprocess.py.)"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.runtime import dkv, failure, heartbeat, recovery
+from h2o3_tpu.runtime.job import Job, RUNNING, FAILED
+
+
+def test_watchdog_aborts_running_jobs_on_dead_member(cl):
+    failure.reset()
+    name = heartbeat.start(interval=0.05)
+    job = Job("stuck train")
+    job.status = RUNNING            # simulate a job blocked in a collective
+    try:
+        # a ghost peer that stopped stamping long enough ago to be dead
+        dkv.put(heartbeat.PREFIX + "ghost", {"ts": time.time() - 1.0,
+                                             "interval": 0.05, "pid": 1})
+        newly = failure.check(hb_interval=0.05)
+        assert newly == ["ghost"]
+        assert job.status == FAILED
+        with pytest.raises(failure.NodeFailedError, match="ghost"):
+            job.join()
+        # failure record published for REST/tooling
+        rec = dkv.get(failure.FAILURES_PREFIX + "ghost")
+        assert rec and rec["pid"] == 1
+        # second sweep is idempotent
+        assert failure.check(hb_interval=0.05) == []
+        assert failure.any_dead() and failure.cluster_degraded()
+    finally:
+        heartbeat.stop()
+        failure.reset()
+        dkv.remove(heartbeat.PREFIX + "ghost")
+        dkv.remove(failure.FAILURES_PREFIX + "ghost")
+        dkv.remove(job.key)
+
+
+def test_node_death_keeps_journal_resumable(cl, tmp_path, monkeypatch):
+    """A train that fails while the cluster is degraded keeps its journal
+    entry 'running', and recovery.resume() retrains it."""
+    from h2o3_tpu.models import GBM
+    monkeypatch.setenv("H2O3_TPU_RECOVERY_DIR", str(tmp_path))
+    failure.reset()
+    rng = np.random.default_rng(3)
+    n = 600
+    x = rng.normal(size=n).astype(np.float32)
+    y = np.where(rng.random(n) < 1 / (1 + np.exp(-2 * x)), "Y", "N")
+    fr = h2o3_tpu.H2OFrame({"x": x, "y": y.astype(object)},
+                           destination_frame="chaos_unit_fr")
+    # mark a member dead, then make the build blow up mid-fit: the journal
+    # must stay 'running' (node failure), not flip to 'failed'
+    failure._handled.add("ghost")
+    boom = RuntimeError("collective aborted: peer closed connection")
+
+    class BoomGBM(GBM):
+        def _fit(self, *a, **k):
+            raise boom
+
+    BoomGBM.__name__ = "GBM"        # journal records the resumable algo
+    with pytest.raises(RuntimeError):
+        BoomGBM(response_column="y", ntrees=3, max_depth=2, seed=1).train(fr)
+    entries = list(tmp_path.glob("job_*.json"))
+    assert len(entries) == 1
+    import json
+    assert json.loads(entries[0].read_text())["status"] == "running"
+    failure.reset()                 # "restart": healthy again
+    done = recovery.resume(str(tmp_path))
+    assert len(done) == 1
+    model = dkv.get(done[0])
+    assert model is not None and model.output["ntrees_trained"] == 3
+    assert not list(tmp_path.glob("job_*.json"))
+
+
+def test_plain_failure_still_marks_journal_failed(cl, tmp_path, monkeypatch):
+    from h2o3_tpu.models import GBM
+    monkeypatch.setenv("H2O3_TPU_RECOVERY_DIR", str(tmp_path))
+    failure.reset()
+    heartbeat.start(interval=0.5)   # healthy self-stamp: not degraded
+    try:
+        fr = h2o3_tpu.H2OFrame({"x": [1.0, 2.0, 3.0],
+                                "y": ["a", "b", "a"]},
+                               destination_frame="plainfail_fr")
+        with pytest.raises(Exception):
+            GBM(response_column="nosuch", ntrees=2).train(fr)
+    finally:
+        heartbeat.stop()
+    # a deterministic failure must NOT be resurrected
+    import json
+    for e in tmp_path.glob("job_*.json"):
+        assert json.loads(e.read_text())["status"] == "failed"
+    assert recovery.resume(str(tmp_path)) == []
+
+
+def test_fault_injection_spec_parsing(cl, monkeypatch):
+    """maybe_inject is a no-op for other points/processes (the kill path
+    is exercised by the multi-process chaos test)."""
+    failure.reset()
+    monkeypatch.setenv("H2O3_TPU_FAULT_INJECT", "tree_chunk:7:1")
+    failure.maybe_inject("tree_chunk")      # wrong process index: survive
+    failure.maybe_inject("dl_iter")         # wrong point: survive
+    monkeypatch.setenv("H2O3_TPU_FAULT_INJECT", "garbage")
+    failure.maybe_inject("tree_chunk")      # malformed: survive
