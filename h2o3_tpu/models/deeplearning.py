@@ -246,45 +246,86 @@ class DeepLearning(ModelBuilder):
         steps_per_iter = max(samples_per_iter // batch, 1)
         n_iters = max(total_samples // (steps_per_iter * batch), 1)
 
+        def sgd_step(carry, key):
+            params, opt_state = carry
+            k1, k2 = jax.random.split(key)
+            idx = jax.random.randint(k1, (batch,), 0, n)
+            xb = jnp.take(X, idx, axis=0)
+            yb = jnp.take(y, idx)
+            wb = jnp.take(w, idx)
+            loss, grads = jax.value_and_grad(loss_fn)(params, xb, yb, wb, k2)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), loss
+
         @jax.jit
         def train_steps(params, opt_state, rng):
             """lax.scan over minibatch SGD steps — one compiled program."""
-            def step(carry, key):
-                params, opt_state = carry
-                k1, k2 = jax.random.split(key)
-                idx = jax.random.randint(k1, (batch,), 0, n)
-                xb = jnp.take(X, idx, axis=0)
-                yb = jnp.take(y, idx)
-                wb = jnp.take(w, idx)
-                loss, grads = jax.value_and_grad(loss_fn)(params, xb, yb, wb, k2)
-                updates, opt_state = tx.update(grads, opt_state, params)
-                params = optax.apply_updates(params, updates)
-                return (params, opt_state), loss
             keys = jax.random.split(rng, steps_per_iter)
             (params, opt_state), losses = jax.lax.scan(
-                step, (params, opt_state), keys)
+                sgd_step, (params, opt_state), keys)
             return params, opt_state, jnp.mean(losses)
+
+        @jax.jit
+        def train_all(params, opt_state, rng):
+            """EVERY iteration inside one compiled program (nested scan).
+
+            Per-iteration host fetches cost a full round trip each on a
+            remote-tunnelled accelerator and starved the MXU at ~3k
+            samples/s (PROFILE.md); with no early stopping there is
+            nothing to decide on host mid-run, so the whole training is
+            one dispatch + ONE loss fetch.  The rng threading reproduces
+            the per-iteration loop's key sequence exactly."""
+            def iter_body(carry, _):
+                params, opt_state, rng = carry
+                rng, k = jax.random.split(rng)
+                keys = jax.random.split(k, steps_per_iter)
+                (params, opt_state), losses = jax.lax.scan(
+                    sgd_step, (params, opt_state), keys)
+                return (params, opt_state, rng), jnp.mean(losses)
+            (params, opt_state, _), iter_losses = jax.lax.scan(
+                iter_body, (params, opt_state, rng), None, length=n_iters)
+            return params, opt_state, iter_losses
 
         history = []
         seen = 0
         import time as _time
         t0 = _time.time()
         from ..runtime import failure
-        for it in range(n_iters):
+        if not p.stopping_rounds:
             failure.maybe_inject("dl_iter")
-            rng, k = jax.random.split(rng)
-            params, opt_state, mean_loss = train_steps(params, opt_state, k)
-            seen += steps_per_iter * batch
-            entry = {"iteration": it, "epochs": seen / n,
-                     "samples": seen, "training_loss": float(mean_loss),
-                     "samples_per_sec": seen / max(_time.time() - t0, 1e-9)}
-            history.append(entry)
-            job.update((it + 1) / n_iters,
-                       f"epoch {seen / n:.2f} loss {float(mean_loss):.5f}")
-            if p.stopping_rounds and stop_early(
-                    [h["training_loss"] for h in history],
-                    p.stopping_rounds, p.stopping_tolerance, maximize=False):
-                break
+            params, opt_state, iter_losses = train_all(params, opt_state,
+                                                       rng)
+            iter_losses = np.asarray(iter_losses)         # the ONE fetch
+            dt = max(_time.time() - t0, 1e-9)
+            for it in range(n_iters):
+                seen += steps_per_iter * batch
+                history.append({
+                    "iteration": it, "epochs": seen / n, "samples": seen,
+                    "training_loss": float(iter_losses[it]),
+                    "samples_per_sec": seen / (dt * (it + 1) / n_iters)})
+            job.update(1.0, f"epoch {seen / n:.2f} "
+                            f"loss {float(iter_losses[-1]):.5f}")
+        else:
+            for it in range(n_iters):
+                failure.maybe_inject("dl_iter")
+                rng, k = jax.random.split(rng)
+                params, opt_state, mean_loss = train_steps(params,
+                                                           opt_state, k)
+                seen += steps_per_iter * batch
+                entry = {"iteration": it, "epochs": seen / n,
+                         "samples": seen, "training_loss": float(mean_loss),
+                         "samples_per_sec": seen / max(_time.time() - t0,
+                                                       1e-9)}
+                history.append(entry)
+                job.update((it + 1) / n_iters,
+                           f"epoch {seen / n:.2f} "
+                           f"loss {float(mean_loss):.5f}")
+                if stop_early(
+                        [h["training_loss"] for h in history],
+                        p.stopping_rounds, p.stopping_tolerance,
+                        maximize=False):
+                    break
 
         model.output["weights"] = [(np.asarray(W), np.asarray(b))
                                    for W, b in params]
