@@ -147,8 +147,10 @@ class _Handler(BaseHTTPRequestHandler):
             m = re.fullmatch(pattern, parsed.path)
             if m:
                 try:
-                    return self._reply(200, fn(self.server.api,
-                                               *m.groups(), **params))
+                    out = fn(self.server.api, *m.groups(), **params)
+                    if isinstance(out, bytes):       # artifact downloads
+                        return self._reply_bytes(out)
+                    return self._reply(200, out)
                 except KeyError as e:
                     return self._reply(404, {"error": str(e)})
                 except Exception as e:      # noqa: BLE001
@@ -156,6 +158,13 @@ class _Handler(BaseHTTPRequestHandler):
                         "error": repr(e),
                         "stacktrace": traceback.format_exc().splitlines()})
         self._reply(404, {"error": f"no route {parsed.path}"})
+
+    def _reply_bytes(self, data: bytes):
+        self.send_response(200)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
 
     def do_GET(self):
         path = urlparse(self.path).path
@@ -167,6 +176,16 @@ class _Handler(BaseHTTPRequestHandler):
         self._dispatch(self.routes_get)
 
     def do_POST(self):
+        if urlparse(self.path).path == "/3/Models.upload.bin":
+            # raw binary body (a saved model artifact), not JSON
+            if not self._authorized():
+                return self._deny()
+            length = int(self.headers.get("Content-Length") or 0)
+            raw = self.rfile.read(length)
+            try:
+                return self._reply(200, self.server.api.model_upload(raw))
+            except Exception as e:          # noqa: BLE001
+                return self._reply(400, {"error": repr(e)})
         self._dispatch(self.routes_post)
 
     def do_DELETE(self):
@@ -228,18 +247,9 @@ class Api:
                 "destination_frame": {"name": fr.key}}
 
     # ---------------------------------------------------------------- models
-    def train(self, algo: str, **params) -> dict:
-        from ..runtime import dkv
-        algo = algo.lower()
-        if algo not in ALGOS:
-            raise KeyError(f"unknown algo {algo!r}")
-        training = params.pop("training_frame")
-        valid_key = params.pop("validation_frame", None)
-        frame = dkv.get(training)
-        if frame is None:
-            raise KeyError(f"no frame {training!r}")
-        valid = dkv.get(valid_key) if valid_key else None
-        # coerce numeric strings (query-string transport)
+    @staticmethod
+    def _coerce(params: dict) -> dict:
+        """Coerce numeric/JSON strings (query-string transport)."""
         clean = {}
         for k, v in params.items():
             if isinstance(v, str):
@@ -248,6 +258,24 @@ class Api:
                 except Exception:
                     pass
             clean[k] = v
+        return clean
+
+    def _frame_pair(self, params: dict):
+        from ..runtime import dkv
+        training = params.pop("training_frame")
+        valid_key = params.pop("validation_frame", None)
+        frame = dkv.get(training)
+        if frame is None:
+            raise KeyError(f"no frame {training!r}")
+        valid = dkv.get(valid_key) if valid_key else None
+        return frame, valid
+
+    def train(self, algo: str, **params) -> dict:
+        algo = algo.lower()
+        if algo not in ALGOS:
+            raise KeyError(f"unknown algo {algo!r}")
+        frame, valid = self._frame_pair(params)
+        clean = self._coerce(params)
         model = _builder(algo)(**clean).train(frame, valid)
         return {"job": {"status": "DONE",
                         "dest": {"name": model.key}},
@@ -283,6 +311,171 @@ class Api:
         _dkv.put(dest, pred)
         return {"predictions_frame": {"name": dest},
                 "frames": [_frame_schema(dest, pred)]}
+
+    # ----------------------------------------------------------------- grids
+    def grid_train(self, algo: str, **params) -> dict:
+        """POST /99/Grid/{algo} — hyperparameter search
+        (water/api/GridSearchHandler / hex/grid/GridSearch.java)."""
+        from ..runtime import dkv
+        from ..models.grid import GridSearch
+        algo = algo.lower()
+        if algo not in ALGOS:
+            raise KeyError(f"unknown algo {algo!r}")
+        frame, valid = self._frame_pair(params)
+        clean = self._coerce(params)
+        hyper = clean.pop("hyper_parameters", None) or {}
+        criteria = clean.pop("search_criteria", None)
+        sort_metric = clean.pop("sort_metric", None)
+        grid = GridSearch(_builder(algo), hyper,
+                          search_criteria=criteria, **clean).train(
+            frame, valid, sort_metric=sort_metric)
+        # Grid.__init__ registered itself in the DKV
+        return self._grid_schema(grid)
+
+    @staticmethod
+    def _grid_schema(grid) -> dict:
+        return {"grid_id": {"name": grid.key},
+                "hyper_names": grid.hyper_names,
+                "model_ids": [{"name": m.key} for m in grid.models],
+                "sort_metric": grid.sort_metric,
+                "summary_table": grid.sorted_metric_table()}
+
+    def grids(self) -> dict:
+        from ..runtime import dkv
+        from ..models.grid import Grid
+        out = []
+        for k in dkv.keys("grid"):
+            v = dkv.get(k)
+            if isinstance(v, Grid):
+                out.append({"name": k})
+        return {"grids": out}
+
+    def grid(self, key: str) -> dict:
+        from ..runtime import dkv
+        g = dkv.get(key)
+        if g is None:
+            raise KeyError(f"no grid {key!r}")
+        return self._grid_schema(g)
+
+    # ---------------------------------------------------------------- automl
+    def automl_build(self, **params) -> dict:
+        """POST /99/AutoMLBuilder — run AutoML
+        (ai/h2o/automl/AutoML.java:49 via AutoMLBuilderHandler)."""
+        from ..runtime import dkv
+        from ..automl import AutoML
+        frame, valid = self._frame_pair(params)
+        clean = self._coerce(params)
+        project = clean.pop("project_name", None) or dkv.make_key("automl")
+        aml = AutoML(**clean)
+        leader = aml.train(frame, valid)
+        dkv.put(f"automl_{project}", aml)
+        return {"project_name": project,
+                "leader": {"name": leader.key},
+                "leaderboard_table": aml.leaderboard.as_table()
+                if aml.leaderboard else []}
+
+    def leaderboard(self, project: str) -> dict:
+        """GET /99/Leaderboards/{project} (LeaderboardsHandler)."""
+        from ..runtime import dkv
+        aml = dkv.get(f"automl_{project}")
+        if aml is None or aml.leaderboard is None:
+            raise KeyError(f"no automl project {project!r}")
+        lb = aml.leaderboard
+        return {"project_name": project,
+                "sort_metric": lb.sort_metric,
+                "leaderboard_table": lb.as_table()}
+
+    # ------------------------------------------------- model save / download
+    def model_save(self, key: str, dir: str, **kw) -> dict:
+        """POST /99/Models.bin/{model} — server-side save (h2o.save_model)."""
+        from ..runtime import dkv
+        m = dkv.get(key)
+        if m is None:
+            raise KeyError(f"no model {key!r}")
+        path = f"{dir.rstrip('/')}/{key}.bin" if not dir.endswith(".bin") \
+            else dir
+        return {"path": m.save(path)}
+
+    def model_fetch_bin(self, key: str) -> bytes:
+        """GET /3/Models.fetch.bin/{model} — binary artifact download."""
+        import os
+        import tempfile
+        from ..runtime import dkv
+        m = dkv.get(key)
+        if m is None:
+            raise KeyError(f"no model {key!r}")
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "model.bin")
+            m.save(p)
+            with open(p, "rb") as f:
+                return f.read()
+
+    def model_fetch_mojo(self, key: str) -> bytes:
+        """GET /3/Models/{model}/mojo — portable scoring artifact
+        (ModelsHandler.fetchMojo analog)."""
+        import os
+        import tempfile
+        from ..runtime import dkv
+        from ..export.mojo import export_mojo
+        m = dkv.get(key)
+        if m is None:
+            raise KeyError(f"no model {key!r}")
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "model.zip")
+            export_mojo(m, p)
+            with open(p, "rb") as f:
+                return f.read()
+
+    def model_upload(self, raw: bytes, **kw) -> dict:
+        """POST /3/Models.upload.bin — install a client-side artifact."""
+        import os
+        import tempfile
+        from ..models.base import Model
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "model.bin")
+            with open(p, "wb") as f:
+                f.write(raw)
+            m = Model.load(p)
+        return {"models": [_model_schema(m.key, m)]}
+
+    # --------------------------------------------------------------- explain
+    def varimp(self, key: str) -> dict:
+        """GET /3/Models/{model}/varimp — variable importances."""
+        from ..runtime import dkv
+        from ..explain import _varimp_of
+        m = dkv.get(key)
+        if m is None:
+            raise KeyError(f"no model {key!r}")
+        vi = _varimp_of(m) or {}
+        return {"varimp": [{"variable": k, "relative_importance": float(v)}
+                           for k, v in vi.items()]}
+
+    def partial_dependence(self, **params) -> dict:
+        """POST /3/PartialDependence — PD table for one column."""
+        from ..runtime import dkv
+        from ..explain import partial_dependence as pd_fn
+        clean = self._coerce(params)
+        m = dkv.get(clean["model"])
+        fr = dkv.get(clean["frame"])
+        if m is None or fr is None:
+            raise KeyError("missing model or frame")
+        out = pd_fn(m, fr, clean["column"],
+                    nbins=int(clean.get("nbins", 20)))
+        return {"partial_dependence": {
+            k: (v.tolist() if hasattr(v, "tolist") else v)
+            for k, v in out.items()}}
+
+    # -------------------------------------------------------------- builders
+    def model_builders(self, algo: Optional[str] = None) -> dict:
+        """GET /3/ModelBuilders[/{algo}] — algo list + parameter metadata
+        (water/api/ModelBuildersHandler; drives client codegen)."""
+        schemas = {s["algo"]: s for s in self.schemas()["schemas"]}
+        if algo is not None:
+            a = algo.lower()
+            if a not in schemas:
+                raise KeyError(f"unknown algo {algo!r}")
+            return {"model_builders": {a: schemas[a]}}
+        return {"model_builders": schemas}
 
     # ------------------------------------------------------------------ jobs
     def jobs_list(self) -> dict:
@@ -463,6 +656,16 @@ class H2OServer:
             r"/3/Models/([^/]+)": lambda a, k: a.model(k),
             r"/3/Models/([^/]+)/scoring_history": lambda a, k:
                 a.scoring_history(k),
+            r"/3/Models/([^/]+)/varimp": lambda a, k: a.varimp(k),
+            r"/3/Models/([^/]+)/mojo": lambda a, k: a.model_fetch_mojo(k),
+            r"/3/Models\.fetch\.bin/([^/]+)": lambda a, k:
+                a.model_fetch_bin(k),
+            r"/3/ModelBuilders": lambda a: a.model_builders(),
+            r"/3/ModelBuilders/([^/]+)": lambda a, algo:
+                a.model_builders(algo),
+            r"/99/Grids": lambda a: a.grids(),
+            r"/99/Grids/([^/]+)": lambda a, k: a.grid(k),
+            r"/99/Leaderboards/([^/]+)": lambda a, p: a.leaderboard(p),
             r"/3/Jobs": lambda a: a.jobs_list(),
             r"/3/Jobs/([^/]+)": lambda a, k: a.job(k),
             r"/3/ImportFiles": lambda a, **kw: a.import_files(**kw),
@@ -483,6 +686,13 @@ class H2OServer:
             r"/3/ModelMetrics/models/([^/]+)/frames/([^/]+)":
                 lambda a, m, f, **kw: a.model_metrics(m, f, **kw),
             r"/3/SplitFrame": lambda a, **kw: a.split_frame(**kw),
+            r"/99/Grid/([^/]+)": lambda a, algo, **kw:
+                a.grid_train(algo, **kw),
+            r"/99/AutoMLBuilder": lambda a, **kw: a.automl_build(**kw),
+            r"/99/Models\.bin/([^/]+)": lambda a, k, **kw:
+                a.model_save(k, **kw),
+            r"/3/PartialDependence": lambda a, **kw:
+                a.partial_dependence(**kw),
         }
         _Handler.routes_delete = {
             r"/3/DKV/([^/]+)": lambda a, k: a.remove(k),

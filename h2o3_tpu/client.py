@@ -43,20 +43,24 @@ class H2OConnection(Backend):
         self.cloud = self.get("/3/Cloud")
 
     # ------------------------------------------------------------- transport
-    def _req(self, method: str, route: str, params: Optional[dict] = None):
+    def _req(self, method: str, route: str, params: Optional[dict] = None,
+             raw_body: Optional[bytes] = None, binary: bool = False):
         url = f"{self.url}{route}"
-        data = None
-        if method == "GET" and params:
-            url += "?" + urllib.parse.urlencode(params)
-        elif params is not None:
-            data = json.dumps(params).encode()
+        data = raw_body
+        if raw_body is None:
+            if method == "GET" and params:
+                url += "?" + urllib.parse.urlencode(params)
+            elif params is not None:
+                data = json.dumps(params).encode()
         req = urllib.request.Request(url, data=data, method=method)
-        req.add_header("Content-Type", "application/json")
+        req.add_header("Content-Type", "application/octet-stream"
+                       if raw_body is not None else "application/json")
         if self._auth:
             req.add_header("Authorization", self._auth)
         try:
             with urllib.request.urlopen(req) as resp:
-                payload = json.loads(resp.read().decode())
+                body = resp.read()
+                payload = body if binary else json.loads(body.decode())
         except urllib.error.HTTPError as e:
             try:
                 payload = json.loads(e.read().decode())
@@ -112,6 +116,51 @@ class H2OConnection(Backend):
 
     def schemas(self) -> dict:
         return self.get("/3/Metadata/schemas")
+
+    def model_builders(self, algo: Optional[str] = None) -> dict:
+        """Parameter metadata — /3/ModelBuilders (drives codegen)."""
+        route = "/3/ModelBuilders" + (f"/{algo}" if algo else "")
+        return self.get(route)["model_builders"]
+
+    def grid(self, algo: str, hyper_params: dict, training_frame,
+             validation_frame=None, search_criteria: Optional[dict] = None,
+             sort_metric: Optional[str] = None, **base_params) -> "RemoteGrid":
+        """Hyperparameter search over REST — h2o.grid analog."""
+        tf = training_frame.key if hasattr(training_frame, "key") \
+            else str(training_frame)
+        params = dict(base_params, training_frame=tf,
+                      hyper_parameters=hyper_params)
+        if validation_frame is not None:
+            params["validation_frame"] = validation_frame.key \
+                if hasattr(validation_frame, "key") else str(validation_frame)
+        if search_criteria:
+            params["search_criteria"] = search_criteria
+        if sort_metric:
+            params["sort_metric"] = sort_metric
+        out = self.post(f"/99/Grid/{algo}", **params)
+        return RemoteGrid(self, out)
+
+    def automl(self, training_frame, validation_frame=None,
+               **params) -> "RemoteAutoML":
+        """Run AutoML over REST — H2OAutoML analog."""
+        tf = training_frame.key if hasattr(training_frame, "key") \
+            else str(training_frame)
+        params["training_frame"] = tf
+        if validation_frame is not None:
+            params["validation_frame"] = validation_frame.key \
+                if hasattr(validation_frame, "key") else str(validation_frame)
+        out = self.post("/99/AutoMLBuilder", **params)
+        return RemoteAutoML(self, out)
+
+    def upload_model(self, path: str) -> "RemoteModel":
+        """Install a locally saved model artifact on the server."""
+        with open(path, "rb") as f:
+            raw = f.read()
+        out = self._req("POST", "/3/Models.upload.bin", raw_body=raw)
+        return RemoteModel(self, out["models"][0]["model_id"]["name"])
+
+    def _fetch_bytes(self, route: str) -> bytes:
+        return self._req("GET", route, binary=True)
 
     def remove(self, key: str):
         self.delete(f"/3/DKV/{key}")
@@ -202,8 +251,89 @@ class RemoteModel:
             f"/3/ModelMetrics/models/{self.key}/frames/{fk}"
         )["model_metrics"][0]
 
+    def varimp(self) -> List[dict]:
+        return self.conn.get(f"/3/Models/{self.key}/varimp")["varimp"]
+
+    def partial_dependence(self, frame: Union[RemoteFrame, str],
+                           column: str, nbins: int = 20) -> dict:
+        fk = frame.key if isinstance(frame, RemoteFrame) else str(frame)
+        return self.conn.post("/3/PartialDependence", model=self.key,
+                              frame=fk, column=column,
+                              nbins=nbins)["partial_dependence"]
+
+    def download(self, path: str) -> str:
+        """Download the binary model artifact (h2o.download_model)."""
+        raw = self.conn._fetch_bytes(f"/3/Models.fetch.bin/{self.key}")
+        with open(path, "wb") as f:
+            f.write(raw)
+        return path
+
+    def download_mojo(self, path: str) -> str:
+        """Download the portable scoring artifact (h2o.download_mojo)."""
+        raw = self.conn._fetch_bytes(f"/3/Models/{self.key}/mojo")
+        with open(path, "wb") as f:
+            f.write(raw)
+        return path
+
+    def save(self, directory: str) -> str:
+        """Server-side save (h2o.save_model)."""
+        return self.conn.post(f"/99/Models.bin/{self.key}",
+                              dir=directory)["path"]
+
     def __repr__(self):
         return f"<RemoteModel {self.key}>"
+
+
+class RemoteGrid:
+    """Handle to a server-side Grid."""
+
+    def __init__(self, conn: H2OConnection, schema: dict):
+        self.conn = conn
+        self.key = schema["grid_id"]["name"]
+        self._schema = schema
+
+    @property
+    def model_ids(self) -> List[str]:
+        return [m["name"] for m in self._schema["model_ids"]]
+
+    @property
+    def models(self) -> List[RemoteModel]:
+        return [RemoteModel(self.conn, k) for k in self.model_ids]
+
+    def summary_table(self) -> List[dict]:
+        return self._schema["summary_table"]
+
+    @property
+    def best_model(self) -> RemoteModel:
+        return RemoteModel(self.conn,
+                           self.summary_table()[0]["model_id"])
+
+    def refresh(self) -> "RemoteGrid":
+        self._schema = self.conn.get(f"/99/Grids/{self.key}")
+        return self
+
+    def __repr__(self):
+        return f"<RemoteGrid {self.key}: {len(self.model_ids)} models>"
+
+
+class RemoteAutoML:
+    """Handle to a finished server-side AutoML run."""
+
+    def __init__(self, conn: H2OConnection, schema: dict):
+        self.conn = conn
+        self.project_name = schema["project_name"]
+        self._schema = schema
+
+    @property
+    def leader(self) -> RemoteModel:
+        return RemoteModel(self.conn, self._schema["leader"]["name"])
+
+    def leaderboard(self) -> List[dict]:
+        return self.conn.get(
+            f"/99/Leaderboards/{self.project_name}")["leaderboard_table"]
+
+    def __repr__(self):
+        return f"<RemoteAutoML {self.project_name}>"
 
 
 def connect(url: str = "http://127.0.0.1:54321", username: str = "",

@@ -142,6 +142,14 @@ class Model:
             custom_metric_func=self.params.custom_metric_func)
 
     # ------------------------------------------------------------ persistence
+    # Model artifacts are pickles; load() may face bytes from outside this
+    # process (POST /3/Models.upload.bin), so deserialization is allow-
+    # listed: only this package's classes plus numpy/stdlib containers can
+    # reconstruct.  save() already converts device arrays to numpy, so
+    # legitimate artifacts never need anything else; os/subprocess-style
+    # pickle gadgets fail to resolve.
+    _UNPICKLE_PREFIXES = ("h2o3_tpu.", "numpy", "builtins", "collections")
+
     def save(self, path: str) -> str:
         """Save the model to any persist URI (local, gcs://, s3://, …)."""
         from .. import persist
@@ -166,7 +174,7 @@ class Model:
     def load(path: str) -> "Model":
         from .. import persist
         with persist.open_read(path) as f:
-            cls, state = pickle.load(f)
+            cls, state = _RestrictedUnpickler(f).load()
         m = object.__new__(cls)
         m.__dict__.update(state)
         dkv.put(m.key, m)
@@ -179,6 +187,22 @@ class Model:
 
     def __repr__(self):
         return f"<{type(self).__name__} {self.key}>"
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    """Allowlisted unpickling for model artifacts (see Model.save note)."""
+
+    def find_class(self, module, name):
+        full = f"{module}.{name}"
+        if module == "builtins" and name in ("eval", "exec", "compile",
+                                             "open", "__import__",
+                                             "getattr", "setattr"):
+            raise pickle.UnpicklingError(f"blocked global {full}")
+        if any(module == p.rstrip(".") or module.startswith(p)
+               for p in Model._UNPICKLE_PREFIXES):
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"model artifact references disallowed global {full}")
 
 
 class ModelBuilder:

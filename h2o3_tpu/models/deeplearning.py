@@ -266,53 +266,26 @@ class DeepLearning(ModelBuilder):
                 sgd_step, (params, opt_state), keys)
             return params, opt_state, jnp.mean(losses)
 
-        @jax.jit
-        def train_all(params, opt_state, rng):
-            """EVERY iteration inside one compiled program (nested scan).
-
-            Per-iteration host fetches cost a full round trip each on a
-            remote-tunnelled accelerator and starved the MXU at ~3k
-            samples/s (PROFILE.md); with no early stopping there is
-            nothing to decide on host mid-run, so the whole training is
-            one dispatch + ONE loss fetch.  The rng threading reproduces
-            the per-iteration loop's key sequence exactly."""
-            def iter_body(carry, _):
-                params, opt_state, rng = carry
-                rng, k = jax.random.split(rng)
-                keys = jax.random.split(k, steps_per_iter)
-                (params, opt_state), losses = jax.lax.scan(
-                    sgd_step, (params, opt_state), keys)
-                return (params, opt_state, rng), jnp.mean(losses)
-            (params, opt_state, _), iter_losses = jax.lax.scan(
-                iter_body, (params, opt_state, rng), None, length=n_iters)
-            return params, opt_state, iter_losses
-
+        # Per-iteration host fetches of the mean loss cost a full round
+        # trip each on a remote-tunnelled accelerator and starved the MXU
+        # at ~3k samples/s (PROFILE.md).  Dispatch stays per-iteration
+        # (async — XLA pipelines the queued steps; cancellation and fault
+        # injection keep their per-iteration semantics), but the loss is
+        # only FETCHED per iteration when early stopping needs it on host;
+        # otherwise the whole history is one fetch at the end.
         history = []
+        device_losses = []
         seen = 0
         import time as _time
         t0 = _time.time()
         from ..runtime import failure
-        if not p.stopping_rounds:
+        stopped_at = n_iters
+        for it in range(n_iters):
             failure.maybe_inject("dl_iter")
-            params, opt_state, iter_losses = train_all(params, opt_state,
-                                                       rng)
-            iter_losses = np.asarray(iter_losses)         # the ONE fetch
-            dt = max(_time.time() - t0, 1e-9)
-            for it in range(n_iters):
-                seen += steps_per_iter * batch
-                history.append({
-                    "iteration": it, "epochs": seen / n, "samples": seen,
-                    "training_loss": float(iter_losses[it]),
-                    "samples_per_sec": seen / (dt * (it + 1) / n_iters)})
-            job.update(1.0, f"epoch {seen / n:.2f} "
-                            f"loss {float(iter_losses[-1]):.5f}")
-        else:
-            for it in range(n_iters):
-                failure.maybe_inject("dl_iter")
-                rng, k = jax.random.split(rng)
-                params, opt_state, mean_loss = train_steps(params,
-                                                           opt_state, k)
-                seen += steps_per_iter * batch
+            rng, k = jax.random.split(rng)
+            params, opt_state, mean_loss = train_steps(params, opt_state, k)
+            seen += steps_per_iter * batch
+            if p.stopping_rounds:
                 entry = {"iteration": it, "epochs": seen / n,
                          "samples": seen, "training_loss": float(mean_loss),
                          "samples_per_sec": seen / max(_time.time() - t0,
@@ -325,7 +298,21 @@ class DeepLearning(ModelBuilder):
                         [h["training_loss"] for h in history],
                         p.stopping_rounds, p.stopping_tolerance,
                         maximize=False):
+                    stopped_at = it + 1
                     break
+            else:
+                device_losses.append(mean_loss)       # device scalar only
+                job.update((it + 1) / n_iters, f"epoch {seen / n:.2f}")
+        if not p.stopping_rounds and device_losses:
+            iter_losses = np.asarray(jnp.stack(device_losses))  # ONE fetch
+            dt = max(_time.time() - t0, 1e-9)
+            seen = 0
+            for it in range(stopped_at):
+                seen += steps_per_iter * batch
+                history.append({
+                    "iteration": it, "epochs": seen / n, "samples": seen,
+                    "training_loss": float(iter_losses[it]),
+                    "samples_per_sec": seen / (dt * (it + 1) / stopped_at)})
 
         model.output["weights"] = [(np.asarray(W), np.asarray(b))
                                    for W, b in params]

@@ -165,3 +165,107 @@ def test_about_config_and_extensions(cl, monkeypatch):
     finally:
         srv.stop()
         cfg.reload()
+
+
+def test_full_remote_workflow(cl, server, rng, tmp_path):
+    """The whole h2o-py user journey purely over HTTP via client.py:
+    import -> munge (/99/Rapids) -> grid -> automl -> explain ->
+    checkpoint -> artifact download/upload round trips."""
+    from h2o3_tpu import client as h2oc
+    n = 400
+    X = rng.normal(size=(n, 3))
+    y = (X[:, 0] - 0.5 * X[:, 1] > 0).astype(int)
+    csv = tmp_path / "wf.csv"
+    with open(csv, "w") as f:
+        f.write("a,b,c,y\n")
+        for i in range(n):
+            f.write(f"{X[i,0]:.5f},{X[i,1]:.5f},{X[i,2]:.5f},"
+                    f"{'yes' if y[i] else 'no'}\n")
+    conn = h2oc.connect(server.url)
+
+    # import + munge through the lazy expression DAG -> /99/Rapids
+    fr = conn.import_file(str(csv), destination_frame="wf_train")
+    lz = fr.lazy()
+    munged = (lz["a"] * 2.0).execute()      # exercises rapids round trip
+
+    # parameter metadata endpoint drives codegen
+    mb = conn.model_builders("gbm")
+    names = [p["name"] for p in mb["gbm"]["parameters"]]
+    assert "ntrees" in names and "learn_rate" in names
+    assert conn.model_builders()["glm"]["parameters"]
+
+    # grid search over REST
+    grid = conn.grid("gbm", {"max_depth": [2, 3]}, fr,
+                     response_column="y", ntrees=3, seed=1)
+    assert len(grid.model_ids) == 2
+    table = grid.summary_table()
+    assert "max_depth" in table[0] and "model_id" in table[0]
+    best = grid.best_model
+    assert grid.refresh().model_ids == grid.model_ids  # GET /99/Grids/{id}
+    assert any(g["name"] == grid.key
+               for g in conn.get("/99/Grids")["grids"])
+
+    # CV params ride the normal train route
+    cvm = conn.train("glm", fr, response_column="y", family="binomial",
+                     nfolds=3, seed=1, lambda_=0.0)
+    cv_metrics = cvm.metrics()
+    assert cv_metrics.get("auc") is None or cv_metrics["auc"] > 0.5
+
+    # checkpoint continuation through REST
+    m5 = conn.train("gbm", fr, response_column="y", ntrees=2, seed=1,
+                    max_depth=3)
+    m8 = conn.train("gbm", fr, response_column="y", ntrees=5, seed=1,
+                    max_depth=3, checkpoint=m5.key)
+    assert m8.schema["output"]["ntrees_trained"] == 5
+
+    # automl over REST + leaderboard route
+    aml = conn.automl(fr, response_column="y", max_models=3, seed=1,
+                      project_name="wf_proj",
+                      exclude_algos=["StackedEnsemble", "DeepLearning"])
+    lb = aml.leaderboard()
+    assert 1 <= len(lb) <= 4 and "model_id" in lb[0]
+    leader = aml.leader
+
+    # explain over REST
+    vi = best.varimp()
+    assert vi and {"variable", "relative_importance"} <= set(vi[0])
+    pd_out = best.partial_dependence(fr, "a", nbins=5)
+    assert len(pd_out["grid"]) == len(pd_out["mean_response"]) > 0
+
+    # artifact download / upload round trip
+    local = tmp_path / "model.bin"
+    best.download(str(local))
+    assert local.stat().st_size > 0
+    re_up = conn.upload_model(str(local))
+    preds = re_up.predict(fr)
+    assert preds.nrows == n
+    # mojo artifact + server-side save
+    mojo = tmp_path / "model.zip"
+    best.download_mojo(str(mojo))
+    import zipfile
+    assert zipfile.is_zipfile(mojo)
+    saved = best.save(str(tmp_path))
+    import os
+    assert os.path.exists(saved)
+    # predictions from the leader still flow
+    assert leader.predict(fr).nrows == n
+    del munged
+
+
+def test_model_upload_rejects_pickle_gadgets(cl, server, tmp_path):
+    """POST /3/Models.upload.bin must refuse pickles that reference
+    globals outside the model-artifact allowlist (RCE gadget defense)."""
+    import pickle
+
+    class Gadget:
+        def __reduce__(self):
+            import os
+            return (os.system, ("true",))
+
+    bad = tmp_path / "evil.bin"
+    with open(bad, "wb") as f:
+        pickle.dump(Gadget(), f)
+    from h2o3_tpu import client as h2oc
+    conn = h2oc.connect(server.url)
+    with pytest.raises(h2oc.H2OConnectionError, match="disallowed|blocked"):
+        conn.upload_model(str(bad))
