@@ -30,6 +30,11 @@ class H2OConnectionError(Exception):
     pass
 
 
+def _frame_key(frame) -> str:
+    """Accept a RemoteFrame/Frame handle or a bare key string."""
+    return frame.key if hasattr(frame, "key") else str(frame)
+
+
 class H2OConnection(Backend):
     """HTTP connection to a running h2o3_tpu REST server."""
 
@@ -105,13 +110,10 @@ class H2OConnection(Backend):
 
     def train(self, algo: str, training_frame, validation_frame=None,
               **params) -> "RemoteModel":
-        tf = training_frame.key if hasattr(training_frame, "key") \
-            else str(training_frame)
         if validation_frame is not None:
-            params["validation_frame"] = validation_frame.key \
-                if hasattr(validation_frame, "key") else str(validation_frame)
-        out = self.post(f"/3/ModelBuilders/{algo}", training_frame=tf,
-                        **params)
+            params["validation_frame"] = _frame_key(validation_frame)
+        out = self.post(f"/3/ModelBuilders/{algo}",
+                        training_frame=_frame_key(training_frame), **params)
         return RemoteModel(self, out["model"]["model_id"]["name"])
 
     def schemas(self) -> dict:
@@ -126,13 +128,10 @@ class H2OConnection(Backend):
              validation_frame=None, search_criteria: Optional[dict] = None,
              sort_metric: Optional[str] = None, **base_params) -> "RemoteGrid":
         """Hyperparameter search over REST — h2o.grid analog."""
-        tf = training_frame.key if hasattr(training_frame, "key") \
-            else str(training_frame)
-        params = dict(base_params, training_frame=tf,
+        params = dict(base_params, training_frame=_frame_key(training_frame),
                       hyper_parameters=hyper_params)
         if validation_frame is not None:
-            params["validation_frame"] = validation_frame.key \
-                if hasattr(validation_frame, "key") else str(validation_frame)
+            params["validation_frame"] = _frame_key(validation_frame)
         if search_criteria:
             params["search_criteria"] = search_criteria
         if sort_metric:
@@ -143,12 +142,9 @@ class H2OConnection(Backend):
     def automl(self, training_frame, validation_frame=None,
                **params) -> "RemoteAutoML":
         """Run AutoML over REST — H2OAutoML analog."""
-        tf = training_frame.key if hasattr(training_frame, "key") \
-            else str(training_frame)
-        params["training_frame"] = tf
+        params["training_frame"] = _frame_key(training_frame)
         if validation_frame is not None:
-            params["validation_frame"] = validation_frame.key \
-                if hasattr(validation_frame, "key") else str(validation_frame)
+            params["validation_frame"] = _frame_key(validation_frame)
         out = self.post("/99/AutoMLBuilder", **params)
         return RemoteAutoML(self, out)
 
@@ -240,13 +236,13 @@ class RemoteModel:
             f"/3/Models/{self.key}/scoring_history")["scoring_history"]
 
     def predict(self, frame: Union[RemoteFrame, str]) -> RemoteFrame:
-        fk = frame.key if isinstance(frame, RemoteFrame) else str(frame)
+        fk = _frame_key(frame)
         out = self.conn.post(
             f"/3/Predictions/models/{self.key}/frames/{fk}")
         return RemoteFrame(self.conn, out["predictions_frame"]["name"])
 
     def model_performance(self, frame: Union[RemoteFrame, str]) -> dict:
-        fk = frame.key if isinstance(frame, RemoteFrame) else str(frame)
+        fk = _frame_key(frame)
         return self.conn.post(
             f"/3/ModelMetrics/models/{self.key}/frames/{fk}"
         )["model_metrics"][0]
@@ -256,7 +252,7 @@ class RemoteModel:
 
     def partial_dependence(self, frame: Union[RemoteFrame, str],
                            column: str, nbins: int = 20) -> dict:
-        fk = frame.key if isinstance(frame, RemoteFrame) else str(frame)
+        fk = _frame_key(frame)
         return self.conn.post("/3/PartialDependence", model=self.key,
                               frame=fk, column=column,
                               nbins=nbins)["partial_dependence"]

@@ -144,11 +144,22 @@ class Model:
     # ------------------------------------------------------------ persistence
     # Model artifacts are pickles; load() may face bytes from outside this
     # process (POST /3/Models.upload.bin), so deserialization is allow-
-    # listed: only this package's classes plus numpy/stdlib containers can
-    # reconstruct.  save() already converts device arrays to numpy, so
-    # legitimate artifacts never need anything else; os/subprocess-style
-    # pickle gadgets fail to resolve.
-    _UNPICKLE_PREFIXES = ("h2o3_tpu.", "numpy", "builtins", "collections")
+    # listed: this package's CLASSES (never functions — blocks e.g.
+    # h2o3_tpu.persist.delete as a gadget), numpy array reconstruction,
+    # and stdlib containers.  save() already converts device arrays to
+    # numpy, so legitimate artifacts never need anything else.  Known
+    # limitation: a model whose params hold a user callable (custom
+    # metric fn) will not reload — security of the upload route wins.
+    _UNPICKLE_CLASS_MODULES = ("h2o3_tpu", "numpy", "collections",
+                               "builtins")
+    _UNPICKLE_CALLABLES = {
+        "numpy._core.multiarray._reconstruct",
+        "numpy.core.multiarray._reconstruct",
+        "numpy._core.multiarray.scalar",
+        "numpy.core.multiarray.scalar",
+        "numpy._core.numeric._frombuffer",
+        "numpy.core.numeric._frombuffer",
+    }
 
     def save(self, path: str) -> str:
         """Save the model to any persist URI (local, gcs://, s3://, …)."""
@@ -194,13 +205,16 @@ class _RestrictedUnpickler(pickle.Unpickler):
 
     def find_class(self, module, name):
         full = f"{module}.{name}"
-        if module == "builtins" and name in ("eval", "exec", "compile",
-                                             "open", "__import__",
-                                             "getattr", "setattr"):
-            raise pickle.UnpicklingError(f"blocked global {full}")
-        if any(module == p.rstrip(".") or module.startswith(p)
-               for p in Model._UNPICKLE_PREFIXES):
+        if full in Model._UNPICKLE_CALLABLES:
             return super().find_class(module, name)
+        root = module.split(".", 1)[0]
+        if root in Model._UNPICKLE_CLASS_MODULES:
+            obj = super().find_class(module, name)
+            # classes only: reconstructing instances is fine, but plain
+            # functions (persist.delete, builtins.exec, np.f2py helpers…)
+            # are exactly what pickle gadgets invoke
+            if isinstance(obj, type):
+                return obj
         raise pickle.UnpicklingError(
             f"model artifact references disallowed global {full}")
 

@@ -6,12 +6,23 @@ PersistGcs in h2o-persist-gcs, PersistS3, PersistHdfs, PersistHTTP); the
 data plane reads raw byte ranges, the control plane lists/globs keys.
 
 TPU-native redesign: the storage layer has no device concerns at all, so
-the SPI is a small host-side protocol (open_read/open_write/list/exists/
-delete).  The GCS backend is first (TPU-VMs live next to GCS, SURVEY.md §7
-step 9): it uses ``google.cloud.storage`` when installed and otherwise a
-"mock root" mapping (``gcs://bucket/key`` -> ``$H2O3_TPU_GCS_ROOT/bucket/
-key``) so the full import/export surface stays testable offline.  S3/HDFS
-get the same mock treatment; HTTP is read-only via urllib.
+the SPI is a small host-side protocol (open_read/open_write/read_range/
+size/list/exists/delete).  Real backends:
+
+- GCS (``gs://``/``gcs://``): ``google.cloud.storage`` SDK — range reads,
+  streaming resumable writes; honors ``STORAGE_EMULATOR_HOST``
+  (integration-tested against an in-process fake GCS server).
+- S3 (``s3://``): native REST + SigV4 (no boto3 in this image) — range
+  reads, multipart streaming writes; custom endpoints via
+  ``H2O3_TPU_S3_ENDPOINT`` (minio / fakes / interop).
+- HDFS (``hdfs://``): WebHDFS protocol against
+  ``H2O3_TPU_HDFS_NAMENODE`` or ``hdfs://host:port/path`` URIs.
+- HTTP(S): read-only via urllib.
+
+TEST-ONLY escape hatch: setting ``H2O3_TPU_{GCS,S3,HDFS}_ROOT`` remaps a
+scheme onto a local directory (``gcs://bucket/key`` ->
+``$ROOT/bucket/key``).  That exercises the SPI, not the backend — CI
+integration tests use the protocol fakes instead.
 """
 
 from __future__ import annotations
@@ -47,6 +58,17 @@ class PersistBackend:
     def delete(self, path: str) -> None:
         raise NotImplementedError
 
+    def read_range(self, path: str, offset: int, length: int) -> bytes:
+        """Byte-range read; default reads the object and slices."""
+        with self.open_read(path) as f:
+            f.seek(offset)
+            return f.read(length)
+
+    def size(self, path: str) -> int:
+        with self.open_read(path) as f:
+            f.seek(0, os.SEEK_END)
+            return f.tell()
+
     def _uri(self, path: str) -> str:
         return f"{self.scheme}://{path}" if self.scheme else path
 
@@ -80,36 +102,25 @@ class LocalPersist(PersistBackend):
             os.remove(path)
 
 
-class MockableCloudPersist(PersistBackend):
-    """Cloud object store backend with an offline mock root.
+class CloudPersist(PersistBackend):
+    """Scheme dispatcher: real protocol backend, or the TEST-ONLY mock
+    root when ``H2O3_TPU_{SCHEME}_ROOT`` is set (exercises the SPI without
+    network; CI uses the protocol fakes instead — see module docstring)."""
 
-    Real client libraries are used when importable; otherwise paths map
-    onto ``$H2O3_TPU_{SCHEME}_ROOT`` (default /tmp/h2o3_tpu_{scheme}) so
-    integration flows run without cloud credentials — the reference's
-    PersistGcs tests use the same trick with a fake GCS server.
-    """
-
-    def __init__(self, scheme: str):
+    def __init__(self, scheme: str, real_factory):
         self.scheme = scheme
         self._local = LocalPersist()
+        self._real_factory = real_factory
+        self._real = None
 
     @property
     def _root(self) -> Optional[str]:
-        """Mock root dir; set H2O3_TPU_{SCHEME}_ROOT to activate the mock."""
         return os.environ.get(f"H2O3_TPU_{self.scheme.upper()}_ROOT")
 
-    def _client_open(self, path: str, mode: str):
-        if self.scheme in ("gcs", "gs"):
-            from google.cloud import storage  # needs creds at call time
-            bucket_name, _, key = path.partition("/")
-            blob = storage.Client().bucket(bucket_name).blob(key)
-            if mode == "rb":
-                return io.BytesIO(blob.download_as_bytes())
-            return _BlobWriter(blob)
-        raise NotImplementedError(
-            f"scheme {self.scheme!r} has no live client in this build; "
-            f"set H2O3_TPU_{self.scheme.upper()}_ROOT to use the offline "
-            f"mock mapping")
+    def real(self):
+        if self._real is None:
+            self._real = self._real_factory()
+        return self._real
 
     def _map(self, path: str) -> str:
         return os.path.join(self._root, path)
@@ -117,12 +128,22 @@ class MockableCloudPersist(PersistBackend):
     def open_read(self, path: str) -> BinaryIO:
         if self._root is not None:
             return self._local.open_read(self._map(path))
-        return self._client_open(path, "rb")
+        return self.real().open_read(path)
 
     def open_write(self, path: str) -> BinaryIO:
         if self._root is not None:
             return self._local.open_write(self._map(path))
-        return self._client_open(path, "wb")
+        return self.real().open_write(path)
+
+    def read_range(self, path: str, offset: int, length: int) -> bytes:
+        if self._root is not None:
+            return super().read_range(path, offset, length)
+        return self.real().read_range(path, offset, length)
+
+    def size(self, path: str) -> int:
+        if self._root is not None:
+            return os.path.getsize(self._map(path))
+        return self.real().size(path)
 
     def list(self, pattern: str) -> List[str]:
         if self._root is not None:
@@ -130,40 +151,18 @@ class MockableCloudPersist(PersistBackend):
             out = self._local.list(self._map(pattern))
             return [f"{self.scheme}://{os.path.relpath(p, root)}"
                     for p in out]
-        if self.scheme in ("gcs", "gs"):  # pragma: no cover - needs creds
-            from google.cloud import storage
-            bucket_name, _, prefix = pattern.partition("/")
-            prefix = prefix.split("*", 1)[0]
-            blobs = storage.Client().list_blobs(bucket_name, prefix=prefix)
-            return [f"{self.scheme}://{bucket_name}/{b.name}" for b in blobs]
-        raise NotImplementedError
+        return self.real().list(pattern)
 
     def exists(self, path: str) -> bool:
         if self._root is not None:
             return self._local.exists(self._map(path))
-        try:
-            self.open_read(path).close()
-            return True
-        except Exception:
-            return False
+        return self.real().exists(path)
 
     def delete(self, path: str) -> None:
         if self._root is not None:
             self._local.delete(self._map(path))
-        else:  # pragma: no cover - needs creds
-            from google.cloud import storage
-            bucket_name, _, key = path.partition("/")
-            storage.Client().bucket(bucket_name).blob(key).delete()
-
-
-class _BlobWriter(io.BytesIO):  # pragma: no cover - needs real GCS
-    def __init__(self, blob):
-        super().__init__()
-        self._blob = blob
-
-    def close(self):
-        self._blob.upload_from_string(self.getvalue())
-        super().close()
+        else:
+            self.real().delete(path)
 
 
 class HTTPPersist(PersistBackend):
@@ -187,13 +186,30 @@ class HTTPPersist(PersistBackend):
             return False
 
 
+def _gcs(scheme):
+    def make():
+        from .gcs import GcsPersist
+        return GcsPersist(scheme)
+    return make
+
+
+def _s3():
+    from .s3 import S3Persist
+    return S3Persist()
+
+
+def _hdfs():
+    from .hdfs import WebHDFSPersist
+    return WebHDFSPersist()
+
+
 _REGISTRY: Dict[str, PersistBackend] = {
     "": LocalPersist(),
     "file": LocalPersist(),
-    "gcs": MockableCloudPersist("gcs"),
-    "gs": MockableCloudPersist("gs"),
-    "s3": MockableCloudPersist("s3"),
-    "hdfs": MockableCloudPersist("hdfs"),
+    "gcs": CloudPersist("gcs", _gcs("gcs")),
+    "gs": CloudPersist("gs", _gcs("gs")),
+    "s3": CloudPersist("s3", _s3),
+    "hdfs": CloudPersist("hdfs", _hdfs),
     "http": HTTPPersist("http"),
     "https": HTTPPersist("https"),
 }

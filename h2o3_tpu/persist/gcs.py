@@ -1,0 +1,102 @@
+"""GCS persist backend — the h2o-persist-gcs PersistGcs analog, real SDK.
+
+Reference: ``h2o-persist-gcs/src/main/java/water/persist/PersistGcs.java`` —
+SDK-backed range reads, streaming channel writes, prefix listing.
+
+Uses ``google.cloud.storage`` (baked into TPU-VM images).  When
+``STORAGE_EMULATOR_HOST`` is set the client runs anonymously against the
+emulator — integration tests spin up an in-process fake GCS server and
+exercise this exact code path (no mock-root shortcuts).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import io
+import os
+import threading
+from typing import BinaryIO, List, Optional
+
+
+class GcsPersist:
+    """Real-SDK GCS backend (``gs://`` / ``gcs://``)."""
+
+    def __init__(self, scheme: str = "gs"):
+        self.scheme = scheme
+        self._client = None
+        self._lock = threading.Lock()
+
+    # One client per backend: construction is expensive (auth discovery)
+    # and clients are thread-safe.
+    def client(self):
+        with self._lock:
+            if self._client is None:
+                from google.cloud import storage
+                if os.environ.get("STORAGE_EMULATOR_HOST"):
+                    from google.auth.credentials import AnonymousCredentials
+                    self._client = storage.Client(
+                        credentials=AnonymousCredentials(),
+                        project=os.environ.get("GOOGLE_CLOUD_PROJECT",
+                                               "h2o3-tpu-test"))
+                else:                      # pragma: no cover - needs creds
+                    self._client = storage.Client()
+            return self._client
+
+    def reset(self) -> None:
+        """Forget the cached client (tests flip emulator env vars)."""
+        with self._lock:
+            self._client = None
+
+    def _blob(self, path: str):
+        bucket_name, _, key = path.partition("/")
+        return self.client().bucket(bucket_name).blob(key)
+
+    # ------------------------------------------------------------------ SPI
+    def open_read(self, path: str) -> BinaryIO:
+        return io.BytesIO(self._blob(path).download_as_bytes())
+
+    def read_range(self, path: str, offset: int, length: int) -> bytes:
+        """SDK range read (PersistGcs.load reads chunk byte ranges)."""
+        if length <= 0:
+            return b""
+        return self._blob(path).download_as_bytes(
+            start=offset, end=offset + length - 1)
+
+    def size(self, path: str) -> int:
+        blob = self._blob(path)
+        blob.reload()
+        return int(blob.size or 0)
+
+    def open_write(self, path: str) -> BinaryIO:
+        """Streaming resumable upload (the SDK's BlobWriter channel).
+
+        checksum=None: emulators/fakes rarely echo crc32c metadata and the
+        SDK hard-fails on its absence; GCS still integrity-checks per
+        request at the HTTP layer."""
+        blob = self._blob(path)
+        try:
+            return blob.open("wb", ignore_flush=True, checksum=None)
+        except TypeError:              # older SDK without ignore_flush
+            return blob.open("wb", checksum=None)
+
+    def list(self, pattern: str) -> List[str]:
+        bucket_name, _, keypat = pattern.partition("/")
+        prefix = keypat.split("*", 1)[0].split("?", 1)[0]
+        names = [b.name for b in
+                 self.client().list_blobs(bucket_name, prefix=prefix)]
+        if any(c in keypat for c in "*?[") :
+            names = [n for n in names if fnmatch.fnmatch(n, keypat)]
+        elif keypat:
+            # bare prefix: a directory-ish listing
+            names = [n for n in names
+                     if n == keypat or n.startswith(keypat.rstrip("/") + "/")]
+        return [f"{self.scheme}://{bucket_name}/{n}" for n in sorted(names)]
+
+    def exists(self, path: str) -> bool:
+        try:
+            return bool(self._blob(path).exists())
+        except Exception:               # noqa: BLE001 — treat as absent
+            return False
+
+    def delete(self, path: str) -> None:
+        self._blob(path).delete()

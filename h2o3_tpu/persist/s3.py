@@ -1,0 +1,279 @@
+"""S3 persist backend — the h2o-persist-s3 PersistS3 analog, native REST.
+
+Reference: ``h2o-persist-s3/src/main/java/water/persist/PersistS3.java`` —
+SDK-backed range reads and multipart uploads.
+
+boto3 is not in this image, so this speaks the S3 REST protocol directly
+over urllib with AWS Signature V4: GET (with Range), PUT, DELETE, HEAD,
+ListObjectsV2, and the CreateMultipartUpload/UploadPart/Complete flow for
+large streaming writes.  Endpoint resolution:
+
+- ``H2O3_TPU_S3_ENDPOINT`` / ``AWS_ENDPOINT_URL`` — custom endpoint
+  (minio, the test fake, GCS-interop...), path-style addressing.
+- otherwise ``https://{bucket}.s3.{region}.amazonaws.com``.
+
+Credentials from ``AWS_ACCESS_KEY_ID``/``AWS_SECRET_ACCESS_KEY`` (+
+``AWS_SESSION_TOKEN``); requests go unsigned when absent (public buckets /
+auth-free emulators).
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import io
+import os
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import BinaryIO, Dict, List, Optional, Tuple
+from xml.etree import ElementTree
+
+_MULTIPART_CHUNK = 8 * 1024 * 1024
+
+
+def _endpoint() -> Optional[str]:
+    return (os.environ.get("H2O3_TPU_S3_ENDPOINT")
+            or os.environ.get("AWS_ENDPOINT_URL") or None)
+
+
+def _region() -> str:
+    return os.environ.get("AWS_REGION",
+                          os.environ.get("AWS_DEFAULT_REGION", "us-east-1"))
+
+
+def _sign_v4(method: str, url: str, headers: Dict[str, str],
+             payload_hash: str) -> Dict[str, str]:
+    """AWS Signature Version 4 (the subset S3 object ops need)."""
+    access = os.environ.get("AWS_ACCESS_KEY_ID")
+    secret = os.environ.get("AWS_SECRET_ACCESS_KEY")
+    if not access or not secret:
+        return headers                      # unsigned (emulator / public)
+    region = _region()
+    parsed = urllib.parse.urlsplit(url)
+    now = datetime.datetime.now(datetime.timezone.utc)
+    amzdate = now.strftime("%Y%m%dT%H%M%SZ")
+    datestamp = now.strftime("%Y%m%d")
+    headers = dict(headers)
+    headers["x-amz-date"] = amzdate
+    headers["x-amz-content-sha256"] = payload_hash
+    token = os.environ.get("AWS_SESSION_TOKEN")
+    if token:
+        headers["x-amz-security-token"] = token
+    headers.setdefault("host", parsed.netloc)
+    lower_map = {h.lower(): h for h in headers}
+    signed = sorted(lower_map)
+    canonical_headers = "".join(
+        f"{k}:{headers[lower_map[k]].strip()}\n" for k in signed)
+    signed_headers = ";".join(signed)
+    query = "&".join(sorted(
+        f"{urllib.parse.quote(k, safe='')}={urllib.parse.quote(v, safe='')}"
+        for k, v in urllib.parse.parse_qsl(parsed.query,
+                                           keep_blank_values=True)))
+    canonical = "\n".join([
+        method, urllib.parse.quote(parsed.path or "/"), query,
+        canonical_headers, signed_headers, payload_hash])
+    scope = f"{datestamp}/{region}/s3/aws4_request"
+    to_sign = "\n".join([
+        "AWS4-HMAC-SHA256", amzdate, scope,
+        hashlib.sha256(canonical.encode()).hexdigest()])
+
+    def _hmac(key: bytes, msg: str) -> bytes:
+        return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+    k = _hmac(("AWS4" + secret).encode(), datestamp)
+    k = _hmac(k, region)
+    k = _hmac(k, "s3")
+    k = _hmac(k, "aws4_request")
+    sig = hmac.new(k, to_sign.encode(), hashlib.sha256).hexdigest()
+    headers["Authorization"] = (
+        f"AWS4-HMAC-SHA256 Credential={access}/{scope}, "
+        f"SignedHeaders={signed_headers}, Signature={sig}")
+    return headers
+
+
+class S3Persist:
+    """Native-REST S3 backend (``s3://``)."""
+
+    scheme = "s3"
+
+    def _url(self, bucket: str, key: str = "", query: str = "") -> str:
+        ep = _endpoint()
+        key_q = urllib.parse.quote(key)
+        if ep:
+            url = f"{ep.rstrip('/')}/{bucket}"
+        else:                              # pragma: no cover - live AWS
+            url = f"https://{bucket}.s3.{_region()}.amazonaws.com"
+        if key:
+            url += f"/{key_q}"
+        if query:
+            url += f"?{query}"
+        return url
+
+    def _request(self, method: str, url: str, data: bytes = b"",
+                 headers: Optional[Dict[str, str]] = None) -> Tuple[bytes,
+                                                                    dict]:
+        payload_hash = hashlib.sha256(data).hexdigest()
+        headers = _sign_v4(method, url, dict(headers or {}), payload_hash)
+        req = urllib.request.Request(url, data=data if data else None,
+                                     method=method, headers=headers)
+        with urllib.request.urlopen(req) as resp:
+            return resp.read(), dict(resp.headers)
+
+    @staticmethod
+    def _split(path: str) -> Tuple[str, str]:
+        bucket, _, key = path.partition("/")
+        return bucket, key
+
+    # ------------------------------------------------------------------ SPI
+    def open_read(self, path: str) -> BinaryIO:
+        bucket, key = self._split(path)
+        body, _ = self._request("GET", self._url(bucket, key))
+        return io.BytesIO(body)
+
+    def read_range(self, path: str, offset: int, length: int) -> bytes:
+        if length <= 0:
+            return b""
+        bucket, key = self._split(path)
+        body, _ = self._request(
+            "GET", self._url(bucket, key),
+            headers={"Range": f"bytes={offset}-{offset + length - 1}"})
+        return body
+
+    def size(self, path: str) -> int:
+        bucket, key = self._split(path)
+        _, headers = self._request("HEAD", self._url(bucket, key))
+        return int(headers.get("Content-Length", 0))
+
+    def open_write(self, path: str) -> BinaryIO:
+        return _S3Writer(self, path)
+
+    def list(self, pattern: str) -> List[str]:
+        import fnmatch
+        bucket, keypat = self._split(pattern)
+        prefix = keypat.split("*", 1)[0].split("?", 1)[0]
+        names: List[str] = []
+        token = None
+        while True:                      # ListObjectsV2 pages at 1000 keys
+            q = "list-type=2&prefix=" + urllib.parse.quote(prefix, safe="")
+            if token:
+                q += "&continuation-token=" + urllib.parse.quote(
+                    token, safe="")
+            body, _ = self._request("GET", self._url(bucket, query=q))
+            root = ElementTree.fromstring(body)
+            ns = ""
+            if root.tag.startswith("{"):
+                ns = root.tag.split("}", 1)[0] + "}"
+            names += [c.findtext(f"{ns}Key")
+                      for c in root.iter(f"{ns}Contents")]
+            if root.findtext(f"{ns}IsTruncated") != "true":
+                break
+            token = root.findtext(f"{ns}NextContinuationToken")
+            if not token:
+                break
+        names = [n for n in names if n]
+        if any(c in keypat for c in "*?["):
+            names = [n for n in names if fnmatch.fnmatch(n, keypat)]
+        elif keypat:
+            names = [n for n in names
+                     if n == keypat or n.startswith(keypat.rstrip("/") + "/")]
+        return [f"s3://{bucket}/{n}" for n in sorted(names)]
+
+    def exists(self, path: str) -> bool:
+        bucket, key = self._split(path)
+        try:
+            self._request("HEAD", self._url(bucket, key))
+            return True
+        except urllib.error.HTTPError:
+            return False
+        except Exception:               # noqa: BLE001 — unreachable: absent
+            return False
+
+    def delete(self, path: str) -> None:
+        bucket, key = self._split(path)
+        try:
+            self._request("DELETE", self._url(bucket, key))
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
+
+
+class _S3Writer(io.RawIOBase):
+    """Streaming writer: single PUT for small objects, multipart beyond
+    the 8 MB chunk threshold (PersistS3's multipart contract)."""
+
+    def __init__(self, backend: S3Persist, path: str):
+        super().__init__()
+        self._be = backend
+        self._bucket, self._key = backend._split(path)
+        self._buf = bytearray()
+        self._upload_id: Optional[str] = None
+        self._etags: List[str] = []
+
+    def writable(self) -> bool:
+        return True
+
+    def write(self, b) -> int:
+        self._buf.extend(b)
+        try:
+            while len(self._buf) >= _MULTIPART_CHUNK:
+                self._flush_part(bytes(self._buf[:_MULTIPART_CHUNK]))
+                del self._buf[:_MULTIPART_CHUNK]
+        except BaseException:
+            self._abort()
+            raise
+        return len(b)
+
+    def _abort(self) -> None:
+        """AbortMultipartUpload — never leave invisible billed parts."""
+        if self._upload_id is None:
+            return
+        try:
+            q = f"uploadId={urllib.parse.quote(self._upload_id)}"
+            self._be._request(
+                "DELETE", self._be._url(self._bucket, self._key, q))
+        except Exception:               # noqa: BLE001 — abort best-effort
+            pass
+        self._upload_id = None
+
+    def _flush_part(self, chunk: bytes) -> None:
+        be = self._be
+        if self._upload_id is None:
+            body, _ = be._request(
+                "POST", be._url(self._bucket, self._key, "uploads"))
+            root = ElementTree.fromstring(body)
+            ns = root.tag.split("}", 1)[0] + "}" if root.tag.startswith(
+                "{") else ""
+            self._upload_id = root.findtext(f"{ns}UploadId")
+        n = len(self._etags) + 1
+        q = f"partNumber={n}&uploadId={urllib.parse.quote(self._upload_id)}"
+        _, headers = be._request(
+            "PUT", be._url(self._bucket, self._key, q), data=chunk)
+        self._etags.append(headers.get("ETag", f'"{n}"'))
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        be = self._be
+        try:
+            if self._upload_id is None:
+                be._request("PUT", be._url(self._bucket, self._key),
+                            data=bytes(self._buf))
+            else:
+                if self._buf:
+                    self._flush_part(bytes(self._buf))
+                    self._buf.clear()
+                parts = "".join(
+                    f"<Part><PartNumber>{i + 1}</PartNumber>"
+                    f"<ETag>{etag}</ETag></Part>"
+                    for i, etag in enumerate(self._etags))
+                xml = (f"<CompleteMultipartUpload>{parts}"
+                       f"</CompleteMultipartUpload>").encode()
+                q = f"uploadId={urllib.parse.quote(self._upload_id)}"
+                be._request("POST", be._url(self._bucket, self._key, q),
+                            data=xml)
+        except BaseException:
+            self._abort()
+            raise
+        super().close()

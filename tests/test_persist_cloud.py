@@ -1,0 +1,451 @@
+"""Cloud persist integration tests against in-process protocol fakes.
+
+The reference integration-tests PersistGcs/PersistS3 against emulator
+servers; same approach here: a fake GCS JSON-API server (driven through
+the REAL google.cloud.storage SDK via STORAGE_EMULATOR_HOST), a fake S3
+REST server (driven through the native SigV4 client), and a fake WebHDFS
+namenode.  No mock-root shortcuts — every byte crosses HTTP.
+"""
+
+import io
+import json
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu import persist
+
+
+# --------------------------------------------------------------- fake GCS
+
+class _FakeGcs(BaseHTTPRequestHandler):
+    store = {}          # (bucket, name) -> bytes
+    sessions = {}       # token -> {"bucket","name","data"}
+
+    def log_message(self, *a):
+        pass
+
+    def _send(self, code, body=b"", headers=None):
+        self.send_response(code)
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, code, obj, headers=None):
+        self._send(code, json.dumps(obj).encode(),
+                   {"Content-Type": "application/json", **(headers or {})})
+
+    def _meta(self, bucket, name):
+        data = self.store[(bucket, name)]
+        return {"kind": "storage#object", "name": name, "bucket": bucket,
+                "size": str(len(data)), "generation": "1",
+                "metageneration": "1",
+                "contentType": "application/octet-stream"}
+
+    def do_GET(self):
+        u = urllib.parse.urlsplit(self.path)
+        q = dict(urllib.parse.parse_qsl(u.query))
+        m = re.fullmatch(r"/download/storage/v1/b/([^/]+)/o/(.+)", u.path)
+        if m and q.get("alt") == "media":
+            bucket, name = m.group(1), urllib.parse.unquote(m.group(2))
+            if (bucket, name) not in self.store:
+                return self._json(404, {"error": "not found"})
+            data = self.store[(bucket, name)]
+            rng = self.headers.get("Range")
+            if rng:
+                lo, hi = re.fullmatch(r"bytes=(\d+)-(\d+)", rng).groups()
+                part = data[int(lo):int(hi) + 1]
+                return self._send(206, part)
+            return self._send(200, data)
+        m = re.fullmatch(r"/storage/v1/b/([^/]+)/o/(.+)", u.path)
+        if m:
+            bucket, name = m.group(1), urllib.parse.unquote(m.group(2))
+            if q.get("alt") == "media":
+                if (bucket, name) not in self.store:
+                    return self._json(404, {"error": "not found"})
+                return self._send(200, self.store[(bucket, name)])
+            if (bucket, name) not in self.store:
+                return self._json(404, {"error": "not found"})
+            return self._json(200, self._meta(bucket, name))
+        m = re.fullmatch(r"/storage/v1/b/([^/]+)/o", u.path)
+        if m:
+            bucket = m.group(1)
+            prefix = q.get("prefix", "")
+            items = [self._meta(b, n) for (b, n) in sorted(self.store)
+                     if b == bucket and n.startswith(prefix)]
+            return self._json(200, {"kind": "storage#objects",
+                                    "items": items})
+        self._json(404, {"error": f"GET {self.path}"})
+
+    def do_POST(self):
+        u = urllib.parse.urlsplit(self.path)
+        q = dict(urllib.parse.parse_qsl(u.query))
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length)
+        m = re.fullmatch(r"/upload/storage/v1/b/([^/]+)/o", u.path)
+        if m and q.get("uploadType") == "resumable":
+            bucket = m.group(1)
+            name = q.get("name")
+            if not name and body:
+                name = json.loads(body.decode()).get("name")
+            token = f"sess{len(self.sessions)}"
+            self.sessions[token] = {"bucket": bucket, "name": name,
+                                    "data": bytearray()}
+            host = self.headers.get("Host")
+            return self._send(200, b"", {
+                "Location": f"http://{host}/upload-session/{token}"})
+        if m and q.get("uploadType") == "multipart":
+            bucket = m.group(1)
+            ctype = self.headers.get("Content-Type", "")
+            boundary = ctype.split("boundary=")[-1].strip('"').encode()
+            parts = body.split(b"--" + boundary)
+            meta = json.loads(parts[1].split(b"\r\n\r\n", 1)[1]
+                              .rsplit(b"\r\n", 1)[0].decode())
+            payload = parts[2].split(b"\r\n\r\n", 1)[1]
+            payload = payload.rsplit(b"\r\n", 1)[0]
+            self.store[(bucket, meta["name"])] = payload
+            return self._json(200, self._meta(bucket, meta["name"]))
+        self._json(404, {"error": f"POST {self.path}"})
+
+    def do_PUT(self):
+        u = urllib.parse.urlsplit(self.path)
+        m = re.fullmatch(r"/upload-session/(\w+)", u.path)
+        if m:
+            sess = self.sessions[m.group(1)]
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length)
+            crange = self.headers.get("Content-Range", "")
+            cm = re.fullmatch(r"bytes (\d+)-(\d+)/(\d+|\*)", crange)
+            if cm:
+                lo = int(cm.group(1))
+                total = cm.group(3)
+                buf = sess["data"]
+                if len(buf) < lo:
+                    buf.extend(b"\0" * (lo - len(buf)))
+                buf[lo:lo + len(body)] = body
+                if total != "*" and len(buf) >= int(total):
+                    self.store[(sess["bucket"], sess["name"])] = bytes(buf)
+                    return self._json(200, self._meta(sess["bucket"],
+                                                      sess["name"]))
+                return self._send(308, b"", {
+                    "Range": f"bytes=0-{len(buf) - 1}"})
+            cm = re.fullmatch(r"bytes \*/(\d+|\*)", crange)
+            if cm:            # finalize empty or query status
+                self.store[(sess["bucket"], sess["name"])] = \
+                    bytes(sess["data"])
+                return self._json(200, self._meta(sess["bucket"],
+                                                  sess["name"]))
+            self._json(400, {"error": f"bad content-range {crange}"})
+            return
+        self._json(404, {"error": f"PUT {self.path}"})
+
+    def do_DELETE(self):
+        u = urllib.parse.urlsplit(self.path)
+        m = re.fullmatch(r"/storage/v1/b/([^/]+)/o/(.+)", u.path)
+        if m:
+            bucket, name = m.group(1), urllib.parse.unquote(m.group(2))
+            if (bucket, name) in self.store:
+                del self.store[(bucket, name)]
+                return self._send(204)
+            return self._json(404, {"error": "not found"})
+        self._json(404, {"error": f"DELETE {self.path}"})
+
+
+# --------------------------------------------------------------- fake S3
+
+class _FakeS3(BaseHTTPRequestHandler):
+    store = {}          # (bucket, key) -> bytes
+    uploads = {}        # upload_id -> {"bucket","key","parts":{n: bytes}}
+
+    def log_message(self, *a):
+        pass
+
+    def _send(self, code, body=b"", headers=None):
+        self.send_response(code)
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if self.command != "HEAD":
+            self.wfile.write(body)
+
+    def _split(self):
+        u = urllib.parse.urlsplit(self.path)
+        parts = u.path.lstrip("/").split("/", 1)
+        bucket = parts[0]
+        key = urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
+        return bucket, key, dict(urllib.parse.parse_qsl(
+            u.query, keep_blank_values=True))
+
+    def do_GET(self):
+        bucket, key, q = self._split()
+        if "list-type" in q or not key:
+            prefix = q.get("prefix", "")
+            keys = [k for (b, k) in sorted(self.store)
+                    if b == bucket and k.startswith(prefix)]
+            xml = "".join(f"<Contents><Key>{k}</Key></Contents>"
+                          for k in keys)
+            return self._send(200, (f"<ListBucketResult>{xml}"
+                                    f"</ListBucketResult>").encode())
+        if (bucket, key) not in self.store:
+            return self._send(404, b"<Error><Code>NoSuchKey</Code></Error>")
+        data = self.store[(bucket, key)]
+        rng = self.headers.get("Range")
+        if rng:
+            lo, hi = re.fullmatch(r"bytes=(\d+)-(\d+)", rng).groups()
+            return self._send(206, data[int(lo):int(hi) + 1])
+        return self._send(200, data)
+
+    def do_HEAD(self):
+        bucket, key, _ = self._split()
+        if (bucket, key) not in self.store:
+            return self._send(404)
+        self._send(200, self.store[(bucket, key)])
+
+    def do_PUT(self):
+        bucket, key, q = self._split()
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length)
+        if "partNumber" in q:
+            up = self.uploads[q["uploadId"]]
+            n = int(q["partNumber"])
+            up["parts"][n] = body
+            return self._send(200, b"", {"ETag": f'"part{n}"'})
+        self.store[(bucket, key)] = body
+        self._send(200, b"", {"ETag": '"whole"'})
+
+    def do_POST(self):
+        bucket, key, q = self._split()
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length)
+        if "uploads" in q:
+            uid = f"up{len(self.uploads)}"
+            self.uploads[uid] = {"bucket": bucket, "key": key, "parts": {}}
+            return self._send(200, (
+                f"<InitiateMultipartUploadResult><UploadId>{uid}"
+                f"</UploadId></InitiateMultipartUploadResult>").encode())
+        if "uploadId" in q:
+            up = self.uploads.pop(q["uploadId"])
+            data = b"".join(up["parts"][n]
+                            for n in sorted(up["parts"]))
+            self.store[(up["bucket"], up["key"])] = data
+            return self._send(200, b"<CompleteMultipartUploadResult/>")
+        self._send(404, body)
+
+    def do_DELETE(self):
+        bucket, key, _ = self._split()
+        self.store.pop((bucket, key), None)
+        self._send(204)
+
+
+# ------------------------------------------------------------ fake WebHDFS
+
+class _FakeHdfs(BaseHTTPRequestHandler):
+    store = {}          # path -> bytes
+
+    def log_message(self, *a):
+        pass
+
+    def _send(self, code, body=b"", headers=None):
+        self.send_response(code)
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, code, obj):
+        self._send(code, json.dumps(obj).encode(),
+                   {"Content-Type": "application/json"})
+
+    def _path_op(self):
+        u = urllib.parse.urlsplit(self.path)
+        q = dict(urllib.parse.parse_qsl(u.query))
+        path = urllib.parse.unquote(u.path)
+        for pre in ("/webhdfs/v1", "/webhdfs-data"):
+            if path.startswith(pre):
+                return path[len(pre):], q, pre
+        return path, q, ""
+
+    def do_GET(self):
+        path, q, _ = self._path_op()
+        op = q.get("op")
+        if op == "OPEN":
+            if path not in self.store:
+                return self._json(404, {"RemoteException":
+                                        {"message": "not found"}})
+            data = self.store[path]
+            off = int(q.get("offset", 0))
+            ln = int(q["length"]) if "length" in q else len(data) - off
+            return self._send(200, data[off:off + ln])
+        if op == "GETFILESTATUS":
+            if path not in self.store:
+                return self._json(404, {"RemoteException":
+                                        {"message": "not found"}})
+            return self._json(200, {"FileStatus": {
+                "length": len(self.store[path]), "type": "FILE",
+                "pathSuffix": ""}})
+        if op == "LISTSTATUS":
+            if path in self.store:
+                return self._json(200, {"FileStatuses": {"FileStatus": [
+                    {"pathSuffix": "", "type": "FILE",
+                     "length": len(self.store[path])}]}})
+            base = path.rstrip("/") + "/"
+            kids = [p[len(base):] for p in self.store
+                    if p.startswith(base) and "/" not in p[len(base):]]
+            if not kids:
+                return self._json(404, {"RemoteException":
+                                        {"message": "not found"}})
+            return self._json(200, {"FileStatuses": {"FileStatus": [
+                {"pathSuffix": k, "type": "FILE",
+                 "length": len(self.store[base + k])} for k in
+                sorted(kids)]}})
+        self._json(400, {"RemoteException": {"message": f"op {op}"}})
+
+    def do_PUT(self):
+        path, q, pre = self._path_op()
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length)
+        if pre == "/webhdfs/v1" and q.get("op") == "CREATE":
+            host = self.headers.get("Host")
+            loc = (f"http://{host}/webhdfs-data{urllib.parse.quote(path)}"
+                   f"?op=CREATE")
+            return self._send(307, b"", {"Location": loc})
+        if pre == "/webhdfs-data":
+            self.store[path] = body
+            return self._send(201)
+        self._json(400, {"RemoteException": {"message": "bad put"}})
+
+    def do_DELETE(self):
+        path, q, _ = self._path_op()
+        existed = path in self.store
+        self.store.pop(path, None)
+        self._json(200, {"boolean": existed})
+
+
+@pytest.fixture()
+def fake_server():
+    servers = []
+
+    def start(handler):
+        handler.store = {}
+        if hasattr(handler, "sessions"):
+            handler.sessions = {}
+        if hasattr(handler, "uploads"):
+            handler.uploads = {}
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        servers.append(srv)
+        return srv.server_address[1]
+
+    yield start
+    for s in servers:
+        s.shutdown()
+        s.server_close()
+
+
+def _roundtrip_frame(cl, scheme_uri):
+    """export_file -> list -> import_file round trip over one backend."""
+    rng = np.random.default_rng(5)
+    n = 300
+    fr = h2o3_tpu.H2OFrame({
+        "x": rng.normal(size=n).astype(np.float32),
+        "g": np.array([f"g{i % 4}" for i in range(n)], dtype=object)})
+    from h2o3_tpu.frame.parse import export_file
+    export_file(fr, scheme_uri)
+    back = h2o3_tpu.import_file(scheme_uri)
+    assert back.shape == fr.shape
+    assert np.allclose(back.vec("x").to_numpy(), fr.vec("x").to_numpy(),
+                       atol=1e-6)
+    assert list(back.vec("g").decoded()) == list(fr.vec("g").decoded())
+    return fr
+
+
+def test_gcs_backend_against_emulator(cl, fake_server, monkeypatch):
+    port = fake_server(_FakeGcs)
+    monkeypatch.setenv("STORAGE_EMULATOR_HOST", f"http://127.0.0.1:{port}")
+    monkeypatch.delenv("H2O3_TPU_GCS_ROOT", raising=False)
+    # drop any cached client bound to an older emulator address
+    persist._REGISTRY["gs"]._real = None
+    persist._REGISTRY["gcs"]._real = None
+
+    _roundtrip_frame(cl, "gs://bkt/dir/data.csv")
+    # raw SPI: range read + size + list + exists + delete
+    with persist.open_write("gs://bkt/dir/blob.bin") as f:
+        f.write(b"0123456789abcdef")
+    assert persist.exists("gs://bkt/dir/blob.bin")
+    be, path = persist.split_uri("gs://bkt/dir/blob.bin")
+    assert be.read_range(path, 4, 6) == b"456789"
+    assert be.size(path) == 16
+    ls = persist.list_uris("gs://bkt/dir/*")
+    assert "gs://bkt/dir/blob.bin" in ls and "gs://bkt/dir/data.csv" in ls
+    persist.delete("gs://bkt/dir/blob.bin")
+    assert not persist.exists("gs://bkt/dir/blob.bin")
+    persist._REGISTRY["gs"]._real = None
+    persist._REGISTRY["gcs"]._real = None
+
+
+def test_s3_backend_against_emulator(cl, fake_server, monkeypatch):
+    port = fake_server(_FakeS3)
+    monkeypatch.setenv("H2O3_TPU_S3_ENDPOINT", f"http://127.0.0.1:{port}")
+    monkeypatch.setenv("AWS_ACCESS_KEY_ID", "test")      # exercise SigV4
+    monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "secret")
+    monkeypatch.delenv("H2O3_TPU_S3_ROOT", raising=False)
+    persist._REGISTRY["s3"]._real = None
+
+    _roundtrip_frame(cl, "s3://bkt/dir/data.csv")
+    with persist.open_write("s3://bkt/dir/blob.bin") as f:
+        f.write(b"0123456789abcdef")
+    be, path = persist.split_uri("s3://bkt/dir/blob.bin")
+    assert be.read_range(path, 4, 6) == b"456789"
+    assert be.size(path) == 16
+    ls = persist.list_uris("s3://bkt/dir/*")
+    assert "s3://bkt/dir/blob.bin" in ls and "s3://bkt/dir/data.csv" in ls
+    persist.delete("s3://bkt/dir/blob.bin")
+    assert not persist.exists("s3://bkt/dir/blob.bin")
+    persist._REGISTRY["s3"]._real = None
+
+
+def test_s3_multipart_streaming_write(cl, fake_server, monkeypatch):
+    from h2o3_tpu.persist import s3 as s3mod
+    port = fake_server(_FakeS3)
+    monkeypatch.setenv("H2O3_TPU_S3_ENDPOINT", f"http://127.0.0.1:{port}")
+    monkeypatch.delenv("AWS_ACCESS_KEY_ID", raising=False)  # unsigned path
+    monkeypatch.delenv("AWS_SECRET_ACCESS_KEY", raising=False)
+    monkeypatch.setattr(s3mod, "_MULTIPART_CHUNK", 1024)
+    persist._REGISTRY["s3"]._real = None
+
+    payload = bytes(range(256)) * 20          # 5120 B -> 5 parts + tail
+    with persist.open_write("s3://bkt/big.bin") as f:
+        for i in range(0, len(payload), 700):  # odd-sized writes
+            f.write(payload[i:i + 700])
+    with persist.open_read("s3://bkt/big.bin") as f:
+        assert f.read() == payload
+    assert _FakeS3.uploads == {}              # completed, not dangling
+    persist._REGISTRY["s3"]._real = None
+
+
+def test_hdfs_backend_against_fake_namenode(cl, fake_server, monkeypatch):
+    port = fake_server(_FakeHdfs)
+    monkeypatch.setenv("H2O3_TPU_HDFS_NAMENODE", f"http://127.0.0.1:{port}")
+    monkeypatch.delenv("H2O3_TPU_HDFS_ROOT", raising=False)
+    persist._REGISTRY["hdfs"]._real = None
+
+    with persist.open_write("hdfs://data/dir/blob.bin") as f:
+        f.write(b"0123456789abcdef")
+    assert persist.exists("hdfs://data/dir/blob.bin")
+    be, path = persist.split_uri("hdfs://data/dir/blob.bin")
+    assert be.read_range(path, 4, 6) == b"456789"
+    assert be.size(path) == 16
+    with persist.open_read("hdfs://data/dir/blob.bin") as f:
+        assert f.read() == b"0123456789abcdef"
+    persist.delete("hdfs://data/dir/blob.bin")
+    assert not persist.exists("hdfs://data/dir/blob.bin")
+    persist._REGISTRY["hdfs"]._real = None
