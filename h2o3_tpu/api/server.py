@@ -29,7 +29,7 @@ ALGOS = ("glm", "gbm", "drf", "xgboost", "deeplearning", "kmeans", "pca",
          "isotonicregression", "quantile", "stackedensemble", "adaboost",
          "targetencoder", "glrm", "coxph", "word2vec", "rulefit",
          "aggregator", "gam", "upliftdrf", "dt", "psvm", "anovaglm",
-         "modelselection")
+         "modelselection", "infogram")
 
 
 def _builder(algo: str):
@@ -47,7 +47,7 @@ def _builder(algo: str):
         "rulefit": M.RuleFit, "aggregator": M.Aggregator, "gam": M.GAM,
         "upliftdrf": M.UpliftDRF, "dt": M.DecisionTree,
         "psvm": M.PSVM, "anovaglm": M.ANOVAGLM,
-        "modelselection": M.ModelSelection,
+        "modelselection": M.ModelSelection, "infogram": M.Infogram,
     }[algo]
 
 

@@ -16,6 +16,7 @@ from .tree.xgboost import XGBoost, XGBoostModel, XGBoostParameters
 from .ensemble import (StackedEnsemble, StackedEnsembleModel,
                        StackedEnsembleParameters)
 from .grid import Grid, GridSearch
+from .infogram import Infogram, InfogramModel, InfogramParameters
 from .adaboost import AdaBoost, AdaBoostModel, AdaBoostParameters
 from .targetencoder import (TargetEncoder, TargetEncoderModel,
                             TargetEncoderParameters)

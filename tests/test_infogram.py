@@ -1,0 +1,86 @@
+"""Infogram / admissible ML golden tests (hex/Infogram analog)."""
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.models import Infogram
+
+
+def _core_data(rng, n=900):
+    x1 = rng.normal(size=n).astype(np.float32)       # strong signal
+    x2 = rng.normal(size=n).astype(np.float32)       # moderate signal
+    noise = rng.normal(size=n).astype(np.float32)    # pure noise
+    logit = 2.5 * x1 + 1.0 * x2
+    y = rng.random(n) < 1 / (1 + np.exp(-logit))
+    return h2o3_tpu.H2OFrame({
+        "x1": x1, "x2": x2, "noise": noise,
+        "y": np.where(y, "Y", "N").astype(object)})
+
+
+def test_core_infogram(cl, rng):
+    fr = _core_data(rng)
+    ig = Infogram(response_column="y", seed=7,
+                  infogram_algorithm_params={"ntrees": 10,
+                                             "max_depth": 4}).train(fr)
+    rows = {d["column"]: d for d in ig.output["admissible_score"]}
+    assert set(rows) == {"x1", "x2", "noise"}
+    # the strong signal dominates both axes (normalized to 1.0)
+    assert rows["x1"]["relevance"] == pytest.approx(1.0)
+    assert rows["x1"]["cmi"] == pytest.approx(1.0)
+    # noise is neither relevant nor informative
+    assert rows["noise"]["relevance"] < 0.1
+    assert rows["noise"]["cmi"] < 0.35
+    assert "x1" in ig.admissible_features
+    assert "noise" not in ig.admissible_features
+    # sorted by admissible_index descending, thresholds recorded
+    idx = [d["admissible_index"] for d in ig.output["admissible_score"]]
+    assert idx == sorted(idx, reverse=True)
+    assert ig.output["build_core"] is True
+    assert ig.output["nmodels_trained"] == 4
+    with pytest.raises(NotImplementedError):
+        ig.predict(fr)
+
+
+def test_fair_infogram_flags_proxy_feature(cl, rng):
+    n = 900
+    protected = rng.integers(0, 2, n)                # protected attribute
+    x_safe = rng.normal(size=n).astype(np.float32)   # legitimate signal
+    # proxy: almost a copy of the protected attribute
+    x_leak = (protected + rng.normal(0, 0.05, n)).astype(np.float32)
+    logit = 2.0 * x_safe + 2.0 * (protected - 0.5)
+    y = rng.random(n) < 1 / (1 + np.exp(-logit))
+    fr = h2o3_tpu.H2OFrame({
+        "x_safe": x_safe, "x_leak": x_leak,
+        "prot": np.where(protected == 1, "a", "b").astype(object),
+        "y": np.where(y, "Y", "N").astype(object)})
+    ig = Infogram(response_column="y", protected_columns=["prot"], seed=7,
+                  infogram_algorithm_params={"ntrees": 10,
+                                             "max_depth": 4}).train(fr)
+    rows = {d["column"]: d for d in ig.output["admissible_score"]}
+    assert set(rows) == {"x_safe", "x_leak"}
+    # conditioned on the protected column, the proxy adds ~no information
+    assert rows["x_safe"]["cmi"] == pytest.approx(1.0)
+    assert rows["x_leak"]["cmi"] < 0.2
+    assert ig.admissible_features == ["x_safe"]
+    assert ig.output["build_core"] is False
+
+
+def test_infogram_over_rest(cl, rng, tmp_path):
+    """Infogram exposed through /3/ModelBuilders/infogram."""
+    from h2o3_tpu.api import start_server
+    from h2o3_tpu import client as h2oc
+    fr = _core_data(rng, n=500)
+    fr.key = "ig_frame"
+    from h2o3_tpu.runtime import dkv
+    dkv.put("ig_frame", fr)
+    server = start_server(port=0)
+    try:
+        conn = h2oc.connect(server.url)
+        m = conn.train("infogram", "ig_frame", response_column="y", seed=3,
+                       infogram_algorithm_params={"ntrees": 5,
+                                                  "max_depth": 3})
+        out = m.schema["output"]
+        assert out["build_core"] is True
+    finally:
+        server.stop()
