@@ -1,0 +1,380 @@
+"""Reader for REAL H2O-3 MOJO archives (GBM / DRF / GLM) — migration path.
+
+Reference format: ``hex/genmodel/ModelMojoReader.java:25`` — a zip holding
+``model.ini`` ([info] key=value, [columns], [domains] with per-domain text
+files) plus binary blobs.  Tree models store one bytecode blob per
+(class, tree) at ``trees/t{class:02d}_{group:03d}.bin``
+(SharedTreeMojoReader.java:52); the node stream is walked by
+``SharedTreeMojoModel.scoreTree`` (SharedTreeMojoModel.java:134): nodeType
+byte, colId u16 (0xFFFF = leaf), NA direction byte, then a float split or
+an inline/offset bitset, with left-subtree skip sizes encoded in the
+nodeType masks.  GLM stores coefficients inline in the ini
+(GlmMojoModel.score0, GlmMojoModel.java:26).
+
+This reader re-implements the *format* so a MOJO produced by the Java
+reference scores identically here — it does not share any code with it.
+Scoring is vectorized numpy on host: these artifacts serve migration and
+serving parity checks, not TPU training.  Mojo versions 1.10+ are
+supported (1.00 used a different bitset layout and predates every modern
+export).
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+import zipfile
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+_LEAF_COL = 0xFFFF
+_NA_VS_REST, _NA_LEFT, _NA_RIGHT, _LEFT, _RIGHT = 1, 2, 3, 4, 5
+
+
+def _parse_scalar(s: str):
+    s = s.strip()
+    if s in ("null", "None", ""):
+        return None
+    if s in ("true", "false"):
+        return s == "true"
+    if s.startswith("[") and s.endswith("]"):
+        inner = s[1:-1].strip()
+        return [_parse_scalar(x) for x in inner.split(",")] if inner else []
+    try:
+        return int(s)
+    except ValueError:
+        pass
+    try:
+        return float(s)
+    except ValueError:
+        return s
+
+
+class MojoArchive:
+    """Parsed model.ini + blob access for one MOJO zip."""
+
+    def __init__(self, path_or_bytes):
+        if isinstance(path_or_bytes, (bytes, bytearray)):
+            path_or_bytes = io.BytesIO(path_or_bytes)
+        self.zf = zipfile.ZipFile(path_or_bytes)
+        self.info: Dict[str, object] = {}
+        self.columns: List[str] = []
+        self.domains: Dict[int, List[str]] = {}
+        section = None
+        for line in self.zf.read("model.ini").decode().splitlines():
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            if line.startswith("["):
+                section = line.strip("[]").lower()
+                continue
+            if section == "info" and "=" in line:
+                k, _, v = line.partition("=")
+                self.info[k.strip()] = _parse_scalar(v)
+            elif section == "columns":
+                self.columns.append(line)
+            elif section == "domains":
+                # "0: 7 d000.txt" -> column_index: cardinality file
+                idx, _, rest = line.partition(":")
+                fname = rest.split()[-1]
+                levels = self.zf.read(
+                    f"domains/{fname}").decode().splitlines()
+                self.domains[int(idx)] = levels
+
+    def blob(self, name: str) -> bytes:
+        return self.zf.read(name)
+
+    def has(self, name: str) -> bool:
+        try:
+            self.zf.getinfo(name)
+            return True
+        except KeyError:
+            return False
+
+
+# ----------------------------------------------------------- tree bytecode
+
+def _score_tree(tree: bytes, row: np.ndarray,
+                domain_len: Sequence[int], v11: bool) -> float:
+    """One tree walk — SharedTreeMojoModel.scoreTree (Java :134 / :1040).
+
+    ``domain_len[col]`` is the domain cardinality (0 for numeric); the
+    current (v1.2+) walker treats an out-of-domain integer like NA.
+    ``v11`` selects the 1.10 bitset layout (fill3_1: u16 nbytes) over the
+    current one (fill3: u32 nbits).
+    """
+    pos = 0
+    while True:
+        node_type = tree[pos]
+        col = tree[pos + 1] | (tree[pos + 2] << 8)
+        pos += 3
+        if col == _LEAF_COL:
+            return struct.unpack_from("<f", tree, pos)[0]
+        na_dir = tree[pos]
+        pos += 1
+        na_vs_rest = na_dir == _NA_VS_REST
+        leftward = na_dir in (_NA_LEFT, _LEFT)
+        lmask = node_type & 51
+        equal = node_type & 12
+        split_val = None
+        bs_off = bs_nbits = bs_bitoff = 0
+        if not na_vs_rest:
+            if equal == 0:
+                split_val = struct.unpack_from("<f", tree, pos)[0]
+                pos += 4
+            elif equal == 8:                   # 32-bit inline bitset
+                bs_off, bs_nbits, bs_bitoff = pos, 32, 0
+                pos += 4
+            else:                              # offset bitset (equal == 12)
+                bs_bitoff = tree[pos] | (tree[pos + 1] << 8)
+                if v11:
+                    nbytes = tree[pos + 2] | (tree[pos + 3] << 8)
+                    bs_nbits = nbytes << 3
+                    pos += 4
+                else:
+                    bs_nbits = struct.unpack_from("<i", tree, pos + 2)[0]
+                    nbytes = ((bs_nbits - 1) >> 3) + 1
+                    pos += 6
+                bs_off = pos
+                pos += nbytes
+
+        d = row[col]
+        if np.isnan(d):
+            missing = True
+        elif equal != 0:
+            i = int(d) - bs_bitoff
+            missing = not (0 <= i < bs_nbits)
+        elif not v11 and domain_len[col] and int(d) >= domain_len[col]:
+            missing = True
+        else:
+            missing = False
+        if missing:
+            go_right = not leftward
+        elif na_vs_rest:
+            go_right = False
+        elif equal == 0:
+            go_right = d >= split_val
+        else:
+            i = int(d) - bs_bitoff
+            go_right = bool(tree[bs_off + (i >> 3)] & (1 << (i & 7)))
+
+        if go_right:
+            if lmask == 0:
+                pos += 1 + tree[pos]
+            elif lmask == 1:
+                pos += 2 + (tree[pos] | (tree[pos + 1] << 8))
+            elif lmask == 2:
+                pos += 3 + (tree[pos] | (tree[pos + 1] << 8)
+                            | (tree[pos + 2] << 16))
+            elif lmask == 3:
+                pos += 4 + struct.unpack_from("<i", tree, pos)[0]
+            elif lmask == 48:
+                pos += 4                       # skip the left prediction
+            else:
+                raise ValueError(f"illegal lmask {lmask}")
+            lmask = (node_type & 0xC0) >> 2    # switch to the right mask
+        else:
+            if lmask <= 3:
+                pos += lmask + 1
+        if lmask & 16:
+            return struct.unpack_from("<f", tree, pos)[0]
+
+
+class H2OMojoModel:
+    """Common surface: predict(dict of named columns) -> dict."""
+
+    def __init__(self, ar: MojoArchive):
+        self.archive = ar
+        self.algo = str(ar.info["algo"])
+        self.columns = ar.columns
+        self.n_features = int(ar.info["n_features"])
+        self.nclasses = int(ar.info["n_classes"])
+        self.domains = ar.domains
+        resp_idx = self.n_features
+        self.response_domain = ar.domains.get(resp_idx)
+        self.feature_names = ar.columns[: self.n_features]
+
+    # -- row assembly: names -> model column order, cats -> domain codes
+    def _matrix(self, data: Dict[str, Sequence]) -> np.ndarray:
+        n = len(next(iter(data.values())))
+        X = np.full((n, self.n_features), np.nan)
+        for j, name in enumerate(self.feature_names):
+            if name not in data:
+                continue
+            col = np.asarray(data[name], dtype=object)
+            dom = self.domains.get(j)
+            if dom is not None:
+                lookup = {s: i for i, s in enumerate(dom)}
+                X[:, j] = [lookup.get(str(v), np.nan)
+                           if v is not None else np.nan for v in col]
+            else:
+                X[:, j] = [np.nan if v is None else float(v) for v in col]
+        return X
+
+    def _finish(self, raw: np.ndarray) -> dict:
+        if self.nclasses >= 2:
+            labels = np.argmax(raw, axis=1)
+            if self.nclasses == 2:
+                thr = float(self.archive.info.get("default_threshold", 0.5))
+                labels = (raw[:, 1] >= thr).astype(int)
+            dom = self.response_domain or [str(i) for i in
+                                           range(self.nclasses)]
+            return {"predict": np.asarray(dom, dtype=object)[labels],
+                    "classes": dom,
+                    "probabilities": raw}
+        return {"predict": raw[:, 0]}
+
+    def predict(self, data: Dict[str, Sequence]) -> dict:
+        return self._finish(self._score_raw(self._matrix(data)))
+
+
+class H2OMojoTreeModel(H2OMojoModel):
+    """GBM / DRF / IsolationForest-style shared-tree MOJO."""
+
+    def __init__(self, ar: MojoArchive):
+        super().__init__(ar)
+        self.ntree_groups = int(ar.info["n_trees"])
+        self.ntrees_per_group = int(ar.info["n_trees_per_class"])
+        self.mojo_version = float(ar.info["mojo_version"])
+        if self.mojo_version < 1.1:
+            raise NotImplementedError(
+                "MOJO 1.00 tree archives predate the supported format")
+        self.trees: List[Optional[bytes]] = []
+        for group in range(self.ntree_groups):
+            for cls in range(self.ntrees_per_group):
+                name = f"trees/t{cls:02d}_{group:03d}.bin"
+                self.trees.append(ar.blob(name) if ar.has(name) else None)
+        self.domain_len = [len(self.domains.get(j, ()))
+                          for j in range(self.n_features)]
+
+    def _tree_sums(self, X: np.ndarray) -> np.ndarray:
+        n = X.shape[0]
+        k = self.ntrees_per_group
+        out = np.zeros((n, k))
+        v11 = self.mojo_version < 1.2
+        for t, tree in enumerate(self.trees):
+            if tree is None:
+                continue
+            cls = t % k
+            for r in range(n):
+                out[r, cls] += _score_tree(tree, X[r], self.domain_len,
+                                           v11)
+        return out
+
+    def _score_raw(self, X: np.ndarray) -> np.ndarray:
+        sums = self._tree_sums(X)
+        info = self.archive.info
+        if self.algo == "gbm":
+            init_f = float(info.get("init_f") or 0.0)
+            family = str(info.get("distribution"))
+            link = str(info.get("link_function", "") or "")
+            if family in ("bernoulli", "quasibinomial", "modified_huber"):
+                f = sums[:, 0] + init_f
+                p1 = _link_inv(link or "logit", f)
+                return np.stack([1.0 - p1, p1], axis=1)
+            if family == "multinomial":
+                if self.nclasses == 2:
+                    f = sums[:, 0] + init_f
+                    e = np.stack([f, -f], axis=1)
+                else:
+                    e = sums
+                e = np.exp(e - e.max(axis=1, keepdims=True))
+                return e / e.sum(axis=1, keepdims=True)
+            return _link_inv(link or "identity",
+                             sums[:, [0]] + init_f)
+        if self.algo == "drf":
+            if self.nclasses == 1:
+                return sums / self.ntree_groups
+            if self.nclasses == 2 and not bool(
+                    info.get("binomial_double_trees")):
+                p1 = sums[:, 0] / self.ntree_groups
+                return np.stack([1.0 - p1, p1], axis=1)
+            s = sums.sum(axis=1, keepdims=True)
+            with np.errstate(invalid="ignore", divide="ignore"):
+                return np.where(s > 0, sums / s, sums)
+        raise NotImplementedError(
+            f"tree MOJO algo {self.algo!r} not supported yet "
+            "(gbm/drf are)")
+
+
+def _link_inv(link: str, f: np.ndarray) -> np.ndarray:
+    link = link.lower()
+    if link in ("logit", ""):
+        return 1.0 / (1.0 + np.exp(-f))
+    if link == "log":
+        return np.exp(f)
+    if link == "inverse":
+        xx = np.where(np.abs(f) < 1e-5, np.sign(f) * 1e-5 + (f == 0) * 1e-5,
+                      f)
+        return 1.0 / xx
+    if link == "ologit":
+        return 1.0 / (1.0 + np.exp(-f))
+    return f                                   # identity
+
+
+class H2OMojoGlmModel(H2OMojoModel):
+    """GLM MOJO — GlmMojoModel.score0 (GlmMojoModel.java:26)."""
+
+    def __init__(self, ar: MojoArchive):
+        super().__init__(ar)
+        info = ar.info
+        self.beta = np.asarray(info["beta"], dtype=float)
+        self.cats = int(info.get("cats", 0))
+        self.cat_offsets = list(info.get("cat_offsets") or [0])
+        self.nums = int(info.get("nums", 0))
+        self.use_all_levels = bool(info.get("use_all_factor_levels", False))
+        self.mean_imputation = bool(info.get("mean_imputation", False))
+        self.num_means = list(info.get("num_means") or [])
+        self.cat_modes = list(info.get("cat_modes") or [])
+        self.family = str(info.get("family", "gaussian"))
+        self.link = str(info.get("link", "identity"))
+
+    def _score_raw(self, X: np.ndarray) -> np.ndarray:
+        X = X.copy()
+        if self.mean_imputation:
+            for i in range(self.cats):
+                bad = ~np.isfinite(X[:, i])
+                X[bad, i] = self.cat_modes[i]
+            for j in range(self.nums):
+                col = self.cats + j
+                bad = ~np.isfinite(X[:, col])
+                X[bad, col] = self.num_means[j]
+        eta = np.zeros(X.shape[0])
+        for i in range(self.cats):
+            ival = X[:, i].astype(int)
+            if not self.use_all_levels:
+                ival = ival - 1
+            ok = np.isfinite(X[:, i]) & (ival >= 0)
+            idx = ival + self.cat_offsets[i]
+            ok &= idx < self.cat_offsets[i + 1]
+            eta[ok] += self.beta[idx[ok]]
+        noff = self.cat_offsets[self.cats] - self.cats
+        for i in range(self.cats, len(self.beta) - 1 - noff):
+            eta += self.beta[noff + i] * np.nan_to_num(X[:, i])
+        eta += self.beta[-1]
+        mu = _link_inv(self.link, eta)
+        if self.family in ("binomial", "quasibinomial", "fractionalbinomial"):
+            return np.stack([1.0 - mu, mu], axis=1)
+        return mu[:, None]
+
+
+def load_h2o_mojo(path_or_bytes) -> H2OMojoModel:
+    """Open a reference-produced MOJO zip (ModelMojoReader.load analog)."""
+    ar = MojoArchive(path_or_bytes)
+    algo = str(ar.info.get("algo"))
+    if algo in ("gbm", "drf"):
+        return H2OMojoTreeModel(ar)
+    if algo == "glm":
+        return H2OMojoGlmModel(ar)
+    raise NotImplementedError(
+        f"H2O MOJO algo {algo!r} not supported (gbm, drf, glm are)")
+
+
+def is_h2o_mojo(path) -> bool:
+    try:
+        with zipfile.ZipFile(path) as z:
+            z.getinfo("model.ini")
+        return True
+    except Exception:               # noqa: BLE001 — not a reference MOJO
+        return False

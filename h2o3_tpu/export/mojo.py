@@ -155,8 +155,16 @@ def export_mojo(model, path: str) -> str:
     return path
 
 
-def import_mojo(path: str) -> ScoringModel:
-    """Load a portable artifact for offline scoring — MojoModel.load."""
+def import_mojo(path: str):
+    """Load a portable artifact for offline scoring — MojoModel.load.
+
+    Accepts BOTH this package's archives (model.json + arrays.npz) and
+    REAL reference-produced H2O MOJO zips (model.ini + blobs; GBM/DRF/
+    GLM) — the migration path for existing H2O users
+    (hex/genmodel/ModelMojoReader.java:25)."""
+    from .h2o_mojo import is_h2o_mojo, load_h2o_mojo
+    if is_h2o_mojo(path):
+        return load_h2o_mojo(path)
     with zipfile.ZipFile(path) as z:
         meta = json.loads(z.read("model.json"))
         npz = np.load(io.BytesIO(z.read("arrays.npz")))

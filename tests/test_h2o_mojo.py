@@ -1,0 +1,101 @@
+"""Real H2O MOJO import: score reference-produced artifacts identically.
+
+Golden fixtures come from the reference's own test resources (read-only,
+never copied into this repo); tests skip when the reference tree is not
+mounted.  The GBM golden value (71.085) is the reference's own
+MojoReaderBackendFactoryTest.testMojoE2E expectation.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+_REF = "/root/reference/h2o-genmodel/src/test/resources/hex/genmodel"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(_REF), reason="reference fixtures not mounted")
+
+# the reference's own golden row (MojoReaderBackendFactoryTest.makeTestRow)
+_GOLDEN_ROW = (
+    "75,0,190,80,91,193,371,174,121,-16,13,64,-2,0,63,0,52,44,0,0,32,0,0,0,"
+    "0,0,0,0,44,20,36,0,28,0,0,0,0,0,0,52,40,0,0,0,60,0,0,0,0,0,0,52,0,0,0,"
+    "0,0,0,0,0,0,0,0,0,56,36,0,0,32,0,0,0,0,0,0,48,32,0,0,0,56,0,0,0,0,0,0,"
+    "80,0,0,0,0,0,0,0,0,0,0,0,0,40,52,0,0,28,0,0,0,0,0,0,0,48,48,0,0,32,0,"
+    "0,0,0,0,0,0,52,52,0,0,36,0,0,0,0,0,0,0,52,48,0,0,32,0,0,0,0,0,0,0,56,"
+    "44,0,0,32,0,0,0,0,0,0,-0.2,0.0,6.1,-1.0,0.0,0.0,0.6,2.1,13.6,30.8,0.0,"
+    "0.0,1.7,-1.0,0.6,0.0,1.3,1.5,3.7,14.5,0.1,-5.2,1.4,0.0,0.0,0.0,0.8,"
+    "-0.6,-10.7,-15.6,0.4,-3.9,0.0,0.0,0.0,0.0,-0.8,-1.7,-10.1,-22.0,0.0,"
+    "0.0,5.7,-1.0,0.0,0.0,-0.1,1.2,14.1,22.5,0.0,-2.5,0.8,0.0,0.0,0.0,1.0,"
+    "0.4,-4.8,-2.7,0.1,-6.0,0.0,0.0,0.0,0.0,-0.8,-0.6,-24.0,-29.7,0.0,0.0,"
+    "2.0,-6.4,0.0,0.0,0.2,2.9,-12.6,15.2,-0.1,0.0,8.4,-10.0,0.0,0.0,0.6,"
+    "5.9,-3.9,52.7,-0.3,0.0,15.2,-8.4,0.0,0.0,0.9,5.1,17.7,70.7,-0.4,0.0,"
+    "13.5,-4.0,0.0,0.0,0.9,3.9,25.5,62.9,-0.3,0.0,9.0,-0.9,0.0,0.0,0.9,"
+    "2.9,23.3,49.4,8")
+
+
+def test_reference_gbm_mojo_golden_prediction():
+    """Scores the reference's mojo.zip to ITS OWN golden value
+    (MojoReaderBackendFactoryTest.java:68: 71.085 +- 0.001)."""
+    from h2o3_tpu.export.h2o_mojo import load_h2o_mojo
+    m = load_h2o_mojo(os.path.join(_REF, "mojo.zip"))
+    assert m.algo == "gbm" and m.nclasses == 1
+    assert m.n_features == 262
+    vals = [float(v) for v in _GOLDEN_ROW.split(",")]
+    data = {f"C{i + 1}": [v] for i, v in enumerate(vals)}
+    out = m.predict(data)
+    assert out["predict"][0] == pytest.approx(71.085, abs=1e-3)
+
+
+def test_reference_gbm_varimp_mojo_loads_and_scores():
+    from h2o3_tpu.export.h2o_mojo import load_h2o_mojo
+    path = os.path.join(_REF, "algos/gbm/gbm_variable_importance.zip")
+    m = load_h2o_mojo(path)
+    assert m.algo == "gbm"
+    rng = np.random.default_rng(1)
+    data = {}
+    for j, name in enumerate(m.feature_names):
+        dom = m.domains.get(j)
+        if dom is not None:
+            data[name] = [dom[int(i)] for i in
+                          rng.integers(0, len(dom), 20)]
+        else:
+            data[name] = rng.normal(size=20).tolist()
+    out = m.predict(data)
+    if m.nclasses >= 2:
+        probs = out["probabilities"]
+        assert probs.shape == (20, m.nclasses)
+        assert np.all(probs >= 0) and np.all(probs <= 1)
+        assert np.allclose(probs.sum(axis=1), 1.0, atol=1e-6)
+    else:
+        assert np.all(np.isfinite(out["predict"]))
+
+
+def test_reference_glm_mojo_scores_prostate():
+    from h2o3_tpu.export.h2o_mojo import load_h2o_mojo
+    m = load_h2o_mojo(os.path.join(_REF, "algos/pipeline/glm_model.zip"))
+    assert m.algo == "glm"
+    # the model regresses CAPSULE (gaussian) over prostate columns with a
+    # categorical CLUSTER feature; exercise domain mapping + NA imputation
+    data = {"CLUSTER": ["3", "0", None], "DPROS": [2.0, 1.0, None],
+            "DCAPS": [1.0, 2.0, 1.0], "PSA": [15.0, 4.0, 20.0],
+            "VOL": [10.0, 0.0, 30.0], "GLEASON": [7.0, 6.0, None]}
+    out = m.predict(data)
+    assert out["predict"].shape == (3,)
+    assert np.all(np.isfinite(out["predict"]))
+    # hand-check row 1 against the published beta vector
+    beta = np.asarray(m.archive.info["beta"])
+    eta = beta[0]                               # CLUSTER level "0"
+    noff = m.cat_offsets[m.cats] - m.cats
+    nums = [1.0, 2.0, 4.0, 0.0, 6.0]            # DPROS..GLEASON row 1
+    for i, v in enumerate(nums):
+        eta += beta[noff + m.cats + i] * v
+    eta += beta[-1]
+    assert out["predict"][1] == pytest.approx(eta, rel=1e-10)
+
+
+def test_import_mojo_sniffs_reference_archives():
+    import h2o3_tpu
+    from h2o3_tpu.export.h2o_mojo import H2OMojoTreeModel
+    m = h2o3_tpu.import_mojo(os.path.join(_REF, "mojo.zip"))
+    assert isinstance(m, H2OMojoTreeModel)
