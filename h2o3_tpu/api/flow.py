@@ -1,17 +1,21 @@
-"""Flow-lite: a single-page dashboard over the REST API.
+"""Flow: an interactive single-page workbench over the REST API.
 
-Reference: ``h2o-web``'s Flow notebook UI.  This is deliberately a
-minimal read-only surface (cloud status, frames with summaries and data
-preview, models with metrics, jobs, timeline) driven purely by the same
-/3 endpoints any client uses — an honest subset, not a notebook clone.
+Reference: ``h2o-web``'s Flow notebook UI (assist, import, parse, build
+model, predict from the browser).  This is a dependency-free SPA served
+inline and driven purely by the same /3 and /99 endpoints every client
+uses: import/parse, frame inspect/summary/split, assisted model building
+(algo list + parameter metadata from /3/ModelBuilders), predictions,
+Rapids expressions, AutoML with leaderboard, variable importances,
+partial dependence, artifact downloads, and the live cloud/jobs/timeline
+dashboards.
 """
 
 FLOW_HTML = """<!DOCTYPE html>
-<html><head><meta charset="utf-8"><title>h2o3_tpu</title>
+<html><head><meta charset="utf-8"><title>h2o3_tpu Flow</title>
 <style>
  body{font-family:system-ui,sans-serif;margin:0;background:#f6f7f9;color:#1c2b33}
- header{background:#12333d;color:#fff;padding:10px 20px;font-size:18px}
- header small{opacity:.7;margin-left:12px}
+ header{background:#12333d;color:#fff;padding:10px 20px;font-size:18px;display:flex;align-items:center}
+ header small{opacity:.7;margin-left:12px;font-size:13px}
  main{padding:16px 20px;display:grid;gap:16px;grid-template-columns:1fr 1fr}
  section{background:#fff;border:1px solid #dde3e8;border-radius:8px;padding:12px 16px}
  h2{font-size:14px;text-transform:uppercase;letter-spacing:.06em;color:#5b6b73;margin:0 0 8px}
@@ -19,44 +23,176 @@ FLOW_HTML = """<!DOCTYPE html>
  td,th{border-bottom:1px solid #eef1f4;padding:4px 8px;text-align:left}
  th{color:#5b6b73;font-weight:600}
  tr:hover{background:#f2f7fa}
- pre{background:#f2f4f6;padding:8px;border-radius:6px;overflow:auto;font-size:12px;max-height:320px}
+ pre{background:#f2f4f6;padding:8px;border-radius:6px;overflow:auto;font-size:12px;max-height:340px}
  .pill{display:inline-block;background:#e4f0ee;border-radius:10px;padding:1px 8px;font-size:12px}
  #detail{grid-column:1 / -1}
  a{color:#176d81;cursor:pointer;text-decoration:none}
+ input,select,textarea,button{font:inherit;font-size:13px;margin:2px 4px 2px 0;
+   border:1px solid #c5cfd6;border-radius:5px;padding:4px 6px;background:#fff}
+ button{background:#176d81;color:#fff;border:none;cursor:pointer;padding:5px 12px}
+ button:hover{background:#12333d}
+ textarea{width:100%;box-sizing:border-box;font-family:ui-monospace,monospace}
+ .err{color:#b3261e;white-space:pre-wrap;font-size:12px}
+ label{font-size:12px;color:#5b6b73;margin-right:2px}
 </style></head><body>
-<header>h2o3_tpu<small id="cloud"></small></header>
+<header>h2o3_tpu Flow<small id="cloud"></small></header>
 <main>
+ <section>
+  <h2>Import / Parse</h2>
+  <label>path/glob</label><input id="imp_path" size="38" placeholder="/data/train*.csv">
+  <label>as</label><input id="imp_dest" size="12" placeholder="frame name">
+  <button onclick="doImport()">import</button>
+  <div id="imp_err" class="err"></div>
+  <h2 style="margin-top:12px">Rapids</h2>
+  <input id="rapids_expr" size="50" placeholder="(mean (cols train 'x'))">
+  <button onclick="doRapids()">run</button>
+  <div id="rapids_err" class="err"></div>
+ </section>
+ <section>
+  <h2>Build Model (assist)</h2>
+  <label>algo</label><select id="bm_algo" onchange="fillParams()"></select>
+  <label>frame</label><select id="bm_frame" onchange="fillCols()"></select>
+  <label>response</label><select id="bm_resp"></select>
+  <br><label>params (JSON)</label>
+  <textarea id="bm_params" rows="3">{"seed": 1}</textarea>
+  <button onclick="doTrain()">train</button>
+  <button onclick="doAutoML()">run AutoML</button>
+  <div id="bm_err" class="err"></div>
+ </section>
  <section><h2>Frames</h2><table id="frames"></table></section>
  <section><h2>Models</h2><table id="models"></table></section>
  <section><h2>Jobs</h2><table id="jobs"></table></section>
  <section><h2>Timeline</h2><table id="timeline"></table></section>
- <section id="detail"><h2 id="dtitle">Detail</h2><pre id="dbody">select a frame or model…</pre></section>
+ <section id="detail"><h2 id="dtitle">Detail</h2><pre id="dbody">import a frame, then train…</pre></section>
 </main>
 <script>
-const J = async p => (await fetch(p)).json();
+const J = async p => { const r = await fetch(p); return r.json(); };
+const P = async (p, body) => {
+  const r = await fetch(p, {method:'POST', headers:{'Content-Type':'application/json'},
+                            body: JSON.stringify(body||{})});
+  const out = await r.json();
+  if (!r.ok) throw new Error(out.error || r.statusText);
+  return out;
+};
 const el = id => document.getElementById(id);
 const esc = s => String(s).replace(/[&<>"'`]/g, c => ({'&':'&amp;','<':'&lt;','>':'&gt;','\"':'&quot;',"'":'&#39;','`':'&#96;'}[c]));
-async function show(title, path){
+const enc = encodeURIComponent;
+function detail(title, obj){
   el('dtitle').textContent = title;
-  el('dbody').textContent = JSON.stringify(await J(path), null, 2);
+  el('dbody').textContent = typeof obj === 'string' ? obj : JSON.stringify(obj, null, 2);
+}
+async function show(title, path){ detail(title, await J(path)); }
+let frameCache = [];
+async function doImport(){
+  el('imp_err').textContent = '';
+  try {
+    const out = await P('/3/Parse', {path: el('imp_path').value,
+                                     destination_frame: el('imp_dest').value || null});
+    detail('parsed ' + out.destination_frame.name, out);
+    refresh();
+  } catch(e){ el('imp_err').textContent = e.message; }
+}
+async function doRapids(){
+  el('rapids_err').textContent = '';
+  try { detail('rapids', await P('/99/Rapids', {ast: el('rapids_expr').value})); refresh(); }
+  catch(e){ el('rapids_err').textContent = e.message; }
+}
+async function doTrain(){
+  el('bm_err').textContent = '';
+  try {
+    const params = JSON.parse(el('bm_params').value || '{}');
+    params.training_frame = el('bm_frame').value;
+    if (el('bm_resp').value) params.response_column = el('bm_resp').value;
+    const algo = el('bm_algo').value;
+    detail('training ' + algo + '…', 'working');
+    const out = await P('/3/ModelBuilders/' + enc(algo), params);
+    detail('trained ' + out.model.model_id.name, out.model);
+    refresh();
+  } catch(e){ el('bm_err').textContent = e.message; detail('train failed', e.message); }
+}
+async function doAutoML(){
+  el('bm_err').textContent = '';
+  try {
+    const params = JSON.parse(el('bm_params').value || '{}');
+    params.training_frame = el('bm_frame').value;
+    if (el('bm_resp').value) params.response_column = el('bm_resp').value;
+    if (!params.max_models) params.max_models = 5;
+    detail('automl running…', 'working');
+    const out = await P('/99/AutoMLBuilder', params);
+    detail('automl leader ' + out.leader.name, out);
+    refresh();
+  } catch(e){ el('bm_err').textContent = e.message; }
+}
+async function doPredict(model){
+  const frame = prompt('predict frame key', el('bm_frame').value);
+  if (!frame) return;
+  try {
+    const out = await P('/3/Predictions/models/' + enc(model) + '/frames/' + enc(frame), {});
+    await show('predictions ' + out.predictions_frame.name,
+               '/3/Frames/' + enc(out.predictions_frame.name) + '/data?row_count=20');
+    refresh();
+  } catch(e){ detail('predict failed', e.message); }
+}
+async function doPD(model){
+  const col = prompt('partial dependence column');
+  if (!col) return;
+  try { detail('pd ' + model + ' / ' + col,
+               await P('/3/PartialDependence', {model: model, frame: el('bm_frame').value, column: col})); }
+  catch(e){ detail('pd failed', e.message); }
+}
+async function doSplit(frame){
+  const r = prompt('split ratio (0-1)', '0.75');
+  if (!r) return;
+  try { detail('split ' + frame, await P('/3/SplitFrame', {key: frame, ratios: JSON.stringify([+r])})); refresh(); }
+  catch(e){ detail('split failed', e.message); }
+}
+async function doDelete(key){
+  await fetch('/3/DKV/' + enc(key), {method:'DELETE'});
+  refresh();
+}
+async function fillCols(){
+  const f = frameCache.find(x => x.frame_id.name === el('bm_frame').value);
+  el('bm_resp').innerHTML = '<option value=""></option>' + (f ? f.columns.map(c =>
+    `<option>${esc(c.label)}</option>`).join('') : '');
+}
+async function fillParams(){
+  try {
+    const mb = await J('/3/ModelBuilders/' + enc(el('bm_algo').value));
+    const ps = Object.values(mb.model_builders)[0].parameters.slice(0, 40);
+    el('bm_params').placeholder = ps.map(p => p.name).join(', ');
+  } catch(e) {}
 }
 async function refresh(){
   const c = await J('/3/Cloud');
-  el('cloud').textContent = `${c.platform} · ${JSON.stringify(c.mesh_shape)} · ${c.cloud_size} process(es)`;
+  el('cloud').textContent = `${c.platform} · ${JSON.stringify(c.mesh_shape)} · ${c.cloud_size} process(es) · ${c.cloud_healthy ? 'healthy' : 'DEGRADED'}`;
   const fr = await J('/3/Frames');
-  el('frames').innerHTML = '<tr><th>frame</th><th>rows</th><th>cols</th><th></th></tr>' +
+  frameCache = fr.frames;
+  const selected = el('bm_frame').value;
+  el('bm_frame').innerHTML = fr.frames.map(f =>
+    `<option ${f.frame_id.name===selected?'selected':''}>${esc(f.frame_id.name)}</option>`).join('');
+  if (!selected && fr.frames.length) fillCols();
+  el('frames').innerHTML = '<tr><th>frame</th><th>rows</th><th>cols</th><th>actions</th></tr>' +
     fr.frames.map(f => `<tr><td>${esc(f.frame_id.name)}</td><td>${f.rows}</td>
       <td>${f.columns.length}</td>
-      <td><a onclick="show('frame ${esc(f.frame_id.name)}','/3/Frames/${encodeURIComponent(f.frame_id.name)}/data?row_count=20')">data</a>
-          <a onclick="show('summary ${esc(f.frame_id.name)}','/3/Frames/${encodeURIComponent(f.frame_id.name)}/summary')">summary</a></td></tr>`).join('');
+      <td><a onclick="show('frame ${esc(f.frame_id.name)}','/3/Frames/${enc(f.frame_id.name)}/data?row_count=20')">data</a>
+          <a onclick="show('summary ${esc(f.frame_id.name)}','/3/Frames/${enc(f.frame_id.name)}/summary')">summary</a>
+          <a onclick="doSplit('${esc(f.frame_id.name)}')">split</a>
+          <a onclick="doDelete('${esc(f.frame_id.name)}')">✕</a></td></tr>`).join('');
   const mo = await J('/3/Models');
-  el('models').innerHTML = '<tr><th>model</th><th>algo</th><th>metrics</th></tr>' +
+  el('models').innerHTML = '<tr><th>model</th><th>algo</th><th>metrics</th><th>actions</th></tr>' +
     mo.models.map(m => {
       const t = m.training_metrics || {};
       const head = ['auc','rmse','logloss','r2'].filter(k => t[k] != null)
         .map(k => `${k}=${(+t[k]).toFixed(4)}`).join(' ');
-      return `<tr><td><a onclick="show('model ${esc(m.model_id.name)}','/3/Models/${encodeURIComponent(m.model_id.name)}')">${esc(m.model_id.name)}</a></td>
-        <td><span class="pill">${esc(m.algo)}</span></td><td>${head}</td></tr>`;}).join('');
+      const k = m.model_id.name;
+      return `<tr><td><a onclick="show('model ${esc(k)}','/3/Models/${enc(k)}')">${esc(k)}</a></td>
+        <td><span class="pill">${esc(m.algo)}</span></td><td>${head}</td>
+        <td><a onclick="doPredict('${esc(k)}')">predict</a>
+            <a onclick="show('varimp ${esc(k)}','/3/Models/${enc(k)}/varimp')">varimp</a>
+            <a onclick="doPD('${esc(k)}')">pd</a>
+            <a href="/3/Models/${enc(k)}/mojo" download="${esc(k)}.zip">mojo</a>
+            <a href="/3/Models.fetch.bin/${enc(k)}" download="${esc(k)}.bin">bin</a>
+            <a onclick="doDelete('${esc(k)}')">✕</a></td></tr>`;}).join('');
   const jo = await J('/3/Jobs');
   el('jobs').innerHTML = '<tr><th>job</th><th>status</th><th>progress</th></tr>' +
     jo.jobs.slice(-12).reverse().map(j =>
@@ -67,7 +203,13 @@ async function refresh(){
     tl.events.slice(-12).reverse().map(e => {
       const {ts, kind, ...rest} = e;
       return `<tr><td>${esc(kind)}</td><td>${esc(JSON.stringify(rest)).slice(0,90)}</td></tr>`;}).join('');
+  const algoSel = el('bm_algo');
+  if (!algoSel.options.length){
+    const mb = await J('/3/ModelBuilders');
+    algoSel.innerHTML = Object.keys(mb.model_builders).map(a =>
+      `<option ${a==='gbm'?'selected':''}>${a}</option>`).join('');
+  }
 }
-refresh(); setInterval(refresh, 4000);
+refresh(); setInterval(refresh, 5000);
 </script></body></html>
 """

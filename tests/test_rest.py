@@ -131,7 +131,9 @@ def test_deploy_serve_launcher(cl, tmp_path):
         assert p.wait(timeout=15) == 0
 
 
-def test_flow_dashboard_served(cl):
+def test_flow_workbench_served(cl):
+    """The interactive Flow SPA: import/train/predict/automl/rapids
+    actions wired to the same REST routes clients use."""
     from h2o3_tpu.api.server import start_server
     import urllib.request
     srv = start_server()
@@ -140,6 +142,14 @@ def test_flow_dashboard_served(cl):
         assert "h2o3_tpu" in html and "/3/Frames" in html
         assert urllib.request.urlopen(
             srv.url + "/flow").read().decode() == html
+        # interactive affordances present and wired to real routes
+        for hook in ("doImport", "doTrain", "doAutoML", "doPredict",
+                     "doRapids", "doSplit", "doPD", "fillCols"):
+            assert hook in html, hook
+        for route in ("/3/Parse", "/3/ModelBuilders/", "/99/AutoMLBuilder",
+                      "/99/Rapids", "/3/SplitFrame", "/3/PartialDependence",
+                      "/3/Models.fetch.bin/", "/mojo"):
+            assert route in html, route
     finally:
         srv.stop()
 
