@@ -1,0 +1,62 @@
+# Grid search + AutoML — h2o-r grid.R / automl.R analogs.
+
+#' Hyperparameter grid search — POST /99/Grid/{algo}.
+#' @param hyper_params named list of value vectors, e.g.
+#'   list(max_depth = c(3, 5), ntrees = c(20, 50))
+h2o.grid <- function(algo, hyper_params, training_frame,
+                     validation_frame = NULL, search_criteria = NULL, ...) {
+  params <- list(...)
+  params$training_frame <- .h2o.frame_key(training_frame)
+  params$hyper_parameters <- hyper_params
+  if (!is.null(validation_frame))
+    params$validation_frame <- .h2o.frame_key(validation_frame)
+  if (!is.null(search_criteria)) params$search_criteria <- search_criteria
+  out <- .h2o.request("POST", paste0("/99/Grid/", algo), body = params)
+  structure(list(key = out$grid_id$name, schema = out), class = "H2OGrid")
+}
+
+#' Fetch an existing grid.
+h2o.getGrid <- function(grid_id) {
+  out <- .h2o.request("GET", paste0(
+    "/99/Grids/", utils::URLencode(grid_id, reserved = TRUE)))
+  structure(list(key = out$grid_id$name, schema = out), class = "H2OGrid")
+}
+
+#' Run AutoML — POST /99/AutoMLBuilder.
+h2o.automl <- function(y, training_frame, validation_frame = NULL,
+                       max_models = 10, project_name = NULL, ...) {
+  params <- list(...)
+  params$training_frame <- .h2o.frame_key(training_frame)
+  params$response_column <- y
+  params$max_models <- max_models
+  if (!is.null(project_name)) params$project_name <- project_name
+  if (!is.null(validation_frame))
+    params$validation_frame <- .h2o.frame_key(validation_frame)
+  out <- .h2o.request("POST", "/99/AutoMLBuilder", body = params)
+  structure(list(project_name = out$project_name,
+                 leader = .h2o.model(out$leader$name),
+                 schema = out), class = "H2OAutoML")
+}
+
+#' Leaderboard of a finished AutoML run.
+h2o.get_leaderboard <- function(object) {
+  project <- if (inherits(object, "H2OAutoML")) object$project_name
+             else object
+  out <- .h2o.request("GET", paste0(
+    "/99/Leaderboards/", utils::URLencode(project, reserved = TRUE)))
+  do.call(rbind, lapply(out$leaderboard_table, function(r)
+    as.data.frame(r, stringsAsFactors = FALSE)))
+}
+
+#' @export
+print.H2OGrid <- function(x, ...) {
+  cat(sprintf("H2OGrid %s: %d models\n", x$key,
+              length(x$schema$model_ids)))
+  invisible(x)
+}
+
+#' @export
+print.H2OAutoML <- function(x, ...) {
+  cat(sprintf("H2OAutoML %s, leader %s\n", x$project_name, x$leader$key))
+  invisible(x)
+}

@@ -1,0 +1,142 @@
+# Connection + HTTP transport for the h2o3-tpu REST API.
+#
+# Reference surface: h2o-r/h2o-package/R/connection.R + communication.R —
+# h2o.init / h2o.connect and a versioned REST transport.  The transport
+# here is base-R sockets (no libcurl dependency): one HTTP/1.1 request
+# per call, JSON via jsonlite.
+
+.h2o.env <- new.env(parent = emptyenv())
+
+#' Connect to a running h2o3-tpu server.
+#' @param url server base url, e.g. "http://127.0.0.1:54321"
+#' @param username,password optional HTTP basic credentials
+h2o.connect <- function(url = "http://127.0.0.1:54321",
+                        username = "", password = "") {
+  parts <- .h2o.parse_url(url)
+  conn <- structure(list(host = parts$host, port = parts$port,
+                         auth = if (nzchar(username))
+                           paste0(username, ":", password) else NULL),
+                    class = "H2OConnection")
+  assign("conn", conn, envir = .h2o.env)
+  cloud <- .h2o.request("GET", "/3/Cloud")
+  message(sprintf("Connected to h2o3-tpu cloud (platform %s, %s process(es))",
+                  cloud$platform, cloud$cloud_size))
+  invisible(conn)
+}
+
+#' h2o.init analog: connect, assuming a server is already running.
+h2o.init <- function(ip = "127.0.0.1", port = 54321, ...) {
+  h2o.connect(sprintf("http://%s:%d", ip, port), ...)
+}
+
+#' Cluster status (/3/Cloud).
+h2o.clusterInfo <- function() .h2o.request("GET", "/3/Cloud")
+
+#' There is no remote shutdown route; stop the server process instead.
+h2o.shutdown <- function(prompt = TRUE) {
+  warning("h2o3-tpu has no remote shutdown; stop the server process")
+  invisible(NULL)
+}
+
+.h2o.parse_url <- function(url) {
+  u <- sub("^https?://", "", url)
+  host <- sub(":.*$", "", u)
+  port <- if (grepl(":", u)) as.integer(sub("^.*:", "", sub("/.*$", "", u)))
+          else 80L
+  list(host = host, port = port)
+}
+
+.h2o.conn <- function() {
+  if (!exists("conn", envir = .h2o.env))
+    stop("not connected; call h2o.init() / h2o.connect() first")
+  get("conn", envir = .h2o.env)
+}
+
+# One HTTP request over a base-R socket; returns parsed JSON (or raw
+# bytes when binary = TRUE).
+.h2o.request <- function(method, route, params = NULL, body = NULL,
+                         binary = FALSE) {
+  conn <- .h2o.conn()
+  path <- route
+  payload <- raw(0)
+  headers <- c(sprintf("Host: %s:%d", conn$host, conn$port),
+               "Connection: close")
+  if (!is.null(conn$auth))
+    headers <- c(headers, paste0(
+      "Authorization: Basic ",
+      jsonlite::base64_enc(charToRaw(conn$auth))))
+  if (identical(method, "GET") && length(params)) {
+    q <- paste(vapply(names(params), function(k) paste0(
+      utils::URLencode(k, reserved = TRUE), "=",
+      utils::URLencode(as.character(params[[k]]), reserved = TRUE)),
+      character(1)), collapse = "&")
+    path <- paste0(path, "?", q)
+  } else if (!is.null(body)) {
+    payload <- if (is.raw(body)) body else
+      charToRaw(jsonlite::toJSON(body, auto_unbox = TRUE, null = "null"))
+    headers <- c(headers,
+                 if (is.raw(body)) "Content-Type: application/octet-stream"
+                 else "Content-Type: application/json",
+                 sprintf("Content-Length: %d", length(payload)))
+  } else if (method %in% c("POST", "DELETE")) {
+    headers <- c(headers, "Content-Length: 0")
+  }
+  sock <- socketConnection(conn$host, conn$port, open = "w+b",
+                           blocking = TRUE, timeout = 600)
+  on.exit(close(sock), add = TRUE)
+  writeBin(charToRaw(paste0(method, " ", path, " HTTP/1.1\r\n",
+                            paste(headers, collapse = "\r\n"),
+                            "\r\n\r\n")), sock)
+  if (length(payload)) writeBin(payload, sock)
+  flush(sock)
+  status_line <- .h2o.read_line(sock)
+  status <- as.integer(strsplit(status_line, " ")[[1]][2])
+  clen <- -1L
+  repeat {
+    line <- .h2o.read_line(sock)
+    if (!nzchar(line)) break
+    if (grepl("^[Cc]ontent-[Ll]ength:", line))
+      clen <- as.integer(trimws(sub("^[^:]*:", "", line)))
+  }
+  raw_body <- if (clen >= 0) .h2o.read_n(sock, clen) else
+    .h2o.read_all(sock)
+  if (binary && status < 300) return(raw_body)
+  out <- tryCatch(jsonlite::fromJSON(rawToChar(raw_body),
+                                     simplifyVector = FALSE),
+                  error = function(e) list(error = rawToChar(raw_body)))
+  if (status >= 300)
+    stop(sprintf("%s %s -> %d: %s", method, route, status,
+                 if (is.null(out$error)) "error" else out$error))
+  out
+}
+
+.h2o.read_line <- function(sock) {
+  bytes <- raw(0)
+  repeat {
+    b <- readBin(sock, "raw", 1L)
+    if (!length(b)) break
+    if (identical(b, as.raw(10L))) break
+    bytes <- c(bytes, b)
+  }
+  sub("\r$", "", rawToChar(bytes))
+}
+
+.h2o.read_n <- function(sock, n) {
+  out <- raw(0)
+  while (length(out) < n) {
+    chunk <- readBin(sock, "raw", n - length(out))
+    if (!length(chunk)) break
+    out <- c(out, chunk)
+  }
+  out
+}
+
+.h2o.read_all <- function(sock) {
+  out <- raw(0)
+  repeat {
+    chunk <- readBin(sock, "raw", 65536L)
+    if (!length(chunk)) break
+    out <- c(out, chunk)
+  }
+  out
+}

@@ -1,0 +1,95 @@
+# Frame handles and munging — h2o-r/h2o-package/R/frame.R analog (compact).
+
+.h2o.frame <- function(key) structure(list(key = key), class = "H2OFrame")
+
+#' Import one or many files (paths, globs, persist URIs) as a frame.
+h2o.importFile <- function(path, destination_frame = NULL, ...) {
+  out <- .h2o.request("POST", "/3/Parse",
+                      body = list(path = path,
+                                  destination_frame = destination_frame))
+  .h2o.frame(out$destination_frame$name)
+}
+
+#' Handle to an existing server-side frame.
+h2o.getFrame <- function(key) {
+  .h2o.request("GET", paste0("/3/Frames/", utils::URLencode(key,
+                                                            reserved = TRUE)))
+  .h2o.frame(key)
+}
+
+#' All keys (frames + models) in the cluster.
+h2o.ls <- function() {
+  frames <- vapply(.h2o.request("GET", "/3/Frames")$frames,
+                   function(f) f$frame_id$name, character(1))
+  models <- vapply(.h2o.request("GET", "/3/Models")$models,
+                   function(m) m$model_id$name, character(1))
+  data.frame(key = c(frames, models),
+             type = c(rep("frame", length(frames)),
+                      rep("model", length(models))))
+}
+
+#' Remove a key from the DKV.
+h2o.rm <- function(x) {
+  key <- if (inherits(x, c("H2OFrame", "H2OModel"))) x$key else x
+  .h2o.request("DELETE", paste0("/3/DKV/",
+                                utils::URLencode(key, reserved = TRUE)))
+  invisible(NULL)
+}
+
+#' Split a frame by ratios; returns a list of H2OFrame.
+h2o.splitFrame <- function(data, ratios = 0.75, seed = 0) {
+  out <- .h2o.request("POST", "/3/SplitFrame",
+                      body = list(key = data$key,
+                                  ratios = jsonlite::toJSON(ratios),
+                                  seed = seed))
+  lapply(out$destination_frames, .h2o.frame)
+}
+
+#' Export a frame to a path / persist URI.
+h2o.exportFile <- function(data, path) {
+  .h2o.request("POST", paste0("/3/Frames/",
+                              utils::URLencode(data$key, reserved = TRUE),
+                              "/export"),
+               body = list(path = path))$path
+}
+
+#' Evaluate a Rapids expression string.
+h2o.rapids <- function(ast) .h2o.request("POST", "/99/Rapids",
+                                         body = list(ast = ast))
+
+#' Column summaries (rollups) for a frame.
+h2o.describe <- function(data) {
+  .h2o.request("GET", paste0("/3/Frames/",
+                             utils::URLencode(data$key, reserved = TRUE),
+                             "/summary"))$frames[[1]]$summary
+}
+
+#' First n rows as a data.frame.
+h2o.head <- function(data, n = 10) {
+  out <- .h2o.request("GET", paste0(
+    "/3/Frames/", utils::URLencode(data$key, reserved = TRUE), "/data"),
+    params = list(row_offset = 0, row_count = n))
+  as.data.frame(lapply(out$data, function(col)
+    unlist(lapply(col, function(v) if (is.null(v)) NA else v))),
+    stringsAsFactors = FALSE)
+}
+
+.h2o.frame_schema <- function(key) {
+  .h2o.request("GET", paste0("/3/Frames/",
+                             utils::URLencode(key, reserved = TRUE))
+               )$frames[[1]]
+}
+
+#' @export
+dim.H2OFrame <- function(x) {
+  sch <- .h2o.frame_schema(x$key)
+  c(sch$rows, length(sch$columns))
+}
+
+#' @export
+print.H2OFrame <- function(x, ...) {
+  sch <- .h2o.frame_schema(x$key)
+  cat(sprintf("H2OFrame %s: %d rows x %d cols\n", x$key, sch$rows,
+              length(sch$columns)))
+  invisible(x)
+}

@@ -1,0 +1,146 @@
+# Model training / scoring — per-algo wrappers like h2o-r's gbm.R etc.
+
+.h2o.model <- function(key) structure(list(key = key), class = "H2OModel")
+
+.h2o.frame_key <- function(x) if (inherits(x, "H2OFrame")) x$key else x
+
+#' Train any algorithm by name — POST /3/ModelBuilders/{algo}.
+h2o.train <- function(algo, training_frame, validation_frame = NULL, ...) {
+  params <- list(...)
+  params$training_frame <- .h2o.frame_key(training_frame)
+  if (!is.null(validation_frame))
+    params$validation_frame <- .h2o.frame_key(validation_frame)
+  out <- .h2o.request("POST", paste0("/3/ModelBuilders/", algo),
+                      body = params)
+  .h2o.model(out$model$model_id$name)
+}
+
+# ---- per-algo wrappers (h2o-r naming) -------------------------------------
+h2o.gbm <- function(y, training_frame, ...)
+  h2o.train("gbm", training_frame, response_column = y, ...)
+h2o.glm <- function(y, training_frame, ...)
+  h2o.train("glm", training_frame, response_column = y, ...)
+h2o.randomForest <- function(y, training_frame, ...)
+  h2o.train("drf", training_frame, response_column = y, ...)
+h2o.deeplearning <- function(y, training_frame, ...)
+  h2o.train("deeplearning", training_frame, response_column = y, ...)
+h2o.xgboost <- function(y, training_frame, ...)
+  h2o.train("xgboost", training_frame, response_column = y, ...)
+h2o.kmeans <- function(training_frame, ...)
+  h2o.train("kmeans", training_frame, ...)
+h2o.prcomp <- function(training_frame, ...)
+  h2o.train("pca", training_frame, ...)
+h2o.naiveBayes <- function(y, training_frame, ...)
+  h2o.train("naivebayes", training_frame, response_column = y, ...)
+h2o.isolationForest <- function(training_frame, ...)
+  h2o.train("isolationforest", training_frame, ...)
+h2o.coxph <- function(y, training_frame, ...)
+  h2o.train("coxph", training_frame, response_column = y, ...)
+h2o.gam <- function(y, training_frame, ...)
+  h2o.train("gam", training_frame, response_column = y, ...)
+h2o.glrm <- function(training_frame, ...)
+  h2o.train("glrm", training_frame, ...)
+h2o.rulefit <- function(y, training_frame, ...)
+  h2o.train("rulefit", training_frame, response_column = y, ...)
+h2o.stackedEnsemble <- function(y, training_frame, ...)
+  h2o.train("stackedensemble", training_frame, response_column = y, ...)
+h2o.infogram <- function(y, training_frame, ...)
+  h2o.train("infogram", training_frame, response_column = y, ...)
+
+#' Handle to an existing model.
+h2o.getModel <- function(key) {
+  .h2o.request("GET", paste0("/3/Models/",
+                             utils::URLencode(key, reserved = TRUE)))
+  .h2o.model(key)
+}
+
+.h2o.model_schema <- function(key) {
+  .h2o.request("GET", paste0("/3/Models/",
+                             utils::URLencode(key, reserved = TRUE))
+               )$models[[1]]
+}
+
+#' Score a frame; returns an H2OFrame of predictions.
+h2o.predict <- function(object, newdata) {
+  out <- .h2o.request("POST", paste0(
+    "/3/Predictions/models/", utils::URLencode(object$key, reserved = TRUE),
+    "/frames/", utils::URLencode(.h2o.frame_key(newdata), reserved = TRUE)))
+  .h2o.frame(out$predictions_frame$name)
+}
+
+#' Metrics of a model on a frame.
+h2o.performance <- function(model, newdata) {
+  .h2o.request("POST", paste0(
+    "/3/ModelMetrics/models/",
+    utils::URLencode(model$key, reserved = TRUE),
+    "/frames/", utils::URLencode(.h2o.frame_key(newdata),
+                                 reserved = TRUE)))$model_metrics[[1]]
+}
+
+#' Variable importances.
+h2o.varimp <- function(model) {
+  out <- .h2o.request("GET", paste0(
+    "/3/Models/", utils::URLencode(model$key, reserved = TRUE), "/varimp"))
+  do.call(rbind, lapply(out$varimp, as.data.frame))
+}
+
+#' Partial dependence data for one column.
+h2o.partialPlot <- function(model, data, column, nbins = 20) {
+  .h2o.request("POST", "/3/PartialDependence",
+               body = list(model = model$key,
+                           frame = .h2o.frame_key(data),
+                           column = column,
+                           nbins = nbins))$partial_dependence
+}
+
+#' Scoring history entries.
+h2o.scoreHistory <- function(model) {
+  .h2o.request("GET", paste0(
+    "/3/Models/", utils::URLencode(model$key, reserved = TRUE),
+    "/scoring_history"))$scoring_history
+}
+
+#' Save a model server-side; returns the server path.
+h2o.saveModel <- function(model, path) {
+  .h2o.request("POST", paste0("/99/Models.bin/",
+                              utils::URLencode(model$key, reserved = TRUE)),
+               body = list(dir = path))$path
+}
+
+#' Load = upload a locally downloaded artifact back to the server.
+h2o.loadModel <- function(path) h2o.upload_model(path)
+
+#' Download the binary model artifact to a local file.
+h2o.download_model <- function(model, path) {
+  raw <- .h2o.request("GET", paste0(
+    "/3/Models.fetch.bin/", utils::URLencode(model$key, reserved = TRUE)),
+    binary = TRUE)
+  writeBin(raw, path)
+  path
+}
+
+#' Upload a binary model artifact; returns the installed model.
+h2o.upload_model <- function(path) {
+  raw <- readBin(path, "raw", file.info(path)$size)
+  out <- .h2o.request("POST", "/3/Models.upload.bin", body = raw)
+  .h2o.model(out$models[[1]]$model_id$name)
+}
+
+#' Download the portable scoring artifact (MOJO analog).
+h2o.download_mojo <- function(model, path) {
+  raw <- .h2o.request("GET", paste0(
+    "/3/Models/", utils::URLencode(model$key, reserved = TRUE), "/mojo"),
+    binary = TRUE)
+  writeBin(raw, path)
+  path
+}
+
+#' @export
+print.H2OModel <- function(x, ...) {
+  sch <- .h2o.model_schema(x$key)
+  cat(sprintf("H2OModel %s (%s)\n", x$key, sch$algo))
+  invisible(x)
+}
+
+#' @export
+summary.H2OModel <- function(object, ...) .h2o.model_schema(object$key)

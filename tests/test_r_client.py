@@ -1,0 +1,104 @@
+"""R client package consistency: every route the R source calls exists
+on the server, and every NAMESPACE export is defined.
+
+(No R interpreter ships in this image, so the package is validated
+structurally + against the live route tables rather than executed —
+the same routes are exercised end-to-end by the Python client tests.)
+"""
+
+import os
+import re
+
+ROOT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "h2o3-r", "h2o3tpu")
+
+
+def _r_sources():
+    rdir = os.path.join(ROOT, "R")
+    return {f: open(os.path.join(rdir, f)).read()
+            for f in sorted(os.listdir(rdir)) if f.endswith(".R")}
+
+
+def test_r_package_layout():
+    assert os.path.exists(os.path.join(ROOT, "DESCRIPTION"))
+    assert os.path.exists(os.path.join(ROOT, "NAMESPACE"))
+    srcs = _r_sources()
+    assert {"connection.R", "frame.R", "models.R", "automl.R"} <= set(srcs)
+
+
+def test_r_namespace_exports_are_defined():
+    ns = open(os.path.join(ROOT, "NAMESPACE")).read()
+    exports = re.findall(r"^export\(([^)]+)\)", ns, re.M)
+    assert len(exports) >= 40
+    body = "\n".join(_r_sources().values())
+    for fn in exports:
+        pat = re.escape(fn) + r"\s*<-\s*function"
+        assert re.search(pat, body), f"export {fn} has no definition"
+    for s3 in re.findall(r"^S3method\((\w+),\s*(\w+)\)", ns, re.M):
+        pat = re.escape(f"{s3[0]}.{s3[1]}") + r"\s*<-\s*function"
+        assert re.search(pat, body), f"S3 method {s3} has no definition"
+
+
+def test_r_routes_exist_on_server(cl):
+    """Every literal route fragment in the R source must match a
+    registered server route (client/server drift gate)."""
+    from h2o3_tpu.api.server import H2OServer, _Handler
+    srv = H2OServer(port=0)       # registers the route tables on _Handler
+    try:
+        patterns = (list(_Handler.routes_get)
+                    + list(_Handler.routes_post)
+                    + list(_Handler.routes_delete)
+                    + [r"/3/Models\.upload\.bin"])
+    finally:
+        # never started serve_forever: close the socket directly
+        # (shutdown() would block waiting for the serve loop)
+        srv.httpd.server_close()
+    body = "\n".join(_r_sources().values())
+    called = set(re.findall(r'"(/(?:3|99)/[^"?]*)"', body))
+    assert called, "no routes found in R sources"
+    # literal prefix of each registered pattern (up to the first group)
+    literals = [p.split("(")[0].replace("\\.", ".") for p in patterns]
+    for route in called:
+        # full-route fragments must fullmatch; paste0 prefixes (ending in
+        # "/" or otherwise completed with a key) must extend to a
+        # registered pattern's literal prefix
+        ok = any(re.fullmatch(p, route) for p in patterns) or any(
+            lit.startswith(route) or route.startswith(lit)
+            for lit in literals if len(lit) > 4)
+        assert ok, f"R client calls unregistered route {route!r}"
+
+
+def test_r_balanced_delimiters():
+    """Cheap syntax smoke for the R sources (no interpreter in image):
+    quotes-aware paren/brace balance per file."""
+    for name, src in _r_sources().items():
+        stack = []
+        pairs = {")": "(", "}": "{", "]": "["}
+        in_str = None
+        esc = False
+        for i, ch in enumerate(src):
+            if esc:
+                esc = False
+                continue
+            if ch == "\\":
+                esc = True
+                continue
+            if in_str:
+                if ch == in_str:
+                    in_str = None
+                continue
+            if ch in "\"'":
+                in_str = ch
+            elif ch == "#":
+                nl = src.find("\n", i)
+                if nl == -1:
+                    break
+                # skip to end of comment by faking a string until newline
+                in_str = "\n"
+            elif ch in "({[":
+                stack.append(ch)
+            elif ch in ")}]":
+                assert stack and stack[-1] == pairs[ch], \
+                    f"{name}: unbalanced {ch!r} at offset {i}"
+                stack.pop()
+        assert not stack, f"{name}: unclosed {stack[-3:]}"
