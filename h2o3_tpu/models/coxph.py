@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Optional
+from typing import List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -43,6 +43,13 @@ class CoxPHParameters(Parameters):
     ties: str = "efron"                      # efron | breslow (ref default)
     max_iterations: int = 20
     standardize: bool = True
+    # covariate interactions (CoxPHModel.java:52-53 _interactions /
+    # _interaction_pairs).  Combined with counting-process episodes
+    # (start/stop rows + a period indicator) these express TIME-VARYING
+    # coefficients: interact a covariate with the period factor and each
+    # period gets its own hazard ratio.
+    interactions: Optional[Sequence[str]] = None        # all pairs among
+    interaction_pairs: Optional[Sequence] = None        # explicit (a, b)
 
 
 @functools.partial(jax.jit, static_argnames=("efron", "use_start"))
@@ -99,14 +106,71 @@ def _cox_stats(X, w, event, tie_end, strat_first, gid, grank, gsize,
     return -ll, grad, hess
 
 
+def _interaction_list(p: "CoxPHParameters") -> List[tuple]:
+    pairs = [tuple(x) for x in (p.interaction_pairs or ())]
+    if p.interactions:
+        import itertools
+        pairs += list(itertools.combinations(p.interactions, 2))
+    return pairs
+
+
+def expand_interactions(frame: Frame, pairs: Sequence[tuple]) -> Frame:
+    """Add product columns for covariate interactions.
+
+    num x num -> one ``a:b`` product column; cat x num -> one slope
+    column per level (``cat.level:num`` — the per-level coefficients ARE
+    the time-varying betas when the cat is a period indicator);
+    cat x cat -> the crossed factor ``a_b``.
+    """
+    names, vecs = list(frame.names), list(frame.vecs)
+    for a, b in pairs:
+        va, vb = frame.vec(a), frame.vec(b)
+        if va.type == T_CAT and vb.type == T_CAT:
+            ca, cb = va.to_numpy(), vb.to_numpy()
+            lb = len(vb.domain)
+            codes = np.where((ca < 0) | (cb < 0), -1, ca * lb + cb)
+            domain = [f"{x}_{y}" for x in va.domain for y in vb.domain]
+            names.append(f"{a}_{b}")
+            vecs.append(Vec.from_numpy(codes.astype(np.int32), T_CAT,
+                                       domain=domain))
+        elif va.type == T_CAT or vb.type == T_CAT:
+            cat, num, cn, nn = (va, vb, a, b) if va.type == T_CAT \
+                else (vb, va, b, a)
+            codes = cat.to_numpy()
+            x = np.nan_to_num(num.to_numpy())
+            for li, lvl in enumerate(cat.domain):
+                names.append(f"{cn}.{lvl}:{nn}")
+                vecs.append(Vec.from_numpy(
+                    np.where(codes == li, x, 0.0), T_NUM))
+        else:
+            names.append(f"{a}:{b}")
+            vecs.append(Vec.from_numpy(
+                np.nan_to_num(va.to_numpy())
+                * np.nan_to_num(vb.to_numpy()), T_NUM))
+    return Frame(names, vecs)
+
+
 class CoxPHModel(Model):
     algo = "coxph"
+
+    def _with_interactions(self, frame: Frame) -> Frame:
+        pairs = [tuple(x) for x in
+                 self.output.get("interaction_pairs", ())]
+        if pairs and not all(
+                (f"{a}:{b}" in frame.names or f"{a}_{b}" in frame.names
+                 or any(n.startswith(f"{a}.") and n.endswith(f":{b}")
+                        or n.startswith(f"{b}.") and n.endswith(f":{a}")
+                        for n in frame.names))
+                for a, b in pairs):
+            return expand_interactions(frame, pairs)
+        return frame
 
     def _predict_raw(self, X: jax.Array) -> jax.Array:
         beta = jnp.asarray(self.output["beta_std"], jnp.float32)
         return X @ beta                       # linear predictor (log hazard)
 
     def predict(self, frame: Frame) -> Frame:
+        frame = self._with_interactions(frame)
         X = self.datainfo.make_matrix(frame)
         lp = np.asarray(self._predict_raw(X))[: frame.nrows]
         return Frame(["lp"], [Vec.from_numpy(lp.astype(np.float64), T_NUM)])
@@ -134,6 +198,14 @@ class CoxPH(ModelBuilder):
 
     def __init__(self, params: Optional[CoxPHParameters] = None, **kw):
         super().__init__(params or CoxPHParameters(**kw))
+
+    def train(self, frame: Frame, valid: Optional[Frame] = None):
+        pairs = _interaction_list(self.params)
+        if pairs:
+            frame = expand_interactions(frame, pairs)
+            if valid is not None:
+                valid = expand_interactions(valid, pairs)
+        return super().train(frame, valid)
 
     def _validate(self, frame: Frame) -> None:
         p: CoxPHParameters = self.params
@@ -277,6 +349,7 @@ class CoxPH(ModelBuilder):
             "beta_std": beta, "coef": dict(zip(di.coef_names, coef)),
             "neg_log_partial_likelihood": nll, "iterations": it + 1,
             "n_events": int(np.sum(e[ok] > 0)), "ties": p.ties,
+            "interaction_pairs": _interaction_list(p),
         })
         model.training_metrics = {
             "neg_log_partial_likelihood": nll,

@@ -54,6 +54,13 @@ class DeepLearningParameters(Parameters):
     hidden_dropout_ratios: Optional[Sequence[float]] = None
     l1: float = 0.0
     l2: float = 0.0
+    # custom per-row loss UDF (CDistributionFunc analog): callable
+    # (pred, y) -> per-row loss, jittable; pred is logits [B, K] for
+    # classifiers / autoencoders, the scalar prediction [B] otherwise.
+    # NOTE: with standardize=True (the default) regression targets reach
+    # the loss STANDARDIZED ((y-mean)/sigma) — scale-sensitive losses
+    # (e.g. huber with a delta in raw units) should set standardize=False
+    custom_loss_func: Optional[object] = None
     loss: str = "automatic"              # automatic|cross_entropy|quadratic|
     # absolute|huber
     distribution: str = "auto"
@@ -213,7 +220,10 @@ class DeepLearning(ModelBuilder):
             logits = model._forward(params, xb, deterministic=False, rng=key,
                                     dropout_in=p.input_dropout_ratio,
                                     dropout_hidden=dropout_h)
-            if p.autoencoder:
+            if p.custom_loss_func is not None:
+                pred = logits if (is_cls or p.autoencoder) else logits[:, 0]
+                per = p.custom_loss_func(pred, xb if p.autoencoder else yb)
+            elif p.autoencoder:
                 per = jnp.mean((logits - xb) ** 2, axis=1)
             elif is_cls:
                 yi = jnp.clip(yb.astype(jnp.int32), 0, out_dim - 1)

@@ -176,8 +176,56 @@ class Multinomial(Distribution):
     name = "multinomial"
 
 
+class CustomDistribution(Distribution):
+    """User-supplied loss — the water/udf/CDistributionFunc analog.
+
+    The reference ships custom distribution UDFs to the cluster as
+    uploaded code (DkvClassLoader); here the cluster is SPMD so a plain
+    Python object works.  Provide ``grad_hess(y, f) -> (g, h)`` (or just
+    ``gradient(y, f)``; unit Hessian assumed), plus optional
+    ``linkinv(f)``, ``init_score(y, w)``, ``deviance(y, f, w)`` — all
+    jittable elementwise math, mirroring this module's protocol.
+    """
+
+    name = "custom"
+
+    def __init__(self, fn):
+        if not (hasattr(fn, "grad_hess") or hasattr(fn, "gradient")):
+            raise ValueError(
+                "custom_distribution_func needs grad_hess(y, f) or "
+                "gradient(y, f)")
+        self.fn = fn
+
+    def init_score(self, y, w):
+        if hasattr(self.fn, "init_score"):
+            return self.fn.init_score(y, w)
+        return super().init_score(y, w)
+
+    def grad_hess(self, y, f):
+        if hasattr(self.fn, "grad_hess"):
+            return self.fn.grad_hess(y, f)
+        g = self.fn.gradient(y, f)
+        return g, jnp.ones_like(f)
+
+    def linkinv(self, f):
+        if hasattr(self.fn, "linkinv"):
+            return self.fn.linkinv(f)
+        return f
+
+    def deviance(self, y, f, w):
+        if hasattr(self.fn, "deviance"):
+            return self.fn.deviance(y, f, w)
+        return super().deviance(y, f, w)
+
+
 def make_distribution(name: str, nclasses: int = 1, **kw) -> Distribution:
+    custom = kw.get("custom_distribution_func")
+    if custom is not None:
+        return CustomDistribution(custom)
     name = (name or "auto").lower()
+    if name == "custom":
+        raise ValueError(
+            "distribution='custom' requires custom_distribution_func")
     if name == "auto":
         if nclasses == 2:
             return Bernoulli()

@@ -1,18 +1,27 @@
-"""GAM: cubic regression splines with curvature penalties over the GLM.
+"""GAM: spline smooths with curvature penalties over the GLM.
 
 Reference: ``hex/gam/GAM.java:53`` (4.7k LoC) — each ``gam_column`` expands
-into a cubic regression spline (CRS) basis at quantile knots with the
-integrated-squared-second-derivative penalty matrix, sum-to-zero centered
-for identifiability, then the penalized GLM runs over [basis, other
-features] (GamSplines/CubicRegressionSplines + penalty_matrix plumbing).
+into a spline basis with a penalty matrix, identifiability-centered, then
+the penalized GLM runs over [basis, other features].  Basis families:
 
-TPU-native redesign: the CRS construction follows the standard natural-
-spline form (banded second-difference system; basis values are two knot
-weights + two curvature weights per row — a dense [n, K] matmul-friendly
-block).  The penalty is diagonalized once per column (Demmler-Reinsch:
-rotate by the centered penalty's eigenvectors) so it becomes per-column
-ridge FACTORS on the shared GLM solver — no bespoke penalized solver, and
-the null space (linear trend) stays unpenalized exactly as in mgcv/H2O.
+- ``bs="cr"`` — cubic regression splines at quantile knots with the
+  integrated-squared-second-derivative penalty
+  (GamSplines/CubicRegressionSplines).
+- ``bs="tp"`` — thin-plate regression splines, including MULTI-predictor
+  smooths (``gam_columns`` entries may be lists of columns;
+  GamSplines/ThinPlateRegressionUtils.java + ThinPlateDistanceWithKnots):
+  radial basis at data knots, polynomial null space projected out, the
+  bending-energy penalty from the radial block.
+- ``bs="is"`` — monotone I-splines (GamSplines/ISplines): integrated
+  B-spline basis whose coefficients are constrained non-negative through
+  the GLM's ``non_negative`` option, yielding monotone-increasing smooths
+  (``splines_non_negative``, NBSplinesTypeII analog).
+
+TPU-native redesign: bases are dense matmul-friendly blocks; each penalty
+is diagonalized once per smooth (Demmler-Reinsch: rotate by the centered
+penalty's eigenvectors) so it becomes per-column ridge FACTORS on the
+shared GLM solver — no bespoke penalized solver, and each null space
+(linear/polynomial trend) stays unpenalized exactly as in mgcv/H2O.
 """
 
 from __future__ import annotations
@@ -33,10 +42,16 @@ from .glm import GLM, GLMParameters
 
 @dataclasses.dataclass
 class GAMParameters(GLMParameters):
-    gam_columns: Sequence[str] = ()
+    # entries are column names, or LISTS of names for multi-predictor
+    # thin-plate smooths (the reference's nested gam_columns)
+    gam_columns: Sequence = ()
     num_knots: int = 8
     scale: float = 1.0                  # smoothing strength per gam column
-    bs: str = "cr"                      # basis type (cubic regression)
+    # basis per smooth: "cr" | "tp" | "is" — a single string applies to
+    # every smooth (the reference's bs array of 0=cr/1=tp/2=is codes)
+    bs: object = "cr"
+    # monotone (I-spline) smooths: constrain coefficients >= 0
+    splines_non_negative: bool = True
 
 
 def _crs_construct(knots: np.ndarray):
@@ -88,6 +103,64 @@ def _crs_eval(x: np.ndarray, knots: np.ndarray,
     return X
 
 
+def _tp_eta(r: np.ndarray, d: int) -> np.ndarray:
+    """Thin-plate radial basis function for d input dimensions (m=2)."""
+    if d == 1:
+        return r ** 3 / 12.0
+    if d == 2:
+        with np.errstate(divide="ignore", invalid="ignore"):
+            out = (r * r) * np.log(np.maximum(r, 1e-300)) / (8 * np.pi)
+        return np.where(r > 0, out, 0.0)
+    return -r / 8.0                         # d == 3 (odd-d general form)
+
+
+def _tp_construct(Xk: np.ndarray):
+    """Thin-plate machinery for one knot matrix [k, d]: returns (Z, S).
+
+    ``Z`` [k, k-d-1] projects radial coefficients onto the null space of
+    the polynomial constraint T'delta = 0 (T = [1, x1..xd] at the knots);
+    ``S = Z' E Z`` is the bending-energy penalty with E the knot-knot
+    radial matrix — the standard TPRS construction
+    (ThinPlateRegressionUtils.java computes the same pieces distributedly).
+    """
+    k, d = Xk.shape
+    r = np.linalg.norm(Xk[:, None, :] - Xk[None, :, :], axis=2)
+    E = _tp_eta(r, d)
+    T = np.concatenate([np.ones((k, 1)), Xk], axis=1)        # [k, d+1]
+    q, _ = np.linalg.qr(T, mode="complete")
+    Z = q[:, d + 1:]                                         # [k, k-d-1]
+    S = Z.T @ E @ Z
+    return Z, (S + S.T) / 2
+
+
+def _tp_eval(X: np.ndarray, Xk: np.ndarray, Z: np.ndarray) -> np.ndarray:
+    """Projected radial design block [n, k-d-1] for rows X [n, d]."""
+    d = Xk.shape[1]
+    r = np.linalg.norm(X[:, None, :] - Xk[None, :, :], axis=2)
+    return _tp_eta(r, d) @ Z
+
+
+def _is_basis(x: np.ndarray, knots: np.ndarray) -> np.ndarray:
+    """I-spline (monotone) basis [n, K]: cumulative integrals of cubic
+    M-splines — each column rises 0 -> 1, so non-negative coefficients
+    give a monotone-increasing smooth (GamSplines/ISplines analog)."""
+    from scipy.interpolate import BSpline
+    order = 4                                # cubic
+    t = np.concatenate([[knots[0]] * order, knots[1:-1],
+                        [knots[-1]] * order])
+    nb = len(t) - order
+    xc = np.clip(x, knots[0], knots[-1])
+    B = np.empty((len(x), nb))
+    for j in range(nb):
+        coef = np.zeros(nb)
+        coef[j] = 1.0
+        B[:, j] = BSpline(t, coef, order - 1)(xc)
+    # I_j(x) = sum of B-spline columns m >= j+1 (integrated M-splines);
+    # drop the first cumulative column (constant 1 = intercept clash)
+    I = np.cumsum(B[:, ::-1], axis=1)[:, ::-1]
+    return I[:, 1:]
+
+
 def _center_and_diagonalize(Xb: np.ndarray, S: np.ndarray):
     """Sum-to-zero centering + Demmler-Reinsch diagonalization.
 
@@ -112,21 +185,37 @@ def _center_and_diagonalize(Xb: np.ndarray, S: np.ndarray):
 class GAMModel(Model):
     algo = "gam"
 
+    def _block(self, m: dict, frame: Frame) -> np.ndarray:
+        """Design block [n, width] for one smooth on any frame."""
+        if m["kind"] == "cr":
+            x = np.nan_to_num(frame.vec(m["cols"][0]).to_numpy(),
+                              nan=m["mean"])
+            B = _crs_eval(x, m["knots"], m["F_full"]) @ m["T"]
+            return B / m["col_scale"][None, :]
+        if m["kind"] == "tp":
+            X = np.stack([np.nan_to_num(frame.vec(c).to_numpy(), nan=mu)
+                          for c, mu in zip(m["cols"], m["means"])], axis=1)
+            Xs = (X - np.asarray(m["means"])) / np.asarray(m["sigmas"])
+            B = _tp_eval(Xs, m["knots"], m["Z"]) @ m["T"]
+            B = B / m["col_scale"][None, :]
+            return np.concatenate([B, Xs], axis=1)   # + linear null space
+        x = np.nan_to_num(frame.vec(m["cols"][0]).to_numpy(),
+                          nan=m["mean"])              # "is"
+        return _is_basis(x, m["knots"])
+
     def _expand(self, frame: Frame) -> Frame:
-        names, vecs = [], []
         meta = self.output["gam_meta"]
+        smooth_cols = {c for m in meta for c in m["cols"]}
+        names, vecs = [], []
         for n, v in zip(frame.names, frame.vecs):
-            if n in meta:
-                m = meta[n]
-                x = np.nan_to_num(v.to_numpy(), nan=m["mean"])
-                B = _crs_eval(x, m["knots"], m["F_full"]) @ m["T"]
-                B = B / m["col_scale"][None, :]
-                for j in range(B.shape[1]):
-                    names.append(f"{n}_gam{j}")
-                    vecs.append(Vec.from_numpy(B[:, j], T_NUM))
-            else:
+            if n not in smooth_cols:
                 names.append(n)
                 vecs.append(v)
+        for m in meta:
+            B = self._block(m, frame)
+            for j in range(B.shape[1]):
+                names.append(f"{m['name']}_gam{j}")
+                vecs.append(Vec.from_numpy(B[:, j], T_NUM))
         return Frame(names, vecs)
 
     def _predict_raw(self, X):
@@ -156,48 +245,138 @@ class GAM(ModelBuilder):
     def __init__(self, params: Optional[GAMParameters] = None, **kw):
         super().__init__(params or GAMParameters(**kw))
 
+    def _smooth_specs(self) -> List[dict]:
+        """Normalize gam_columns/bs into per-smooth descriptors."""
+        p: GAMParameters = self.params
+        entries = [e if isinstance(e, (list, tuple)) else [e]
+                   for e in p.gam_columns]
+        bs = p.bs
+        kinds = list(bs) if isinstance(bs, (list, tuple)) \
+            else [bs] * len(entries)
+        if len(kinds) != len(entries):
+            raise ValueError("bs must be one kind or one per gam_columns "
+                             "entry")
+        code = {0: "cr", 1: "tp", 2: "is", "cr": "cr", "tp": "tp",
+                "is": "is", "ms": "is"}
+        out = []
+        for cols, k in zip(entries, kinds):
+            kind = code.get(k)
+            if kind is None:
+                raise ValueError(f"unknown basis {k!r} (cr | tp | is)")
+            if kind != "tp" and len(cols) > 1:
+                raise ValueError("multi-column smooths need bs='tp'")
+            if kind == "tp" and len(cols) > 3:
+                raise ValueError(
+                    "thin-plate smooths support up to 3 columns (the m=2 "
+                    "radial basis needs 2m > d)")
+            out.append({"cols": list(cols), "kind": kind,
+                        "name": "_".join(cols)})
+        return out
+
     def _validate(self, frame: Frame) -> None:
         super()._validate(frame)
         p: GAMParameters = self.params
         if not p.gam_columns:
             raise ValueError("gam requires gam_columns")
-        for c in p.gam_columns:
-            if c not in frame.names:
-                raise ValueError(f"gam column {c!r} not in frame")
+        for s in self._smooth_specs():
+            for c in s["cols"]:
+                if c not in frame.names:
+                    raise ValueError(f"gam column {c!r} not in frame")
+
+    @staticmethod
+    def _quantile_knots(x: np.ndarray, k: int, col: str) -> np.ndarray:
+        knots = np.unique(np.quantile(x, np.linspace(0, 1, max(k, 4))))
+        if len(knots) < 4:
+            raise ValueError(
+                f"gam column {col!r} has too few distinct values "
+                f"({len(knots)}) for a spline")
+        return knots
 
     def _fit(self, job: Job, frame: Frame, di: DataInfo,
              valid: Optional[Frame]) -> GAMModel:
         p: GAMParameters = self.params
-        meta: Dict[str, dict] = {}
+        meta: List[dict] = []
         factors: Dict[str, float] = {}
-        for c in p.gam_columns:
-            x = frame.vec(c).to_numpy()
-            x = x[~np.isnan(x)]
-            qs = np.linspace(0, 1, max(p.num_knots, 4))
-            knots = np.unique(np.quantile(x, qs))
-            if len(knots) < 4:
-                raise ValueError(
-                    f"gam column {c!r} has too few distinct values "
-                    f"({len(knots)}) for a cubic spline")
-            F_full, S = _crs_construct(knots)
-            Xb = _crs_eval(np.nan_to_num(frame.vec(c).to_numpy(),
-                                         nan=float(x.mean())), knots, F_full)
-            T, d = _center_and_diagonalize(Xb, S)
-            Bt = Xb @ T
-            col_scale = np.maximum(Bt.std(axis=0), 1e-12)
-            meta[c] = {"knots": knots, "F_full": F_full, "T": T,
-                       "mean": float(x.mean()), "col_scale": col_scale}
-            # penalty factor for the scaled column: the design column is
-            # Bt/s, so its coefficient is s*beta and a factor f penalizes
-            # f*s^2*beta^2 — realizing scale*d_j*beta^2 needs f = scale*d/s^2.
-            # d is normalized by its largest eigenvalue (the reference
-            # scales penalty matrices likewise) so scale=1 smooths mildly
-            # regardless of knot spacing / data units.
-            d_max = max(float(d.max()), 1e-30)
-            for j, dj in enumerate(d):
-                factors[f"{c}_gam{j}"] = float(
-                    p.scale * (dj / d_max) / max(col_scale[j] ** 2, 1e-30))
+        nonneg: List[str] = []
         model = GAMModel(job.dest_key or dkv.make_key(self.algo), p, di)
+        for s in self._smooth_specs():
+            name, cols = s["name"], s["cols"]
+            if s["kind"] == "cr":
+                x = frame.vec(cols[0]).to_numpy()
+                x = x[~np.isnan(x)]
+                knots = self._quantile_knots(x, p.num_knots, cols[0])
+                F_full, S = _crs_construct(knots)
+                Xb = _crs_eval(np.nan_to_num(frame.vec(cols[0]).to_numpy(),
+                                             nan=float(x.mean())),
+                               knots, F_full)
+                T, d = _center_and_diagonalize(Xb, S)
+                col_scale = np.maximum((Xb @ T).std(axis=0), 1e-12)
+                meta.append({**s, "knots": knots, "F_full": F_full, "T": T,
+                             "mean": float(x.mean()),
+                             "col_scale": col_scale})
+                # penalty factor for the scaled column: the design column
+                # is Bt/s, so its coefficient is s*beta and a factor f
+                # penalizes f*s^2*beta^2 — realizing scale*d_j*beta^2
+                # needs f = scale*d/s^2.  d is normalized by its largest
+                # eigenvalue (the reference scales penalty matrices
+                # likewise) so scale=1 smooths mildly regardless of knot
+                # spacing / data units.
+                d_max = max(float(d.max()), 1e-30)
+                for j, dj in enumerate(d):
+                    factors[f"{name}_gam{j}"] = float(
+                        p.scale * (dj / d_max)
+                        / max(col_scale[j] ** 2, 1e-30))
+            elif s["kind"] == "tp":
+                Xcols, means, sigmas = [], [], []
+                for c in cols:
+                    xc = frame.vec(c).to_numpy()
+                    mu = float(np.nanmean(xc))
+                    sd = float(np.nanstd(xc)) or 1.0
+                    Xcols.append(np.nan_to_num(xc, nan=mu))
+                    means.append(mu)
+                    sigmas.append(sd)
+                X = (np.stack(Xcols, axis=1) - np.asarray(means)) \
+                    / np.asarray(sigmas)
+                dcols = X.shape[1]
+                k = max(p.num_knots, dcols + 3)
+                # deterministic space-filling knots: evenly strided rows
+                # of the lexicographic sort (kmeans-free knot placement)
+                order = np.lexsort(X.T[::-1])
+                idx = order[np.linspace(0, len(order) - 1, k).astype(int)]
+                knots = np.unique(X[idx], axis=0)
+                Z, S = _tp_construct(knots)
+                B = _tp_eval(X, knots, Z)
+                T, d = _center_and_diagonalize(B, S)
+                col_scale = np.maximum((B @ T).std(axis=0), 1e-12)
+                meta.append({**s, "knots": knots, "Z": Z, "T": T,
+                             "means": means, "sigmas": sigmas,
+                             "col_scale": col_scale})
+                # TP factors are normalized on the SCALED columns (the
+                # radial basis has tiny raw magnitudes, so the CRS-style
+                # d/col_scale^2 blows up): f_raw = d_j/col_scale_j^2,
+                # rescaled so the stiffest direction gets exactly
+                # ``scale`` — scale=1 then smooths mildly, matching the
+                # CRS knob's feel.
+                f_raw = np.maximum(np.asarray(d, float), 0.0) \
+                    / np.maximum(col_scale ** 2, 1e-30)
+                f_max = max(float(f_raw.max()), 1e-30)
+                nrad = len(col_scale)
+                for j in range(nrad):
+                    factors[f"{name}_gam{j}"] = float(
+                        p.scale * f_raw[j] / f_max)
+                for j in range(dcols):            # linear null space
+                    factors[f"{name}_gam{nrad + j}"] = 0.0
+            else:                                 # "is" — monotone
+                x = frame.vec(cols[0]).to_numpy()
+                x = x[~np.isnan(x)]
+                knots = self._quantile_knots(x, p.num_knots, cols[0])
+                meta.append({**s, "knots": knots, "mean": float(x.mean())})
+                width = _is_basis(np.asarray([knots[0]]), knots).shape[1]
+                for j in range(width):
+                    cname = f"{name}_gam{j}"
+                    factors[cname] = float(p.scale)
+                    if p.splines_non_negative:
+                        nonneg.append(cname)
         model.output["gam_meta"] = meta
 
         # non-gam predictors keep the user's lambda as their factor
@@ -206,10 +385,11 @@ class GAM(ModelBuilder):
         for n in expanded.names:
             if n not in factors and n != p.response_column:
                 factors[n] = base_lam
-        job.update(0.3, "fitting penalized GLM over CRS basis")
+        job.update(0.3, "fitting penalized GLM over the spline bases")
         glm = GLM(response_column=p.response_column, family=p.family,
                   alpha=0.0, lambda_=1.0, penalty_factors=factors,
                   weights_column=p.weights_column,
+                  non_negative=nonneg or False,
                   seed=p.effective_seed(),
                   max_iterations=p.max_iterations).train(
             expanded, model._expand(valid) if valid is not None else None)

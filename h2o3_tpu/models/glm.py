@@ -253,12 +253,16 @@ def _make_softmax_stats(nclasses: int):
 def _solve_penalized(gram: np.ndarray, xtwz: np.ndarray, n: float,
                      lam: float, alpha: float, beta0: np.ndarray,
                      penalize: np.ndarray, max_inner: int = 100,
-                     tol: float = 1e-8) -> np.ndarray:
+                     tol: float = 1e-8,
+                     nonneg: Optional[np.ndarray] = None) -> np.ndarray:
     """Solve 0.5 b'Gb - c'b + lam*(alpha*|b|_1 + (1-alpha)/2 |b|_2^2).
 
-    G = gram/n, c = xtwz/n.  Pure L2 -> one Cholesky solve; any L1 ->
-    cyclic coordinate descent on the Gram (the reference's COD,
-    GLM.java:2840).  ``penalize`` masks out the intercept.
+    G = gram/n, c = xtwz/n.  Pure L2 -> one Cholesky solve; any L1 or
+    sign constraint -> cyclic coordinate descent on the Gram (the
+    reference's COD, GLM.java:2840).  ``penalize`` masks out the
+    intercept; ``nonneg`` marks coefficients clamped to >= 0 (the GLM
+    ``non_negative`` option — per-coordinate projection, which for CD is
+    the exact constrained minimizer).
     """
     G = gram / n
     c = xtwz / n
@@ -267,13 +271,16 @@ def _solve_penalized(gram: np.ndarray, xtwz: np.ndarray, n: float,
     # values scale both the L1 and L2 shares (GAM penalty eigenvalues)
     l2 = lam * (1 - alpha) * penalize
     l1 = lam * alpha * penalize
-    if np.all(l1 == 0.0):
+    constrained = nonneg is not None and bool(np.any(nonneg))
+    if np.all(l1 == 0.0) and not constrained:
         A = G + np.diag(l2 + 1e-10)
         try:
             return np.linalg.solve(A, c)
         except np.linalg.LinAlgError:
             return np.linalg.lstsq(A, c, rcond=None)[0]
     beta = beta0.copy()
+    if constrained:
+        beta[nonneg] = np.maximum(beta[nonneg], 0.0)
     d = np.diag(G).copy()
     Gb = G @ beta
     for _ in range(max_inner):
@@ -285,6 +292,8 @@ def _solve_penalized(gram: np.ndarray, xtwz: np.ndarray, n: float,
                     / (d[j] + l2[j] + 1e-12)
             else:
                 bj = r / (d[j] + 1e-12)
+            if constrained and nonneg[j]:
+                bj = max(bj, 0.0)
             diff = bj - beta[j]
             if diff != 0.0:
                 Gb += G[:, j] * diff
@@ -306,6 +315,10 @@ class GLMParameters(Parameters):
     nlambdas: int = 30
     lambda_min_ratio: float = 1e-4
     solver: str = "irlsm"
+    # sign constraint (GLMParameters._non_negative): True = every
+    # non-intercept coefficient >= 0; a list of column names constrains
+    # only those columns (monotone GAM splines ride this)
+    non_negative: Union[bool, Sequence[str]] = False
     # per-column penalty factors {column: factor}; cat columns apply the
     # factor to every one-hot slot (glmnet penalty.factor / GAM penalties)
     penalty_factors: Optional[dict] = None
@@ -395,6 +408,27 @@ class GLM(ModelBuilder):
                 f = p.penalty_factors.get(spec.name)
                 if f is not None:
                     penalize[spec.offset: spec.offset + spec.width] = f
+        nonneg = np.zeros(P, dtype=bool)
+        if p.non_negative is True:
+            nonneg[:] = True
+            if di.add_intercept:
+                nonneg[-1] = False
+        elif p.non_negative:
+            want = set(p.non_negative)
+            matched = set()
+            for spec in di.specs:
+                if spec.name in want:
+                    nonneg[spec.offset: spec.offset + spec.width] = True
+                    matched.add(spec.name)
+            if want - matched:
+                raise ValueError(
+                    f"non_negative names not in the design: "
+                    f"{sorted(want - matched)}")
+        if nonneg.any() and (fam_name in ("multinomial", "ordinal")
+                             or p.solver.lower() in ("l_bfgs", "lbfgs")):
+            raise ValueError("non_negative requires the IRLSM/COD solver "
+                             "on a non-multinomial family")
+        self._nonneg = nonneg if nonneg.any() else None
 
         if fam_name == "ordinal":
             lam0 = 0.0 if p.lambda_ is None else float(np.max(p.lambda_))
@@ -619,7 +653,9 @@ class GLM(ModelBuilder):
                 gram = np.asarray(gram, np.float64)
                 xtwz = np.asarray(xtwz, np.float64)
                 new_beta = _solve_penalized(gram, xtwz, n, lam, p.alpha,
-                                            beta, penalize)
+                                            beta, penalize,
+                                            nonneg=getattr(self, "_nonneg",
+                                                           None))
                 delta = float(np.max(np.abs(new_beta - beta)))
                 beta = new_beta
                 dev_new = float(dev_new)

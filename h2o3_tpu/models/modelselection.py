@@ -1,15 +1,18 @@
 """ModelSelection: best-subset GLM search — ``hex/modelselection`` analog.
 
 Reference: ``hex/modelselection/ModelSelection.java`` with modes maxr
-(sequential-replacement best subset), forward (maxrsweep's greedy
-direction), and backward (drop smallest |z|).  Each candidate subset is a
-GLM fit; the result reports the best predictor subset per size with its
+(sequential-replacement best subset), maxrsweep (same search evaluated
+with sweep operators on the cross-product matrix, ModelSelection.java:89
+/ ModelSelectionUtils sweep implementations — no GLM builds inside the
+search loop), forward (greedy direction), and backward (drop smallest
+|z|).  The result reports the best predictor subset per size with its
 R^2 (gaussian) / deviance metric, mirroring the reference's result frame.
 
 TPU-native redesign: candidate GLMs reuse the device-resident design block
-(the frame matrix cache) and each fit is the usual jit-compiled IRLSM —
-the search is pure host control flow, trivially parallelizable over mesh
-slices later.
+(the frame matrix cache) and each fit is the usual jit-compiled IRLSM;
+maxrsweep computes ONE cross-product matrix on device (an MXU matmul,
+psum-reduced over the row shards) and runs the cheap O(p^2) sweep updates
+on host — the search is pure host control flow.
 """
 
 from __future__ import annotations
@@ -28,13 +31,17 @@ from .glm import GLM, GLMParameters
 
 @dataclasses.dataclass
 class ModelSelectionParameters(Parameters):
-    mode: str = "maxr"                   # maxr | forward | backward
+    mode: str = "maxr"                   # maxr | maxrsweep | forward | backward
     max_predictor_number: int = 0        # 0 = all
     min_predictor_number: int = 1
     family: str = "auto"
     alpha: float = 0.0
     lambda_: float = 0.0
     intercept: bool = True
+    # maxrsweep only: also build a GLM per best subset (reference's
+    # build_glm_model); off by default — the sweeps already yield the
+    # coefficients
+    build_glm_model: bool = False
 
 
 class ModelSelectionModel(Model):
@@ -54,6 +61,11 @@ class ModelSelectionModel(Model):
         })
 
     def best_model(self, size: Optional[int] = None) -> Model:
+        if self.output.get("mode") == "maxrsweep" and not getattr(
+                self.params, "build_glm_model", False):
+            raise ValueError(
+                "maxrsweep ran without build_glm_model=True; read "
+                "coefficients from result()/output['subsets'] instead")
         rows = self.output["subsets"]
         if size is None:
             row = max(rows, key=lambda r: r["metric"])
@@ -159,6 +171,9 @@ class ModelSelection(ModelBuilder):
                 job.update(1 - len(chosen) / len(predictors),
                            f"size {len(chosen)}")
             subsets.reverse()
+        elif p.mode == "maxrsweep":
+            subsets = self._maxrsweep(job, frame, di, p, predictors, maxp,
+                                      fit_subset)
         else:
             raise ValueError(f"unknown mode {p.mode!r}")
 
@@ -167,5 +182,140 @@ class ModelSelection(ModelBuilder):
         model.output["subsets"] = subsets
         model.output["mode"] = p.mode
         best = max(subsets, key=lambda r: r["metric"])
-        model.training_metrics = dkv.get(best["model_key"]).training_metrics
+        if best.get("model_key"):
+            model.training_metrics = dkv.get(
+                best["model_key"]).training_metrics
         return model
+
+    # -- maxrsweep: sweep-operator subset search (ModelSelection.java:89) --
+    @staticmethod
+    def _sweep(M: np.ndarray, idx: Sequence[int]) -> Optional[np.ndarray]:
+        """Symmetric sweep of M on the given pivots; None if singular."""
+        M = M.copy()
+        for k in idx:
+            d = M[k, k]
+            if abs(d) < 1e-10:
+                return None
+            col = M[:, k].copy()
+            rowk = M[k, :].copy()
+            M -= np.outer(col, rowk) / d
+            M[:, k] = col / d
+            M[k, :] = rowk / d
+            M[k, k] = -1.0 / d
+        return M
+
+    def _maxrsweep(self, job: Job, frame: Frame, di, p, predictors, maxp,
+                   fit_subset) -> List[dict]:
+        """maxr's sequential-replacement search, but each candidate subset
+        is scored by sweeping the cross-product matrix instead of fitting
+        a GLM: err(S) = CPM swept on S's design columns (+ intercept),
+        read at the [y, y] cell; coefficients fall out at [cols, y]."""
+        import jax.numpy as jnp
+        if di.is_classifier:
+            raise ValueError("maxrsweep supports regression only "
+                             "(ModelSelection.java:134)")
+        X = di.make_matrix(frame)                  # [padded, cols+icpt]
+        y = di.response(frame)
+        w = di.weights(frame)
+        y = jnp.where(w > 0, jnp.nan_to_num(y), 0.0)
+        Z = jnp.concatenate([X, y[:, None]], axis=1)
+        CPM = np.asarray((Z * w[:, None]).T @ Z, dtype=np.float64)
+        names = di.coef_names                      # expanded design names
+        yi = CPM.shape[0] - 1                      # y cell index
+        icpt = [names.index("Intercept")] if "Intercept" in names else []
+        groups: Dict[str, List[int]] = {}
+        for pred in predictors:
+            groups[pred] = [j for j, nm in enumerate(names)
+                            if nm == pred or nm.startswith(pred + ".")]
+
+        def sweep_cols(M: np.ndarray, cols: Sequence[int]) -> np.ndarray:
+            """Sweep pivots in order, skipping singular ones (empty
+            one-hot levels)."""
+            for k in cols:
+                nxt = self._sweep(M, [k])
+                if nxt is not None:
+                    M = nxt
+            return M
+
+        # incremental search: the classical sweep trick — keep the matrix
+        # swept on the chosen set; evaluating a candidate sweeps ONLY its
+        # own columns (O(g*p^2)), never the whole subset again
+        base = sweep_cols(CPM, icpt)
+        sst = float(base[yi, yi])
+        sse_none = sst if sst > 0 else 1.0
+
+        def r2(sse: float) -> float:
+            return 1.0 - sse / sse_none
+
+        subsets: List[dict] = []
+        chosen: List[str] = []
+        M_chosen = base
+        best_sse = sse_none
+        for size in range(1, maxp + 1):
+            best = None
+            for cand in predictors:
+                if cand in chosen:
+                    continue
+                v = float(sweep_cols(M_chosen, groups[cand])[yi, yi])
+                if best is None or v < best[0]:
+                    best = (v, cand)
+            chosen.append(best[1])
+            best_sse = best[0]
+            M_chosen = sweep_cols(M_chosen, groups[best[1]])
+            if size >= 2:                          # sequential replacement
+                improved = True
+                while improved:
+                    improved = False
+                    for i in range(len(chosen)):
+                        # un-swept base + everything but position i, ONCE;
+                        # each candidate then adds only its own columns
+                        keep = [j for c in chosen if c != chosen[i]
+                                for j in groups[c]]
+                        M_minus = sweep_cols(base, keep)
+                        for cand in predictors:
+                            if cand in chosen:
+                                continue
+                            v = float(sweep_cols(
+                                M_minus, groups[cand])[yi, yi])
+                            if v < best_sse - 1e-10:
+                                chosen[i] = cand
+                                best_sse = v
+                                M_chosen = sweep_cols(M_minus,
+                                                      groups[cand])
+                                improved = True
+                                break
+                        if improved:
+                            break
+            row = {"size": size, "predictors": list(chosen),
+                   "metric": r2(best_sse), "model_key": None}
+            if p.build_glm_model:
+                m = fit_subset(chosen)
+                row["model_key"] = m.key
+            else:
+                cols = icpt + [j for c in chosen for j in groups[c]]
+                M = M_chosen
+                # de-standardize: x_std=(x-m)/s => b_raw=b_std/s and the
+                # intercept absorbs -sum(b_std*m/s) (GLM's reporting units)
+                mean_s = {}
+                for s in di.specs:
+                    if s.type != "cat":
+                        mean_s[s.name] = (s.mean, s.sigma)
+                coefs = {}
+                icpt_adj = 0.0
+                for j in cols:
+                    nm = names[j]
+                    if nm == "Intercept":
+                        continue
+                    b = float(M[j, yi])
+                    if nm in mean_s:
+                        m_, s_ = mean_s[nm]
+                        coefs[nm] = b / s_
+                        icpt_adj += b * m_ / s_
+                    else:
+                        coefs[nm] = b
+                if icpt:
+                    coefs["Intercept"] = float(M[icpt[0], yi]) - icpt_adj
+                row["coefficients"] = coefs
+            subsets.append(row)
+            job.update(size / maxp, f"maxrsweep size {size}/{maxp}")
+        return subsets

@@ -39,7 +39,9 @@ from ...metrics.core import make_metrics
 
 @dataclasses.dataclass
 class GBMParameters(SharedTreeParameters):
-    pass
+    # custom loss UDF (water/udf/CDistributionFunc analog); see
+    # distributions.CustomDistribution for the protocol
+    custom_distribution_func: Optional[object] = None
 
 
 class GBMModel(SharedTreeModel):
@@ -47,11 +49,14 @@ class GBMModel(SharedTreeModel):
 
     def _predict_raw(self, X: jax.Array) -> jax.Array:
         F = self._raw_scores(X)
-        dist = make_distribution(self.output["distribution"],
-                                 nclasses=self.datainfo.nclasses,
-                                 tweedie_power=self.params.tweedie_power,
-                                 quantile_alpha=self.params.quantile_alpha,
-                                 huber_alpha=self.params.huber_alpha)
+        dist = make_distribution(
+            self.output["distribution"],
+            nclasses=self.datainfo.nclasses,
+            tweedie_power=self.params.tweedie_power,
+            quantile_alpha=self.params.quantile_alpha,
+            huber_alpha=self.params.huber_alpha,
+            custom_distribution_func=getattr(
+                self.params, "custom_distribution_func", None))
         if self.datainfo.is_classifier and self.datainfo.nclasses > 2:
             return jax.nn.softmax(F, axis=1)
         if self.datainfo.is_classifier:
@@ -89,8 +94,14 @@ class GBM(SharedTree):
         dist = make_distribution(p.distribution, nclasses=di.nclasses,
                                  tweedie_power=p.tweedie_power,
                                  quantile_alpha=p.quantile_alpha,
-                                 huber_alpha=p.huber_alpha)
+                                 huber_alpha=p.huber_alpha,
+                                 custom_distribution_func=p
+                                 .custom_distribution_func)
         multinomial = isinstance(dist, Multinomial) or K > 1
+        if multinomial and p.custom_distribution_func is not None:
+            raise ValueError(
+                "custom_distribution_func is not supported for multinomial "
+                "responses (the K-tree softmax path has its own gradients)")
         y = di.response(frame)
         w = di.weights(frame)
         from .shared import (resolve_checkpoint, checkpoint_binned,
@@ -251,7 +262,8 @@ class GBM(SharedTree):
                 p.max_depth, p.nbins, binned.nfeatures, N, p.effective_hist_precision,
                 p.sample_rate, p.col_sample_rate_per_tree,
                 hier=use_hier_split_search(p, N) and mono is None,
-                bin_counts=binned.bin_counts, mono=mono)
+                bin_counts=binned.bin_counts, mono=mono,
+                custom_fn=p.custom_distribution_func)
             scalars = (p.reg_lambda, p.min_rows, p.min_split_improvement,
                        p.learn_rate, p.col_sample_rate, p.reg_alpha, p.gamma,
                        p.min_child_weight)

@@ -501,7 +501,7 @@ def make_tree_scan_fn(mode: str, tweedie_power: float, quantile_alpha: float,
                       huber_alpha: float, max_depth: int, nbins: int, F: int,
                       n_padded: int, hist_precision: str, sample_rate: float,
                       col_sample_rate_per_tree: float, hier: bool = False,
-                      bin_counts=None, mono=None):
+                      bin_counts=None, mono=None, custom_fn=None):
     """Scan a CHUNK of boosting/bagging rounds in ONE device dispatch.
 
     The per-tree driver loop (gradients -> row/column sample -> grow ->
@@ -519,7 +519,7 @@ def make_tree_scan_fn(mode: str, tweedie_power: float, quantile_alpha: float,
         dist = make_distribution(
             mode, nclasses=2 if mode == "bernoulli" else 1,
             tweedie_power=tweedie_power, quantile_alpha=quantile_alpha,
-            huber_alpha=huber_alpha)
+            huber_alpha=huber_alpha, custom_distribution_func=custom_fn)
     bt_fn = make_build_tree_fn(max_depth, nbins, F, n_padded, hist_precision,
                                hier=hier, bin_counts=bin_counts, mono=mono)
 

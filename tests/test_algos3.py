@@ -231,3 +231,172 @@ def test_modelselection_maxr_and_backward(cl, rng):
     two = next(i for i in range(resb.nrows) if sizes[i] == 2)
     assert set(resb.vec("predictor_names").decoded()[two]
                .split(", ")) == {"x0", "x2"}
+
+
+def test_modelselection_maxrsweep(cl, rng):
+    """maxrsweep finds the same subsets as maxr via sweep operators, with
+    matching R^2 and coefficients — and no GLM builds in the search."""
+    n = 1500
+    X = rng.normal(size=(n, 5))
+    y = 3 * X[:, 0] - 2 * X[:, 2] + 0.05 * rng.normal(size=n)
+    cols = {**{f"x{j}": X[:, j] for j in range(5)}, "y": y}
+    # a categorical predictor exercises grouped (multi-column) sweeps
+    cols["g"] = np.array([("a", "b", "c")[i % 3] for i in range(n)],
+                         dtype=object)
+    fr = Frame.from_numpy(cols)
+    m = ModelSelection(response_column="y", mode="maxrsweep",
+                       max_predictor_number=3,
+                       family="gaussian").train(fr)
+    res = m.result()
+    assert res.nrows == 3
+    names = res.vec("predictor_names").decoded()
+    assert set(names[1].split(", ")) == {"x0", "x2"}, names
+    r2 = res.vec("best_r2_value").to_numpy()
+    assert r2[1] > 0.99
+    assert np.all(np.diff(r2) >= -1e-9)
+    # coefficients from the swept CPM match the data-generating betas
+    coefs = m.output["subsets"][1]["coefficients"]
+    assert coefs["x0"] == pytest.approx(3.0, abs=0.05)
+    assert coefs["x2"] == pytest.approx(-2.0, abs=0.05)
+    # no GLM models were built in the search
+    assert all(r["model_key"] is None for r in m.output["subsets"])
+    with pytest.raises(ValueError, match="build_glm_model"):
+        m.best_model(2)
+    # build_glm_model=True attaches real GLMs whose R^2 agrees
+    mg = ModelSelection(response_column="y", mode="maxrsweep",
+                        max_predictor_number=2, build_glm_model=True,
+                        family="gaussian").train(fr)
+    best2 = mg.best_model(2)
+    assert best2.coef["x0"] == pytest.approx(3.0, abs=0.05)
+    sweep_r2 = mg.output["subsets"][1]["metric"]
+    glm_r2 = best2.training_metrics.r2
+    assert sweep_r2 == pytest.approx(glm_r2, abs=1e-4)
+
+
+def test_gam_thinplate_splines(cl, rng):
+    """bs='tp': thin-plate smooths, incl. a MULTI-column smooth
+    (ThinPlateRegressionUtils analog)."""
+    from h2o3_tpu.models.gam import GAM
+    n = 1200
+    x = rng.uniform(-2, 2, n)
+    y1 = np.sin(1.7 * x) + 0.05 * rng.normal(size=n)
+    fr1 = Frame.from_numpy({"x": x.astype(np.float32),
+                            "y": y1.astype(np.float32)})
+    m1 = GAM(response_column="y", gam_columns=["x"], bs="tp",
+             num_knots=12, family="gaussian", seed=1).train(fr1)
+    assert m1.training_metrics.r2 > 0.95
+    # 2-D smooth: a radial bump no additive/linear model can capture
+    u, v = rng.uniform(-2, 2, n), rng.uniform(-2, 2, n)
+    y2 = np.exp(-(u ** 2 + v ** 2)) + 0.03 * rng.normal(size=n)
+    fr2 = Frame.from_numpy({"u": u.astype(np.float32),
+                            "v": v.astype(np.float32),
+                            "y": y2.astype(np.float32)})
+    m2 = GAM(response_column="y", gam_columns=[["u", "v"]], bs="tp",
+             num_knots=30, family="gaussian", seed=1).train(fr2)
+    assert m2.training_metrics.r2 > 0.9
+    from h2o3_tpu.models import GLM
+    lin = GLM(response_column="y", family="gaussian",
+              lambda_=0.0).train(fr2)
+    assert m2.training_metrics.r2 > lin.training_metrics.r2 + 0.5
+    # scoring on fresh data works through the same basis
+    preds = m2.predict(fr2)
+    assert preds.nrows == n
+
+
+def test_gam_monotone_isplines(cl, rng):
+    """bs='is': I-spline smooths with non-negative coefficients are
+    monotone non-decreasing everywhere (GamSplines/ISplines +
+    splines_non_negative analog)."""
+    from h2o3_tpu.models.gam import GAM
+    n = 1200
+    x = rng.uniform(0, 4, n)
+    # monotone signal with a flat stretch + noise that tempts wiggles
+    f = np.where(x < 1.5, 0.0, np.where(x < 2.5, 2 * (x - 1.5), 2.0))
+    y = f + 0.15 * rng.normal(size=n)
+    fr = Frame.from_numpy({"x": x.astype(np.float32),
+                           "y": y.astype(np.float32)})
+    m = GAM(response_column="y", gam_columns=["x"], bs="is",
+            num_knots=8, scale=1e-3, family="gaussian", seed=1).train(fr)
+    assert m.training_metrics.r2 > 0.85
+    grid = Frame.from_numpy({
+        "x": np.linspace(0, 4, 200).astype(np.float32),
+        "y": np.zeros(200, np.float32)})
+    g = m.predict(grid).vec("predict").to_numpy()
+    assert np.all(np.diff(g) >= -1e-5), "monotonicity violated"
+    # an unconstrained CRS fit on the same data DOES wiggle downward
+    mc = GAM(response_column="y", gam_columns=["x"], bs="cr",
+             num_knots=8, scale=1e-3, family="gaussian", seed=1).train(fr)
+    gc = mc.predict(grid).vec("predict").to_numpy()
+    assert np.any(np.diff(gc) < -1e-5)
+
+
+def test_glm_non_negative(cl, rng):
+    """GLM non_negative: all-coefficient and per-column constraint."""
+    from h2o3_tpu.models import GLM
+    n = 1500
+    X = rng.normal(size=(n, 3))
+    y = 2 * X[:, 0] - 1.5 * X[:, 1] + 0.05 * rng.normal(size=n)
+    fr = Frame.from_numpy({"a": X[:, 0], "b": X[:, 1], "c": X[:, 2],
+                           "y": y})
+    m = GLM(response_column="y", family="gaussian", lambda_=0.0,
+            non_negative=True).train(fr)
+    assert m.coef["a"] == pytest.approx(2.0, abs=0.1)
+    assert m.coef["b"] >= -1e-8          # clamped at the boundary
+    m2 = GLM(response_column="y", family="gaussian", lambda_=0.0,
+             non_negative=["b"]).train(fr)
+    assert m2.coef["a"] == pytest.approx(2.0, abs=0.1)
+    assert m2.coef["b"] >= -1e-8
+    with pytest.raises(ValueError, match="non_negative"):
+        GLM(response_column="y", family="gaussian", solver="lbfgs",
+            non_negative=True).train(fr)
+
+
+def test_coxph_time_varying_coefficients(cl, rng):
+    """Counting-process episodes + a period x covariate interaction
+    recover a coefficient that CHANGES over time — the reference's
+    _interaction_pairs mechanism (CoxPHModel.java:52) composed with
+    start/stop rows."""
+    from h2o3_tpu.models import CoxPH
+    n = 3000
+    x = rng.normal(size=n)
+    tau, b_early, b_late = 1.5, 1.2, -0.8
+    lam0 = 0.2
+    # inverse-CDF sampling of a piecewise-constant-coefficient hazard
+    E = -np.log(rng.random(n))
+    h_early = lam0 * np.exp(b_early * x)
+    h_late = lam0 * np.exp(b_late * x)
+    T = np.where(E < h_early * tau, E / h_early,
+                 tau + (E - h_early * tau) / h_late)
+    cens = 6.0
+    event = T <= cens
+    T = np.minimum(T, cens)
+    # episode rows: [0, min(T, tau)) as 'early'; (tau, T] as 'late'
+    rows = {"start": [], "stop": [], "event": [], "period": [], "x": []}
+    for i in range(n):
+        rows["start"].append(0.0)
+        rows["stop"].append(min(T[i], tau))
+        rows["event"].append(1.0 if (event[i] and T[i] <= tau) else 0.0)
+        rows["period"].append("early")
+        rows["x"].append(x[i])
+        if T[i] > tau:
+            rows["start"].append(tau)
+            rows["stop"].append(T[i])
+            rows["event"].append(1.0 if event[i] else 0.0)
+            rows["period"].append("late")
+            rows["x"].append(x[i])
+    fr = Frame.from_numpy({
+        "start": np.asarray(rows["start"]),
+        "stop": np.asarray(rows["stop"]),
+        "event": np.asarray(rows["event"]),
+        "period": np.asarray(rows["period"], dtype=object),
+        "x": np.asarray(rows["x"])})
+    m = CoxPH(start_column="start", stop_column="stop",
+              event_column="event",
+              interaction_pairs=[("period", "x")],
+              ignored_columns=["x", "period"]).train(fr)
+    coef = m.output["coef"]
+    assert coef["period.early:x"] == pytest.approx(b_early, abs=0.12)
+    assert coef["period.late:x"] == pytest.approx(b_late, abs=0.15)
+    # scoring a raw (unexpanded) frame re-derives the interaction cols
+    lp = m.predict(fr).vecs[0].to_numpy()
+    assert np.all(np.isfinite(lp))
