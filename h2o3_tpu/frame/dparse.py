@@ -88,6 +88,8 @@ def _read_span(path: str, lo: int, hi: int, skip_header: bool) -> bytes:
     last line it owns.  ``skip_header`` drops the file's header row (only
     meaningful for the span containing byte 0).
     """
+    if "://" in path:
+        return _read_span_persist(path, lo, hi, skip_header)
     with open(path, "rb") as f:
         if lo > 0:
             f.seek(lo - 1)
@@ -102,6 +104,43 @@ def _read_span(path: str, lo: int, hi: int, skip_header: bool) -> bytes:
         if not buf.endswith(b"\n"):
             buf += f.readline()
         return buf
+
+
+_TAIL_CHUNK = 1 << 20
+
+
+def _read_span_persist(uri: str, lo: int, hi: int,
+                       skip_header: bool) -> bytes:
+    """Line-aligned span read over the persist SPI (GCS/S3/HDFS range
+    reads — PersistGcs/PersistS3 load byte ranges the same way)."""
+    from .. import persist
+    be, path = persist.split_uri(uri)
+    total = be.size(path)
+    hi = min(hi, total)
+    buf = be.read_range(path, lo, hi - lo)
+    if lo > 0 and be.read_range(path, lo - 1, 1) != b"\n":
+        nl = buf.find(b"\n")
+        if nl < 0:
+            return b""            # the whole span is an upstream line
+        buf = buf[nl + 1:]
+    elif skip_header:
+        while b"\n" not in buf and lo + len(buf) < total:
+            buf += be.read_range(path, lo + len(buf), _TAIL_CHUNK)
+        nl = buf.find(b"\n")
+        if nl < 0:
+            return b""
+        buf = buf[nl + 1:]
+    # finish the last owned line past hi
+    pos = hi
+    while buf and not buf.endswith(b"\n") and pos < total:
+        ext = be.read_range(path, pos, min(_TAIL_CHUNK, total - pos))
+        nl = ext.find(b"\n")
+        if nl >= 0:
+            buf += ext[: nl + 1]
+            break
+        buf += ext
+        pos += len(ext)
+    return buf
 
 
 # ------------------------------------------------------------------ tokenize
@@ -421,12 +460,26 @@ def parse_files_distributed(paths: Sequence[str],
     col_types = dict(col_types or {})
     sepc = sep if sep is not None else ","
     paths = list(paths)
-    sizes = [os.path.getsize(p) for p in paths]
+    from .. import persist
+
+    def _size(p):
+        if "://" in p:
+            be, rest = persist.split_uri(p)
+            return be.size(rest)
+        return os.path.getsize(p)
+
+    sizes = [_size(p) for p in paths]
 
     # ParseSetup analog: deterministic header/name guess from file 0's head
     # (every process reads the same few bytes — no communication needed).
-    with open(paths[0], "rb") as f:
-        first = f.readline().decode(errors="replace").rstrip("\r\n")
+    if "://" in paths[0]:
+        be0, rest0 = persist.split_uri(paths[0])
+        head = be0.read_range(rest0, 0, min(64 * 1024, sizes[0]))
+        first = head.split(b"\n", 1)[0].decode(errors="replace") \
+            .rstrip("\r\n")
+    else:
+        with open(paths[0], "rb") as f:
+            first = f.readline().decode(errors="replace").rstrip("\r\n")
     import csv as _csv
     try:
         head_cells = [c.strip() for c in

@@ -593,9 +593,16 @@ def import_file(path, destination_frame: Optional[str] = None,
             "avro import needs the fastavro library, which is not in this "
             "build; convert to parquet/orc/csv or install fastavro")
     import jax
-    if (jax.process_count() > 1
-            and all("://" not in p and not p.lower().endswith(
-                (".gz", ".zip", ".bz2", ".xz")) for p in paths)):
+
+    def _rangeable(p: str) -> bool:
+        """Byte-range-capable source: local files and the cloud persist
+        backends with real range reads (GCS/S3/HDFS/file)."""
+        if p.lower().endswith((".gz", ".zip", ".bz2", ".xz")):
+            return False
+        scheme = p.split("://", 1)[0] if "://" in p else ""
+        return scheme in ("", "file", "gs", "gcs", "s3", "hdfs")
+
+    if jax.process_count() > 1 and all(_rangeable(p) for p in paths):
         # pod-scale ingest: tokenize on the hosts that own the byte ranges
         # (MultiFileParseTask analog) instead of replicating the full
         # tokenization on every process
