@@ -95,10 +95,11 @@ class GBM(SharedTree):
                                  tweedie_power=p.tweedie_power,
                                  quantile_alpha=p.quantile_alpha,
                                  huber_alpha=p.huber_alpha,
-                                 custom_distribution_func=p
-                                 .custom_distribution_func)
+                                 custom_distribution_func=getattr(
+                                     p, "custom_distribution_func", None))
         multinomial = isinstance(dist, Multinomial) or K > 1
-        if multinomial and p.custom_distribution_func is not None:
+        if multinomial and getattr(p, "custom_distribution_func",
+                                   None) is not None:
             raise ValueError(
                 "custom_distribution_func is not supported for multinomial "
                 "responses (the K-tree softmax path has its own gradients)")
@@ -263,7 +264,7 @@ class GBM(SharedTree):
                 p.sample_rate, p.col_sample_rate_per_tree,
                 hier=use_hier_split_search(p, N) and mono is None,
                 bin_counts=binned.bin_counts, mono=mono,
-                custom_fn=p.custom_distribution_func)
+                custom_fn=getattr(p, "custom_distribution_func", None))
             scalars = (p.reg_lambda, p.min_rows, p.min_split_improvement,
                        p.learn_rate, p.col_sample_rate, p.reg_alpha, p.gamma,
                        p.min_child_weight)

@@ -227,30 +227,37 @@ def _make_pallas_varbin_hist(L: int, F: int, bin_counts, B: int,
     pad_to = nblk * R
     L3 = planes * L
     dt = jnp.bfloat16 if precision == "bf16" else jnp.float32
+    # PROFILE.md roadmap: stream codes+leaf as int16 and stats as bf16 —
+    # halves the kernel's HBM input bytes.  The VPU cannot compare
+    # sub-32-bit ints (Mosaic), so values upcast in-VMEM after the DMA;
+    # int16 only when every id fits (packed bin ids < Q8, leaf < L).
+    code_dt = jnp.int16 if max(Q8, L) < 32_000 else jnp.int32
+    stat_dt = dt
 
-    def kernel(codes_ref, ls_ref, out_ref):
+    def kernel(codes_ref, leaf_ref, st_ref, out_ref):
         i = pl.program_id(0)
 
         @pl.when(i == 0)
         def _():
             out_ref[:] = jnp.zeros_like(out_ref)
 
-        LS = ls_ref[:]
-        leaf = LS[0].astype(jnp.int32)
+        leaf = leaf_ref[0].astype(jnp.int32)
+        ST = st_ref[:]                                 # [3, R] stat_dt
         cols = jax.lax.broadcasted_iota(jnp.int32, (R, L3), 1)
         l_of, s_of = cols // planes, cols % planes
         match = leaf[:, None] == l_of
-        sv = jnp.where(s_of == 0, LS[1][:, None],
-                       jnp.where(s_of == 1, LS[2][:, None],
-                                 LS[3][:, None]))
+        sv = jnp.where(s_of == 0, ST[0][:, None],
+                       jnp.where(s_of == 1, ST[1][:, None],
+                                 ST[2][:, None]))
         if planes == 4:
-            sv = jnp.where(s_of == 3, jnp.abs(LS[1])[:, None], sv)
+            sv = jnp.where(s_of == 3, jnp.abs(ST[0])[:, None], sv)
         A = jnp.where(match, sv, 0.0).astype(dt)
+        codes = codes_ref[:].astype(jnp.int32)         # [F, R]
         pieces = []
         for f in range(F):
             q_of = jax.lax.broadcasted_iota(
                 jnp.int32, (int(seg_rows[f]), 1), 0) + int(offsets[f])
-            pieces.append((codes_ref[f, :][None, :] == q_of).astype(dt))
+            pieces.append((codes[f, :][None, :] == q_of).astype(dt))
         OHT = jnp.concatenate(pieces, axis=0)          # [Q8, R]
         out_ref[:] += jnp.dot(OHT, A, preferred_element_type=jnp.float32)
 
@@ -259,7 +266,8 @@ def _make_pallas_varbin_hist(L: int, F: int, bin_counts, B: int,
         grid=(nblk,),
         in_specs=[
             pl.BlockSpec((F, R), lambda i: (0, i), memory_space=pltpu.VMEM),
-            pl.BlockSpec((4, R), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, R), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, R), lambda i: (0, i), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((Q8, L3), lambda i: (0, 0),
                                memory_space=pltpu.VMEM),
@@ -271,25 +279,35 @@ def _make_pallas_varbin_hist(L: int, F: int, bin_counts, B: int,
     def local(gcodes, leaf, g, h, w):
         pad = pad_to - n_local
 
-        def padr(x):
+        def padr(x, fill):
             if pad == 0:
                 return x
             return jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad)],
-                           constant_values=-1)
-        LS = jnp.stack([leaf.astype(jnp.float32), g, h, w], axis=0)
-        return call(padr(gcodes), padr(LS))            # [Q8, pL]
+                           constant_values=fill)
+        # casts fuse into the per-level leaf/grad producers; gcodes are
+        # already code_dt from offset_codes (no per-level copy)
+        ST = jnp.stack([g, h, w], axis=0).astype(stat_dt)
+        return call(padr(gcodes.astype(code_dt), -1),
+                    padr(leaf[None].astype(code_dt), -1),
+                    padr(ST, 0))                       # [Q8, pL]
 
     return local
 
 
 def offset_codes(codes, bin_counts, nbins: int):
     """codes [F, N] (NA == nbins) -> packed global bin ids for the varbin
-    kernel.  Done once per tree by the build driver."""
-    offsets, _, _, _ = varbin_layout(bin_counts, nbins + 1)
+    kernel.  Done once per tree by the build driver.  Emitted as int16
+    when every packed id fits — the ids persist in HBM across all levels
+    of the tree, so the narrow dtype halves the histogram kernel's
+    dominant streaming input for the whole build."""
+    offsets, _, Q8, _ = varbin_layout(bin_counts, nbins + 1)
     off = jnp.asarray(offsets)[:, None]
     bf = jnp.asarray([min(b, nbins) for b in bin_counts],
                      jnp.int32)[:, None]
-    return jnp.where(codes >= nbins, off + bf, codes + off)
+    out = jnp.where(codes >= nbins, off + bf, codes + off)
+    if Q8 < 32_000:
+        out = out.astype(jnp.int16)
+    return out
 
 
 @functools.lru_cache(maxsize=None)
