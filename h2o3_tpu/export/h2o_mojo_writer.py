@@ -1,0 +1,319 @@
+"""Writer for REAL H2O-3 MOJO archives (GBM / DRF / XGBoost-as-GBM / GLM).
+
+The deployment contract (SURVEY §2.7) is *bidirectional* portability:
+``export/h2o_mojo.py`` imports reference-produced MOJOs; this module is the
+inverse — models trained here are written in the reference's own zip format
+(``hex/ModelMojoWriter.java:1``) so the reference's genmodel (and this repo's
+own format reader) can score them.
+
+Format pinning: ``mojo_version = 1.30`` for tree models (the current
+SharedTreeMojoModel node-stream layout — nodeType masks, little-endian skip
+offsets, bare-float leaf children; ``SharedTreeMojoModel.java:134``) and
+``1.00`` for GLM (coefficients inline in model.ini; ``GlmMojoModel.java:26``).
+The ini key surface mirrors a reference-produced archive (see the golden
+fixtures under ``h2o-genmodel/src/test/resources``).
+
+Semantics notes (documented deltas, all exactness-tested in
+``tests/test_h2o_mojo_writer.py``):
+ - Tree splits are always numeric threshold splits (``d >= split``) on the
+   domain code for categoricals — this framework's trees are ordinal-split
+   (hist.py bins cat codes), and the reference walker scores numeric splits
+   on categorical columns natively, so scoring is exact.
+ - GBM multinomial: the per-class init scores are folded into the first
+   tree's leaves of each class (softmax is shift-per-class invariant in the
+   folded form: sum_t leaf + init_k is preserved), since the reference
+   multinomial path reads no init_f.
+ - GLM: this framework learns an explicit ``.missing(NA)`` coefficient per
+   categorical; the reference format has no NA bucket, so rows with missing
+   categoricals score as "contribute 0" (reference semantics) rather than
+   the NA-bucket coefficient.  Rows without missing categoricals are exact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+import zipfile
+from typing import List
+
+import numpy as np
+
+_MOJO_TREE_VERSION = "1.30"
+_MOJO_GLM_VERSION = "1.00"
+_NA_LEFT, _NA_RIGHT = 2, 3
+
+
+# -------------------------------------------------------------- tree bytecode
+
+def encode_tree(tree, depth: int) -> bytes:
+    """Serialize one per-level-array Tree to the reference node stream.
+
+    Inverse of ``h2o_mojo._score_tree``: nodeType byte (left-leaf 0x30 /
+    skip-size bits 0..3, right-leaf 0xC0), colId u16, NA-direction byte,
+    float32 split, little-endian left-subtree size, then the subtrees
+    (leaf children are bare float32 payloads).
+    """
+    feat = [np.asarray(a) for a in tree.feat]
+    thr = [np.asarray(a) for a in tree.thr]
+    na_left = [np.asarray(a) for a in tree.na_left]
+    valid = [np.asarray(a) for a in tree.valid]
+    values = np.asarray(tree.values)
+
+    def is_leaf(d: int, i: int) -> bool:
+        return d == depth or not bool(valid[d][i])
+
+    def leaf_value(d: int, i: int) -> bytes:
+        # invalid subtrees descend left: leaf index doubles per level
+        return struct.pack("<f", float(values[i << (depth - d)]))
+
+    def enc(d: int, i: int) -> bytes:
+        lkid, rkid = 2 * i, 2 * i + 1
+        lleaf, rleaf = is_leaf(d + 1, lkid), is_leaf(d + 1, rkid)
+        left = leaf_value(d + 1, lkid) if lleaf else enc(d + 1, lkid)
+        right = leaf_value(d + 1, rkid) if rleaf else enc(d + 1, rkid)
+        nt = 0
+        if lleaf:
+            nt |= 0x30
+            offs = b""
+        else:
+            n = len(left)
+            nbytes = 1 if n < 1 << 8 else 2 if n < 1 << 16 else \
+                3 if n < 1 << 24 else 4
+            nt |= nbytes - 1
+            offs = n.to_bytes(nbytes, "little")
+        if rleaf:
+            nt |= 0xC0
+        col = int(feat[d][i])
+        head = bytes([nt, col & 0xFF, (col >> 8) & 0xFF,
+                      _NA_LEFT if na_left[d][i] else _NA_RIGHT])
+        return head + struct.pack("<f", float(thr[d][i])) + offs + left + right
+
+    if is_leaf(0, 0):
+        return bytes([0, 0xFF, 0xFF]) + leaf_value(0, 0)
+    return enc(0, 0)
+
+
+# ----------------------------------------------------------------- model.ini
+
+def _format_val(v) -> str:
+    if v is None:
+        return "null"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (list, tuple, np.ndarray)):
+        return "[" + ", ".join(
+            str(x) if isinstance(x, (int, np.integer)) else repr(float(x))
+            for x in v) + "]"
+    if isinstance(v, float):
+        return repr(v)
+    return str(v)
+
+
+def _build_ini(info: dict, columns: List[str], domains: dict) -> str:
+    lines = ["[info]"]
+    for k, v in info.items():
+        lines.append(f"{k} = {_format_val(v)}")
+    lines.append("")
+    lines.append("[columns]")
+    lines.extend(columns)
+    lines.append("")
+    lines.append("[domains]")
+    for k, idx in enumerate(sorted(domains)):
+        lines.append(f"{idx}: {len(domains[idx])} d{k:03d}.txt")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def _write_archive(path: str, info: dict, columns: List[str],
+                   domains: dict, blobs: dict) -> str:
+    """domains: {col_index: levels}; blobs: {zip_name: bytes}."""
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr("model.ini", _build_ini(info, columns, domains))
+        for k, idx in enumerate(sorted(domains)):
+            for lvl in domains[idx]:
+                if "\n" in str(lvl):
+                    raise ValueError(
+                        f"domain level with newline not exportable: {lvl!r}")
+            zf.writestr(f"domains/d{k:03d}.txt",
+                        "\n".join(str(x) for x in domains[idx]))
+        for name, data in blobs.items():
+            zf.writestr(name, data)
+    return path
+
+
+def _common_info(model, algo: str) -> tuple:
+    """(info dict, columns, domains) shared by all families."""
+    from ..frame.vec import T_CAT
+    di = model.datainfo
+    specs = list(di.specs)
+    columns = [s.name for s in specs]
+    domains = {j: list(s.domain) for j, s in enumerate(specs)
+               if s.type == T_CAT and s.domain}
+    n_features = len(specs)
+    nclasses = di.nclasses
+    if di.response_column:
+        columns.append(di.response_column)
+        if di.response_domain:
+            domains[n_features] = list(di.response_domain)
+    category = ("Binomial" if nclasses == 2 else
+                "Multinomial" if nclasses > 2 else "Regression")
+    info = {
+        "h2o_version": "3.46.0.1",
+        "mojo_version": _MOJO_TREE_VERSION,
+        "license": "Apache License Version 2.0",
+        "algo": algo,
+        "endianness": "LITTLE_ENDIAN",
+        "category": category,
+        "supervised": True,
+        "n_features": n_features,
+        "n_classes": max(nclasses, 1),
+        "n_columns": len(columns),
+        "n_domains": len(domains),
+        "balance_classes": False,
+        "default_threshold": float(model.default_threshold())
+        if nclasses == 2 else 0.5,
+    }
+    return info, columns, domains
+
+
+def _tree_matrix(model) -> List[List]:
+    """[group][class] host Tree objects + per-class init folding plan."""
+    trees = list(model.output["trees"])
+    K = model.output.get("nclass_trees", 1)
+    if K > 1:
+        return [[t[k] for k in range(K)] for t in trees], K
+    return [[t] for t in trees], 1
+
+
+def write_tree_mojo(model, path: str) -> str:
+    """GBM / DRF / XGBoost model -> reference-format shared-tree MOJO zip.
+
+    XGBoost models export with ``algo = gbm`` — this framework's XGBoost is
+    the same additive-margin family (sigmoid/identity link over summed
+    leaves), which is exactly the reference gbm scoring contract; the
+    reference's own xgboost MOJO format is a native-booster dump that does
+    not apply here.
+    """
+    algo = "drf" if model.algo == "drf" else "gbm"
+    info, columns, domains = _common_info(model, algo)
+    matrix, K = _tree_matrix(model)
+    depth = model.params.max_depth
+    init = np.atleast_1d(np.asarray(model.output["init_score"],
+                                    np.float64)).copy()
+    dist = model.output.get("distribution", "gaussian")
+    nclasses = info["n_classes"]
+    if algo == "gbm":
+        if K > 1:
+            # fold per-class init into the first round's leaves
+            matrix = [list(g) for g in matrix]
+            matrix[0] = [
+                dataclasses.replace(
+                    t, values=np.asarray(t.values, np.float32)
+                    + np.float32(init[k]))
+                for k, t in enumerate(matrix[0])]
+            info["init_f"] = 0.0
+            info["distribution"] = "multinomial"
+        else:
+            info["init_f"] = float(init[0])
+            info["distribution"] = ("bernoulli" if nclasses == 2 and
+                                    dist not in ("quasibinomial",)
+                                    else dist)
+        info["link_function"] = {
+            "bernoulli": "logit", "quasibinomial": "logit",
+            "poisson": "log", "gamma": "log", "tweedie": "log",
+        }.get(info["distribution"], "identity")
+    else:
+        info["init_f"] = 0.0
+        info["distribution"] = dist
+        info["link_function"] = "identity"
+        if nclasses == 2:
+            info["binomial_double_trees"] = False
+    info["n_trees"] = len(matrix)
+    info["n_trees_per_class"] = K
+    blobs = {}
+    for group, per_class in enumerate(matrix):
+        for cls, tree in enumerate(per_class):
+            blobs[f"trees/t{cls:02d}_{group:03d}.bin"] = \
+                encode_tree(tree, depth)
+    return _write_archive(path, info, columns, domains, blobs)
+
+
+def write_glm_mojo(model, path: str) -> str:
+    """GLM model -> reference-format GLM MOJO (coefficients in model.ini).
+
+    Columns are emitted categoricals-first (the reference GLM layout,
+    ``GlmMojoModel.java:26``); the learned per-cat NA-bucket coefficient has
+    no reference representation and is dropped (see module docstring).
+    """
+    from ..frame.vec import T_CAT
+    di = model.datainfo
+    fam = model.output["family"]
+    if fam == "multinomial":
+        raise ValueError("reference GLM MOJO format is binomial/regression "
+                         "only (GlmMojoModel.score0)")
+    cat_specs = [s for s in di.specs if s.type == T_CAT]
+    num_specs = [s for s in di.specs if s.type != T_CAT]
+    beta = np.asarray(model.output["beta"], np.float64)
+
+    # per-spec slices of this framework's interleaved layout
+    h2o_beta: List[float] = []
+    cat_offsets = [0]
+    for s in cat_specs:
+        h2o_beta.extend(beta[s.offset: s.offset + s.width - 1])  # drop NA
+        cat_offsets.append(len(h2o_beta))
+    for s in num_specs:
+        h2o_beta.append(float(beta[s.offset]))
+    h2o_beta.append(float(beta[-1]) if di.add_intercept else 0.0)
+
+    specs = cat_specs + num_specs
+    columns = [s.name for s in specs]
+    domains = {j: list(s.domain) for j, s in enumerate(specs)
+               if s.type == T_CAT and s.domain}
+    if di.response_column:
+        columns.append(di.response_column)
+        if di.response_domain:
+            domains[len(specs)] = list(di.response_domain)
+    nclasses = di.nclasses
+    link = {"binomial": "logit", "quasibinomial": "logit",
+            "fractionalbinomial": "logit", "poisson": "log",
+            "gamma": "log", "tweedie": "log",
+            "negativebinomial": "log"}.get(fam, "identity")
+    info = {
+        "h2o_version": "3.46.0.1",
+        "mojo_version": _MOJO_GLM_VERSION,
+        "license": "Apache License Version 2.0",
+        "algo": "glm",
+        "endianness": "LITTLE_ENDIAN",
+        "category": "Binomial" if nclasses == 2 else "Regression",
+        "supervised": True,
+        "n_features": len(specs),
+        "n_classes": max(nclasses, 1),
+        "n_columns": len(columns),
+        "n_domains": len(domains),
+        "balance_classes": False,
+        "default_threshold": float(model.default_threshold())
+        if nclasses == 2 else 0.5,
+        "family": "binomial" if fam in ("binomial", "quasibinomial",
+                                        "fractionalbinomial") else fam,
+        "link": link,
+        "beta": h2o_beta,
+        "cats": len(cat_specs),
+        "cat_offsets": [int(x) for x in cat_offsets],
+        "nums": len(num_specs),
+        "use_all_factor_levels": bool(di.use_all_factor_levels),
+        "mean_imputation": True,
+        "num_means": [float(s.mean) for s in num_specs],
+        "cat_modes": [-1.0] * len(cat_specs),
+    }
+    return _write_archive(path, info, columns, domains, {})
+
+
+def write_h2o_mojo(model, path: str) -> str:
+    """Dispatch: model trained here -> reference-format MOJO archive."""
+    if model.algo in ("gbm", "drf", "xgboost"):
+        return write_tree_mojo(model, path)
+    if model.algo == "glm":
+        return write_glm_mojo(model, path)
+    raise ValueError(
+        f"no reference MOJO format writer for algo {model.algo!r} "
+        "(gbm, drf, xgboost, glm are supported)")

@@ -1,0 +1,79 @@
+"""Rehearsal tests for bench.py's robustness contract (VERDICT r03 weak #1).
+
+The driver runs `python bench.py` under an outer wall clock; the r03 round was
+lost because the orchestrator's per-attempt timeouts summed past that clock.
+These tests rehearse the failure modes locally and assert the contract: one
+JSON record on stdout, rc=0, inside the configured total budget.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+BENCH = os.path.join(os.path.dirname(__file__), "..", "bench.py")
+
+
+def _run(env_overrides, outer_timeout):
+    env = os.environ.copy()
+    env.update(env_overrides)
+    t0 = time.time()
+    r = subprocess.run([sys.executable, BENCH], env=env,
+                       timeout=outer_timeout, capture_output=True, text=True)
+    return r, time.time() - t0
+
+
+def _record(r):
+    assert r.returncode == 0, r.stderr[-2000:]
+    recs = [json.loads(ln) for ln in r.stdout.strip().splitlines()
+            if ln.startswith("{")]
+    assert len(recs) == 1
+    assert recs[0]["metric"] == "xgboost_trees_per_sec_airlines10m_shape"
+    return recs[0]
+
+
+@pytest.mark.slow
+def test_hung_primary_still_lands_record():
+    """Primary worker hangs forever -> orchestrator kills it at the budget
+    split, CPU fallback emits the record, total stays under the budget."""
+    r, wall = _run({
+        "H2O3_BENCH_TEST_HANG": "1",            # primary sleeps 10,000 s
+        "H2O3_BENCH_TOTAL_BUDGET": "420",
+        "H2O3_BENCH_FALLBACK_RESERVE": "390",
+        "H2O3_BENCH_CPU_ROWS": "20000",
+        "H2O3_BENCH_CPU_TREES": "3",
+    }, outer_timeout=420)
+    rec = _record(r)
+    assert wall < 420
+    assert rec["extra"]["platform"] == "cpu"
+    assert rec["extra"]["secondaries"] == "skipped"
+    assert "primary_attempt" in rec["extra"]["fallback_errors"]
+    assert rec["value"] > 0
+
+
+@pytest.mark.slow
+def test_everything_dead_emits_zero_record():
+    """Even when both attempts die instantly, a record lands rc=0."""
+    r, wall = _run({
+        "H2O3_BENCH_TEST_HANG": "1",
+        "H2O3_BENCH_TOTAL_BUDGET": "70",        # reserve clamps to budget-60
+        "H2O3_BENCH_FALLBACK_RESERVE": "600",
+        "H2O3_BENCH_CPU_ROWS": "100000000",     # fallback can't finish in 60s
+        "H2O3_BENCH_CPU_TREES": "50",
+    }, outer_timeout=300)
+    rec = _record(r)
+    assert rec["value"] == 0.0
+    assert rec["extra"]["platform"] == "none"
+    assert "cpu_attempt" in rec["extra"]["fallback_errors"]
+
+
+def test_budget_arithmetic_is_total_not_per_attempt():
+    """Static check: the orchestrator derives both attempt timeouts from one
+    deadline (the r03 bug was per-attempt 2700 s x 2)."""
+    src = open(BENCH).read()
+    assert "H2O3_BENCH_TOTAL_BUDGET" in src
+    assert "deadline - time.time()" in src
+    assert "H2O3_BENCH_TIMEOUT" not in src      # the old per-attempt knob

@@ -1,0 +1,105 @@
+"""No-hardware Mosaic lowering gate (VERDICT r03 next-step #2).
+
+Interpret mode lies: the real Mosaic compiler rejects programs interpret
+mode accepts (PROFILE.md — f32 iotas, unit-minor-dim iota vectors).  This
+gate cross-platform-lowers every histogram-kernel geometry bench.py uses
+via ``jax.export(..., platforms=["tpu"])`` on the CPU host: Pallas runs its
+TPU lowering + the Mosaic MLIR verifier at export time, so an illegal iota
+form / op signature in ``hist.py`` fails HERE, without a chip.  (Verified:
+a unit-minor-dim f32 iota raises VerificationError at export in this
+image.)  The residual risk is the Mosaic *compiler* pass pipeline
+(layout inference etc.), which only runs on a real backend — bench.py's
+warmup covers that when the tunnel is up.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import export as jexport
+
+import h2o3_tpu
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _init():
+    h2o3_tpu.init()
+
+
+# bench.py's airlines shape: 8 features, nbins=256 -> B=257, depth 6.
+# bin_counts mirror fit_bins on make_airlines_like: small-cardinality
+# numerics (year/month/day), full-bin numerics, a 22-level cat, capped cats.
+BENCH_BIN_COUNTS = (21, 12, 7, 256, 256, 22, 256, 256)
+F, B, NBINS = 8, 257, 256
+N_PADDED = 10_000_000 - (10_000_000 % (8 * 512))  # divisible by mesh*tile
+BENCH_LEVELS = (1, 4, 32)                          # depth-6 level widths
+
+
+def _lower_tpu(jitted, *arg_shapes):
+    args = [jax.ShapeDtypeStruct(s, d) for s, d in arg_shapes]
+    exp = jexport.export(jitted, platforms=["tpu"])(*args)
+    assert len(exp.mlir_module_serialized) > 0
+    return exp
+
+
+def _stat_shapes(n):
+    return ((F, n), jnp.int16), ((n,), jnp.int32), \
+        ((n,), jnp.float32), ((n,), jnp.float32), ((n,), jnp.float32)
+
+
+def test_varbin_int16_bf16_kernel_lowers_for_tpu():
+    """The exact kernel path bench.py times (varbin + int16 codes + bf16
+    stats), at every level width of a depth-6 build."""
+    from h2o3_tpu.models.tree.hist import make_varbin_hist_fn
+    for L in BENCH_LEVELS:
+        fn = make_varbin_hist_fn(L, F, BENCH_BIN_COUNTS, B, N_PADDED)
+        _lower_tpu(fn, *_stat_shapes(N_PADDED))
+
+
+def test_varbin_f32_kernel_lowers_for_tpu():
+    """reproducible=True forces f32 stat streaming — lower that too."""
+    from h2o3_tpu.models.tree.hist import make_varbin_hist_fn
+    fn = make_varbin_hist_fn(8, F, BENCH_BIN_COUNTS, B, N_PADDED,
+                             precision="f32")
+    _lower_tpu(fn, *_stat_shapes(N_PADDED))
+
+
+def test_uniform_kernel_lowers_for_tpu():
+    """The uniform-bin kernel (hist_type without per-feature bins), both
+    the shallow and deep-L variants."""
+    from h2o3_tpu.models.tree.hist import make_hist_fn
+    for L in (1, 32):
+        fn = make_hist_fn(L, F, B, N_PADDED)
+        codes = ((F, N_PADDED), jnp.int32)
+        rest = _stat_shapes(N_PADDED)[1:]
+        _lower_tpu(fn, codes, *rest)
+
+
+def test_hier_fine_kernel_lowers_for_tpu():
+    """Opt-in split_search='hier' fine-refinement kernel."""
+    from h2o3_tpu.models.tree.hist import make_fine_hist_fn
+    W, K = 16, 2
+    fn = make_fine_hist_fn(4, F, W, K, NBINS, N_PADDED)
+    codes = ((F, N_PADDED), jnp.int32)
+    leaf, g, h, w = _stat_shapes(N_PADDED)[1:]
+    sel = ((4, F, K), jnp.int32)
+    _lower_tpu(fn, codes, leaf, g, h, w, sel)
+
+
+def test_export_catches_known_mosaic_violation():
+    """Meta-test: the gate actually rejects the iota form PROFILE.md
+    documents as interpret-accepted / chip-rejected — proving the gate
+    sees Mosaic verification, not just StableHLO emission."""
+    from jax.experimental import pallas as pl
+
+    def bad_kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] + jax.lax.broadcasted_iota(
+            jnp.float32, (128, 1), 0)
+
+    def f(x):
+        return pl.pallas_call(bad_kernel, out_shape=jax.ShapeDtypeStruct(
+            (128, 1), jnp.float32))(x)
+
+    with pytest.raises(Exception, match="iota|Verification"):
+        jexport.export(jax.jit(f), platforms=["tpu"])(
+            jax.ShapeDtypeStruct((128, 1), jnp.float32))
