@@ -21,6 +21,9 @@ from .frame.parse import (import_file, parse_csv, parse_files,
                           parse_svmlight, parse_arff, export_file,
                           upload_string, from_pandas, H2OFrame)
 from .frame.sql import import_sql_table, import_sql_select
+from .frame.hive import import_hive_table, import_hive_metadata
+from .frame.create import (create_frame, insert_missing_values, interaction,
+                           tabulate, dct_transform)
 from .datasets import load_dataset
 from .export.mojo import import_mojo
 

@@ -1,0 +1,212 @@
+"""REST authentication: pluggable authn SPI + form-login sessions + HTTPS.
+
+Reference surface: ``h2o-security/`` and ``h2o-jaas-pam/`` give H2O's Jetty
+server hash-file login, LDAP, Kerberos, PAM and form login
+(``water/webserver/jetty9/Jetty9ServerAdapter`` wires the LoginService;
+``hash_login`` / ``ldap_login`` / ``pam_login`` flags in
+``water.H2O.OptArgs``).  TPU-native redesign: authentication is a small SPI
+(`Authenticator.check`) in front of the stdlib HTTP server, with three
+built-ins and a module hook so enterprise backends (LDAP/Kerberos) can be
+plugged without changing framework code — those live behind site modules
+because this image has no directory server to speak to.
+
+Spec strings (the ``-hash_login``-style CLI surface, env
+``H2O3_TPU_AUTH``):
+  ``static:<user>:<password>``     single credential pair
+  ``hash_file:<path>``             htpasswd-style file of ``user:pbkdf2``
+                                   records (make them with `hash_password`)
+  ``cmd:<executable>``             external verifier — username as argv[1],
+                                   password on stdin, exit 0 = authenticated
+                                   (the PAM/LDAP escape hatch)
+  ``module:<pkg.attr>``            import an Authenticator instance/factory
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import os
+import secrets
+import subprocess
+import time
+from typing import Dict, Optional
+
+_PBKDF2_ITERS = 120_000
+
+
+def hash_password(password: str, iters: int = _PBKDF2_ITERS) -> str:
+    """One hash-file record value: ``pbkdf2_sha256$iters$salt$hex``."""
+    salt = secrets.token_hex(16)
+    dk = hashlib.pbkdf2_hmac("sha256", password.encode(), salt.encode(),
+                             iters)
+    return f"pbkdf2_sha256${iters}${salt}${dk.hex()}"
+
+
+def _verify_hash(password: str, record: str) -> bool:
+    try:
+        scheme, iters, salt, want = record.strip().split("$")
+        if scheme != "pbkdf2_sha256":
+            return False
+        dk = hashlib.pbkdf2_hmac("sha256", password.encode(), salt.encode(),
+                                 int(iters))
+        return hmac.compare_digest(dk.hex(), want)
+    except (ValueError, AttributeError):
+        return False
+
+
+class Authenticator:
+    """SPI: return True iff (username, password) is a valid login."""
+
+    name = "base"
+
+    def check(self, username: str, password: str) -> bool:  # pragma: no cover
+        raise NotImplementedError
+
+
+class StaticAuthenticator(Authenticator):
+    name = "static"
+
+    def __init__(self, username: str, password: str):
+        self._user, self._password = username, password
+
+    def check(self, username: str, password: str) -> bool:
+        return (hmac.compare_digest(username, self._user)
+                and hmac.compare_digest(password, self._password))
+
+
+class HashFileAuthenticator(Authenticator):
+    """``user:pbkdf2_sha256$...`` per line — the `hash_login` analog.
+
+    The file is re-read when its mtime changes, so operators can rotate
+    credentials without restarting the server.
+    """
+
+    name = "hash_file"
+
+    def __init__(self, path: str):
+        self.path = path
+        self._mtime = -1.0
+        self._records: Dict[str, str] = {}
+        self._load()
+
+    def _load(self):
+        mtime = os.stat(self.path).st_mtime
+        if mtime == self._mtime:
+            return
+        records = {}
+        with open(self.path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                user, _, rec = line.partition(":")
+                records[user] = rec
+        self._records, self._mtime = records, mtime
+
+    def check(self, username: str, password: str) -> bool:
+        self._load()
+        rec = self._records.get(username)
+        return bool(rec) and _verify_hash(password, rec)
+
+
+class CommandAuthenticator(Authenticator):
+    """Delegate to an external verifier — the PAM/LDAP/Kerberos hook.
+
+    Contract: ``<cmd> <username>`` with the password on stdin; exit code 0
+    means authenticated.  A site wraps ``pamtester`` / ``ldapwhoami`` /
+    ``kinit`` in a 3-line script and points ``H2O3_TPU_AUTH=cmd:...`` at
+    it — no framework change for a new enterprise backend.
+    """
+
+    name = "cmd"
+
+    def __init__(self, cmd: str, timeout_s: float = 10.0):
+        self.cmd = cmd
+        self.timeout_s = timeout_s
+
+    def check(self, username: str, password: str) -> bool:
+        if "\x00" in username or "\n" in username:
+            return False
+        try:
+            r = subprocess.run([self.cmd, username],
+                               input=password.encode(),
+                               timeout=self.timeout_s,
+                               stdout=subprocess.DEVNULL,
+                               stderr=subprocess.DEVNULL)
+            return r.returncode == 0
+        except Exception:               # noqa: BLE001 — verifier died = deny
+            return False
+
+
+def resolve_authenticator(spec) -> Optional[Authenticator]:
+    """Spec string / instance / None -> Authenticator (see module doc)."""
+    if spec is None or isinstance(spec, Authenticator):
+        return spec
+    kind, _, rest = str(spec).partition(":")
+    if kind == "static":
+        user, _, password = rest.partition(":")
+        return StaticAuthenticator(user, password)
+    if kind == "hash_file":
+        return HashFileAuthenticator(rest)
+    if kind == "cmd":
+        return CommandAuthenticator(rest)
+    if kind == "module":
+        import importlib
+        mod, _, attr = rest.rpartition(".")
+        obj = getattr(importlib.import_module(mod), attr)
+        return obj() if isinstance(obj, type) else obj
+    raise ValueError(f"unknown authenticator spec {spec!r} "
+                     "(static:/hash_file:/cmd:/module: are supported)")
+
+
+class SessionStore:
+    """Server-side form-login sessions (the Jetty session analog)."""
+
+    def __init__(self, ttl_s: float = 8 * 3600.0):
+        self.ttl_s = ttl_s
+        self._sessions: Dict[str, tuple] = {}     # token -> (user, expiry)
+
+    def create(self, username: str) -> str:
+        now = time.time()
+        # sweep expired sessions here so a login loop cannot grow the
+        # store without bound on a long-lived coordinator
+        expired = [t for t, (_, exp) in self._sessions.items() if now > exp]
+        for t in expired:
+            self._sessions.pop(t, None)
+        token = secrets.token_urlsafe(32)
+        self._sessions[token] = (username, now + self.ttl_s)
+        return token
+
+    def user_for(self, token: str) -> Optional[str]:
+        entry = self._sessions.get(token)
+        if entry is None:
+            return None
+        user, expiry = entry
+        if time.time() > expiry:
+            self._sessions.pop(token, None)
+            return None
+        return user
+
+    def destroy(self, token: str):
+        self._sessions.pop(token, None)
+
+
+def parse_basic(header: str) -> Optional[tuple]:
+    """'Basic base64(user:pass)' -> (user, pass) or None."""
+    if not header.startswith("Basic "):
+        return None
+    try:
+        user, _, password = base64.b64decode(
+            header[6:]).decode().partition(":")
+        return user, password
+    except Exception:                   # noqa: BLE001 — malformed header
+        return None
+
+
+def parse_cookie(header: str, name: str) -> Optional[str]:
+    for part in (header or "").split(";"):
+        k, _, v = part.strip().partition("=")
+        if k == name:
+            return v
+    return None

@@ -15,6 +15,7 @@ client maps 1:1: /3/Cloud, /3/Jobs, /3/Frames, /3/Parse, /3/ModelBuilders/
 from __future__ import annotations
 
 import json
+import os
 import re
 import threading
 import traceback
@@ -92,22 +93,52 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     def _authorized(self) -> bool:
-        """HTTP Basic auth when the server has credentials configured —
-        the hash-login analog of the reference's h2o-security module
-        (LDAP/Kerberos are deployment-infra concerns left to the proxy)."""
-        creds = getattr(self.server, "basic_auth", None)
-        if not creds:
+        """Pluggable authn (api/auth.py SPI): a valid form-login session
+        cookie OR HTTP Basic checked against the configured Authenticator.
+        Reference surface: h2o-security / h2o-jaas-pam login services."""
+        authn = getattr(self.server, "authenticator", None)
+        if authn is None:
             return True
-        import base64
-        import hmac
-        hdr = self.headers.get("Authorization", "")
-        if not hdr.startswith("Basic "):
-            return False
-        try:
-            got = base64.b64decode(hdr[6:]).decode()
-        except Exception:
-            return False
-        return hmac.compare_digest(got, creds)
+        from . import auth as _auth
+        sessions = self.server.sessions
+        token = _auth.parse_cookie(self.headers.get("Cookie", ""),
+                                   "h2o3-session")
+        if token and sessions.user_for(token):
+            return True
+        creds = _auth.parse_basic(self.headers.get("Authorization", ""))
+        return bool(creds) and authn.check(*creds)
+
+    def _do_login(self, params: dict):
+        """POST /3/Login (form fields username/password) -> session cookie.
+
+        The form-login flow (h2o-security LoginHandler analog): Flow and
+        browser clients authenticate once and carry the cookie."""
+        from . import auth as _auth
+        authn = self.server.authenticator
+        user = str(params.get("username", ""))
+        password = str(params.get("password", ""))
+        if authn is None or authn.check(user, password):
+            body = json.dumps({"login": "ok", "username": user}).encode()
+            self.send_response(200)
+            if authn is not None:
+                token = self.server.sessions.create(user)
+                self.send_header(
+                    "Set-Cookie",
+                    f"h2o3-session={token}; HttpOnly; Path=/; SameSite=Lax")
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self._reply(401, {"error": "invalid credentials"})
+
+    def _do_logout(self):
+        from . import auth as _auth
+        token = _auth.parse_cookie(self.headers.get("Cookie", ""),
+                                   "h2o3-session")
+        if token:
+            self.server.sessions.destroy(token)
+        self._reply(200, {"logout": "ok"})
 
     def _reply(self, code: int, payload: dict):
         body = json.dumps(payload, default=_json_default).encode()
@@ -176,7 +207,18 @@ class _Handler(BaseHTTPRequestHandler):
         self._dispatch(self.routes_get)
 
     def do_POST(self):
-        if urlparse(self.path).path == "/3/Models.upload.bin":
+        path = urlparse(self.path).path
+        if path == "/3/Login":
+            length = int(self.headers.get("Content-Length") or 0)
+            raw = self.rfile.read(length) if length else b""
+            try:
+                params = json.loads(raw)
+            except Exception:           # noqa: BLE001 — form-encoded body
+                params = {k: v[0] for k, v in parse_qs(raw.decode()).items()}
+            return self._do_login(params)
+        if path == "/3/Logout":
+            return self._do_logout()
+        if path == "/3/Models.upload.bin":
             # raw binary body (a saved model artifact), not JSON
             if not self._authorized():
                 return self._deny()
@@ -482,6 +524,77 @@ class Api:
         from ..runtime.job import list_jobs
         return {"jobs": [j.describe() for j in list_jobs()]}
 
+    # -------------------------------------------- small utility handlers
+    # (the reference's RequestServer breadth: Typeahead, CreateFrame,
+    #  MissingInserter, Interactions, Tabulate, DCTTransformer, JStack,
+    #  NetworkTest — water/api/*Handler.java)
+    def typeahead(self, src: str = "", limit: int = 100) -> dict:
+        """GET /3/Typeahead/files — filesystem path completion."""
+        import glob as _glob
+        import os as _os
+        limit = int(limit)
+        pat = src + "*" if not src.endswith("*") else src
+        matches = sorted(_glob.glob(_os.path.expanduser(pat)))[:limit]
+        return {"src": src, "limit": limit, "matches": matches}
+
+    def create_frame(self, **params) -> dict:
+        from ..frame.create import create_frame
+        fr = create_frame(**self._coerce(params))
+        return {"key": {"name": fr.key}, **_frame_schema(fr.key, fr)}
+
+    def missing_inserter(self, dataset: str, fraction: float = 0.1,
+                         seed=None) -> dict:
+        from ..frame.create import insert_missing_values
+        from ..runtime import dkv
+        fr = dkv.get(dataset)
+        if fr is None:
+            raise KeyError(f"no frame {dataset!r}")
+        out = insert_missing_values(
+            fr, fraction=float(fraction),
+            seed=int(seed) if seed is not None else None)
+        return {"key": {"name": out.key}, **_frame_schema(out.key, out)}
+
+    def interaction(self, source_frame: str, factor_columns,
+                    **params) -> dict:
+        from ..frame.create import interaction
+        from ..runtime import dkv
+        fr = dkv.get(source_frame)
+        if fr is None:
+            raise KeyError(f"no frame {source_frame!r}")
+        if isinstance(factor_columns, str):
+            factor_columns = [c for c in factor_columns.split(",") if c]
+        out = interaction(fr, factor_columns, **self._coerce(params))
+        return {"key": {"name": out.key}, **_frame_schema(out.key, out)}
+
+    def tabulate(self, dataset: str, predictor: str, response: str,
+                 **params) -> dict:
+        from ..frame.create import tabulate
+        from ..runtime import dkv
+        fr = dkv.get(dataset)
+        if fr is None:
+            raise KeyError(f"no frame {dataset!r}")
+        return tabulate(fr, predictor, response, **self._coerce(params))
+
+    def dct_transform(self, dataset: str, dimensions,
+                      **params) -> dict:
+        from ..frame.create import dct_transform
+        from ..runtime import dkv
+        fr = dkv.get(dataset)
+        if fr is None:
+            raise KeyError(f"no frame {dataset!r}")
+        if isinstance(dimensions, str):
+            dimensions = [int(x) for x in dimensions.split(",") if x]
+        out = dct_transform(fr, dimensions, **self._coerce(params))
+        return {"key": {"name": out.key}, **_frame_schema(out.key, out)}
+
+    def jstack(self) -> dict:
+        from ..runtime.observability import jstack
+        return {"traces": jstack()}
+
+    def network_test(self) -> dict:
+        from ..runtime.observability import network_test
+        return {"results": network_test()}
+
     # ------------------------------------------------------------------- dkv
     def remove(self, key: str) -> dict:
         from ..runtime import dkv
@@ -636,15 +749,33 @@ class Api:
 
 
 class H2OServer:
-    """In-process REST server — H2OApp/Jetty boot analog."""
+    """In-process REST server — H2OApp/Jetty boot analog.
+
+    ``auth`` is an api.auth SPI spec ("static:u:p", "hash_file:/path",
+    "cmd:/bin/verifier", "module:pkg.attr") or an Authenticator instance;
+    default comes from env ``H2O3_TPU_AUTH``.  ``https=True`` wraps the
+    listener in TLS using ``https_cert``/``https_key`` PEMs or, absent
+    those, the internode TLS pair (H2O3_TPU_TLS_CERT/KEY) — the
+    client-facing counterpart of h2o-security's Jetty HTTPS flags.
+    """
 
     def __init__(self, port: Optional[int] = None, username: str = "",
-                 password: str = ""):
+                 password: str = "", auth=None, https: bool = False,
+                 https_cert: Optional[str] = None,
+                 https_key: Optional[str] = None):
+        from . import auth as _authmod
         self.api = Api()
         if password and not username:
             raise ValueError("basic auth requires a username with the "
                              "password")
-        self._auth = f"{username}:{password}" if username else None
+        if auth is None and username:
+            auth = _authmod.StaticAuthenticator(username, password)
+        if auth is None and os.environ.get("H2O3_TPU_AUTH"):
+            auth = os.environ["H2O3_TPU_AUTH"]
+        self._authn = _authmod.resolve_authenticator(auth)
+        self._sessions = _authmod.SessionStore()
+        self._https = https or bool(https_cert)
+        self._https_cert, self._https_key = https_cert, https_key
         _Handler.routes_get = {
             r"/3/Cloud": lambda a: a.cloud(),
             r"/3/Frames": lambda a: a.frames(),
@@ -673,6 +804,9 @@ class H2OServer:
             r"/3/About": lambda a: a.about(),
             r"/3/Timeline": lambda a: a.timeline(),
             r"/3/Logs": lambda a, **kw: a.logs(**kw),
+            r"/3/Typeahead/files": lambda a, **kw: a.typeahead(**kw),
+            r"/3/JStack": lambda a: a.jstack(),
+            r"/3/NetworkTest": lambda a: a.network_test(),
         }
         _Handler.routes_post = {
             r"/3/Parse": lambda a, **kw: a.parse(**kw),
@@ -693,6 +827,12 @@ class H2OServer:
                 a.model_save(k, **kw),
             r"/3/PartialDependence": lambda a, **kw:
                 a.partial_dependence(**kw),
+            r"/3/CreateFrame": lambda a, **kw: a.create_frame(**kw),
+            r"/3/MissingInserter": lambda a, **kw:
+                a.missing_inserter(**kw),
+            r"/3/Interaction": lambda a, **kw: a.interaction(**kw),
+            r"/99/Tabulate": lambda a, **kw: a.tabulate(**kw),
+            r"/99/DCTTransformer": lambda a, **kw: a.dct_transform(**kw),
         }
         _Handler.routes_delete = {
             r"/3/DKV/([^/]+)": lambda a, k: a.remove(k),
@@ -702,7 +842,21 @@ class H2OServer:
             port = config().port
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
         self.httpd.api = self.api
-        self.httpd.basic_auth = self._auth
+        self.httpd.authenticator = self._authn
+        self.httpd.sessions = self._sessions
+        if self._https:
+            import ssl
+            from ..runtime.config import config
+            cert = self._https_cert or config().tls_cert
+            key = self._https_key or config().tls_key
+            if not (cert and key):
+                raise ValueError(
+                    "https=True needs https_cert/https_key PEMs or "
+                    "H2O3_TPU_TLS_CERT/H2O3_TPU_TLS_KEY in the env")
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(cert, key)
+            self.httpd.socket = ctx.wrap_socket(self.httpd.socket,
+                                                server_side=True)
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
@@ -718,11 +872,15 @@ class H2OServer:
 
     @property
     def url(self) -> str:
-        return f"http://127.0.0.1:{self.port}"
+        scheme = "https" if self._https else "http"
+        return f"{scheme}://127.0.0.1:{self.port}"
 
 
-def start_server(port: int = 0, username: str = "",
-                 password: str = "") -> H2OServer:
-    """Boot the REST layer on an in-process runtime (port 0 = ephemeral)."""
+def start_server(port: int = 0, username: str = "", password: str = "",
+                 **kw) -> H2OServer:
+    """Boot the REST layer on an in-process runtime (port 0 = ephemeral).
+
+    Extra keywords (auth=, https=, https_cert=, https_key=) pass through
+    to H2OServer — see its docstring for the authn/TLS surface."""
     return H2OServer(port=port, username=username,
-                     password=password).start()
+                     password=password, **kw).start()

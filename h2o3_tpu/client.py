@@ -36,15 +36,37 @@ def _frame_key(frame) -> str:
 
 
 class H2OConnection(Backend):
-    """HTTP connection to a running h2o3_tpu REST server."""
+    """HTTP(S) connection to a running h2o3_tpu REST server.
 
-    def __init__(self, url: str, username: str = "", password: str = ""):
+    TLS: ``cafile`` pins the server certificate (self-signed deployments
+    pass the cert PEM itself); ``insecure=True`` skips verification (dev
+    only).  ``use_session=True`` exchanges the credentials for a form-login
+    session cookie (POST /3/Login) so the password is sent exactly once —
+    the h2o-security form-login flow.
+    """
+
+    def __init__(self, url: str, username: str = "", password: str = "",
+                 cafile: Optional[str] = None, insecure: bool = False,
+                 use_session: bool = False):
         self.url = url.rstrip("/")
         self._auth = None
+        self._cookie = None
+        self._ssl_ctx = None
+        if self.url.startswith("https"):
+            import ssl
+            if insecure:
+                self._ssl_ctx = ssl._create_unverified_context()
+            else:
+                self._ssl_ctx = ssl.create_default_context(cafile=cafile)
         if username:
             import base64
             self._auth = "Basic " + base64.b64encode(
                 f"{username}:{password}".encode()).decode()
+        if use_session:
+            out = self.post("/3/Login", username=username, password=password)
+            if out.get("login") != "ok":     # pragma: no cover — server 401s
+                raise H2OConnectionError("login failed")
+            self._auth = None                # cookie replaces the header
         self.cloud = self.get("/3/Cloud")
 
     # ------------------------------------------------------------- transport
@@ -62,8 +84,13 @@ class H2OConnection(Backend):
                        if raw_body is not None else "application/json")
         if self._auth:
             req.add_header("Authorization", self._auth)
+        if self._cookie:
+            req.add_header("Cookie", self._cookie)
         try:
-            with urllib.request.urlopen(req) as resp:
+            with urllib.request.urlopen(req, context=self._ssl_ctx) as resp:
+                set_cookie = resp.headers.get("Set-Cookie")
+                if set_cookie and "h2o3-session=" in set_cookie:
+                    self._cookie = set_cookie.split(";")[0]
                 body = resp.read()
                 payload = body if binary else json.loads(body.decode())
         except urllib.error.HTTPError as e:
@@ -333,6 +360,6 @@ class RemoteAutoML:
 
 
 def connect(url: str = "http://127.0.0.1:54321", username: str = "",
-            password: str = "") -> H2OConnection:
-    """h2o.connect analog."""
-    return H2OConnection(url, username, password)
+            password: str = "", **kw) -> H2OConnection:
+    """h2o.connect analog (kw: cafile=, insecure=, use_session=)."""
+    return H2OConnection(url, username, password, **kw)

@@ -2,6 +2,9 @@
 
 Single host:   python -m h2o3_tpu.deploy.serve --port 54321
 Multi-host:    ... --coordinator host:port --num-processes N --process-id I
+Pod-native:    ... --discover <headless-service> --cluster-size N
+               (DNS-record clouding, H2OCluster.java analog; an Indexed
+               Job sets H2O3_TPU_POD_INDEX for race-free ordinals)
 (REST serves from process 0; workers join the mesh and block.)
 """
 
@@ -21,9 +24,27 @@ def main(argv=None):
                     help="host:port of process 0 (multi-host)")
     ap.add_argument("--num-processes", type=int, default=None)
     ap.add_argument("--process-id", type=int, default=None)
+    ap.add_argument("--discover", default=None, metavar="SERVICE",
+                    help="headless-service DNS discovery instead of an "
+                         "explicit --coordinator (k8s pod clouding)")
+    ap.add_argument("--cluster-size", type=int, default=None,
+                    help="expected process count for --discover")
+    ap.add_argument("--discover-port", type=int, default=8476)
     ap.add_argument("--username", default="")
     ap.add_argument("--password", default="")
+    ap.add_argument("--auth", default=None,
+                    help="authenticator spec (static:/hash_file:/cmd:/"
+                         "module:) — see h2o3_tpu.api.auth")
+    ap.add_argument("--https", action="store_true")
+    ap.add_argument("--https-cert", default=None)
+    ap.add_argument("--https-key", default=None)
     args = ap.parse_args(argv)
+    if args.discover and not args.coordinator:
+        from h2o3_tpu.runtime.discovery import discover
+        (args.coordinator, args.num_processes,
+         args.process_id) = discover(args.discover,
+                                     port=args.discover_port,
+                                     expected=args.cluster_size)
 
     import os
     import jax
@@ -39,9 +60,19 @@ def main(argv=None):
     if jax.process_index() == 0:
         from h2o3_tpu.api.server import start_server
         server = start_server(port=args.port, username=args.username,
-                              password=args.password)
+                              password=args.password, auth=args.auth,
+                              https=args.https, https_cert=args.https_cert,
+                              https_key=args.https_key)
         print(f"h2o3_tpu serving on {server.url} "
               f"(mesh: {dict(cl.mesh.shape)})", flush=True)
+        if os.environ.get("H2O3_TPU_RECOVERY_DIR"):
+            # relaunched coordinator: re-import journaled frames from
+            # their source URIs and retrain interrupted jobs
+            from h2o3_tpu.runtime import recovery
+            resumed = recovery.resume()
+            if resumed:
+                print(f"h2o3_tpu recovery resumed {len(resumed)} job(s): "
+                      f"{resumed}", flush=True)
     else:
         print(f"h2o3_tpu worker {jax.process_index()} joined "
               f"(mesh: {dict(cl.mesh.shape)})", flush=True)

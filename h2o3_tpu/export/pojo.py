@@ -1,0 +1,215 @@
+"""POJO codegen: tree ensembles as self-contained Java (and C) source.
+
+Reference: ``hex/tree/TreeJCodeGen.java`` + ``hex/ModelBuilder`` POJO
+download (``/3/Models/<id>/java``): H2O renders a trained tree model as a
+dependency-free Java class whose ``score0(double[] data, double[] preds)``
+re-implements the ensemble as nested conditionals.
+
+This emitter produces the same artifact from this framework's per-level
+array trees.  The decision logic is generated once and rendered through a
+tiny syntax table into BOTH Java (the POJO deliverable) and C (the same
+trees as a compilable shared library).  The image has no javac, so the
+test suite compiles the C twin with gcc and asserts bit-identical
+predictions against the in-framework scorer — validating the generated
+conditionals themselves; the Java rendering differs only in spelling
+(``Double.isNaN`` vs ``isnan``).
+
+Input convention (same as the reference POJO): ``data[j]`` holds the j-th
+feature, numerics as-is, categoricals as the code in ``DOMAINS[j]``
+(NaN = missing / unseen).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+_JAVA = {"isnan": "Double.isNaN", "static": "static double",
+         "array_arg": "double[] data"}
+_C = {"isnan": "isnan", "static": "static double",
+      "array_arg": "const double* data"}
+
+
+def _fmt(v: float) -> str:
+    """Shortest round-trip double literal, valid in both Java and C."""
+    return repr(float(v))
+
+
+def _tree_source(tree, depth: int, name: str, lang: dict) -> str:
+    """One tree -> one static function of nested conditionals."""
+    feat = [np.asarray(a) for a in tree.feat]
+    thr = [np.asarray(a) for a in tree.thr]
+    na_left = [np.asarray(a) for a in tree.na_left]
+    valid = [np.asarray(a) for a in tree.valid]
+    values = np.asarray(tree.values)
+    lines: List[str] = [f"{lang['static']} {name}({lang['array_arg']}) {{"]
+
+    def is_leaf(d, i):
+        return d == depth or not bool(valid[d][i])
+
+    def emit(d, i, indent):
+        pad = "  " * indent
+        if is_leaf(d, i):
+            lines.append(f"{pad}return {_fmt(values[i << (depth - d)])};")
+            return
+        f, t = int(feat[d][i]), float(thr[d][i])
+        nl = bool(na_left[d][i])
+        # missing goes left iff na_left; otherwise split on value >= thr
+        go_right = (f"!{lang['isnan']}(data[{f}]) && data[{f}] >= {_fmt(t)}"
+                    if nl else
+                    f"{lang['isnan']}(data[{f}]) || data[{f}] >= {_fmt(t)}")
+        lines.append(f"{pad}if ({go_right}) {{")
+        emit(d + 1, 2 * i + 1, indent + 1)
+        lines.append(f"{pad}}} else {{")
+        emit(d + 1, 2 * i, indent + 1)
+        lines.append(f"{pad}}}")
+
+    emit(0, 0, 1)
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def _model_trees(model):
+    trees = list(model.output["trees"])
+    K = model.output.get("nclass_trees", 1)
+    if K > 1:
+        return [[t[k] for k in range(K)] for t in trees], K
+    return [[t] for t in trees], 1
+
+
+def _score_body(model, matrix, K: int, lang: dict) -> List[str]:
+    """score0 body: sum trees per class, apply init + link."""
+    init = np.atleast_1d(np.asarray(model.output["init_score"], np.float64))
+    dist = str(model.output.get("distribution", "gaussian"))
+    nclasses = model.datainfo.nclasses
+    is_drf = model.algo == "drf"
+    T = len(matrix)
+    exp = "Math.exp" if lang is _JAVA else "exp"
+    out: List[str] = []
+    for k in range(K):
+        terms = " + ".join(f"tree_{k}_{g}(data)" for g in range(T))
+        out.append(f"  double f{k} = {terms};")
+    if K > 1:                                         # multinomial softmax
+        for k in range(K):
+            out.append(f"  f{k} += {_fmt(init[k])};")
+        if not is_drf:
+            out.append("  double mx = f0;")
+            for k in range(1, K):
+                out.append(f"  if (f{k} > mx) mx = f{k};")
+            out.append("  double tot = 0.0;")
+            for k in range(K):
+                out.append(f"  double e{k} = {exp}(f{k} - mx); tot += e{k};")
+            for k in range(K):
+                out.append(f"  preds[{k + 1}] = e{k} / tot;")
+        else:                                          # DRF: normalized votes
+            out.append("  double tot = 0.0;")
+            for k in range(K):
+                out.append(f"  f{k} /= {_fmt(T)}; if (f{k} < 0.0) f{k} = "
+                           f"0.0; tot += f{k};")
+            for k in range(K):
+                out.append(f"  preds[{k + 1}] = tot > 0.0 ? f{k} / tot "
+                           ": 0.0;")
+    elif nclasses == 2:
+        if is_drf:
+            out.append(f"  double p1 = f0 / {_fmt(T)};")
+            out.append("  if (p1 < 0.0) p1 = 0.0; if (p1 > 1.0) p1 = 1.0;")
+        else:
+            out.append(f"  double p1 = 1.0 / (1.0 + {exp}(-(f0 "
+                       f"+ {_fmt(init[0])})));")
+        out.append("  preds[1] = 1.0 - p1;")
+        out.append("  preds[2] = p1;")
+        out.append("  preds[0] = p1 >= "
+                   f"{_fmt(model.default_threshold())} ? 1.0 : 0.0;")
+    else:                                              # regression
+        if is_drf:
+            out.append(f"  preds[0] = f0 / {_fmt(T)};")
+        elif dist in ("poisson", "gamma", "tweedie"):
+            out.append(f"  preds[0] = {exp}(f0 + {_fmt(init[0])});")
+        else:
+            out.append(f"  preds[0] = f0 + {_fmt(init[0])};")
+    return out
+
+
+def _domains_java(di) -> List[str]:
+    from ..frame.vec import T_CAT
+    rows = []
+    for s in di.specs:
+        if s.type == T_CAT and s.domain:
+            levels = ", ".join('"%s"' % str(x).replace('"', '\\"')
+                               for x in s.domain)
+            rows.append(f"    new String[] {{{levels}}},")
+        else:
+            rows.append("    null,")
+    return rows
+
+
+def export_pojo(model, path: str, class_name: Optional[str] = None) -> str:
+    """Write a dependency-free Java scoring class (TreeJCodeGen analog)."""
+    if model.algo not in ("gbm", "drf", "xgboost"):
+        raise ValueError("POJO export covers tree ensembles "
+                         "(gbm/drf/xgboost)")
+    di = model.datainfo
+    matrix, K = _model_trees(model)
+    depth = model.params.max_depth
+    cname = class_name or "".join(
+        ch if ch.isalnum() else "_" for ch in model.key)
+    if not cname[0].isalpha():
+        cname = "M_" + cname
+    names = ", ".join(f'"{s.name}"' for s in di.specs)
+    nclasses = max(di.nclasses, 1)
+    preds_len = 1 if nclasses == 1 else nclasses + 1
+    parts = [
+        "// Generated scoring POJO — self-contained, no h2o-genmodel",
+        f"// dependency.  Columns: data[j] = NAMES[j]; categorical columns",
+        "// carry the code of the level in DOMAINS[j] (NaN = missing).",
+        f"public class {cname} {{",
+        f"  public static final String[] NAMES = new String[] {{{names}}};",
+        "  public static final String[][] DOMAINS = new String[][] {",
+        *_domains_java(di),
+        "  };",
+        f"  public static final int NCLASSES = {nclasses};",
+        "",
+        f"  public static double[] score0(double[] data, double[] preds) {{",
+        *_score_body(model, matrix, K, _JAVA),
+        "    return preds;",
+        "  }",
+        "",
+        f"  public static double[] score0(double[] data) {{",
+        f"    return score0(data, new double[{preds_len}]);",
+        "  }",
+        "",
+    ]
+    for g, per_class in enumerate(matrix):
+        for k, tree in enumerate(per_class):
+            src = _tree_source(tree, depth, f"tree_{k}_{g}", _JAVA)
+            parts.append("  " + src.replace("\n", "\n  "))
+            parts.append("")
+    parts.append("}")
+    with open(path, "w") as fh:
+        fh.write("\n".join(parts) + "\n")
+    return path
+
+
+def export_pojo_c(model, path: str) -> str:
+    """The same generated trees as a C translation unit exporting
+    ``score0(const double* data, double* preds)`` — compiled by the test
+    suite to validate the codegen, and usable as a native scorer."""
+    if model.algo not in ("gbm", "drf", "xgboost"):
+        raise ValueError("POJO export covers tree ensembles "
+                         "(gbm/drf/xgboost)")
+    matrix, K = _model_trees(model)
+    depth = model.params.max_depth
+    body = _score_body(model, matrix, K, _C)
+    parts = ["#include <math.h>", ""]
+    for g, per_class in enumerate(matrix):
+        for k, tree in enumerate(per_class):
+            parts.append(_tree_source(tree, depth, f"tree_{k}_{g}", _C))
+            parts.append("")
+    parts.append("double* score0(const double* data, double* preds) {")
+    parts.extend(body)
+    parts.append("  return preds;")
+    parts.append("}")
+    with open(path, "w") as fh:
+        fh.write("\n".join(parts) + "\n")
+    return path

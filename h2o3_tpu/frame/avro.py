@@ -1,0 +1,191 @@
+"""Avro Object Container File parser — no external avro library.
+
+Reference: ``h2o-parsers/h2o-avro-parser/src/main/java/water/parser/avro/
+AvroParser.java`` (flat-record import: primitive fields + nullable unions
++ enums; nested records/arrays/maps are out of scope there too).
+
+This is a from-scratch decoder of the public Avro 1.x container spec
+(magic ``Obj\\x01``, metadata map with ``avro.schema``/``avro.codec``,
+sync-marker-delimited blocks of zigzag-varint-encoded datums; null and
+deflate codecs).  Columns become Vecs: long/int/float/double -> numeric,
+boolean -> 0/1, string/bytes -> cat/str per cardinality heuristics of the
+CSV path, enum -> cat with the schema's symbol list as domain.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+from typing import List, Optional
+
+import numpy as np
+
+_MAGIC = b"Obj\x01"
+
+
+class _Reader:
+    __slots__ = ("buf", "pos")
+
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def read(self, n: int) -> bytes:
+        b = self.buf[self.pos: self.pos + n]
+        if len(b) != n:
+            raise ValueError("truncated avro data")
+        self.pos += n
+        return b
+
+    def long(self) -> int:
+        """zigzag varint — the single Avro integer encoding."""
+        shift, acc = 0, 0
+        while True:
+            if self.pos >= len(self.buf):
+                raise ValueError("truncated avro data")
+            b = self.buf[self.pos]
+            self.pos += 1
+            acc |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+        return (acc >> 1) ^ -(acc & 1)
+
+    def bytes_(self) -> bytes:
+        return self.read(self.long())
+
+    def float_(self) -> float:
+        return struct.unpack("<f", self.read(4))[0]
+
+    def double(self) -> float:
+        return struct.unpack("<d", self.read(8))[0]
+
+
+def _decode_value(r: _Reader, schema):
+    """One datum of a (restricted) schema. Supported: primitives, enum,
+    [null, X] unions, logical types riding on supported primitives."""
+    if isinstance(schema, dict):
+        t = schema["type"]
+        if t == "enum":
+            return schema["symbols"][r.long()]
+        if t in ("record", "array", "map", "fixed"):
+            raise NotImplementedError(
+                f"nested avro type {t!r} is not importable as a column "
+                "(reference AvroParser imports flat records too)")
+        schema = t
+    if isinstance(schema, list):                       # union
+        branch = schema[r.long()]
+        return _decode_value(r, branch)
+    if schema == "null":
+        return None
+    if schema == "boolean":
+        return bool(r.read(1)[0])
+    if schema in ("int", "long"):
+        return r.long()
+    if schema == "float":
+        return r.float_()
+    if schema == "double":
+        return r.double()
+    if schema == "string":
+        return r.bytes_().decode()
+    if schema == "bytes":
+        return r.bytes_()
+    raise NotImplementedError(f"avro type {schema!r}")
+
+
+def _column_kind(schema) -> str:
+    """'num' | 'bool' | 'text' | ('enum', symbols) for a field schema."""
+    if isinstance(schema, list):
+        non_null = [s for s in schema if s != "null"]
+        if len(non_null) != 1:
+            raise NotImplementedError(
+                "only [null, X] unions import as columns")
+        return _column_kind(non_null[0])
+    if isinstance(schema, dict):
+        if schema["type"] == "enum":
+            return ("enum", list(schema["symbols"]))
+        return _column_kind(schema["type"])
+    if schema in ("int", "long", "float", "double"):
+        return "num"
+    if schema == "boolean":
+        return "bool"
+    if schema in ("string", "bytes"):
+        return "text"
+    raise NotImplementedError(f"avro type {schema!r}")
+
+
+def parse_avro(path_or_buf, destination_frame: Optional[str] = None):
+    """Avro container file -> Frame (AvroParser.java parseChunk analog)."""
+    from ..runtime import dkv
+    from .frame import Frame
+    from .parse import _column_to_vec
+    from .vec import Vec, T_CAT, T_NUM
+
+    if isinstance(path_or_buf, (bytes, bytearray)):
+        raw = bytes(path_or_buf)
+    else:
+        with open(path_or_buf, "rb") as fh:
+            raw = fh.read()
+    r = _Reader(raw)
+    if r.read(4) != _MAGIC:
+        raise ValueError("not an avro object container file (bad magic)")
+    meta = {}
+    while True:
+        n = r.long()
+        if n == 0:
+            break
+        if n < 0:                       # negative count -> byte size follows
+            n = -n
+            r.long()
+        for _ in range(n):
+            k = r.bytes_().decode()
+            meta[k] = r.bytes_()
+    sync = r.read(16)
+    schema = json.loads(meta["avro.schema"].decode())
+    codec = meta.get("avro.codec", b"null").decode()
+    if schema.get("type") != "record":
+        raise NotImplementedError("top-level avro schema must be a record")
+    fields = schema["fields"]
+    names = [f["name"] for f in fields]
+    kinds = [_column_kind(f["type"]) for f in fields]
+    cols: List[list] = [[] for _ in names]
+
+    while r.pos < len(r.buf):
+        count = r.long()
+        size = r.long()
+        block = r.read(size)
+        if r.read(16) != sync:
+            raise ValueError("avro block sync marker mismatch")
+        if codec == "deflate":
+            block = zlib.decompress(block, -15)
+        elif codec != "null":
+            raise NotImplementedError(f"avro codec {codec!r} (null/deflate)")
+        br = _Reader(block)
+        for _ in range(count):
+            for j, f in enumerate(fields):
+                cols[j].append(_decode_value(br, f["type"]))
+
+    vecs, out_names = [], []
+    for name, kind, vals in zip(names, kinds, cols):
+        if kind in ("num", "bool"):
+            arr = np.array([np.nan if v is None else float(v)
+                            for v in vals], dtype=np.float64)
+            vecs.append(Vec.from_numpy(arr, T_NUM))
+        elif isinstance(kind, tuple):                  # enum -> cat
+            symbols = kind[1]
+            lookup = {s: i for i, s in enumerate(symbols)}
+            codes = np.array([-1 if v is None else lookup[v]
+                              for v in vals], np.int32)
+            vecs.append(Vec.from_numpy(codes, T_CAT, domain=symbols))
+        else:                                          # text: type-guess
+            decoded = np.array(
+                ["" if v is None else
+                 (v.decode(errors="replace") if isinstance(v, bytes) else v)
+                 for v in vals], dtype=object)
+            vecs.append(_column_to_vec(decoded, name))
+        out_names.append(name)
+    key = destination_frame or dkv.make_key("avro")
+    fr = Frame(out_names, vecs, key=key)
+    dkv.put(key, fr)
+    return fr

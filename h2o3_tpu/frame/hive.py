@@ -1,0 +1,183 @@
+"""Hive table import: SQL mode and direct-metadata mode.
+
+Reference: ``h2o-hive/src/main/java/water/hive/`` —
+``HiveTableImporterImpl.java`` (JDBC SELECT import),
+``DirectHiveMetadata.java`` / ``JdbcHiveMetadata.java`` (read table
+metadata — storage location, format, columns, partitions — then ingest the
+underlying files directly, skipping the HiveServer row path), and
+``PartitionFrameJoiner.java`` (partition-key values appended as constant
+columns per partition).
+
+TPU-native redesign: no thrift client and no JDBC driver manager — both
+modes speak plain DB-API 2.0.  SQL mode takes any DB-API connection to a
+HiveServer (pyhive/impyla, user-supplied).  Direct mode takes a DB-API
+connection to the **metastore's backing database** (the DBS/TBLS/SDS/
+COLUMNS_V2/PARTITIONS tables every HMS maintains) — the same metadata
+DirectHiveMetadata fetches over thrift — and then imports each storage
+location through the persist layer (gcs://, s3://, hdfs://, file paths),
+so data never flows through a Hive daemon.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+def _bt(name: str) -> str:
+    """Backtick-quote a Hive identifier (HiveTableImporterImpl style)."""
+    if not name.replace("_", "").replace(".", "").isalnum():
+        raise ValueError(f"illegal hive identifier {name!r}")
+    return ".".join(f"`{part}`" for part in name.split("."))
+
+
+def import_hive_table(connection, table: str,
+                      partitions: Optional[Dict[str, str]] = None,
+                      destination_frame: Optional[str] = None):
+    """SQL-mode import: SELECT * over a live HiveServer DB-API connection.
+
+    ``partitions`` pushes equality predicates down (partition pruning):
+    ``{"year": "2007", "month": "1"}`` -> ``WHERE `year`='2007' AND ...``.
+    """
+    from .sql import import_sql_select
+    query = f"SELECT * FROM {_bt(table)}"
+    if partitions:
+        preds = []
+        for k, v in partitions.items():
+            sv = str(v).replace("'", "''")     # values inlined: DB-API
+            preds.append(f"{_bt(k)} = '{sv}'")  # paramstyles vary per driver
+        query += " WHERE " + " AND ".join(preds)
+    return import_sql_select(connection, query,
+                             destination_frame=destination_frame)
+
+
+# ------------------------------------------------------ direct metadata mode
+
+_TEXT_FORMATS = ("TextInputFormat",)
+_PARQUET_FORMATS = ("MapredParquetInputFormat", "ParquetInputFormat")
+_ORC_FORMATS = ("OrcInputFormat",)
+
+
+class HiveMetastore:
+    """Reads HMS metadata from its backing RDBMS over DB-API
+    (DirectHiveMetadata's table/partition/column view, minus thrift)."""
+
+    def __init__(self, conn):
+        self.conn = conn
+
+    def _all(self, query: str, args=()) -> list:
+        cur = self.conn.cursor()
+        try:
+            try:
+                cur.execute(query, args)
+            except Exception:           # noqa: BLE001 — driver paramstyle
+                cur.execute(query.replace("?", "%s"), args)
+            return cur.fetchall()
+        finally:
+            cur.close()
+
+    def table(self, table: str, database: str = "default") -> dict:
+        rows = self._all(
+            "SELECT t.TBL_ID, t.SD_ID, s.LOCATION, s.INPUT_FORMAT, s.CD_ID "
+            "FROM TBLS t JOIN DBS d ON t.DB_ID = d.DB_ID "
+            "JOIN SDS s ON t.SD_ID = s.SD_ID "
+            "WHERE d.NAME = ? AND t.TBL_NAME = ?", (database, table))
+        if not rows:
+            raise KeyError(f"hive table {database}.{table} not found "
+                           "in metastore")
+        tbl_id, sd_id, location, input_format, cd_id = rows[0]
+        cols = [(str(r[0]), str(r[1])) for r in self._all(
+            "SELECT COLUMN_NAME, TYPE_NAME FROM COLUMNS_V2 "
+            "WHERE CD_ID = ? ORDER BY INTEGER_IDX", (cd_id,))]
+        pkeys = [(str(r[0]), str(r[1])) for r in self._all(
+            "SELECT PKEY_NAME, PKEY_TYPE FROM PARTITION_KEYS "
+            "WHERE TBL_ID = ? ORDER BY INTEGER_IDX", (tbl_id,))]
+        serde = {str(r[0]): str(r[1]) for r in self._all(
+            "SELECT sp.PARAM_KEY, sp.PARAM_VALUE FROM SERDE_PARAMS sp "
+            "JOIN SDS s ON s.SERDE_ID = sp.SERDE_ID WHERE s.SD_ID = ?",
+            (sd_id,))}
+        parts = [(str(r[0]), str(r[1])) for r in self._all(
+            "SELECT p.PART_NAME, s.LOCATION FROM PARTITIONS p "
+            "JOIN SDS s ON p.SD_ID = s.SD_ID WHERE p.TBL_ID = ?",
+            (tbl_id,))]
+        return {"location": str(location), "input_format": str(input_format),
+                "columns": cols, "partition_keys": pkeys,
+                "serde": serde, "partitions": parts}
+
+
+def _import_location(location: str, meta: dict, col_names: List[str]):
+    """One storage directory -> Frame via the matching format parser."""
+    import glob
+    import os
+    from .parse import parse_csv, parse_arrow
+
+    fmt = meta["input_format"].rsplit(".", 1)[-1]
+    path = location[7:] if location.startswith("file://") else location
+    if os.path.isdir(path):
+        files = sorted(f for f in glob.glob(os.path.join(path, "*"))
+                       if not os.path.basename(f).startswith(
+                           ("_", ".")))                  # skip _SUCCESS etc
+    else:
+        files = [path]
+    if not files:
+        raise ValueError(f"no data files under hive location {location!r}")
+    if fmt in _TEXT_FORMATS:
+        sep = meta["serde"].get("field.delim", "\x01")
+        frames = [parse_csv(f, header=False, sep=sep, col_names=col_names)
+                  for f in files]
+    elif fmt in _PARQUET_FORMATS:
+        frames = [parse_arrow(f, "parquet") for f in files]
+    elif fmt in _ORC_FORMATS:
+        frames = [parse_arrow(f, "orc") for f in files]
+    else:
+        raise NotImplementedError(
+            f"hive input format {meta['input_format']!r} "
+            "(text/parquet/orc are supported)")
+    if len(frames) == 1:
+        return frames[0]
+    from ..rapids.ops import rbind
+    return rbind(*frames)
+
+
+def import_hive_metadata(metastore_conn, table: str,
+                         database: str = "default",
+                         destination_frame: Optional[str] = None):
+    """Direct-metadata import: metastore backing DB -> storage files.
+
+    Partitioned tables ingest every partition directory and append the
+    partition-key values as constant categorical columns
+    (PartitionFrameJoiner semantics); unpartitioned tables ingest the
+    table location directly.
+    """
+    from ..runtime import dkv
+    from .frame import Frame
+    from .vec import Vec, T_CAT
+
+    ms = HiveMetastore(metastore_conn)
+    meta = ms.table(table, database=database)
+    col_names = [c[0] for c in meta["columns"]]
+    if not meta["partition_keys"]:
+        fr = _import_location(meta["location"], meta, col_names)
+        key = destination_frame or dkv.make_key(f"hive_{table}")
+        fr.key = key
+        dkv.put(key, fr)
+        return fr
+
+    pkey_names = [k[0] for k in meta["partition_keys"]]
+    pieces = []
+    for part_name, location in meta["partitions"]:
+        fr = _import_location(location, meta, col_names)
+        # PART_NAME is "k1=v1/k2=v2"; append each key as a constant column
+        values = dict(kv.split("=", 1) for kv in part_name.split("/"))
+        for pk in pkey_names:
+            v = values.get(pk, "")
+            codes = np.zeros(fr.nrows, np.int32)
+            fr = fr.with_vec(pk, Vec.from_numpy(codes, T_CAT, domain=[v]))
+        pieces.append(fr)
+    from ..rapids.ops import rbind
+    out = pieces[0] if len(pieces) == 1 else rbind(*pieces)
+    key = destination_frame or dkv.make_key(f"hive_{table}")
+    out.key = key
+    dkv.put(key, out)
+    return out

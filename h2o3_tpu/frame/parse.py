@@ -559,12 +559,23 @@ def parse_arrow(path: str, fmt: str,
 
 def import_file(path, destination_frame: Optional[str] = None,
                 **kw) -> Frame:
+    """h2o.import_file analog — see ``_import_file_impl``.  The returned
+    frame carries ``source_uri`` provenance so the recovery journal can
+    re-import it after a coordinator restart (Recovery.java:72 contract)."""
+    fr = _import_file_impl(path, destination_frame=destination_frame, **kw)
+    fr.source_uri = path if isinstance(path, str) else list(path)
+    return fr
+
+
+def _import_file_impl(path, destination_frame: Optional[str] = None,
+                      **kw) -> Frame:
     """h2o.import_file analog (h2o-py/h2o/h2o.py import_file -> /3/Parse).
 
     Accepts a single path, a glob pattern, a directory, a list of paths, or
     a persist URI (``gcs://…``, ``file://…``); gzip/zip/bz2/xz shards
     decompress transparently; ``.svm``/``.svmlight``, ``.arff``,
-    ``.parquet``, ``.orc`` and ``.feather`` route to format parsers.
+    ``.parquet``, ``.orc``, ``.feather``, ``.avro``, ``.xlsx`` and legacy
+    ``.xls`` route to format parsers.
     """
     paths = _expand_paths(path)
     low = paths[0].lower()
@@ -588,10 +599,21 @@ def import_file(path, destination_frame: Optional[str] = None,
             out.key = destination_frame or dkv.make_key(fmt)
             dkv.put(out.key, out)
             return out
-    if low.endswith(".avro"):
-        raise NotImplementedError(
-            "avro import needs the fastavro library, which is not in this "
-            "build; convert to parquet/orc/csv or install fastavro")
+    fmt_parsers = {}
+    from .avro import parse_avro
+    from .xls import parse_xls, parse_xlsx
+    fmt_parsers[".avro"] = parse_avro
+    fmt_parsers[".xlsx"] = parse_xlsx
+    fmt_parsers[".xls"] = parse_xls
+    for ext, fn in fmt_parsers.items():
+        if low.endswith(ext):
+            if len(paths) == 1:
+                return fn(paths[0], destination_frame=destination_frame)
+            from ..rapids.ops import rbind
+            out = rbind(*[fn(p2) for p2 in paths])
+            out.key = destination_frame or dkv.make_key(ext.strip("."))
+            dkv.put(out.key, out)
+            return out
     import jax
 
     def _rangeable(p: str) -> bool:

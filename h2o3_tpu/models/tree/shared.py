@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 from typing import List, Optional, Sequence
 
 import jax
@@ -320,16 +321,22 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
     # has the ragged kernel; dense einsum covers CPU tests.  The packed
     # result has the exact same [3, L, F, B] contract, so split search is
     # byte-identical — this is a pure kernel-cost optimization.
+    # H2O3_TPU_HIST_IMPL=varbin forces the varbin path off-TPU (interpret
+    # Pallas) so the multichip dryrun exercises the bench kernel code path.
+    on_tpu = cluster().mesh.devices.flat[0].platform == "tpu"
+    impl_override = os.environ.get("H2O3_TPU_HIST_IMPL", "")
     use_varbin = (bin_counts is not None
-                  and cluster().mesh.devices.flat[0].platform == "tpu"
+                  and (on_tpu or impl_override == "varbin")
                   and F * B * 3 * 2 ** max(max_depth - 1, 0) * 4
                   <= 12 * 1024 * 1024
                   and sum(min(b, nbins) + 9 for b in bin_counts)
                   < F * (nbins + 1))
     if use_varbin:
+        force = "" if on_tpu else "pallas_interpret"
         hist_fns = [make_varbin_hist_fn(2 ** max(d - 1, 0), F,
                                         tuple(bin_counts), B, n_padded,
-                                        precision=hist_precision)
+                                        precision=hist_precision,
+                                        force_impl=force)
                     for d in range(max_depth)]
     else:
         hist_fns = [make_hist_fn(2 ** max(d - 1, 0), F, B, n_padded,

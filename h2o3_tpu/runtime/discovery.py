@@ -1,0 +1,133 @@
+"""Pod-native worker discovery — the k8s headless-service clouding analog.
+
+Reference: ``h2o-k8s/src/main/java/water/k8s/H2OCluster.java`` +
+``KubernetesDnsDiscovery``: pods resolve a headless service's DNS A
+records until the expected cluster size is seen, then form the cloud
+from the discovered addresses.
+
+TPU-native redesign: discovery only needs to produce the THREE values
+``jax.distributed.initialize`` wants — coordinator address, process
+count, and this process's index — because XLA's runtime handles the
+actual rendezvous.  Two modes:
+
+* **Indexed** (preferred on k8s): an Indexed Job / StatefulSet gives each
+  pod a stable ordinal (env ``H2O3_TPU_POD_INDEX``, e.g. from the
+  ``batch.kubernetes.io/job-completion-index`` annotation) and ordinal-0's
+  stable DNS name is the coordinator.  No polling races.
+* **DNS-poll**: resolve the headless service's A records until
+  ``expected`` addresses are stable, sort them, coordinator = lowest,
+  process_id = rank of this pod's own address (H2OCluster's mechanism).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import time
+from typing import List, Optional, Tuple
+
+
+def _own_addresses() -> set:
+    """Every IP this host answers to (for rank lookup in DNS mode)."""
+    out = {"127.0.0.1"}
+    try:
+        host = socket.gethostname()
+        out.add(socket.gethostbyname(host))
+        for info in socket.getaddrinfo(host, None, socket.AF_INET):
+            out.add(info[4][0])
+    except OSError:
+        pass
+    try:                      # routeable source address (no packet sent)
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("10.255.255.255", 1))
+        out.add(s.getsockname()[0])
+        s.close()
+    except OSError:
+        pass
+    return out
+
+
+def resolve_service(service: str, expected: Optional[int] = None,
+                    timeout_s: float = 300.0,
+                    poll_s: float = 2.0) -> List[str]:
+    """Poll DNS A records for ``service`` until ``expected`` distinct
+    addresses appear and are stable for one extra poll (k8s propagates
+    records as pods turn Ready)."""
+    deadline = time.monotonic() + timeout_s
+    last: List[str] = []
+    stable = 0
+    while time.monotonic() < deadline:
+        try:
+            addrs = sorted({info[4][0] for info in socket.getaddrinfo(
+                service, None, socket.AF_INET)})
+        except OSError:
+            addrs = []
+        if addrs and (expected is None or len(addrs) >= expected):
+            if addrs == last:
+                stable += 1
+                if stable >= 1:
+                    return addrs
+            else:
+                stable = 0
+            last = addrs
+        time.sleep(poll_s)
+    raise TimeoutError(
+        f"discovery: {service!r} resolved {len(last)} addresses "
+        f"(expected {expected}) within {timeout_s}s")
+
+
+def discover(service: str, port: int = 8476,
+             expected: Optional[int] = None,
+             index_env: str = "H2O3_TPU_POD_INDEX",
+             timeout_s: float = 300.0) -> Tuple[str, int, int]:
+    """-> (coordinator_address, num_processes, process_id).
+
+    Indexed mode when ``index_env`` is set (coordinator = ordinal 0's
+    stable DNS name ``<service-stem>-0.<service>``); DNS-poll mode
+    otherwise.  ``expected`` defaults to env ``H2O3_TPU_CLUSTER_SIZE``.
+    """
+    if expected is None and os.environ.get("H2O3_TPU_CLUSTER_SIZE"):
+        expected = int(os.environ["H2O3_TPU_CLUSTER_SIZE"])
+    idx = os.environ.get(index_env)
+    if idx is not None:
+        if expected is None:
+            raise ValueError(
+                "indexed discovery needs the cluster size "
+                "(expected= or H2O3_TPU_CLUSTER_SIZE)")
+        # Pod DNS names are <pod-name>.<subdomain>, and Indexed Job /
+        # StatefulSet pods are named <workload>-<ordinal> — the workload
+        # stem comes from THIS pod's own hostname (strip our ordinal),
+        # NOT from the service name (the service is usually named
+        # differently, e.g. job "h2o3-tpu" behind service
+        # "h2o3-tpu-coordinator").
+        stem = os.environ.get("H2O3_TPU_POD_STEM")
+        if not stem:
+            host = socket.gethostname().split(".", 1)[0]
+            suffix = f"-{idx}"
+            if not host.endswith(suffix):
+                raise RuntimeError(
+                    f"indexed discovery: hostname {host!r} does not end "
+                    f"with ordinal suffix {suffix!r}; set "
+                    "H2O3_TPU_POD_STEM to the workload name")
+            stem = host[: -len(suffix)]
+        coord = f"{stem}-0.{service}:{port}"
+        return coord, expected, int(idx)
+    addrs = resolve_service(service, expected=expected,
+                            timeout_s=timeout_s)
+    own = _own_addresses()
+    ranks = [i for i, a in enumerate(addrs) if a in own]
+    if not ranks:
+        raise RuntimeError(
+            f"discovery: none of this host's addresses {sorted(own)} "
+            f"appear in {service!r} records {addrs}")
+    return f"{addrs[0]}:{port}", len(addrs), ranks[0]
+
+
+def init_from_discovery(service: str, port: int = 8476,
+                        expected: Optional[int] = None,
+                        model_axis: int = 1, **kw):
+    """One-call pod boot: discover, then ``cluster.init`` multi-host."""
+    from .cluster import init
+    coord, n, pid = discover(service, port=port, expected=expected, **kw)
+    return init(coordinator=coord, num_processes=n, process_id=pid,
+                model_axis=model_axis)

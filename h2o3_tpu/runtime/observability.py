@@ -81,3 +81,68 @@ def stop_device_trace() -> None:
     import jax
     jax.profiler.stop_trace()
     record("profiler_stop")
+
+
+def jstack() -> List[Dict]:
+    """All-thread stack dump — water/api/JStackHandler (water.util.JStack)
+    rendered for a Python runtime: one traceback per live thread."""
+    import sys
+    import threading
+    import traceback
+    frames = sys._current_frames()
+    by_id = {t.ident: t for t in threading.enumerate()}
+    out = []
+    for tid, frame in frames.items():
+        t = by_id.get(tid)
+        out.append({
+            "thread_id": tid,
+            "name": t.name if t else f"thread-{tid}",
+            "daemon": bool(t.daemon) if t else None,
+            "traces": traceback.format_stack(frame),
+        })
+    return out
+
+
+def network_test(sizes=(1_024, 1_048_576, 16_777_216)) -> List[Dict]:
+    """Collective-bandwidth micro-bench — water/api/NetworkTestHandler.
+
+    The reference times point-to-point UDP/TCP between cloud members;
+    the mesh analog is an all-reduce (psum) across every device at a few
+    payload sizes, which is exactly the traffic training generates.
+    """
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+    from .cluster import cluster, ROW_AXIS
+
+    cl = cluster()
+    rows = cl.mesh.shape[ROW_AXIS]
+    results = []
+    for size in sizes:
+        n = max(size // 4, rows)
+        n = (n // rows) * rows
+        x = jnp.ones((n,), jnp.float32)
+
+        def allred(v):
+            return jax.lax.psum(v, ROW_AXIS)
+
+        f = jax.jit(shard_map(allred, mesh=cl.mesh,
+                              in_specs=P(ROW_AXIS), out_specs=P()))
+        np_out = f(x)
+        _ = float(np_out[0])                  # warmup + compile sync
+        t0 = time.perf_counter()
+        reps = 5
+        for _ in range(reps):
+            out = f(x)
+        _ = float(out[0])                     # fetch = sync point
+        dt = (time.perf_counter() - t0) / reps
+        results.append({
+            "bytes": int(n * 4),
+            "collective": "psum",
+            "seconds": dt,
+            "gbytes_per_sec": (n * 4 / max(dt, 1e-12)) / 1e9,
+        })
+    return results

@@ -54,6 +54,10 @@ def journal_start(builder, frame, job=None, params=None) -> Optional[str]:
         "params": params,
         "skipped_params": skipped,
         "frame_key": getattr(frame, "key", None),
+        # import provenance: lets resume() re-import the data itself
+        # after a coordinator restart (frames are not journaled, their
+        # source URIs are — Recovery.java:72-81 contract, automated)
+        "frame_source": getattr(frame, "source_uri", None),
         "status": "running",
     }
     job = job or builder.job
@@ -122,6 +126,17 @@ def resume(recovery_dir: Optional[str] = None) -> List[str]:
         if entry.get("status") != "running":
             continue
         frame = dkv.get(entry.get("frame_key") or "")
+        if frame is None and entry.get("frame_source"):
+            # automated re-import from the journaled source URI
+            from ..frame.parse import import_file
+            try:
+                frame = import_file(entry["frame_source"],
+                                    destination_frame=entry["frame_key"])
+                log.info("recovery: re-imported %r from %r",
+                         entry.get("frame_key"), entry["frame_source"])
+            except Exception as e:             # noqa: BLE001
+                log.warning("recovery: re-import of %r failed: %r",
+                            entry.get("frame_source"), e)
         if frame is None:
             log.warning("recovery: frame %r not re-imported; skipping %s",
                         entry.get("frame_key"), uri)

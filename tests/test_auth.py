@@ -1,0 +1,181 @@
+"""REST authn hardening: SPI, hash-file + cmd authenticators, form login
+sessions, HTTPS, client propagation.
+
+Reference surface: ``h2o-security/`` + ``h2o-jaas-pam/`` (hash_login /
+ldap_login / pam_login / form_auth / HTTPS Jetty flags).
+"""
+
+import json
+import os
+import stat
+import subprocess
+import urllib.request
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.api.auth import (CommandAuthenticator, HashFileAuthenticator,
+                               StaticAuthenticator, hash_password,
+                               resolve_authenticator)
+from h2o3_tpu.api.server import start_server
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _init():
+    h2o3_tpu.init()
+
+
+# ------------------------------------------------------------ SPI unit tests
+
+def test_static_authenticator():
+    a = StaticAuthenticator("bob", "s3cret")
+    assert a.check("bob", "s3cret")
+    assert not a.check("bob", "wrong")
+    assert not a.check("alice", "s3cret")
+
+
+def test_hash_file_authenticator_and_rotation(tmp_path):
+    path = tmp_path / "realm.properties"
+    path.write_text(f"# users\nbob:{hash_password('pw1', iters=1000)}\n")
+    a = HashFileAuthenticator(str(path))
+    assert a.check("bob", "pw1")
+    assert not a.check("bob", "pw2")
+    assert not a.check("eve", "pw1")
+    # rotate on disk -> picked up without restart (mtime reload)
+    path.write_text(f"bob:{hash_password('pw2', iters=1000)}\n")
+    os.utime(path, (os.stat(path).st_atime, os.stat(path).st_mtime + 5))
+    assert a.check("bob", "pw2")
+    assert not a.check("bob", "pw1")
+
+
+def test_cmd_authenticator_pam_style_hook(tmp_path):
+    """External verifier: username argv[1], password on stdin, rc 0 = ok —
+    the 3-line wrapper contract for PAM/LDAP backends."""
+    script = tmp_path / "verify.sh"
+    script.write_text("#!/bin/sh\n"
+                      'read -r pw\n'
+                      '[ "$1" = "carol" ] && [ "$pw" = "letmein" ]\n')
+    script.chmod(script.stat().st_mode | stat.S_IEXEC)
+    a = CommandAuthenticator(str(script))
+    assert a.check("carol", "letmein")
+    assert not a.check("carol", "nope")
+    assert not a.check("mallory", "letmein")
+    assert not a.check("x\ny", "letmein")      # newline injection denied
+
+
+def test_resolve_specs(tmp_path):
+    assert resolve_authenticator(None) is None
+    a = resolve_authenticator("static:u:p")
+    assert a.check("u", "p") and not a.check("u", "q")
+    path = tmp_path / "h"
+    path.write_text(f"u:{hash_password('p', iters=1000)}\n")
+    assert resolve_authenticator(f"hash_file:{path}").check("u", "p")
+    with pytest.raises(ValueError):
+        resolve_authenticator("kerberos:bogus")
+
+
+# --------------------------------------------------------- server-level flow
+
+def _get(url, headers=None, ctx=None):
+    req = urllib.request.Request(url)
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    with urllib.request.urlopen(req, context=ctx) as r:
+        return r.status, json.loads(r.read().decode()), dict(r.headers)
+
+
+def test_form_login_session_flow():
+    srv = start_server(port=0, auth="static:bob:pw")
+    try:
+        # anonymous -> 401
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"{srv.url}/3/Cloud")
+        assert ei.value.code == 401
+        # bad form login -> 401
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(urllib.request.Request(
+                f"{srv.url}/3/Login", data=b"username=bob&password=no",
+                method="POST"))
+        assert ei.value.code == 401
+        # good form login -> session cookie works without credentials
+        req = urllib.request.Request(
+            f"{srv.url}/3/Login", data=b"username=bob&password=pw",
+            method="POST")
+        with urllib.request.urlopen(req) as r:
+            cookie = r.headers["Set-Cookie"].split(";")[0]
+            assert cookie.startswith("h2o3-session=")
+        st, payload, _ = _get(f"{srv.url}/3/Cloud", {"Cookie": cookie})
+        assert st == 200 and payload["cloud_size"] >= 1
+        # logout invalidates the session
+        urllib.request.urlopen(urllib.request.Request(
+            f"{srv.url}/3/Logout", data=b"", method="POST",
+            headers={"Cookie": cookie}))
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"{srv.url}/3/Cloud", {"Cookie": cookie})
+        assert ei.value.code == 401
+    finally:
+        srv.stop()
+
+
+def test_client_session_and_basic_paths():
+    from h2o3_tpu import client
+    srv = start_server(port=0, auth="static:ann:tok")
+    try:
+        # Basic header path
+        conn = client.connect(srv.url, username="ann", password="tok")
+        assert conn.cloud["cloud_size"] >= 1
+        # form-login session path: password sent once, cookie thereafter
+        conn2 = client.connect(srv.url, username="ann", password="tok",
+                               use_session=True)
+        assert conn2._auth is None and conn2._cookie
+        assert conn2.get("/3/Cloud")["cloud_size"] >= 1
+    finally:
+        srv.stop()
+
+
+@pytest.fixture(scope="module")
+def tls_pair(tmp_path_factory):
+    d = tmp_path_factory.mktemp("tls")
+    cert, key = str(d / "cert.pem"), str(d / "key.pem")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-keyout", key,
+         "-out", cert, "-days", "2", "-nodes", "-subj", "/CN=127.0.0.1",
+         "-addext", "subjectAltName=IP:127.0.0.1"],
+        check=True, capture_output=True)
+    return cert, key
+
+
+def test_https_server_and_client(tls_pair):
+    cert, key = tls_pair
+    srv = start_server(port=0, auth="static:tls:user",
+                      https_cert=cert, https_key=key)
+    try:
+        assert srv.url.startswith("https://")
+        from h2o3_tpu import client
+        conn = client.connect(srv.url, username="tls", password="user",
+                              cafile=cert)
+        assert conn.cloud["cloud_size"] >= 1
+        # frame import over TLS round-trips
+        rng = np.random.default_rng(0)
+        import tempfile
+        with tempfile.NamedTemporaryFile("w", suffix=".csv",
+                                         delete=False) as fh:
+            fh.write("x,y\n" + "\n".join(
+                f"{v:.3f},{v * 2:.3f}" for v in rng.normal(size=100)))
+            tmp = fh.name
+        fr = conn.import_file(tmp)
+        assert fr.nrows == 100
+        os.unlink(tmp)
+    finally:
+        srv.stop()
+
+
+def test_https_refuses_without_cert(monkeypatch):
+    monkeypatch.delenv("H2O3_TPU_TLS_CERT", raising=False)
+    monkeypatch.delenv("H2O3_TPU_TLS_KEY", raising=False)
+    from h2o3_tpu.runtime import config as _cfg
+    _cfg.reload()
+    with pytest.raises(ValueError, match="https"):
+        start_server(port=0, https=True)
+    _cfg.reload()

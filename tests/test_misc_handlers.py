@@ -1,0 +1,162 @@
+"""REST breadth residue (VERDICT r03 missing #5): CreateFrame, Typeahead,
+MissingInserter, Interaction, Tabulate, DCTTransformer, JStack,
+NetworkTest — handler logic + route round trips."""
+
+import json
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu import (Frame, create_frame, dct_transform,
+                      insert_missing_values, interaction, tabulate)
+from h2o3_tpu.frame.vec import T_CAT
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _init():
+    h2o3_tpu.init()
+
+
+def test_create_frame_shapes_and_types():
+    fr = create_frame(rows=500, cols=10, categorical_fraction=0.3,
+                      integer_fraction=0.2, missing_fraction=0.05,
+                      factors=7, has_response=True, response_factors=3,
+                      seed=42)
+    assert fr.nrows == 500 and fr.ncols == 11
+    assert fr.names[0] == "response"
+    types = fr.types()
+    assert types["response"] == "cat"
+    assert sum(1 for t in types.values() if t == "cat") == 4  # 3 + response
+    # missingness actually lands
+    a_num = next(n for n in fr.names[1:] if types[n] == "num")
+    vals = fr.vec(a_num).to_numpy()
+    assert np.isnan(vals).mean() > 0.005
+
+
+def test_create_frame_reproducible():
+    a = create_frame(rows=50, cols=4, seed=7)
+    b = create_frame(rows=50, cols=4, seed=7)
+    np.testing.assert_array_equal(a.vec(a.names[0]).to_numpy(),
+                                  b.vec(b.names[0]).to_numpy())
+
+
+def test_insert_missing_values():
+    rng = np.random.default_rng(0)
+    fr = Frame.from_numpy({
+        "a": rng.normal(size=400),
+        "c": rng.choice(["x", "y"], 400).astype(object)}, types={"c": T_CAT})
+    out = insert_missing_values(fr, fraction=0.3, seed=1)
+    a = out.vec("a").to_numpy()
+    assert 0.2 < np.isnan(a).mean() < 0.4
+    c = out.vec("c").to_numpy()
+    assert 0.2 < (np.asarray(c) < 0).mean() < 0.4
+
+
+def test_interaction_columns():
+    rng = np.random.default_rng(1)
+    fr = Frame.from_numpy({
+        "f1": rng.choice(["a", "b"], 300).astype(object),
+        "f2": rng.choice(["p", "q", "r"], 300).astype(object),
+        "n": rng.normal(size=300)}, types={"f1": T_CAT, "f2": T_CAT})
+    out = interaction(fr, ["f1", "f2"])
+    assert out.names == ["f1_f2"]
+    dom = out.vec("f1_f2").domain
+    assert set(dom) <= {f"{a}_{b}" for a in "ab" for b in "pqr"}
+    assert len(dom) == 6
+    # codes decode consistently with the source pair
+    codes = out.vec("f1_f2").to_numpy()
+    f1 = fr.vec("f1")
+    f2 = fr.vec("f2")
+    for i in (0, 7, 123):
+        want = (f1.domain[int(f1.to_numpy()[i])] + "_"
+                + f2.domain[int(f2.to_numpy()[i])])
+        assert dom[int(codes[i])] == want
+    with pytest.raises(ValueError, match="categorical"):
+        interaction(fr, ["f1", "n"])
+
+
+def test_interaction_max_factors_pools_other():
+    rng = np.random.default_rng(2)
+    fr = Frame.from_numpy({
+        "f1": rng.choice(list("abcdef"), 600).astype(object),
+        "f2": rng.choice(list("uvwxyz"), 600).astype(object)},
+        types={"f1": T_CAT, "f2": T_CAT})
+    out = interaction(fr, ["f1", "f2"], max_factors=5)
+    dom = out.vec("f1_f2").domain
+    assert len(dom) == 6 and dom[-1] == "other"
+
+
+def test_tabulate_counts_and_means():
+    rng = np.random.default_rng(3)
+    g = rng.choice(["u", "v"], 1000)
+    y = np.where(g == "u", 2.0, 5.0) + 0.01 * rng.normal(size=1000)
+    fr = Frame.from_numpy({"g": g.astype(object), "y": y},
+                          types={"g": T_CAT})
+    out = tabulate(fr, "g", "y", nbins_response=4)
+    assert out["predictor_levels"] == ["u", "v"]
+    counts = np.asarray(out["count_table"])
+    assert counts.sum() == 1000
+    means = {row[0]: row[1] for row in out["response_table"]}
+    assert means["u"] == pytest.approx(2.0, abs=0.01)
+    assert means["v"] == pytest.approx(5.0, abs=0.01)
+
+
+def test_dct_roundtrip():
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(64, 12))
+    fr = Frame.from_numpy({f"p{i}": X[:, i] for i in range(12)})
+    spec = dct_transform(fr, [4, 3, 1])
+    assert spec.ncols == 12
+    # orthonormal DCT: inverse(dct(x)) == x
+    back = dct_transform(spec, [4, 3, 1], inverse=True)
+    Y = np.stack([back.vec(n).to_numpy() for n in back.names], axis=1)
+    np.testing.assert_allclose(Y, X, atol=1e-5)
+    # Parseval: energy preserved
+    S = np.stack([spec.vec(n).to_numpy() for n in spec.names], axis=1)
+    np.testing.assert_allclose((S ** 2).sum(), (X ** 2).sum(), rtol=1e-6)
+
+
+def test_jstack_and_network_test():
+    from h2o3_tpu.runtime.observability import jstack, network_test
+    traces = jstack()
+    assert any("MainThread" in t["name"] for t in traces)
+    assert all(t["traces"] for t in traces)
+    res = network_test(sizes=(1024, 65536))
+    assert len(res) == 2
+    assert all(r["gbytes_per_sec"] > 0 for r in res)
+
+
+def test_rest_routes_round_trip(tmp_path):
+    from h2o3_tpu.api.server import start_server
+    srv = start_server(port=0)
+    try:
+        def get(route):
+            with urllib.request.urlopen(f"{srv.url}{route}") as r:
+                return json.loads(r.read().decode())
+
+        def post(route, **params):
+            data = json.dumps(params).encode()
+            req = urllib.request.Request(f"{srv.url}{route}", data=data,
+                                         method="POST")
+            req.add_header("Content-Type", "application/json")
+            with urllib.request.urlopen(req) as r:
+                return json.loads(r.read().decode())
+
+        made = post("/3/CreateFrame", rows=100, cols=4, seed=5)
+        key = made["key"]["name"]
+        assert made["rows"] == 100
+        miss = post("/3/MissingInserter", dataset=key, fraction=0.2, seed=1)
+        assert miss["key"]["name"] == key
+        (tmp_path / "alpha.csv").write_text("x\n1\n")
+        ta = get("/3/Typeahead/files?src="
+                 + urllib.parse.quote(str(tmp_path / "al")))
+        assert str(tmp_path / "alpha.csv") in ta["matches"]
+        js = get("/3/JStack")
+        assert js["traces"]
+        nt = get("/3/NetworkTest")
+        assert nt["results"]
+    finally:
+        srv.stop()
