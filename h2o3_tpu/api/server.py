@@ -84,7 +84,22 @@ def _model_schema(key: str, m) -> dict:
     }
 
 
+class _Server(ThreadingHTTPServer):
+    """HTTP server with optional per-connection TLS (deferred handshake)."""
+
+    ssl_context = None
+    daemon_threads = True
+
+    def get_request(self):
+        sock, addr = super().get_request()
+        if self.ssl_context is not None:
+            sock = self.ssl_context.wrap_socket(
+                sock, server_side=True, do_handshake_on_connect=False)
+        return sock, addr
+
+
 class _Handler(BaseHTTPRequestHandler):
+    timeout = 120                               # bounds a stalled peer
     routes_get: Dict[str, Callable] = {}
     routes_post: Dict[str, Callable] = {}
     routes_delete: Dict[str, Callable] = {}
@@ -213,18 +228,30 @@ class _Handler(BaseHTTPRequestHandler):
             raw = self.rfile.read(length) if length else b""
             try:
                 params = json.loads(raw)
+                if not isinstance(params, dict):
+                    raise ValueError("login body must be an object")
             except Exception:           # noqa: BLE001 — form-encoded body
-                params = {k: v[0] for k, v in parse_qs(raw.decode()).items()}
+                try:
+                    params = {k: v[0] for k, v in
+                              parse_qs(raw.decode()).items()}
+                except Exception:       # noqa: BLE001 — binary garbage
+                    return self._reply(400, {"error": "malformed login "
+                                                      "body"})
             return self._do_login(params)
         if path == "/3/Logout":
             return self._do_logout()
-        if path == "/3/Models.upload.bin":
-            # raw binary body (a saved model artifact), not JSON
+        if path in ("/3/Models.upload.bin", "/3/PostFile"):
+            # raw binary body (artifact / file upload), not JSON
             if not self._authorized():
                 return self._deny()
             length = int(self.headers.get("Content-Length") or 0)
             raw = self.rfile.read(length)
             try:
+                if path == "/3/PostFile":
+                    q = {k: v[0] for k, v in
+                         parse_qs(urlparse(self.path).query).items()}
+                    return self._reply(200, self.server.api.post_file(
+                        raw, filename=q.get("filename", "upload")))
                 return self._reply(200, self.server.api.model_upload(raw))
             except Exception as e:          # noqa: BLE001
                 return self._reply(400, {"error": repr(e)})
@@ -467,6 +494,20 @@ class Api:
             export_mojo(m, p)
             with open(p, "rb") as f:
                 return f.read()
+
+    def post_file(self, raw: bytes, filename: str = "upload") -> dict:
+        """POST /3/PostFile — push raw file bytes to the cluster
+        (water/api/PostFileHandler analog); returns the server-side path
+        to feed /3/Parse."""
+        import os
+        import tempfile
+        base = os.path.join(tempfile.gettempdir(), "h2o3_uploads")
+        os.makedirs(base, exist_ok=True)
+        safe = os.path.basename(filename) or "upload"
+        fd, path = tempfile.mkstemp(suffix="_" + safe, dir=base)
+        with os.fdopen(fd, "wb") as f:
+            f.write(raw)
+        return {"destination_key": path, "total_bytes": len(raw)}
 
     def model_upload(self, raw: bytes, **kw) -> dict:
         """POST /3/Models.upload.bin — install a client-side artifact."""
@@ -840,7 +881,7 @@ class H2OServer:
         if port is None:
             from ..runtime.config import config
             port = config().port
-        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        self.httpd = _Server(("127.0.0.1", port), _Handler)
         self.httpd.api = self.api
         self.httpd.authenticator = self._authn
         self.httpd.sessions = self._sessions
@@ -855,8 +896,11 @@ class H2OServer:
                     "H2O3_TPU_TLS_CERT/H2O3_TPU_TLS_KEY in the env")
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             ctx.load_cert_chain(cert, key)
-            self.httpd.socket = ctx.wrap_socket(self.httpd.socket,
-                                                server_side=True)
+            # per-connection wrap with a deferred handshake: the TLS
+            # handshake then runs in the HANDLER thread (first read),
+            # not the accept loop — one stalled client cannot freeze
+            # the listener (the handler's socket timeout bounds it)
+            self.httpd.ssl_context = ctx
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 

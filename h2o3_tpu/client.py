@@ -175,6 +175,29 @@ class H2OConnection(Backend):
         out = self.post("/99/AutoMLBuilder", **params)
         return RemoteAutoML(self, out)
 
+    def upload_frame(self, frame_or_bytes,
+                     destination_frame: Optional[str] = None,
+                     filename: str = "upload.csv") -> "RemoteFrame":
+        """Push a LOCAL frame (or raw csv bytes) to the server:
+        /3/PostFile + /3/Parse (h2o.upload_file analog)."""
+        if isinstance(frame_or_bytes, (bytes, bytearray)):
+            raw = bytes(frame_or_bytes)
+        else:
+            import io
+            import tempfile
+            import os
+            from .frame.parse import export_file
+            with tempfile.TemporaryDirectory() as d:
+                p = os.path.join(d, "f.csv")
+                export_file(frame_or_bytes, p)
+                with open(p, "rb") as fh:
+                    raw = fh.read()
+        out = self._req("POST",
+                        f"/3/PostFile?filename={urllib.parse.quote(filename)}",
+                        raw_body=raw)
+        return self.import_file(out["destination_key"],
+                                destination_frame=destination_frame)
+
     def upload_model(self, path: str) -> "RemoteModel":
         """Install a locally saved model artifact on the server."""
         with open(path, "rb") as f:

