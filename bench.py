@@ -162,7 +162,12 @@ def _devices_reachable(timeout_s: float = None) -> bool:
 def worker_main():
     if os.environ.get("H2O3_BENCH_TEST_HANG"):        # rehearsal hook
         time.sleep(10_000)
-    if (not os.environ.get("JAX_PLATFORMS")
+    # Probe device init (killable subprocess) unless this is an explicit
+    # CPU run: the image bakes JAX_PLATFORMS=axon into the driver env, so
+    # "env var set" must NOT imply "skip the probe" — a dead tunnel would
+    # then hang the primary attempt for its whole budget slice instead of
+    # failing over in ~probe-timeout seconds (observed in rehearsal).
+    if (os.environ.get("JAX_PLATFORMS", "") != "cpu"
             and not os.environ.get("H2O3_BENCH_SKIP_PROBE")
             and not _devices_reachable()):
         # The orchestrator owns the fallback (reduced-shape CPU retry with

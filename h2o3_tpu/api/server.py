@@ -308,10 +308,24 @@ class Api:
         return {"frames": [_frame_schema(key, fr)]}
 
     def parse(self, source_frames=None, destination_frame=None, path=None,
-              **kw) -> dict:
+              col_types=None, **kw) -> dict:
+        import os
+        import tempfile
         from .. import import_file
         src = path or source_frames
-        fr = import_file(src, destination_frame=destination_frame)
+        if isinstance(col_types, str):
+            col_types = json.loads(col_types)
+        fr = import_file(src, destination_frame=destination_frame,
+                         **({"col_types": col_types} if col_types else {}))
+        # a PostFile spool is single-use: delete once parsed so repeated
+        # uploads cannot leak disk on a long-lived coordinator
+        spool = os.path.join(tempfile.gettempdir(), "h2o3_uploads")
+        for p in ([src] if isinstance(src, str) else list(src or [])):
+            if isinstance(p, str) and os.path.dirname(p) == spool:
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
         return {"job": {"status": "DONE"},
                 "destination_frame": {"name": fr.key}}
 

@@ -124,9 +124,12 @@ class H2OConnection(Backend):
 
     # -------------------------------------------------------------- actions
     def import_file(self, path: str,
-                    destination_frame: Optional[str] = None) -> "RemoteFrame":
+                    destination_frame: Optional[str] = None,
+                    col_types: Optional[Dict[str, str]] = None
+                    ) -> "RemoteFrame":
+        kw = {"col_types": col_types} if col_types else {}
         out = self.post("/3/Parse", path=path,
-                        destination_frame=destination_frame)
+                        destination_frame=destination_frame, **kw)
         return RemoteFrame(self, out["destination_frame"]["name"])
 
     def frames(self) -> List[str]:
@@ -180,10 +183,10 @@ class H2OConnection(Backend):
                      filename: str = "upload.csv") -> "RemoteFrame":
         """Push a LOCAL frame (or raw csv bytes) to the server:
         /3/PostFile + /3/Parse (h2o.upload_file analog)."""
+        col_types = None
         if isinstance(frame_or_bytes, (bytes, bytearray)):
             raw = bytes(frame_or_bytes)
         else:
-            import io
             import tempfile
             import os
             from .frame.parse import export_file
@@ -192,11 +195,15 @@ class H2OConnection(Backend):
                 export_file(frame_or_bytes, p)
                 with open(p, "rb") as fh:
                     raw = fh.read()
+            # the CSV carries no typing — forward the local frame's column
+            # types so the server does not re-infer cats/times as numerics
+            col_types = frame_or_bytes.types()
         out = self._req("POST",
                         f"/3/PostFile?filename={urllib.parse.quote(filename)}",
                         raw_body=raw)
         return self.import_file(out["destination_key"],
-                                destination_frame=destination_frame)
+                                destination_frame=destination_frame,
+                                col_types=col_types)
 
     def upload_model(self, path: str) -> "RemoteModel":
         """Install a locally saved model artifact on the server."""

@@ -6,3 +6,4 @@ from .tree_api import H2OTree, tree_from_model, feature_interactions
 from .h2o_mojo import load_h2o_mojo
 from .h2o_mojo_writer import write_h2o_mojo
 from .pojo import export_pojo, export_pojo_c
+from .pipeline import export_pipeline, load_pipeline

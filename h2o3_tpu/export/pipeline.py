@@ -1,0 +1,122 @@
+"""Scoring pipelines: transformer chain + model in ONE portable artifact.
+
+Reference: ``h2o-extensions/mojo-pipeline/`` — H2O scores pipeline MOJOs
+(transformations + model bundled by Driverless AI) inside the cluster via
+``MojoPipeline`` models.  The TPU-native analog bundles this framework's
+own fitted transformers (target encoders — the transformer the reference
+itself ships as an extension) with a trained model in a single zip that
+scores standalone (numpy only, no cluster), mirroring the portable MOJO
+contract of ``export/mojo.py``.
+
+Format: ``pipeline.json`` (step specs: encoder tables as lists, blending
+constants, source column domains) + ``model.zip`` (the portable model
+artifact).  ``load_pipeline`` -> ``ScoringPipeline.predict(dict)``:
+applies each encoder in inference mode (no leakage handling, blending as
+trained), appends ``<col>_te`` columns, then scores the model.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+_FORMAT_VERSION = 1
+
+
+def _te_spec(te_model) -> dict:
+    """Serialize a fitted TargetEncoderModel's inference state."""
+    out = te_model.output
+    p = te_model.params
+    cols = {}
+    for col, tbl in out["encoding_tables"].items():
+        spec = next(s for s in te_model.datainfo.specs if s.name == col)
+        cols[col] = {
+            "domain": list(spec.domain or []),
+            "sums": np.asarray(tbl["sums"], np.float64).tolist(),
+            "counts": np.asarray(tbl["counts"], np.float64).tolist(),
+        }
+    return {
+        "kind": "target_encoder",
+        "columns": cols,
+        "prior_mean": float(out["prior_mean"]),
+        "blending": bool(p.blending),
+        "inflection_point": float(p.inflection_point),
+        "smoothing": float(p.smoothing),
+    }
+
+
+def export_pipeline(model, path: str, transformers: Sequence = ()) -> str:
+    """Bundle fitted transformers + a trained model into one zip."""
+    from .mojo import export_mojo
+    steps: List[dict] = []
+    for t in transformers:
+        if getattr(t, "algo", None) == "targetencoder":
+            steps.append(_te_spec(t))
+        else:
+            raise ValueError(
+                f"unsupported pipeline transformer {t!r} "
+                "(fitted TargetEncoder models are supported)")
+    buf = io.BytesIO()
+    export_mojo(model, buf)
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr("pipeline.json", json.dumps({
+            "format_version": _FORMAT_VERSION,
+            "steps": steps,
+        }))
+        zf.writestr("model.zip", buf.getvalue())
+    return path
+
+
+class ScoringPipeline:
+    """Standalone pipeline scorer (numpy only, cluster-free)."""
+
+    def __init__(self, steps: List[dict], scorer):
+        self.steps = steps
+        self.scorer = scorer
+
+    def _apply_te(self, step: dict, data: Dict[str, list]) -> None:
+        prior = step["prior_mean"]
+        for col, spec in step["columns"].items():
+            if col not in data:
+                continue
+            lookup = {s: i for i, s in enumerate(spec["domain"])}
+            sums = np.asarray(spec["sums"])
+            counts = np.asarray(spec["counts"])
+            vals = data[col]
+            codes = np.array([lookup.get(str(v), -1)
+                              if v is not None else -1 for v in vals])
+            ok = (codes >= 0) & (codes < len(sums))
+            cc = np.clip(codes, 0, max(len(sums) - 1, 0))
+            s = np.where(ok, sums[cc], 0.0)
+            c = np.where(ok, counts[cc], 0.0)
+            mean = np.where(c > 0, s / np.maximum(c, 1e-12), prior)
+            if step["blending"]:
+                lam = 1.0 / (1.0 + np.exp(
+                    -(c - step["inflection_point"])
+                    / max(step["smoothing"], 1e-6)))
+                mean = lam * mean + (1 - lam) * prior
+            data[f"{col}_te"] = mean.tolist()
+
+    def predict(self, data: Dict[str, Sequence]) -> dict:
+        data = {k: list(v) for k, v in data.items()}
+        for step in self.steps:
+            if step["kind"] == "target_encoder":
+                self._apply_te(step, data)
+            else:                       # pragma: no cover — format guard
+                raise ValueError(f"unknown pipeline step {step['kind']!r}")
+        return self.scorer.predict(data)
+
+
+def load_pipeline(path) -> ScoringPipeline:
+    from .mojo import import_mojo
+    with zipfile.ZipFile(path) as zf:
+        meta = json.loads(zf.read("pipeline.json"))
+        if meta["format_version"] > _FORMAT_VERSION:
+            raise ValueError("pipeline artifact from a newer format")
+        model_bytes = zf.read("model.zip")
+    return ScoringPipeline(meta["steps"],
+                           import_mojo(io.BytesIO(model_bytes)))

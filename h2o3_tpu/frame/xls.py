@@ -129,7 +129,7 @@ def _cfb_stream(raw: bytes, names=("Workbook", "Book")) -> bytes:
         out, cur, guard = [], start, 0
         while cur not in (_FREE, _ENDCHAIN) and guard < len(fat) + 2:
             out.append(sector(cur))
-            cur = fat[cur]
+            cur = fat[cur] if cur < len(fat) else _ENDCHAIN
             guard += 1
         return b"".join(out)
 

@@ -50,7 +50,9 @@ def save_model(model, path: str, run_id: Optional[str] = None) -> str:
     with open(os.path.join(path, "MLmodel"), "w") as fh:
         yaml.safe_dump(_mlmodel_dict(run_id), fh, sort_keys=False)
     with open(os.path.join(path, "requirements.txt"), "w") as fh:
-        fh.write("numpy\n")
+        # the python_function loader imports h2o3_tpu.mlflow_flavor, so a
+        # serving env built from this file must carry the package itself
+        fh.write("numpy\nh2o3_tpu\n")
     return path
 
 
@@ -80,7 +82,8 @@ def _load_pyfunc(data_path: str) -> _PyFuncModel:
     return load_model(data_path)
 
 
-def log_model(model, artifact_path: str = "model", **kw):
+def log_model(model, artifact_path: str = "model",
+              registered_model_name: Optional[str] = None):
     """Log to the active MLflow run (needs the mlflow library)."""
     try:
         import mlflow
@@ -89,9 +92,13 @@ def log_model(model, artifact_path: str = "model", **kw):
             "log_model needs the mlflow library; use save_model for a "
             "library-free MLflow-layout directory") from e
     import tempfile
+    run = mlflow.active_run()
     with tempfile.TemporaryDirectory() as d:
         local = os.path.join(d, "model")
-        save_model(model, local, run_id=mlflow.active_run().info.run_id
-                   if mlflow.active_run() else None)
-        mlflow.log_artifacts(local, artifact_path=artifact_path, **kw)
+        save_model(model, local, run_id=run.info.run_id if run else None)
+        mlflow.log_artifacts(local, artifact_path=artifact_path)
+    if registered_model_name and run:      # pragma: no cover — needs mlflow
+        mlflow.register_model(
+            f"runs:/{run.info.run_id}/{artifact_path}",
+            registered_model_name)
     return artifact_path

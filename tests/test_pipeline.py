@@ -1,0 +1,60 @@
+"""Scoring-pipeline artifact (mojo-pipeline analog, VERDICT r03 missing
+#6): fitted TargetEncoder + model bundle scores standalone and matches
+the in-framework transform->predict path exactly."""
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu import Frame
+from h2o3_tpu.export.pipeline import export_pipeline, load_pipeline
+from h2o3_tpu.frame.vec import T_CAT
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _init():
+    h2o3_tpu.init()
+
+
+def _data(n=400, seed=0):
+    rng = np.random.default_rng(seed)
+    city = rng.choice(["nyc", "sfo", "chi", "aus"], n)
+    lift = {"nyc": 0.3, "sfo": -0.2, "chi": 0.1, "aus": 0.0}
+    x = rng.normal(size=n).astype(np.float32)
+    logit = x * 0.5 + np.array([lift[c] for c in city])
+    y = rng.random(n) < 1 / (1 + np.exp(-logit))
+    return Frame.from_numpy({
+        "city": city.astype(object), "x": x,
+        "y": np.where(y, "yes", "no").astype(object),
+    }, types={"city": T_CAT, "y": T_CAT})
+
+
+def test_pipeline_roundtrip_matches_in_framework(tmp_path):
+    from h2o3_tpu.models import GBM, TargetEncoder
+    fr = _data()
+    te = TargetEncoder(response_column="y", columns=["city"],
+                       blending=True, noise=0.0, seed=1).train(fr)
+    enc = te.transform(fr)                      # inference mode
+    m = GBM(response_column="y", ntrees=6, max_depth=3, seed=2,
+            ignored_columns=["city"]).train(enc)
+    path = export_pipeline(m, str(tmp_path / "pipe.zip"),
+                           transformers=[te])
+    pipe = load_pipeline(path)
+    data = {"city": [str(v) for v in fr.vec("city").decoded()],
+            "x": fr.vec("x").to_numpy().tolist()}
+    out = pipe.predict(data)
+    native = m.predict(enc).to_numpy()[:, 2].astype(np.float64)
+    np.testing.assert_allclose(out["probabilities"][:, 1], native,
+                               atol=1e-6)
+    # unseen level scores with the prior, not an error
+    out2 = pipe.predict({"city": ["mars"], "x": [0.0]})
+    assert np.isfinite(out2["probabilities"]).all()
+
+
+def test_pipeline_rejects_unknown_transformer(tmp_path):
+    from h2o3_tpu.models import GBM
+    fr = _data(100)
+    m = GBM(response_column="y", ntrees=2, max_depth=2, seed=1).train(fr)
+    with pytest.raises(ValueError, match="transformer"):
+        export_pipeline(m, str(tmp_path / "x.zip"),
+                        transformers=["not-a-model"])

@@ -45,6 +45,43 @@ def test_upload_frame_roundtrip():
         srv.stop()
 
 
+def test_upload_frame_preserves_types():
+    """Cat columns with numeric-string levels must NOT be re-inferred as
+    numerics server-side (client forwards col_types to /3/Parse)."""
+    from h2o3_tpu.api.server import start_server
+    from h2o3_tpu import client
+    srv = start_server(port=0)
+    try:
+        conn = client.connect(srv.url)
+        rng = np.random.default_rng(3)
+        fr = Frame.from_numpy({
+            "zip": rng.choice(["0", "1", "2"], 120).astype(object),
+            "x": rng.normal(size=120)}, types={"zip": T_CAT})
+        rf = conn.upload_frame(fr, destination_frame="typed")
+        assert rf.types()["zip"] == "cat"
+        assert rf.types()["x"] == "num"
+    finally:
+        srv.stop()
+
+
+def test_postfile_spool_is_deleted_after_parse(tmp_path):
+    """/3/PostFile spool files are single-use — parsed then unlinked."""
+    import glob
+    import tempfile
+    from h2o3_tpu.api.server import start_server
+    from h2o3_tpu import client
+    spool = os.path.join(tempfile.gettempdir(), "h2o3_uploads")
+    srv = start_server(port=0)
+    try:
+        conn = client.connect(srv.url)
+        before = set(glob.glob(os.path.join(spool, "*")))
+        conn.upload_frame(b"x,y\n1,2\n3,4\n", destination_frame="sp")
+        after = set(glob.glob(os.path.join(spool, "*")))
+        assert after - before == set()      # consumed and removed
+    finally:
+        srv.stop()
+
+
 def test_external_executor_trains_and_installs_locally():
     from h2o3_tpu.api.server import start_server
     from h2o3_tpu.remote_exec import train_remote
