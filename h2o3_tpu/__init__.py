@@ -132,3 +132,22 @@ def deep_copy(frame: Frame, key: str) -> Frame:
             nv._device = None             # only rebound, never mutated
         vecs.append(nv)
     return Frame(frame.names, vecs, key=key)
+
+
+def download_mojo(model, path: str, format: str = "portable") -> str:
+    """h2o.download_mojo analog.  ``format="portable"`` writes this
+    framework's standalone artifact (export/mojo.py); ``format="h2o"``
+    writes the reference's own MOJO zip format (export/h2o_mojo_writer),
+    scoreable by reference genmodel consumers."""
+    if format == "h2o":
+        from .export.h2o_mojo_writer import write_h2o_mojo
+        return write_h2o_mojo(model, path)
+    from .export.mojo import export_mojo
+    return export_mojo(model, path)
+
+
+def download_pojo(model, path: str, class_name=None) -> str:
+    """h2o.download_pojo analog — dependency-free Java scoring source
+    (export/pojo.py; TreeJCodeGen)."""
+    from .export.pojo import export_pojo
+    return export_pojo(model, path, class_name=class_name)

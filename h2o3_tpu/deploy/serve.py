@@ -30,6 +30,10 @@ def main(argv=None):
     ap.add_argument("--cluster-size", type=int, default=None,
                     help="expected process count for --discover")
     ap.add_argument("--discover-port", type=int, default=8476)
+    ap.add_argument("--flatfile", default=None,
+                    help="cloud from a host:port member file (assisted "
+                         "clustering analog; polled until --cluster-size "
+                         "lines exist)")
     ap.add_argument("--username", default="")
     ap.add_argument("--password", default="")
     ap.add_argument("--auth", default=None,
@@ -45,6 +49,16 @@ def main(argv=None):
          args.process_id) = discover(args.discover,
                                      port=args.discover_port,
                                      expected=args.cluster_size)
+    elif args.flatfile and not args.coordinator:
+        from h2o3_tpu.runtime.discovery import from_flatfile
+        (args.coordinator, args.num_processes,
+         args.process_id) = from_flatfile(args.flatfile,
+                                          expected=args.cluster_size)
+    if (args.num_processes or 0) <= 1:
+        # a 1-member cloud needs no rendezvous/control plane — boot the
+        # plain single-host path (jax.distributed would refuse anyway
+        # once the backend is up)
+        args.coordinator = None
 
     import os
     import jax

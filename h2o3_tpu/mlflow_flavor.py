@@ -97,7 +97,12 @@ def log_model(model, artifact_path: str = "model",
         local = os.path.join(d, "model")
         save_model(model, local, run_id=run.info.run_id if run else None)
         mlflow.log_artifacts(local, artifact_path=artifact_path)
-    if registered_model_name and run:      # pragma: no cover — needs mlflow
+    if registered_model_name:              # pragma: no cover — needs mlflow
+        # log_artifacts auto-creates a run when none was active
+        run = run or mlflow.active_run() or mlflow.last_active_run()
+        if run is None:
+            raise RuntimeError(
+                "registered_model_name given but no MLflow run exists")
         mlflow.register_model(
             f"runs:/{run.info.run_id}/{artifact_path}",
             registered_model_name)

@@ -112,7 +112,15 @@ def init(devices=None, model_axis: int = 1, coordinator: str | None = None,
                 "cluster already booted with a different configuration; "
                 "call h2o3_tpu.shutdown() first to re-init")
         if coordinator is not None:
-            if jax.process_count() == 1:
+            # `jax.process_count()` would itself initialize the XLA
+            # backend, after which jax.distributed.initialize refuses to
+            # run — consult the distributed global state instead (callers
+            # like the multiprocess tests may have initialized already).
+            # num_processes=None stays valid: the TPU environment
+            # auto-detects the slice topology.
+            from jax._src import distributed as _dist
+            if (num_processes != 1
+                    and getattr(_dist.global_state, "client", None) is None):
                 jax.distributed.initialize(coordinator_address=coordinator,
                                            num_processes=num_processes,
                                            process_id=process_id)

@@ -123,6 +123,53 @@ def discover(service: str, port: int = 8476,
     return f"{addrs[0]}:{port}", len(addrs), ranks[0]
 
 
+def from_flatfile(path: str, expected: Optional[int] = None,
+                  timeout_s: float = 300.0,
+                  poll_s: float = 2.0) -> Tuple[str, int, int]:
+    """Assisted clustering: form the cloud from a flatfile of members.
+
+    Reference: ``h2o-clustering`` — an external agent (operator,
+    controller) POSTs a flatfile of ``host:port`` lines to each node,
+    which then clouds from it (AssistedClusteringEndpoint).  Mesh-at-
+    launch analog: the launcher polls ``path`` until ``expected`` member
+    lines exist (the agent writes the file), sorts them, and derives the
+    same (coordinator, size, rank) triple the DNS modes produce —
+    rank = position of one of this host's own addresses.
+    """
+    if expected is None and os.environ.get("H2O3_TPU_CLUSTER_SIZE"):
+        expected = int(os.environ["H2O3_TPU_CLUSTER_SIZE"])
+    deadline = time.monotonic() + timeout_s
+    members: List[str] = []
+    prev: List[str] = []
+    while time.monotonic() < deadline:
+        try:
+            with open(path) as fh:
+                members = sorted({ln.strip() for ln in fh
+                                  if ln.strip()
+                                  and not ln.startswith("#")})
+        except OSError:
+            members = []
+        if members and (expected is None or len(members) >= expected):
+            if expected is not None or members == prev:
+                break           # size met, or stable across two polls
+            prev = members      # no expected size: require stability —
+            #                     the agent's write may be mid-flight
+        time.sleep(poll_s)
+    else:
+        raise TimeoutError(
+            f"flatfile {path!r} has {len(members)} members "
+            f"(expected {expected}) after {timeout_s}s")
+    own = _own_addresses() | {socket.gethostname(),
+                              socket.gethostname().split(".", 1)[0]}
+    ranks = [i for i, m in enumerate(members)
+             if m.rsplit(":", 1)[0] in own]
+    if not ranks:
+        raise RuntimeError(
+            f"flatfile {path!r}: none of this host's addresses "
+            f"{sorted(own)} appear in {members}")
+    return members[0], len(members), ranks[0]
+
+
 def init_from_discovery(service: str, port: int = 8476,
                         expected: Optional[int] = None,
                         model_axis: int = 1, **kw):

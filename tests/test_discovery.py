@@ -152,3 +152,45 @@ def test_coordinator_restart_reimports_and_retrains(tmp_path):
                         capture_output=True, text=True, timeout=600)
     assert r2.returncode == 0, (r2.stdout[-800:], r2.stderr[-1500:])
     assert "RESUMED_OK" in r2.stdout
+
+
+# ------------------------------------------------- assisted clustering
+
+def test_flatfile_clouding(tmp_path, monkeypatch):
+    """h2o-clustering analog: poll a member flatfile, derive the triple."""
+    ff = tmp_path / "flatfile"
+    ff.write_text("# members\n10.0.0.9:8476\n10.0.0.2:8476\n")
+    monkeypatch.setattr(discovery, "_own_addresses",
+                        lambda: {"10.0.0.9"})
+    coord, n, pid = discovery.from_flatfile(str(ff), expected=2,
+                                            timeout_s=10)
+    assert coord == "10.0.0.2:8476"          # sorted; lowest = coordinator
+    assert (n, pid) == (2, 1)
+
+
+def test_flatfile_waits_for_members(tmp_path, monkeypatch):
+    """The file is polled until the expected member count appears —
+    the 'assisted' part: an external agent writes it after boot."""
+    import threading
+    import time as _t
+    ff = tmp_path / "flatfile"
+    ff.write_text("10.0.0.2:8476\n")
+    monkeypatch.setattr(discovery, "_own_addresses",
+                        lambda: {"10.0.0.2"})
+
+    def agent():
+        _t.sleep(1.0)
+        ff.write_text("10.0.0.2:8476\n10.0.0.7:8476\n")
+
+    t = threading.Thread(target=agent)
+    t.start()
+    coord, n, pid = discovery.from_flatfile(str(ff), expected=2,
+                                            timeout_s=30, poll_s=0.2)
+    t.join()
+    assert (coord, n, pid) == ("10.0.0.2:8476", 2, 0)
+
+
+def test_flatfile_timeout(tmp_path):
+    with pytest.raises(TimeoutError):
+        discovery.from_flatfile(str(tmp_path / "nope"), expected=2,
+                                timeout_s=2, poll_s=0.5)
