@@ -53,11 +53,12 @@ def main(argv=None):
         from h2o3_tpu.runtime.discovery import from_flatfile
         (args.coordinator, args.num_processes,
          args.process_id) = from_flatfile(args.flatfile,
-                                          expected=args.cluster_size)
-    if (args.num_processes or 0) <= 1:
-        # a 1-member cloud needs no rendezvous/control plane — boot the
-        # plain single-host path (jax.distributed would refuse anyway
-        # once the backend is up)
+                                          expected=args.cluster_size,
+                                          own_port=args.discover_port)
+    if args.num_processes is not None and args.num_processes <= 1:
+        # an EXPLICIT 1-member cloud needs no rendezvous/control plane —
+        # boot the plain single-host path.  num_processes=None stays
+        # multi-host: the TPU environment auto-detects slice topology.
         args.coordinator = None
 
     import os

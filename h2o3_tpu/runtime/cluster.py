@@ -118,9 +118,13 @@ def init(devices=None, model_axis: int = 1, coordinator: str | None = None,
             # like the multiprocess tests may have initialized already).
             # num_processes=None stays valid: the TPU environment
             # auto-detects the slice topology.
-            from jax._src import distributed as _dist
-            if (num_processes != 1
-                    and getattr(_dist.global_state, "client", None) is None):
+            try:
+                already = jax.distributed.is_initialized()
+            except AttributeError:      # older jax: private-state probe
+                from jax._src import distributed as _dist
+                already = getattr(_dist.global_state, "client",
+                                  None) is not None
+            if num_processes != 1 and not already:
                 jax.distributed.initialize(coordinator_address=coordinator,
                                            num_processes=num_processes,
                                            process_id=process_id)

