@@ -1,0 +1,164 @@
+"""Per-piece chip profiling harness — PROFILE.md's methodology as code.
+
+Round-2 lessons, encoded so a chip session starts productive instead of
+re-deriving them (PROFILE.md "measurement methodology"):
+ - per-dispatch tunnel overhead is ~4 ms: every piece is timed as a
+   ``lax.fori_loop`` of REPS dependent invocations inside ONE jit, then
+   divided — the carry feeds back into an operand so XLA cannot CSE or
+   reorder the calls;
+ - ``block_until_ready`` does not synchronize over the tunnel: the sync
+   point is a tiny real device->host fetch;
+ - operand layouts: inputs are produced on device (iota/prng) so pallas
+   custom-call layout constraints don't charge a relayout to the kernel.
+
+Prints one JSON line per piece.  Shape mirrors bench.py's airlines-10M
+workload; H2O3_PIECES_ROWS overrides for smoke runs.
+
+Usage (chip): python bench_pieces.py
+CPU smoke:    JAX_PLATFORMS=cpu H2O3_PIECES_ROWS=100000 python bench_pieces.py
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+N_ROWS = int(os.environ.get("H2O3_PIECES_ROWS", 10_000_000))
+REPS = int(os.environ.get("H2O3_PIECES_REPS", 20))
+BIN_COUNTS = (21, 12, 7, 256, 256, 22, 256, 256)
+F, NBINS = 8, 256
+B = NBINS + 1
+
+
+def main():
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import jax
+    import jax.numpy as jnp
+
+    import h2o3_tpu
+    cl = h2o3_tpu.init()
+    platform = jax.devices()[0].platform
+    n = N_ROWS - (N_ROWS % (512 * cl.n_row_shards))
+
+    from h2o3_tpu.models.tree.hist import (make_varbin_hist_fn,
+                                           make_hist_fn, offset_codes,
+                                           best_splits)
+
+    def emit(piece, ms, **extra):
+        print(json.dumps({"piece": piece, "ms": round(ms, 3),
+                          "platform": platform, "rows": n, **extra}),
+              flush=True)
+
+    def sync(x):
+        np.asarray(jax.device_get(jnp.ravel(x)[:1]))
+
+    def timed(fn_build, *args):
+        """fn_build(acc, *args) -> new scalar acc; time REPS dependent
+        iterations inside one jit."""
+
+        @jax.jit
+        def reps(*a):
+            def body(i, acc):
+                return fn_build(acc, *a)
+            return jax.lax.fori_loop(0, REPS, body, jnp.float32(0.0))
+
+        out = reps(*args)          # compile + warmup
+        sync(out)
+        out = reps(*args)          # absorb first-exec anomaly
+        sync(out)
+        t0 = time.perf_counter()
+        out = reps(*args)
+        sync(out)
+        return (time.perf_counter() - t0) / REPS * 1e3
+
+    # device-generated inputs (no host transfer, producer-fused layouts)
+    key = jax.random.PRNGKey(0)
+    ks = jax.random.split(key, 8)
+    codes = jnp.stack([
+        jax.random.randint(ks[f], (n,), 0, min(bc, NBINS), dtype=jnp.int32)
+        for f, bc in enumerate(BIN_COUNTS)], axis=0)
+    gcodes = offset_codes(codes, BIN_COUNTS, NBINS)
+    g = jax.random.normal(ks[0], (n,), jnp.float32)
+    h = jnp.abs(jax.random.normal(ks[1], (n,), jnp.float32)) + 0.1
+    w = jnp.ones((n,), jnp.float32)
+
+    # --- histogram levels: varbin (bench path) vs uniform
+    # off-TPU smoke: interpret-mode pallas (slow but same code path)
+    force = "" if platform == "tpu" else "pallas_interpret"
+    for L in (1, 2, 4, 8, 16, 32):
+        leaf = jax.random.randint(ks[2], (n,), 0, L, dtype=jnp.int32)
+        fn = make_varbin_hist_fn(L, F, BIN_COUNTS, B, n, force_impl=force)
+
+        def run_vb(acc, gc, lf, gg, hh, ww, _fn=fn):
+            H = _fn(gc, lf, gg + acc * 0.0, hh, ww)
+            return H[0, 0, 0, 0] * 1e-30
+
+        emit(f"varbin_hist_L{L}", timed(run_vb, gcodes, leaf, g, h, w),
+             kernel="varbin+int16+bf16")
+    for L in (1, 32):
+        leaf = jax.random.randint(ks[3], (n,), 0, L, dtype=jnp.int32)
+        fn = make_hist_fn(L, F, B, n)
+
+        def run_u(acc, cc, lf, gg, hh, ww, _fn=fn):
+            H = _fn(cc, lf, gg + acc * 0.0, hh, ww)
+            return H[0, 0, 0, 0] * 1e-30
+
+        emit(f"uniform_hist_L{L}", timed(run_u, codes, leaf, g, h, w))
+
+    # --- split search on a realistic histogram
+    leaf32 = jax.random.randint(ks[4], (n,), 0, 32, dtype=jnp.int32)
+    H = make_varbin_hist_fn(32, F, BIN_COUNTS, B, n, force_impl=force)(
+        gcodes, leaf32, g, h, w)
+
+    def run_split(acc, Hh):
+        out = best_splits(Hh + acc * 0.0, NBINS, 1.0, 1.0, 0.0)
+        return out[3].reshape(-1)[0].astype(jnp.float32) * 1e-30
+
+    emit("best_splits_L32", timed(run_split, H))
+
+    # --- whole-ensemble scoring (50 trees, depth 6)
+    from h2o3_tpu.models.tree.shared import StackedTrees, traverse
+    T, depth = 50, 6
+    rng = np.random.default_rng(0)
+    levels = []
+    for d in range(depth):
+        width = 2 ** d
+        levels.append((
+            jnp.asarray(rng.integers(0, F, (T, width)), jnp.int32),
+            jnp.asarray(rng.normal(size=(T, width)), jnp.float32),
+            jnp.asarray(rng.random((T, width)) < 0.5),
+            jnp.ones((T, width), bool)))
+    values = jnp.asarray(rng.normal(size=(T, 2 ** depth)) * 0.1,
+                         jnp.float32)
+    X = jax.random.normal(ks[5], (n, F), jnp.float32)
+
+    def run_traverse(acc, Xx):
+        s = traverse(levels, values, Xx + acc * 0.0)
+        return s[0] * 1e-30
+
+    t_ms = timed(run_traverse, X)
+    emit("traverse_50trees_d6", t_ms,
+         trees_per_sec_scoring=round(T / (t_ms / 1e3), 1))
+
+    # --- rapids sort / merge (device)
+    from h2o3_tpu.rapids import sort as _sort  # noqa: F401 — warm import
+    keys_col = jax.random.randint(ks[6], (n,), 0, n, dtype=jnp.int32)
+
+    def run_sort(acc, kk):
+        out = jnp.sort(kk + acc.astype(jnp.int32) * 0)
+        return out[0].astype(jnp.float32) * 1e-30
+
+    emit("device_sort", timed(run_sort, keys_col))
+
+    # --- projected end-to-end: one tree = 6 varbin levels + partition
+    print(json.dumps({"piece": "NOTE",
+                      "note": "tree total ~= sum(varbin_hist_L{1..32}) "
+                              "+ 6x partition (~1.6ms) + split search; "
+                              "see PROFILE.md round-2 table"}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -29,7 +29,10 @@ def main(argv=None):
                          "explicit --coordinator (k8s pod clouding)")
     ap.add_argument("--cluster-size", type=int, default=None,
                     help="expected process count for --discover")
-    ap.add_argument("--discover-port", type=int, default=8476)
+    ap.add_argument("--discover-port", type=int, default=None,
+                    help="rendezvous port (default 8476); for --flatfile "
+                         "it also disambiguates this process's rank when "
+                         "several members share the host")
     ap.add_argument("--flatfile", default=None,
                     help="cloud from a host:port member file (assisted "
                          "clustering analog; polled until --cluster-size "
@@ -47,10 +50,13 @@ def main(argv=None):
         from h2o3_tpu.runtime.discovery import discover
         (args.coordinator, args.num_processes,
          args.process_id) = discover(args.discover,
-                                     port=args.discover_port,
+                                     port=args.discover_port or 8476,
                                      expected=args.cluster_size)
     elif args.flatfile and not args.coordinator:
         from h2o3_tpu.runtime.discovery import from_flatfile
+        # own_port only when EXPLICITLY given: a defaulted port would
+        # satisfy the multi-member-per-host ambiguity guard with the
+        # wrong member instead of erroring
         (args.coordinator, args.num_processes,
          args.process_id) = from_flatfile(args.flatfile,
                                           expected=args.cluster_size,
@@ -71,7 +77,6 @@ def main(argv=None):
     cl = h2o3_tpu.init(coordinator=args.coordinator,
                        num_processes=args.num_processes,
                        process_id=args.process_id)
-    import jax
     if jax.process_index() == 0:
         from h2o3_tpu.api.server import start_server
         server = start_server(port=args.port, username=args.username,

@@ -176,7 +176,8 @@ def from_flatfile(path: str, expected: Optional[int] = None,
                 f"flatfile {path!r} lists {len(ranks)} members on this "
                 "host; pass own_port to disambiguate the rank")
         ranks = [i for i in ranks
-                 if members[i].rsplit(":", 1)[1] == str(own_port)]
+                 if ":" in members[i]
+                 and members[i].rsplit(":", 1)[1] == str(own_port)]
         if len(ranks) != 1:
             raise RuntimeError(
                 f"flatfile {path!r}: port {own_port} matches "

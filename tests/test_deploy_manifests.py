@@ -55,10 +55,6 @@ def _get(values, dotted):
 def _render(template: str, values: dict, release: str) -> str:
     # strip {{- if X }} ... {{- end }} blocks when X is falsy; keep body
     # otherwise.  Non-nested usage only (what the chart uses).
-    def if_repl(m):
-        cond, body = m.group(1).strip(), m.group(2)
-        return body if _get(values, cond.replace(".Values.", "")) else ""
-
     out = re.sub(r"\{\{- if \.Values\.([^}]+)\}\}(.*?)\{\{- end \}\}",
                  lambda m: m.group(2) if _get(values, m.group(1).strip())
                  else "", template, flags=re.S)
