@@ -51,13 +51,37 @@ def _parse_scalar(s: str):
         return s
 
 
+class _DirBackend:
+    """Extracted-MOJO directory as a zip-like backend (the reference's
+    MojoReaderBackend has folder/classpath forms too)."""
+
+    def __init__(self, base: str):
+        self.base = base
+
+    def read(self, name: str) -> bytes:
+        import os
+        with open(os.path.join(self.base, name), "rb") as fh:
+            return fh.read()
+
+    def getinfo(self, name: str):
+        import os
+        if not os.path.exists(os.path.join(self.base, name)):
+            raise KeyError(name)
+        return name
+
+
 class MojoArchive:
-    """Parsed model.ini + blob access for one MOJO zip."""
+    """Parsed model.ini + blob access for one MOJO zip (or extracted
+    directory)."""
 
     def __init__(self, path_or_bytes):
+        import os
         if isinstance(path_or_bytes, (bytes, bytearray)):
             path_or_bytes = io.BytesIO(path_or_bytes)
-        self.zf = zipfile.ZipFile(path_or_bytes)
+        if isinstance(path_or_bytes, str) and os.path.isdir(path_or_bytes):
+            self.zf = _DirBackend(path_or_bytes)
+        else:
+            self.zf = zipfile.ZipFile(path_or_bytes)
         self.info: Dict[str, object] = {}
         self.columns: List[str] = []
         self.domains: Dict[int, List[str]] = {}
@@ -359,19 +383,160 @@ class H2OMojoGlmModel(H2OMojoModel):
         return mu[:, None]
 
 
+class H2OMojoKMeansModel(H2OMojoModel):
+    """KMeans MOJO — KMeansMojoModel.score0 + GenModel KMeans utilities
+    (GenModel.java:523-675: standardize/impute preprocess, categorical
+    Manhattan + numeric Euclidean distance with missing-dimension
+    rescaling)."""
+
+    def __init__(self, ar: MojoArchive):
+        super().__init__(ar)
+        info = ar.info
+        k = int(info["center_num"])
+        self.centers = np.asarray(
+            [info[f"center_{i}"] for i in range(k)], dtype=float)
+        self.standardize = bool(info.get("standardize", False))
+        self.means = np.asarray(info.get("standardize_means")
+                                or [0.0] * self.n_features, dtype=float)
+        self.mults = np.asarray(info.get("standardize_mults")
+                                or [1.0] * self.n_features, dtype=float)
+        self.modes = np.asarray(info.get("standardize_modes")
+                                or [-1] * self.n_features, dtype=float)
+        self.is_cat = np.array([j in self.domains
+                                for j in range(self.n_features)])
+
+    def _preprocess(self, X: np.ndarray) -> np.ndarray:
+        """KMeansMojoModel.score0 preprocesses ONLY when standardize=true
+        (impute + scale); otherwise rows pass through raw and missing
+        dimensions are handled by the distance's NA-skip/rescale."""
+        if not self.standardize:
+            return X
+        X = X.copy()
+        for j in range(self.n_features):
+            col = X[:, j]
+            nan = np.isnan(col)
+            if self.modes[j] == -1:               # numeric
+                col = np.where(nan, self.means[j], col)
+                col = (col - self.means[j]) * self.mults[j]
+            else:                                  # categorical: mode
+                col = np.where(nan, self.modes[j], col)
+            X[:, j] = col
+        return X
+
+    def distances(self, data) -> np.ndarray:
+        X = self._preprocess(self._matrix(data))
+        n, k = X.shape[0], self.centers.shape[0]
+        valid = ~np.isnan(X)
+        pts = valid.sum(axis=1)
+        scale = np.where((pts > 0) & (pts < self.n_features),
+                         self.n_features / np.maximum(pts, 1), 1.0)
+        out = np.zeros((n, k))
+        for c in range(k):
+            center = self.centers[c]
+            sq = np.zeros(n)
+            for j in range(self.n_features):
+                d = X[:, j]
+                ok = valid[:, j]
+                if self.is_cat[j]:
+                    sq += ok * (d != center[j])    # Manhattan
+                else:
+                    delta = np.where(ok, d - center[j], 0.0)
+                    sq += delta * delta
+            out[:, c] = sq * scale
+        return out
+
+    def predict(self, data) -> dict:
+        d = self.distances(data)
+        return {"predict": np.argmin(d, axis=1), "distances": d}
+
+
+class H2OMojoSvmModel(H2OMojoModel):
+    """SparkSVM MOJO — SvmMojoModel.score0 (linear margin + threshold)."""
+
+    def __init__(self, ar: MojoArchive):
+        super().__init__(ar)
+        info = ar.info
+        self.weights = np.asarray(info["weights"], dtype=float)
+        self.interceptor = float(info["interceptor"])
+        self.threshold = float(info.get("threshold", 0.0))
+        self.mean_imputation = bool(info.get("meanImputation", False))
+        self.means = np.asarray(info.get("means")
+                                or [0.0] * self.n_features, dtype=float)
+
+    def predict(self, data) -> dict:
+        X = self._matrix(data)
+        pred = np.full(X.shape[0], self.interceptor)
+        for j in range(self.n_features):
+            col = X[:, j]
+            if self.mean_imputation:
+                col = np.where(np.isnan(col), self.means[j], col)
+            # no imputation: NaN propagates, exactly like score0 —
+            # `NaN > threshold` is false, forcing label index 0
+            pred += col * self.weights[j]
+        if self.nclasses == 1:
+            return {"predict": pred}
+        with np.errstate(invalid="ignore"):
+            label = np.where(np.isnan(pred), 0,
+                             pred > self.threshold).astype(int)
+        dom = self.response_domain or ["0", "1"]
+        return {"predict": np.asarray(dom, dtype=object)[label],
+                "label_index": label, "margin": pred}
+
+
+class H2OMojoIsoforModel(H2OMojoTreeModel):
+    """IsolationForest MOJO — IsolationForestMojoModel.unifyPreds:
+    summed per-tree path lengths -> normalized anomaly score."""
+
+    def __init__(self, ar: MojoArchive):
+        super().__init__(ar)
+        self.min_path = float(ar.info["min_path_length"])
+        self.max_path = float(ar.info["max_path_length"])
+        self.output_anomaly_flag = bool(
+            ar.info.get("output_anomaly_flag", False))
+        self.anomaly_threshold = float(
+            ar.info.get("default_threshold", 0.5))
+
+    def predict(self, data) -> dict:
+        X = self._matrix(data)
+        lengths = self._tree_sums(X)[:, 0]
+        mean_len = lengths / max(self.ntree_groups, 1)
+        if self.max_path > self.min_path:
+            score = (self.max_path - lengths) / (self.max_path
+                                                 - self.min_path)
+        else:
+            score = np.ones_like(lengths)
+        out = {"predict": score, "score": score, "mean_length": mean_len,
+               "path_length": lengths}
+        if self.output_anomaly_flag:
+            # unifyPreds emits [flag, score, mean_length] in this mode
+            out["predict"] = (score > self.anomaly_threshold).astype(int)
+        return out
+
+
 def load_h2o_mojo(path_or_bytes) -> H2OMojoModel:
-    """Open a reference-produced MOJO zip (ModelMojoReader.load analog)."""
+    """Open a reference-produced MOJO (zip or extracted directory) —
+    ModelMojoReader.load analog."""
     ar = MojoArchive(path_or_bytes)
     algo = str(ar.info.get("algo"))
     if algo in ("gbm", "drf"):
         return H2OMojoTreeModel(ar)
     if algo == "glm":
         return H2OMojoGlmModel(ar)
+    if algo == "kmeans":
+        return H2OMojoKMeansModel(ar)
+    if algo == "svm":
+        return H2OMojoSvmModel(ar)
+    if algo == "isolationforest":
+        return H2OMojoIsoforModel(ar)
     raise NotImplementedError(
-        f"H2O MOJO algo {algo!r} not supported (gbm, drf, glm are)")
+        f"H2O MOJO algo {algo!r} not supported "
+        "(gbm, drf, glm, kmeans, svm, isolationforest are)")
 
 
 def is_h2o_mojo(path) -> bool:
+    import os
+    if isinstance(path, str) and os.path.isdir(path):
+        return os.path.isfile(os.path.join(path, "model.ini"))
     try:
         with zipfile.ZipFile(path) as z:
             z.getinfo("model.ini")

@@ -99,3 +99,67 @@ def test_import_mojo_sniffs_reference_archives():
     from h2o3_tpu.export.h2o_mojo import H2OMojoTreeModel
     m = h2o3_tpu.import_mojo(os.path.join(_REF, "mojo.zip"))
     assert isinstance(m, H2OMojoTreeModel)
+
+
+def test_reference_kmeans_mojo_golden_clusters():
+    """KMeansMojoModelTest.testPredict: the reference's own rows assign
+    to clusters 0, 1, 2."""
+    from h2o3_tpu.export.h2o_mojo import load_h2o_mojo
+    m = load_h2o_mojo(os.path.join(_REF, "algos/kmeans"))
+    assert m.algo == "kmeans"
+    rows = [[2.0, 1.0, 22.0, 1.0, 0.0],
+            [2.0, 1.0, 2.0, 3.0, 1.0],
+            [2.0, 0.0, 27.0, 0.0, 2.0]]
+    data = {}
+    for j, name in enumerate(m.feature_names):
+        dom = m.domains.get(j)
+        col = [r[j] for r in rows]
+        data[name] = [dom[int(v)] for v in col] if dom else col
+    out = m.predict(data)
+    np.testing.assert_array_equal(out["predict"], [0, 1, 2])
+    assert out["distances"].shape == (3, 3)
+
+
+def test_reference_svm_mojo_golden_labels():
+    """SvmMojoModelTest: all-zeros row -> label index 1, all-ones -> 0."""
+    from h2o3_tpu.export.h2o_mojo import load_h2o_mojo
+    m = load_h2o_mojo(os.path.join(_REF, "algos/svm"))
+    assert m.algo == "svm"
+    rows = [[0.0] * 6, [1.0] * 6]
+    data = {}
+    for j, name in enumerate(m.feature_names):
+        dom = m.domains.get(j)
+        col = [r[j] for r in rows]
+        data[name] = [dom[int(v)] for v in col] if dom else col
+    out = m.predict(data)
+    np.testing.assert_array_equal(out["label_index"], [1, 0])
+
+
+def test_reference_isofor_mojo_scores():
+    """IsolationForest MOJO: path-length normalization per
+    IsolationForestMojoModel.unifyPreds (fixture has no numeric golden;
+    assert the documented invariants on real artifacts)."""
+    from h2o3_tpu.export.h2o_mojo import load_h2o_mojo
+    m = load_h2o_mojo(os.path.join(_REF, "algos/isofor"))
+    assert m.algo == "isolationforest"
+    assert m.ntree_groups == 10
+    rng = np.random.default_rng(1)
+    data = {name: rng.normal(60, 30, 20).tolist()
+            for name in m.feature_names}
+    out = m.predict(data)
+    assert out["predict"].shape == (20,)
+    # score = (max-sum)/(max-min): bounded above by the max-path anchor
+    assert (out["predict"] <= (70.0 - 0.0) / (70.0 - 40.0)).all()
+    np.testing.assert_allclose(out["mean_length"],
+                               out["path_length"] / 10.0)
+    # deeper mean path  <=>  lower anomaly score (strictly monotonic)
+    order = np.argsort(out["mean_length"])
+    assert (np.diff(out["predict"][order]) <= 1e-12).all()
+
+
+def test_import_mojo_accepts_extracted_directory():
+    """The public import_mojo entry point routes extracted-directory
+    archives to the reference-format reader."""
+    from h2o3_tpu.export.mojo import import_mojo
+    m = import_mojo(os.path.join(_REF, "algos/kmeans"))
+    assert m.algo == "kmeans"
