@@ -22,6 +22,7 @@ export).
 from __future__ import annotations
 
 import io
+import os
 import struct
 import zipfile
 from typing import Dict, List, Optional, Sequence
@@ -59,28 +60,43 @@ class _DirBackend:
         self.base = base
 
     def read(self, name: str) -> bytes:
-        import os
         with open(os.path.join(self.base, name), "rb") as fh:
             return fh.read()
 
     def getinfo(self, name: str):
-        import os
         if not os.path.exists(os.path.join(self.base, name)):
             raise KeyError(name)
         return name
 
 
+class _PrefixBackend:
+    """View into a sub-MOJO nested inside an archive (StackedEnsemble
+    stores base models under ``models/<algo>/<key>/`` prefixes)."""
+
+    def __init__(self, parent, prefix: str):
+        self.parent = parent
+        self.prefix = prefix
+
+    def read(self, name: str) -> bytes:
+        return self.parent.read(self.prefix + name)
+
+    def getinfo(self, name: str):
+        return self.parent.getinfo(self.prefix + name)
+
+
 class MojoArchive:
     """Parsed model.ini + blob access for one MOJO zip (or extracted
-    directory)."""
+    directory, or a nested-backend view)."""
 
-    def __init__(self, path_or_bytes):
-        import os
-        if isinstance(path_or_bytes, (bytes, bytearray)):
-            path_or_bytes = io.BytesIO(path_or_bytes)
-        if isinstance(path_or_bytes, str) and os.path.isdir(path_or_bytes):
-            self.zf = _DirBackend(path_or_bytes)
+    def __init__(self, path_or_bytes, backend=None):
+        if backend is not None:
+            self.zf = backend
+        elif isinstance(path_or_bytes, (str, os.PathLike)) \
+                and os.path.isdir(path_or_bytes):
+            self.zf = _DirBackend(os.fspath(path_or_bytes))
         else:
+            if isinstance(path_or_bytes, (bytes, bytearray)):
+                path_or_bytes = io.BytesIO(path_or_bytes)
             self.zf = zipfile.ZipFile(path_or_bytes)
         self.info: Dict[str, object] = {}
         self.columns: List[str] = []
@@ -312,8 +328,10 @@ class H2OMojoTreeModel(H2OMojoModel):
                 return sums / self.ntree_groups
             if self.nclasses == 2 and not bool(
                     info.get("binomial_double_trees")):
-                p1 = sums[:, 0] / self.ntree_groups
-                return np.stack([1.0 - p1, p1], axis=1)
+                # DrfMojoModel.unifyPreds: binomial DRF trees vote for
+                # CLASS 0 — preds[1] = sum/T, preds[2] = 1 - preds[1]
+                p0 = sums[:, 0] / self.ntree_groups
+                return np.stack([p0, 1.0 - p0], axis=1)
             s = sums.sum(axis=1, keepdims=True)
             with np.errstate(invalid="ignore", divide="ignore"):
                 return np.where(s > 0, sums / s, sums)
@@ -513,10 +531,80 @@ class H2OMojoIsoforModel(H2OMojoTreeModel):
         return out
 
 
-def load_h2o_mojo(path_or_bytes) -> H2OMojoModel:
+class H2OMojoEnsembleModel(H2OMojoModel):
+    """StackedEnsemble MOJO — StackedEnsembleMojoModel.score0: base
+    models score the row (each remaps columns by its own layout — free
+    here, since scoring is name-keyed), their predictions form the
+    metalearner's positional input, with the optional logit transform."""
+
+    def __init__(self, ar: MojoArchive):
+        super().__init__(ar)
+        info = ar.info
+        if self.nclasses > 2:
+            raise NotImplementedError(
+                "multinomial StackedEnsemble MOJOs need a multinomial "
+                "GLM metalearner reader (binomial/regression supported)")
+        transform = str(info.get("metalearner_transform")
+                        or "NONE").upper()
+        if transform not in ("NONE", "LOGIT"):
+            raise NotImplementedError(
+                f"metalearner_transform {transform!r} (NONE/Logit are "
+                "supported, matching StackedEnsembleMojoReader)")
+        self.logit_transform = transform == "LOGIT"
+        dirs = {}
+        for i in range(int(info["submodel_count"])):
+            dirs[str(info[f"submodel_key_{i}"])] = \
+                str(info[f"submodel_dir_{i}"])
+
+        def sub(key: str) -> H2OMojoModel:
+            return load_h2o_mojo(None, backend=_PrefixBackend(
+                ar.zf, dirs[key]))
+
+        self.metalearner = sub(str(info["metalearner"]))
+        # absent base_model{i} slots are pruned/unused models — the
+        # reference skips them but keeps their basePreds position as 0.0
+        self.base_models = [
+            sub(str(info[f"base_model{i}"]))
+            if info.get(f"base_model{i}") is not None else None
+            for i in range(int(info["base_models_num"]))]
+
+    @staticmethod
+    def _logit(p: np.ndarray) -> np.ndarray:
+        p = np.clip(p, 1e-9, 1 - 1e-9)
+        x = p / (1 - p)
+        return np.where(x == 0, -19.0, np.maximum(-19.0, np.log(x)))
+
+    def predict(self, data) -> dict:
+        n = len(next(iter(data.values())))
+        base = np.zeros((n, len(self.base_models)))
+        for i, bm in enumerate(self.base_models):
+            if bm is None:                    # pruned slot: 0.0 column
+                continue
+            out = bm.predict(data)
+            if self.nclasses == 2:
+                base[:, i] = out["probabilities"][:, 1]
+            else:
+                base[:, i] = np.asarray(out["predict"], dtype=float)
+        if self.logit_transform:
+            base = self._logit(base)
+        meta_data = {name: base[:, j].tolist() for j, name in
+                     enumerate(self.metalearner.feature_names)}
+        out = self.metalearner.predict(meta_data)
+        if self.nclasses == 2:
+            # label decisions use the ENSEMBLE's threshold + domain
+            p1 = out["probabilities"][:, 1]
+            thr = float(self.archive.info.get("default_threshold", 0.5))
+            dom = self.response_domain or ["0", "1"]
+            out["predict"] = np.asarray(dom, dtype=object)[
+                (p1 >= thr).astype(int)]
+            out["classes"] = dom
+        return out
+
+
+def load_h2o_mojo(path_or_bytes, backend=None) -> H2OMojoModel:
     """Open a reference-produced MOJO (zip or extracted directory) —
     ModelMojoReader.load analog."""
-    ar = MojoArchive(path_or_bytes)
+    ar = MojoArchive(path_or_bytes, backend=backend)
     algo = str(ar.info.get("algo"))
     if algo in ("gbm", "drf"):
         return H2OMojoTreeModel(ar)
@@ -528,14 +616,15 @@ def load_h2o_mojo(path_or_bytes) -> H2OMojoModel:
         return H2OMojoSvmModel(ar)
     if algo == "isolationforest":
         return H2OMojoIsoforModel(ar)
+    if algo == "stackedensemble":
+        return H2OMojoEnsembleModel(ar)
     raise NotImplementedError(
-        f"H2O MOJO algo {algo!r} not supported "
-        "(gbm, drf, glm, kmeans, svm, isolationforest are)")
+        f"H2O MOJO algo {algo!r} not supported (gbm, drf, glm, kmeans, "
+        "svm, isolationforest, stackedensemble are)")
 
 
 def is_h2o_mojo(path) -> bool:
-    import os
-    if isinstance(path, str) and os.path.isdir(path):
+    if isinstance(path, (str, os.PathLike)) and os.path.isdir(path):
         return os.path.isfile(os.path.join(path, "model.ini"))
     try:
         with zipfile.ZipFile(path) as z:

@@ -228,6 +228,13 @@ def write_tree_mojo(model, path: str) -> str:
         info["link_function"] = "identity"
         if nclasses == 2:
             info["binomial_double_trees"] = False
+            # reference binomial DRF trees vote for CLASS 0
+            # (DrfMojoModel.unifyPreds: p0 = sum/T) — this framework's
+            # DRF leaves carry class-1 fractions, so flip on export
+            matrix = [[dataclasses.replace(
+                t, values=np.float32(1.0)
+                - np.asarray(t.values, np.float32))
+                for t in per_class] for per_class in matrix]
     info["n_trees"] = len(matrix)
     info["n_trees_per_class"] = K
     blobs = {}

@@ -163,3 +163,48 @@ def test_import_mojo_accepts_extracted_directory():
     from h2o3_tpu.export.mojo import import_mojo
     m = import_mojo(os.path.join(_REF, "algos/kmeans"))
     assert m.algo == "kmeans"
+
+
+def test_reference_stackedensemble_regression_golden():
+    """StackedEnsembleRegressionMojoTest: prostate row -> 66.29695."""
+    from h2o3_tpu.export.h2o_mojo import load_h2o_mojo
+    m = load_h2o_mojo(os.path.join(_REF, "algos/ensemble/regression.zip"))
+    assert m.algo == "stackedensemble"
+    row = {"CAPSULE": ["0"], "RACE": ["1"], "DPROS": ["2"],
+           "DCAPS": ["1"], "PSA": [1.4], "VOL": [0], "GLEASON": [6]}
+    out = m.predict(row)
+    assert out["predict"][0] == pytest.approx(66.29695, abs=1e-5)
+
+
+def test_reference_stackedensemble_binomial_golden():
+    """StackedEnsembleBinomialMojoTest: label '0',
+    probs [0.8222695, 0.1777305]."""
+    from h2o3_tpu.export.h2o_mojo import load_h2o_mojo
+    m = load_h2o_mojo(os.path.join(_REF, "algos/ensemble/binomial.zip"))
+    row = {"AGE": [65], "RACE": ["1"], "DPROS": ["2"], "DCAPS": ["1"],
+           "PSA": [1.4], "VOL": [0], "GLEASON": [6]}
+    out = m.predict(row)
+    np.testing.assert_allclose(out["probabilities"][0],
+                               [0.8222695, 0.1777305], atol=1e-5)
+    assert out["predict"][0] == "0"
+
+
+def test_reference_stackedensemble_pruned_base_models():
+    """StackedEnsembleBinomialWithoutUselessModelsMojoTest: 27 slots,
+    only base_model6 present (rest pruned -> None + 0.0 columns);
+    AGE=65 row labels '1'."""
+    from h2o3_tpu.export.h2o_mojo import load_h2o_mojo
+    m = load_h2o_mojo(os.path.join(
+        _REF, "algos/ensemble/binomial_without_useless_models.zip"))
+    assert len(m.base_models) == 27
+    assert [i for i, b in enumerate(m.base_models)
+            if b is not None] == [6]
+    out = m.predict({"AGE": [65]})
+    assert out["predict"][0] == "1"
+
+
+def test_import_mojo_accepts_pathlib_directory(tmp_path):
+    import pathlib
+    from h2o3_tpu.export.mojo import import_mojo
+    m = import_mojo(pathlib.Path(_REF) / "algos" / "kmeans")
+    assert m.algo == "kmeans"
