@@ -585,7 +585,9 @@ class H2OMojoEnsembleModel(H2OMojoModel):
                 base[:, i] = out["probabilities"][:, 1]
             else:
                 base[:, i] = np.asarray(out["predict"], dtype=float)
-        if self.logit_transform:
+        if self.logit_transform and self.nclasses == 2:
+            # score0 logit-transforms only the classification branches;
+            # regression base predictions feed the metalearner raw
             base = self._logit(base)
         meta_data = {name: base[:, j].tolist() for j, name in
                      enumerate(self.metalearner.feature_names)}
@@ -599,6 +601,46 @@ class H2OMojoEnsembleModel(H2OMojoModel):
                 (p1 >= thr).astype(int)]
             out["classes"] = dom
         return out
+
+
+class H2OMojoWord2VecModel(H2OMojoModel):
+    """Word2Vec MOJO — Word2VecMojoModel.transform0: vocabulary text
+    lines + BIG-endian float32 vectors (Java ByteBuffer default order,
+    despite the ini's LITTLE_ENDIAN marker — Word2VecMojoReader wraps
+    the blob without setting an order)."""
+
+    def __init__(self, ar: MojoArchive):
+        super().__init__(ar)
+        self.vec_size = int(ar.info["vec_size"])
+        vocab_size = int(ar.info["vocab_size"])
+        # readtext semantics: every line kept (even blank tokens, which
+        # consume a vector row), newline escapes undone, then trimmed
+        vocab = [w.replace("\\n", "\n").strip()
+                 for w in ar.blob("vocabulary").decode().splitlines()]
+        raw = ar.blob("vectors")
+        if len(raw) != vocab_size * self.vec_size * 4 \
+                or len(vocab) != vocab_size:
+            raise ValueError(
+                f"corrupted word2vec vectors: {len(raw)} bytes / "
+                f"{len(vocab)} words for vocab_size={vocab_size}, "
+                f"vec_size={self.vec_size}")
+        vecs = np.frombuffer(raw, dtype=">f4").astype(np.float32)
+        vecs = vecs.reshape(vocab_size, self.vec_size)
+        self.embeddings = {w: vecs[i] for i, w in enumerate(vocab)}
+
+    def transform(self, words) -> np.ndarray:
+        """[n, vec_size]; out-of-dictionary words become NaN rows
+        (transform0 returns null there)."""
+        out = np.full((len(words), self.vec_size), np.nan, np.float32)
+        for i, w in enumerate(words):
+            vec = self.embeddings.get(str(w))
+            if vec is not None:
+                out[i] = vec
+        return out
+
+    def predict(self, data) -> dict:
+        col = next(iter(data.values()))
+        return {"embeddings": self.transform(list(col))}
 
 
 def load_h2o_mojo(path_or_bytes, backend=None) -> H2OMojoModel:
@@ -618,9 +660,11 @@ def load_h2o_mojo(path_or_bytes, backend=None) -> H2OMojoModel:
         return H2OMojoIsoforModel(ar)
     if algo == "stackedensemble":
         return H2OMojoEnsembleModel(ar)
+    if algo == "word2vec":
+        return H2OMojoWord2VecModel(ar)
     raise NotImplementedError(
         f"H2O MOJO algo {algo!r} not supported (gbm, drf, glm, kmeans, "
-        "svm, isolationforest, stackedensemble are)")
+        "svm, isolationforest, stackedensemble, word2vec are)")
 
 
 def is_h2o_mojo(path) -> bool:

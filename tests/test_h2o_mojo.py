@@ -208,3 +208,15 @@ def test_import_mojo_accepts_pathlib_directory(tmp_path):
     from h2o3_tpu.export.mojo import import_mojo
     m = import_mojo(pathlib.Path(_REF) / "algos" / "kmeans")
     assert m.algo == "kmeans"
+
+
+def test_reference_word2vec_mojo_golden():
+    """Word2VecMojoModelTest: 'a' -> [0,1,0.2], 'b' -> [1,0,0.8],
+    out-of-dictionary 'c' -> null (NaN row here)."""
+    from h2o3_tpu.export.h2o_mojo import load_h2o_mojo
+    m = load_h2o_mojo(os.path.join(_REF, "algos/word2vec"))
+    assert m.algo == "word2vec" and m.vec_size == 3
+    emb = m.transform(["a", "b", "c"])
+    np.testing.assert_allclose(emb[0], [0.0, 1.0, 0.2], atol=1e-4)
+    np.testing.assert_allclose(emb[1], [1.0, 0.0, 0.8], atol=1e-4)
+    assert np.isnan(emb[2]).all()
