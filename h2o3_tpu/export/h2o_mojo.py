@@ -627,6 +627,13 @@ class H2OMojoWord2VecModel(H2OMojoModel):
         vecs = np.frombuffer(raw, dtype=">f4").astype(np.float32)
         vecs = vecs.reshape(vocab_size, self.vec_size)
         self.embeddings = {w: vecs[i] for i, w in enumerate(vocab)}
+        if len(self.embeddings) != vocab_size:
+            # duplicate vocabulary words collapse in the map; the reference
+            # reader rejects this as corruption (Word2VecMojoReader:
+            # "Corrupted model, unexpected number of words")
+            raise ValueError(
+                f"corrupted word2vec vocabulary: {len(self.embeddings)} "
+                f"distinct words for vocab_size={vocab_size}")
 
     def transform(self, words) -> np.ndarray:
         """[n, vec_size]; out-of-dictionary words become NaN rows

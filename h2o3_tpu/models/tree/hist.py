@@ -242,7 +242,13 @@ def _make_pallas_varbin_hist(L: int, F: int, bin_counts, B: int,
             out_ref[:] = jnp.zeros_like(out_ref)
 
         leaf = leaf_ref[0].astype(jnp.int32)
-        ST = st_ref[:]                                 # [3, R] stat_dt
+        # [3, R] stat_dt -> f32: Mosaic's apply-vector-layout pass only
+        # supports non-no-op minor-dim insertion ([R] -> [R, 1]) for 32-bit
+        # types, and the sv select below does exactly that broadcast.  The
+        # upcast is VMEM-local; A still feeds the MXU as bf16.  (Found on
+        # chip: the AOT gate's MLIR verifier passes this, the backend
+        # layout pass rejects it.)
+        ST = st_ref[:].astype(jnp.float32)
         cols = jax.lax.broadcasted_iota(jnp.int32, (R, L3), 1)
         l_of, s_of = cols // planes, cols % planes
         match = leaf[:, None] == l_of
