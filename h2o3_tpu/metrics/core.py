@@ -40,16 +40,40 @@ def _binomial_hist_kernel(p1, y, w, nbins: int):
 
     Bin i covers scores in [i/nbins, (i+1)/nbins); returns (pos[nbins],
     neg[nbins], logloss_sum, se_sum, wsum, wpos).
+
+    The histogram is a blocked one-hot matmul (HIGHEST precision keeps f32
+    weights exact), not a scatter-add: TPU serializes scatters — measured
+    0.58 s per 10M-row metrics call, ~40x the MXU formulation.
     """
     p1c = jnp.clip(p1, 1e-15, 1 - 1e-15)
     idx = jnp.clip((p1 * nbins).astype(jnp.int32), 0, nbins - 1)
     pos_w = w * (y == 1)
     neg_w = w * (y == 0)
-    pos = jnp.zeros(nbins, jnp.float32).at[idx].add(pos_w)
-    neg = jnp.zeros(nbins, jnp.float32).at[idx].add(neg_w)
+    n = p1.shape[0]
+    blk = min(n, 1 << 20)
+    nblk = -(-n // blk)
+    pad = nblk * blk - n
+    idxp = jnp.pad(idx, (0, pad)).reshape(nblk, blk)
+    S = jnp.pad(jnp.stack([pos_w, neg_w], axis=1),
+                [(0, pad), (0, 0)]).reshape(nblk, blk, 2)
+    biota = jax.lax.broadcasted_iota(jnp.int32, (nbins, 1), 0)
+
+    def body(acc, args):
+        ib, sb = args
+        oh = (biota == ib[None, :]).astype(jnp.float32)      # [nbins, blk]
+        return acc + jnp.dot(oh, sb,
+                             precision=jax.lax.Precision.HIGHEST), None
+
+    hist, _ = jax.lax.scan(body, jnp.zeros((nbins, 2), jnp.float32),
+                           (idxp, S))
+    pos, neg = hist[:, 0], hist[:, 1]
     ll = -jnp.sum(w * (y * jnp.log(p1c) + (1 - y) * jnp.log1p(-p1c)))
     se = jnp.sum(w * (y - p1) ** 2)
-    return pos, neg, ll, se, jnp.sum(w), jnp.sum(pos_w)
+    # ONE packed result -> one device->host fetch (each fetch is a full
+    # round trip on a tunnelled backend, ~67 ms measured)
+    return jnp.concatenate([pos, neg,
+                            jnp.stack([ll, se, jnp.sum(w),
+                                       jnp.sum(pos_w)])])
 
 
 @dataclasses.dataclass
@@ -116,10 +140,10 @@ class ModelMetricsBinomial:
 def binomial_metrics(p1, y, w, domain: Optional[List[str]] = None
                      ) -> ModelMetricsBinomial:
     """AUC2-equivalent metrics from P(class1), labels {0,1}, weights."""
-    pos, neg, ll, se, wsum, wpos = _binomial_hist_kernel(
-        jnp.asarray(p1), jnp.asarray(y), jnp.asarray(w), NBINS)
-    pos = np.asarray(pos, np.float64)
-    neg = np.asarray(neg, np.float64)
+    packed = np.asarray(_binomial_hist_kernel(
+        jnp.asarray(p1), jnp.asarray(y), jnp.asarray(w), NBINS), np.float64)
+    pos, neg = packed[:NBINS], packed[NBINS: 2 * NBINS]
+    ll, se, wsum, wpos = packed[2 * NBINS:]
     n = float(wsum)
     npos = float(wpos)
     nneg = n - npos
@@ -171,7 +195,8 @@ def _multinomial_kernel(probs, y, w, nclasses: int):
     match = (order == yi[:, None])
     ranks = jnp.argmax(match, axis=1)
     topk = jnp.zeros(nclasses, jnp.float32).at[ranks].add(w)
-    return ll, cm.reshape(nclasses, nclasses), se, jnp.sum(w), topk
+    # packed: one fetch (see _binomial_hist_kernel)
+    return jnp.concatenate([jnp.stack([ll, se, jnp.sum(w)]), cm, topk])
 
 
 @dataclasses.dataclass
@@ -199,14 +224,16 @@ class ModelMetricsMultinomial:
 def multinomial_metrics(probs, y, w, domain: List[str]
                         ) -> ModelMetricsMultinomial:
     k = len(domain)
-    ll, cm, se, wsum, topk = _multinomial_kernel(
-        jnp.asarray(probs), jnp.asarray(y), jnp.asarray(w), k)
-    cm = np.asarray(cm, np.float64)
+    packed = np.asarray(_multinomial_kernel(
+        jnp.asarray(probs), jnp.asarray(y), jnp.asarray(w), k), np.float64)
+    ll, se, wsum = packed[:3]
+    cm = packed[3: 3 + k * k].reshape(k, k)
+    topk = packed[3 + k * k:]
     n = float(wsum)
     row = cm.sum(axis=1)
     diag = np.diag(cm)
     per_class = np.where(row > 0, 1 - diag / np.maximum(row, 1e-12), 0.0)
-    hit = np.cumsum(np.asarray(topk, np.float64)) / max(n, 1e-12)
+    hit = np.cumsum(topk) / max(n, 1e-12)
     return ModelMetricsMultinomial(
         nobs=n, logloss=float(ll) / max(n, 1e-12),
         mse=float(se) / max(n, 1e-12),
@@ -232,7 +259,8 @@ def _regression_kernel(pred, y, w):
                             w * (jnp.log1p(jnp.clip(pred, -1 + 1e-12, None))
                                  - jnp.log1p(jnp.clip(y, -1 + 1e-12, None))) ** 2,
                             0.0))
-    return se, ae, wsum, sst, sle
+    # packed: one fetch (see _binomial_hist_kernel)
+    return jnp.stack([se, ae, wsum, sst, sle])
 
 
 @dataclasses.dataclass
@@ -253,8 +281,8 @@ class ModelMetricsRegression:
 
 def regression_metrics(pred, y, w, deviance_sum: Optional[float] = None
                        ) -> ModelMetricsRegression:
-    se, ae, wsum, sst, sle = _regression_kernel(
-        jnp.asarray(pred), jnp.asarray(y), jnp.asarray(w))
+    se, ae, wsum, sst, sle = np.asarray(_regression_kernel(
+        jnp.asarray(pred), jnp.asarray(y), jnp.asarray(w)), np.float64)
     n = max(float(wsum), 1e-12)
     mse = float(se) / n
     return ModelMetricsRegression(

@@ -27,6 +27,7 @@ from ..frame.frame import Frame
 from ..frame.vec import Vec, T_CAT, T_NUM, T_TIME
 from ..runtime.cluster import cluster
 
+
 MEAN_IMPUTATION = "mean_imputation"
 SKIP = "skip"
 
@@ -148,13 +149,42 @@ class DataInfo:
         with NA bucket, optional intercept column.  Unseen test levels map to
         the NA bucket (the reference's adaptTestForTrain ``skipMissing`` /
         makeNA path, hex/Model.java:1683).
+
+        Memoized in the Frame's ``_matrix_cache`` (so ``Frame.spill()``
+        evicts it under HBM pressure like every other device view): repeated
+        train/predict over the same Frame reuse one device matrix.  Runs of
+        numeric columns are processed as ONE batched block — per-column
+        eager ops cost a ~1.4 ms dispatch each on a tunnelled backend
+        (784 columns = seconds).
         """
         standardize = self.standardize if standardize is None else standardize
+        key = ("__design__", standardize, self._design_signature())
+        hit = frame._matrix_cache.get(key)
+        if hit is not None:
+            return hit
         cl = cluster()
-        cols = []
+        cols = []          # list of [padded, k] blocks in spec order
+        num_run: list = []
+
+        def flush_numeric():
+            if not num_run:
+                return
+            specs_r, arrs = zip(*num_run)
+            num_run.clear()
+            X = jnp.stack(arrs, axis=0).astype(jnp.float32)  # [C, padded]
+            means = jnp.asarray([s.mean for s in specs_r],
+                                jnp.float32)[:, None]
+            X = jnp.where(jnp.isnan(X), means, X)
+            if standardize:
+                sigmas = jnp.asarray([s.sigma for s in specs_r],
+                                     jnp.float32)[:, None]
+                X = (X - means) / sigmas
+            cols.append(X.T)
+
         for s in self.specs:
             vec = frame.vec(s.name)
             if s.type == T_CAT:
+                flush_numeric()
                 codes = self._aligned_codes(vec, s)
                 lo = 0 if self.use_all_factor_levels else 1
                 width = s.width - 1
@@ -166,15 +196,30 @@ class DataInfo:
                 x = vec.data
                 if s.type == T_TIME and abs(vec.time_base - s.time_base) > 0:
                     x = x + (vec.time_base - s.time_base) / 1000.0
-                x = jnp.where(jnp.isnan(x), s.mean, x)
-                if standardize:
-                    x = (x - s.mean) / s.sigma
-                cols.append(x[:, None])
+                num_run.append((s, x))
+        flush_numeric()
         if self.add_intercept:
             cols.append(jnp.ones((frame.padded_rows, 1), jnp.float32))
-        mat = jnp.concatenate(cols, axis=1)
+        mat = cols[0] if len(cols) == 1 else jnp.concatenate(cols, axis=1)
         from ..runtime.cluster import put_sharded
-        return put_sharded(mat, cl.matrix_sharding)
+        mat = put_sharded(mat, cl.matrix_sharding)
+        frame._matrix_cache[key] = mat
+        return mat
+
+    def _design_signature(self) -> int:
+        """Compact memo key for the design layout, computed once per
+        DataInfo (repr(self) would rebuild every categorical domain list as
+        a string on every call)."""
+        sig = self.__dict__.get("_design_sig")
+        if sig is None:
+            sig = hash((
+                tuple((s.name, s.type, tuple(s.domain or ()), s.mean,
+                       s.sigma, s.time_base, s.offset, s.width)
+                      for s in self.specs),
+                self.use_all_factor_levels, self.add_intercept,
+                self.missing_values_handling))
+            object.__setattr__(self, "_design_sig", sig)
+        return sig
 
     def _aligned_codes(self, vec: Vec, s: ColumnSpec) -> jax.Array:
         """Map a (possibly differently-coded) cat Vec onto training codes."""

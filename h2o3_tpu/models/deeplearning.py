@@ -21,6 +21,7 @@ processed between scoring/early-stopping checks.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from typing import List, Optional, Sequence
 
@@ -74,6 +75,94 @@ class DeepLearningParameters(Parameters):
     stopping_metric: str = "auto"
     stopping_tolerance: float = 0.0
     max_iterations: int = 10 ** 9        # unused; epochs governs
+
+
+@functools.lru_cache(maxsize=None)
+def _make_train_steps(activation: str, dropout_in: float, dropout_h: tuple,
+                      loss_kind: str, is_cls: bool, autoenc: bool,
+                      out_dim: int, l1: float, l2: float, opt_cfg: tuple,
+                      batch: int, steps_per_iter: int, n: int):
+    """Compiled training-interval program, CACHED ACROSS train() calls.
+
+    The per-call ``@jax.jit def train_steps`` pattern recompiled (and paid
+    the remote backend's multi-second first-execution penalty) on every
+    train() — bench.py's warmup model compiled a program the timed model
+    then could not reuse (measured on chip: the timed MNIST run spent most
+    of its wall clock there, reporting 2.7k samples/s).  Everything the
+    program closes over is reconstructed here from hashable config; the
+    data (X, y, w) are traced arguments, so any same-shaped training run
+    reuses the executable.  Returns (train_steps, tx).
+    """
+    act = _activation_fn(activation)
+    maxout = act is None
+
+    def forward(params, X, rng):
+        h = X
+        if dropout_in > 0:
+            rng, k = jax.random.split(rng)
+            h = h * jax.random.bernoulli(k, 1 - dropout_in, h.shape) \
+                / (1 - dropout_in)
+        for i, (W, b) in enumerate(params[:-1]):
+            z = h @ W + b
+            z = z.reshape(z.shape[0], -1, 2).max(axis=2) if maxout else act(z)
+            dr = dropout_h[i] if i < len(dropout_h) else 0.0
+            if dr > 0:
+                rng, k = jax.random.split(rng)
+                z = z * jax.random.bernoulli(k, 1 - dr, z.shape) / (1 - dr)
+            h = z
+        W, b = params[-1]
+        return h @ W + b
+
+    def loss_fn(params, xb, yb, wb, key):
+        logits = forward(params, xb, key)
+        if autoenc:
+            per = jnp.mean((logits - xb) ** 2, axis=1)
+        elif is_cls:
+            yi = jnp.clip(yb.astype(jnp.int32), 0, out_dim - 1)
+            per = optax.softmax_cross_entropy_with_integer_labels(logits, yi)
+        elif loss_kind == "absolute":
+            per = jnp.abs(logits[:, 0] - yb)
+        elif loss_kind == "huber":
+            per = optax.huber_loss(logits[:, 0], yb, delta=1.0)
+        else:
+            per = (logits[:, 0] - yb) ** 2
+        loss = jnp.sum(per * wb) / jnp.maximum(jnp.sum(wb), 1e-12)
+        if l2 > 0 or l1 > 0:
+            for W, _ in params:
+                loss = loss + l2 * jnp.sum(W * W) + l1 * jnp.sum(jnp.abs(W))
+        return loss
+
+    kind, *hp = opt_cfg
+    if kind == "adadelta":
+        tx = optax.adadelta(learning_rate=1.0, rho=hp[0], eps=hp[1])
+    elif kind == "sgd_momentum":
+        tx = optax.sgd(hp[0], momentum=hp[1])
+    else:
+        tx = optax.sgd(hp[0])
+
+    def sgd_step(X, y, w, carry, key):
+        params, opt_state = carry
+        k1, k2 = jax.random.split(key)
+        idx = jax.random.randint(k1, (batch,), 0, n)
+        xb = jnp.take(X, idx, axis=0)
+        yb = jnp.take(y, idx)
+        wb = jnp.take(w, idx)
+        loss, grads = jax.value_and_grad(loss_fn)(params, xb, yb, wb, k2)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return (params, opt_state), loss
+
+    @jax.jit
+    def train_steps(params, opt_state, rng0, it, X, y, w):
+        # keys derive in-jit from (rng0, iteration): eager jax.random ops
+        # in the driver loop cost a ~50 ms round trip each on a tunnelled
+        # backend (measured round 4)
+        keys = jax.random.split(jax.random.fold_in(rng0, it), steps_per_iter)
+        (params, opt_state), losses = jax.lax.scan(
+            functools.partial(sgd_step, X, y, w), (params, opt_state), keys)
+        return params, opt_state, jnp.mean(losses)
+
+    return train_steps, tx
 
 
 def _activation_fn(name: str):
@@ -202,12 +291,12 @@ class DeepLearning(ModelBuilder):
                       for W, b in prior.output["weights"]]
 
         if p.adaptive_rate:
-            tx = optax.adadelta(learning_rate=1.0, rho=p.rho, eps=p.epsilon)
+            opt_cfg = ("adadelta", p.rho, p.epsilon)
         elif p.momentum_stable > 0 or p.momentum_start > 0:
-            tx = optax.sgd(p.rate, momentum=p.momentum_stable or p.momentum_start)
+            opt_cfg = ("sgd_momentum", p.rate,
+                       p.momentum_stable or p.momentum_start)
         else:
-            tx = optax.sgd(p.rate)
-        opt_state = tx.init(params)
+            opt_cfg = ("sgd", p.rate)
 
         loss_kind = p.loss
         if loss_kind == "automatic":
@@ -216,33 +305,7 @@ class DeepLearning(ModelBuilder):
         if p.activation.endswith("_with_dropout") and not dropout_h:
             dropout_h = tuple(0.5 for _ in p.hidden)
 
-        def loss_fn(params, xb, yb, wb, key):
-            logits = model._forward(params, xb, deterministic=False, rng=key,
-                                    dropout_in=p.input_dropout_ratio,
-                                    dropout_hidden=dropout_h)
-            if p.custom_loss_func is not None:
-                pred = logits if (is_cls or p.autoencoder) else logits[:, 0]
-                per = p.custom_loss_func(pred, xb if p.autoencoder else yb)
-            elif p.autoencoder:
-                per = jnp.mean((logits - xb) ** 2, axis=1)
-            elif is_cls:
-                yi = jnp.clip(yb.astype(jnp.int32), 0, out_dim - 1)
-                per = optax.softmax_cross_entropy_with_integer_labels(logits, yi)
-            elif loss_kind == "absolute":
-                per = jnp.abs(logits[:, 0] - yb)
-            elif loss_kind == "huber":
-                per = optax.huber_loss(logits[:, 0], yb, delta=1.0)
-            else:
-                per = (logits[:, 0] - yb) ** 2
-            loss = jnp.sum(per * wb) / jnp.maximum(jnp.sum(wb), 1e-12)
-            if p.l2 > 0 or p.l1 > 0:
-                for W, _ in params:
-                    loss = loss + p.l2 * jnp.sum(W * W) \
-                        + p.l1 * jnp.sum(jnp.abs(W))
-            return loss
-
         batch = min(p.mini_batch_size, n)
-        padded = X.shape[0]
 
         # iteration sizing: train_samples_per_iteration semantics
         tspi = p.train_samples_per_iteration
@@ -256,25 +319,66 @@ class DeepLearning(ModelBuilder):
         steps_per_iter = max(samples_per_iter // batch, 1)
         n_iters = max(total_samples // (steps_per_iter * batch), 1)
 
-        def sgd_step(carry, key):
-            params, opt_state = carry
-            k1, k2 = jax.random.split(key)
-            idx = jax.random.randint(k1, (batch,), 0, n)
-            xb = jnp.take(X, idx, axis=0)
-            yb = jnp.take(y, idx)
-            wb = jnp.take(w, idx)
-            loss, grads = jax.value_and_grad(loss_fn)(params, xb, yb, wb, k2)
-            updates, opt_state = tx.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return (params, opt_state), loss
+        if p.custom_loss_func is None:
+            # cached across train() calls: same architecture/config/shapes
+            # reuse one executable (no recompile, no first-exec penalty)
+            train_steps, tx = _make_train_steps(
+                p.activation, p.input_dropout_ratio, dropout_h, loss_kind,
+                is_cls, p.autoencoder, out_dim, p.l1, p.l2, opt_cfg,
+                batch, steps_per_iter, n)
+        else:
+            # custom python loss: not hashable, keep the per-call program
+            def loss_fn(params, xb, yb, wb, key):
+                logits = model._forward(
+                    params, xb, deterministic=False, rng=key,
+                    dropout_in=p.input_dropout_ratio,
+                    dropout_hidden=dropout_h)
+                pred = logits if (is_cls or p.autoencoder) else logits[:, 0]
+                per = p.custom_loss_func(pred, xb if p.autoencoder else yb)
+                loss = jnp.sum(per * wb) / jnp.maximum(jnp.sum(wb), 1e-12)
+                if p.l2 > 0 or p.l1 > 0:
+                    for W, _ in params:
+                        loss = loss + p.l2 * jnp.sum(W * W) \
+                            + p.l1 * jnp.sum(jnp.abs(W))
+                return loss
 
-        @jax.jit
-        def train_steps(params, opt_state, rng):
-            """lax.scan over minibatch SGD steps — one compiled program."""
-            keys = jax.random.split(rng, steps_per_iter)
-            (params, opt_state), losses = jax.lax.scan(
-                sgd_step, (params, opt_state), keys)
-            return params, opt_state, jnp.mean(losses)
+            kind, *hp = opt_cfg
+            tx = optax.adadelta(1.0, rho=hp[0], eps=hp[1]) \
+                if kind == "adadelta" else optax.sgd(
+                    hp[0], momentum=hp[1] if kind == "sgd_momentum" else 0.0)
+
+            def sgd_step(Xa, ya, wa, carry, key):
+                params, opt_state = carry
+                k1, k2 = jax.random.split(key)
+                idx = jax.random.randint(k1, (batch,), 0, n)
+                loss, grads = jax.value_and_grad(loss_fn)(
+                    params, jnp.take(Xa, idx, axis=0), jnp.take(ya, idx),
+                    jnp.take(wa, idx), k2)
+                updates, opt_state = tx.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), loss
+
+            @jax.jit
+            def train_steps(params, opt_state, rng0, it, Xa, ya, wa):
+                keys = jax.random.split(jax.random.fold_in(rng0, it),
+                                        steps_per_iter)
+                (params, opt_state), losses = jax.lax.scan(
+                    functools.partial(sgd_step, Xa, ya, wa),
+                    (params, opt_state), keys)
+                return params, opt_state, jnp.mean(losses)
+
+        opt_state = tx.init(params)
+        # Commit params/opt_state to the replicated sharding explicitly:
+        # the jit executable cache keys on input sharding+committedness, and
+        # fresh eager arrays ("unspecified") vs committed arrays from a
+        # previous run's outputs would compile TWO executables for the same
+        # program (measured: a 5.7 s recompile inside bench.py's timed DL
+        # run, while the warmup had compiled the other variant).
+        from jax.sharding import NamedSharding, PartitionSpec
+        from ..runtime.cluster import cluster
+        rep = NamedSharding(cluster().mesh, PartitionSpec())
+        params = jax.device_put(params, rep)
+        opt_state = jax.device_put(opt_state, rep)
 
         # Per-iteration host fetches of the mean loss cost a full round
         # trip each on a remote-tunnelled accelerator and starved the MXU
@@ -292,8 +396,8 @@ class DeepLearning(ModelBuilder):
         stopped_at = n_iters
         for it in range(n_iters):
             failure.maybe_inject("dl_iter")
-            rng, k = jax.random.split(rng)
-            params, opt_state, mean_loss = train_steps(params, opt_state, k)
+            params, opt_state, mean_loss = train_steps(params, opt_state,
+                                                       rng, it, X, y, w)
             seen += steps_per_iter * batch
             if p.stopping_rounds:
                 entry = {"iteration": it, "epochs": seen / n,
@@ -314,7 +418,9 @@ class DeepLearning(ModelBuilder):
                 device_losses.append(mean_loss)       # device scalar only
                 job.update((it + 1) / n_iters, f"epoch {seen / n:.2f}")
         if not p.stopping_rounds and device_losses:
-            iter_losses = np.asarray(jnp.stack(device_losses))  # ONE fetch
+            # batched device_get: one prefetch pass, no per-n_iters
+            # jnp.stack program compile
+            iter_losses = np.asarray(jax.device_get(device_losses))
             dt = max(_time.time() - t0, 1e-9)
             seen = 0
             for it in range(stopped_at):

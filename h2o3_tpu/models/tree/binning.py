@@ -10,11 +10,20 @@ Layout: each feature gets ``nbins`` regular bins; bin ``nbins`` is reserved
 for NA (the missing bucket).  Categorical codes are their own bins (capped at
 ``nbins``, the reference's nbins_cats analog).  Edges are float32 split
 thresholds usable directly at prediction time.
+
+Perf note (round 4, measured on chip): the original host-loop sketch cost
+16.9 s on the 10M x 8 bench shape — five per-feature tunnel fetches plus
+eight separately-compiled searchsorted dispatches, each charged the
+remote backend's first-execution penalty.  It is now TWO cached compiled
+programs: one masked-sort sketch over all numeric columns (device sort is
+4.5 ms/column on chip), one encode pass over all features; the only
+device->host traffic is the small [C, nbins-1] edge matrix.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import List, Optional
 
 import jax
@@ -63,6 +72,70 @@ class BinnedFrame:
         return tuple(out)
 
 
+@functools.lru_cache(maxsize=None)
+def _make_sketch_fn(n: int, padded: int, ncols: int, nq: int):
+    """One compiled program: exact masked quantiles + min/max for a stacked
+    [C, padded] block of numeric columns.
+
+    Rows beyond ``n``, non-finite values, and rows with weight <= 0 are
+    masked to +inf before an ascending device sort; quantile k then linearly
+    interpolates positions q_k * (m_c - 1) within each column's m_c valid
+    rows (numpy's default interpolation, so edges match the old host
+    np.quantile sketch on unweighted data).
+    """
+
+    def sketch(X, w):
+        iota = jax.lax.broadcasted_iota(jnp.int32, (ncols, padded), 1)
+        valid = (iota < n) & jnp.isfinite(X) & (w[None, :] > 0)
+        m = jnp.sum(valid, axis=1)                       # [C] valid counts
+        Xm = jnp.where(valid, X, jnp.inf)
+        Xs = jnp.sort(Xm, axis=1)                        # invalid -> tail
+        lo = jnp.min(jnp.where(valid, X, jnp.inf), axis=1)
+        hi = jnp.max(jnp.where(valid, X, -jnp.inf), axis=1)
+        qs = jnp.arange(1, nq + 1, dtype=jnp.float32) / (nq + 1)
+        pos = qs[None, :] * jnp.maximum(m[:, None] - 1, 0)   # [C, nq]
+        p0 = jnp.floor(pos).astype(jnp.int32)
+        frac = pos - p0
+        v0 = jnp.take_along_axis(Xs, p0, axis=1)
+        v1 = jnp.take_along_axis(
+            Xs, jnp.minimum(p0 + 1, jnp.maximum(m[:, None] - 1, 0)), axis=1)
+        edges = v0 * (1 - frac) + v1 * frac
+        return edges, lo, hi, m
+
+    return jax.jit(sketch)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_encode_fn(padded: int, nfeat: int, emax: int, is_cat: tuple,
+                    nbins: int):
+    """One compiled program encoding all features to bin codes.
+
+    Numerics: ``searchsorted(edges, x, side="right")`` against +inf-padded
+    edge rows (padding never counts); NaN -> the NA bin.  Cats: code as bin,
+    clamped to ``nbins - 1``; negative (NA sentinel) or NaN -> NA bin.
+    """
+
+    def encode(X, E, counts):
+        outs = []
+        for f in range(nfeat):
+            x = X[f]
+            if is_cat[f]:
+                xi = jnp.where(jnp.isnan(x), -1.0, x).astype(jnp.int32)
+                c = jnp.where(xi < 0, nbins, jnp.minimum(xi, nbins - 1))
+            else:
+                c = jnp.searchsorted(E[f], x, side="right").astype(jnp.int32)
+                # +inf rows sort past the +inf PADDING too (searchsorted
+                # side="right" counts equal values), yielding the global
+                # emax instead of this feature's top bin — clip to the
+                # feature's own edge count
+                c = jnp.minimum(c, counts[f])
+                c = jnp.where(jnp.isnan(x), nbins, c)
+            outs.append(c.astype(jnp.int32))
+        return jnp.stack(outs, axis=0)
+
+    return jax.jit(encode)
+
+
 def fit_bins(frame: Frame, features: List[str], nbins: int = 64,
              sample: int = 1_000_000, seed: int = 0,
              weights=None,
@@ -75,10 +148,11 @@ def fit_bins(frame: Frame, features: List[str], nbins: int = 64,
     "random" (uniform-random split points; drawn ONCE per model — the
     frame is encoded a single time, so unlike the reference's per-tree
     redraw, ensembles share these edges; vary ``seed`` for diversity
-    across models).  The sketch runs on a host-side row sample; the encode
-    step is one fused device pass per call.  ``weights`` (host or
-    device [>=nrows]) restricts the sketch to rows with weight > 0 —
-    keeps CV's zero-weight holdout rows out of the bin edges.
+    across models).  Quantiles are EXACT over all weight>0 rows (a device
+    sort costs less than the old 1M-row host sample did in transfer);
+    ``sample`` is kept for API compatibility and ignored.  ``weights``
+    (host or device [>=nrows]) restricts the sketch to rows with
+    weight > 0 — keeps CV's zero-weight holdout rows out of the bin edges.
     """
     htype = histogram_type.lower().replace("_", "")
     if htype in ("auto", "quantilesglobal"):
@@ -89,54 +163,53 @@ def fit_bins(frame: Frame, features: List[str], nbins: int = 64,
         raise ValueError(
             f"unknown histogram_type {histogram_type!r}: use "
             "QuantilesGlobal, UniformAdaptive or Random")
-    from ...runtime.cluster import fetch
     rng = np.random.default_rng(seed)
     n = frame.nrows
-    idx = None
-    stride = 1
-    if weights is not None:
-        live = np.flatnonzero(fetch(weights)[:n] > 0)
-        idx = live if len(live) <= sample \
-            else rng.choice(live, size=sample, replace=False)
-    elif n > sample:
-        # strided device slice: rows are unordered, so a stride is as good a
-        # sketch sample as rng.choice — and it fetches `sample` elements to
-        # host instead of the whole 40MB+ column over the device link
-        stride = -(-n // sample)
-    edges_list, is_cat, domains = [], [], []
-    for name in features:
-        vec = frame.vec(name)
-        if vec.type == T_CAT:
-            card = vec.cardinality
-            # categorical: one bin per code (codes >= nbins clamp into last)
-            edges = np.arange(0.5, min(card, nbins) - 0.5 + 1e-9, 1.0,
-                              dtype=np.float32)
-            is_cat.append(True)
-            domains.append(vec.domain)
+
+    vecs = [frame.vec(name) for name in features]
+    is_cat = [v.type == T_CAT for v in vecs]
+    domains = [v.domain if c else None for v, c in zip(vecs, is_cat)]
+    num_idx = [f for f, c in enumerate(is_cat) if not c]
+
+    # --- sketch: one device program over the stacked numeric block
+    num_edges: dict = {}
+    if num_idx:
+        X = jnp.stack([vecs[f].data.astype(jnp.float32) for f in num_idx],
+                      axis=0)
+        padded = int(X.shape[1])
+        if weights is not None:
+            wv = jnp.asarray(weights, jnp.float32)
+            if wv.shape[0] < padded:
+                wv = jnp.pad(wv, (0, padded - wv.shape[0]))
+            wv = wv[:padded]
         else:
-            if stride > 1:
-                col = fetch(vec.data[:n:stride])
-            else:
-                col = fetch(vec.data)[: n]
-                if idx is not None:
-                    col = col[idx]
-            col = col[np.isfinite(col)]
-            if len(col) == 0:
-                edges = np.zeros(0, dtype=np.float32)
+            wv = jnp.ones((padded,), jnp.float32)
+        sk = _make_sketch_fn(n, padded, len(num_idx), nbins - 1)
+        edges_q, lo, hi, m = (np.asarray(a, np.float64)
+                              for a in sk(X, wv))       # one small fetch
+        for i, f in enumerate(num_idx):
+            if m[i] == 0:
+                e = np.zeros(0, dtype=np.float32)
             elif htype == "uniform":
-                lo, hi = float(col.min()), float(col.max())
-                edges = np.unique(np.linspace(lo, hi, nbins + 1)[1:-1]
-                                  .astype(np.float32))
+                e = np.unique(np.linspace(lo[i], hi[i], nbins + 1)[1:-1]
+                              .astype(np.float32))
             elif htype == "random":
-                lo, hi = float(col.min()), float(col.max())
-                edges = np.unique(np.sort(
-                    rng.uniform(lo, hi, nbins - 1)).astype(np.float32))
+                e = np.unique(np.sort(
+                    rng.uniform(lo[i], hi[i], nbins - 1)).astype(np.float32))
             else:
-                qs = np.linspace(0, 1, nbins + 1)[1:-1]
-                edges = np.unique(np.quantile(col, qs).astype(np.float32))
-            is_cat.append(False)
-            domains.append(None)
-        edges_list.append(edges)
+                e = np.unique(edges_q[i].astype(np.float32))
+                e = e[np.isfinite(e)]
+            num_edges[f] = e
+
+    edges_list = []
+    for f, cat in enumerate(is_cat):
+        if cat:
+            card = vecs[f].cardinality
+            edges_list.append(np.arange(
+                0.5, min(card, nbins) - 0.5 + 1e-9, 1.0, dtype=np.float32))
+        else:
+            edges_list.append(num_edges[f])
+
     codes = encode_bins(frame, features, edges_list, is_cat, nbins)
     return BinnedFrame(codes=codes, edges=edges_list, names=list(features),
                        is_cat=is_cat, cat_domains=domains, nbins=nbins)
@@ -159,19 +232,15 @@ def edges_matrix(edges_list, nbins: int) -> np.ndarray:
 
 def encode_bins(frame: Frame, features: List[str], edges_list, is_cat,
                 nbins: int) -> jax.Array:
-    """Encode columns as bin codes with one device pass per feature."""
-    cols = []
-    for name, edges, cat in zip(features, edges_list, is_cat):
-        vec = frame.vec(name)
-        if cat:
-            codes = vec.data if vec.type == T_CAT else jnp.where(
-                jnp.isnan(vec.data), -1, vec.data).astype(jnp.int32)
-            c = jnp.where(codes < 0, nbins, jnp.minimum(codes, nbins - 1))
-        else:
-            x = vec.data
-            e = jnp.asarray(edges, dtype=jnp.float32)
-            c = jnp.searchsorted(e, x, side="right").astype(jnp.int32) \
-                if len(edges) else jnp.zeros(x.shape, jnp.int32)
-            c = jnp.where(jnp.isnan(x), nbins, c)
-        cols.append(c.astype(jnp.int32))
-    return jnp.stack(cols, axis=0)
+    """Encode columns as bin codes — ONE cached device program per
+    geometry (padded length, feature count, edge width, cat pattern)."""
+    vecs = [frame.vec(name) for name in features]
+    X = jnp.stack([v.data.astype(jnp.float32) for v in vecs], axis=0)
+    emax = max([1] + [len(e) for e in edges_list])
+    E = np.full((len(features), emax), np.inf, np.float32)
+    for f, e in enumerate(edges_list):
+        E[f, : len(e)] = e
+    counts = np.asarray([len(e) for e in edges_list], np.int32)
+    enc = _make_encode_fn(int(X.shape[1]), len(features), emax,
+                          tuple(bool(c) for c in is_cat), nbins)
+    return enc(X, jnp.asarray(E), jnp.asarray(counts))

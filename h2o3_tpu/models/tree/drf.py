@@ -113,6 +113,12 @@ class DRF(SharedTree):
 
         F_sum = jnp.zeros((N, K), jnp.float32) if K > 1 \
             else jnp.zeros((N,), jnp.float32)
+        # commit to the chunk-output sharding — see gbm.py (avoids a second
+        # jit executable keyed on uncommitted-vs-committed F)
+        from jax.sharding import NamedSharding, PartitionSpec
+        from ...runtime.cluster import cluster
+        F_sum = jax.device_put(F_sum,
+                               NamedSharding(cluster().mesh, PartitionSpec()))
         if valid is not None:
             Xv = model._design(valid)
             y_v, w_v = di.response(valid), di.weights(valid)
@@ -152,18 +158,17 @@ class DRF(SharedTree):
         if prior is not None:
             for k in range(K):
                 chunks[k].append(prior_stacked(prior, k if K > 1 else None))
-        for c, t_new, score_now in chunk_schedule(
-                p.ntrees - prior_nt, p.score_tree_interval):
+        for chunk_no, (c, t_new, score_now) in enumerate(chunk_schedule(
+                p.ntrees - prior_nt, p.score_tree_interval)):
             t_done = prior_nt + t_new
-            rng, kc = jax.random.split(rng)
-            keys = jax.random.split(kc, c)
             for k in range(K):
                 Fk0 = F_sum[:, k] if K > 1 else F_sum
-                # same keys across classes -> same bootstrap per iteration
-                # (DRF.java samples once per tree); the salt decorrelates
-                # each class tree's per-split feature subsets
+                # same (rng, chunk_no) across classes -> same bootstrap per
+                # iteration (DRF.java samples once per tree); the salt
+                # decorrelates each class tree's per-split feature subsets
                 Fk, lv, vals, cov = scan_fn(codes, targets[k], w, Fk0,
-                                            edges_mat, keys, *scalars, k)
+                                            edges_mat, rng, chunk_no, c,
+                                            *scalars, k)
                 chunks[k].append(StackedTrees(lv, vals, cov))
                 if K > 1:
                     F_sum = F_sum.at[:, k].set(Fk)

@@ -81,6 +81,15 @@ class GBM(SharedTree):
         model.output["ntrees_trained"] = ntrees
         model.output["edges"] = binned.edges
         model.scoring_history = history
+        im = getattr(model, "_interval_metrics", None)
+        if im is not None and im[0] == ntrees:
+            # the final interval already scored this exact ensemble state
+            model.training_metrics = im[1]
+            if valid is not None and im[2] is not None:
+                model.validation_metrics = im[2]
+            elif valid is not None:
+                model.validation_metrics = model.model_performance(valid)
+            return model
         model.training_metrics = make_metrics(
             di, self._scores_to_preds(F, dist, di), y, w)
         if valid is not None:
@@ -157,6 +166,14 @@ class GBM(SharedTree):
             F_v = jnp.full((Xv.shape[0],), f0, jnp.float32) \
                 if valid is not None else None
             init_host = float(f0)
+        # Commit F to the replicated sharding the scan chunk outputs use:
+        # an uncommitted F0 and a committed chunk-output F key DIFFERENT
+        # jit executables for the same scan program — the warmup paid a
+        # silent ~16 s recompile between chunk 1 and chunk 2 (the round-2
+        # "first-execution anomaly" decoded).
+        from jax.sharding import NamedSharding, PartitionSpec
+        from ...runtime.cluster import cluster
+        F = jax.device_put(F, NamedSharding(cluster().mesh, PartitionSpec()))
         prior_nt = 0
         if prior is not None:
             # continue from the checkpoint: F starts at its predictions
@@ -226,13 +243,11 @@ class GBM(SharedTree):
                        p.min_child_weight)
             chunks_k = [[prior_stacked(prior, k)] if prior is not None
                         else [] for k in range(K)]
-            for c, t_new, score_now in chunk_schedule(
-                    p.ntrees - prior_nt, p.score_tree_interval):
+            for chunk_no, (c, t_new, score_now) in enumerate(chunk_schedule(
+                    p.ntrees - prior_nt, p.score_tree_interval)):
                 t_done = prior_nt + t_new
-                rng, kc = jax.random.split(rng)
-                keys = jax.random.split(kc, c)
                 F, lv, vals, cov = scan_fn(codes, Y1, w, F, edges_mat,
-                                           keys, *scalars)
+                                           rng, chunk_no, c, *scalars)
                 for k in range(K):
                     lv_k = [tuple(lvd[i][:, k] for i in range(4))
                             for lvd in lv]
@@ -269,13 +284,11 @@ class GBM(SharedTree):
                        p.learn_rate, p.col_sample_rate, p.reg_alpha, p.gamma,
                        p.min_child_weight)
             chunks = [prior_stacked(prior)] if prior is not None else []
-            for c, t_new, score_now in chunk_schedule(
-                    p.ntrees - prior_nt, p.score_tree_interval):
+            for chunk_no, (c, t_new, score_now) in enumerate(chunk_schedule(
+                    p.ntrees - prior_nt, p.score_tree_interval)):
                 t_done = prior_nt + t_new
-                rng, kc = jax.random.split(rng)
-                keys = jax.random.split(kc, c)
                 F, lv, vals, cov = scan_fn(codes, y, w, F, edges_mat,
-                                           keys, *scalars, 0)
+                                           rng, chunk_no, c, *scalars, 0)
                 chunk = StackedTrees(lv, vals, cov)
                 chunks.append(chunk)
                 job.update(t_done / p.ntrees, f"tree {t_done}/{p.ntrees}")

@@ -142,24 +142,40 @@ class StackedTrees:
 
     @staticmethod
     def concat(chunks: Sequence["StackedTrees"]) -> "StackedTrees":
+        """Host-side concatenation.  Tree metadata is kilobytes; a device
+        ``jnp.concatenate`` here compiled one program per (level, array,
+        chunk-count) geometry — measured 9.3 s of XLA compiles inside the
+        bench's timed 50-tree train (chunk counts the warmup never saw).
+        The fetch is ONE ``jax.device_get`` over every chunk array: it
+        prefetches all transfers async, so the whole pull costs ~one round
+        trip instead of one per array (measured 0.13 s vs 7.9 s for the
+        5-chunk x 26-array case on the tunnel)."""
         if len(chunks) == 1:
             return chunks[0]
+        host = jax.device_get([
+            [[c.levels[d][i] for i in range(4)]
+             for d in range(c.depth)] +
+            [c.values, c.covers if c.covers is not None else np.zeros(0)]
+            for c in chunks])
+        depth = chunks[0].depth
         levels = []
-        for d in range(chunks[0].depth):
+        for d in range(depth):
             levels.append(tuple(
-                jnp.concatenate([c.levels[d][i] for c in chunks], axis=0)
+                np.concatenate([h[d][i] for h in host], axis=0)
                 for i in range(4)))
-        values = jnp.concatenate([c.values for c in chunks], axis=0)
+        values = np.concatenate([h[depth] for h in host], axis=0)
         covers = None
         if all(c.covers is not None for c in chunks):
-            covers = jnp.concatenate([c.covers for c in chunks], axis=0)
+            covers = np.concatenate([h[depth + 1] for h in host], axis=0)
         return StackedTrees(levels, values, covers)
 
     def to_tree_list(self) -> List[Tree]:
-        """Host materialization — one fetch per level array, then slices."""
-        host_levels = [tuple(np.asarray(a) for a in lv) for lv in self.levels]
-        values = np.asarray(self.values)
-        covers = np.asarray(self.covers) if self.covers is not None else None
+        """Host materialization — one batched fetch, then slices."""
+        host_levels, values, covers = jax.device_get(
+            [[tuple(a for a in lv) for lv in self.levels], self.values,
+             self.covers if self.covers is not None else np.zeros(0)])
+        if self.covers is None:
+            covers = None
         out = []
         for t in range(values.shape[0]):
             out.append(Tree(
@@ -530,12 +546,17 @@ def make_tree_scan_fn(mode: str, tweedie_power: float, quantile_alpha: float,
     bt_fn = make_build_tree_fn(max_depth, nbins, F, n_padded, hist_precision,
                                hier=hier, bin_counts=bin_counts, mono=mono)
 
-    def scan_fn(codes, y, w, F0, edges_mat, keys, reg_lambda, min_rows,
-                min_split_improvement, learn_rate, col_sample_rate,
-                reg_alpha, gamma, min_child_weight, salt=0):
+    def scan_fn(codes, y, w, F0, edges_mat, rng0, chunk_no, nchunk,
+                reg_lambda, min_rows, min_split_improvement, learn_rate,
+                col_sample_rate, reg_alpha, gamma, min_child_weight, salt=0):
+        # Per-chunk keys derive IN-JIT from (rng0, chunk_no): each eager
+        # jax.random op costs a ~50 ms round trip on a tunnelled backend
+        # (measured round 4), so the driver loop must stay dispatch-only.
+        # ``nchunk`` (trees per chunk) is static — it sets the scan length.
         # ``salt`` decorrelates column/build randomness between callers that
-        # share ``keys`` (DRF class trees share the bootstrap via ks but
-        # must draw independent per-split feature subsets).
+        # share the chunk stream (DRF class trees share the bootstrap via ks
+        # but must draw independent per-split feature subsets).
+        keys = jax.random.split(jax.random.fold_in(rng0, chunk_no), nchunk)
         def body(Fc, key_t):
             ks, km, kb = jax.random.split(key_t, 3)
             km = jax.random.fold_in(km, salt)
@@ -562,7 +583,7 @@ def make_tree_scan_fn(mode: str, tweedie_power: float, quantile_alpha: float,
         Ff, (lv, vals, covers) = jax.lax.scan(body, F0, keys)
         return Ff, list(lv), vals, covers
 
-    return jax.jit(scan_fn, donate_argnums=(3,))
+    return jax.jit(scan_fn, donate_argnums=(3,), static_argnums=(7,))
 
 
 @functools.lru_cache(maxsize=None)
@@ -584,10 +605,12 @@ def make_multinomial_scan_fn(K: int, max_depth: int, nbins: int, F: int,
                                hist_precision, hier=hier,
                                bin_counts=bin_counts)
 
-    def scan_fn(codes, Y1, w, F0, edges_mat, keys, reg_lambda, min_rows,
-                min_split_improvement, learn_rate, col_sample_rate,
-                reg_alpha, gamma, min_child_weight):
+    def scan_fn(codes, Y1, w, F0, edges_mat, rng0, chunk_no, nchunk,
+                reg_lambda, min_rows, min_split_improvement, learn_rate,
+                col_sample_rate, reg_alpha, gamma, min_child_weight):
         from .hist import table_lookup
+        # in-jit key derivation — see make_tree_scan_fn
+        keys = jax.random.split(jax.random.fold_in(rng0, chunk_no), nchunk)
 
         def body(Fc, key_t):
             ks, km, kb = jax.random.split(key_t, 3)
@@ -629,7 +652,7 @@ def make_multinomial_scan_fn(K: int, max_depth: int, nbins: int, F: int,
         Ff, (lv, vals, covers) = jax.lax.scan(body, F0, keys)
         return Ff, list(lv), vals, covers
 
-    return jax.jit(scan_fn, donate_argnums=(3,))
+    return jax.jit(scan_fn, donate_argnums=(3,), static_argnums=(7,))
 
 
 def chunk_schedule(ntrees: int, score_tree_interval: int,
@@ -959,12 +982,17 @@ class SharedTree(ModelBuilder):
         raw = self._scores_to_preds(F_train, dist, di)
         m = make_metrics(di, raw, y, w)
         entry = {"iteration": it, **m.describe()}
+        mv = None
         if valid_state is not None:
             F_v, y_v, w_v = valid_state
             mv = make_metrics(di, self._scores_to_preds(F_v, dist, di),
                               y_v, w_v)
             entry.update({f"valid_{k}": v for k, v in mv.describe().items()})
         history.append(entry)
+        # stash for _finalize_fused: when the last interval lands on the
+        # final tree count, finalize reuses these instead of recomputing a
+        # full-frame metrics pass (and a whole-ensemble valid traverse)
+        model._interval_metrics = (it, m, mv)
         return m
 
     def _interval_score(self, model, t_done, F, y, w, di, dist, history,

@@ -159,6 +159,28 @@ def test_tree_save_load_predict(cl, rng, tmp_path):
     np.testing.assert_allclose(p1, p2, rtol=1e-5)
 
 
+def test_fit_bins_inf_stays_in_own_feature(cl, rng):
+    """+inf must encode to the FEATURE's top bin, not the padded edge
+    width: the encode program pads every edge row to the global max with
+    +inf, and searchsorted(side='right') counts the padding as <= inf —
+    an unclipped code lands inside a NEIGHBORING feature's packed varbin
+    segment (round-4 review finding)."""
+    import h2o3_tpu
+    from h2o3_tpu.models.tree.binning import fit_bins
+    n = 2000
+    a = rng.integers(0, 4, n).astype(np.float32)
+    a[5] = np.inf
+    a[7] = -np.inf
+    b = rng.normal(size=n).astype(np.float32)
+    fr = h2o3_tpu.Frame.from_numpy({"a": a, "b": b})
+    bn = fit_bins(fr, ["a", "b"], nbins=64)
+    codes = np.asarray(bn.codes)
+    assert len(bn.edges[0]) < len(bn.edges[1])      # uneven edge widths
+    assert codes[0, 5] == len(bn.edges[0])          # inf -> own top bin
+    assert codes[0, 7] == 0                         # -inf -> bottom bin
+    assert codes[0, :n].max() <= len(bn.edges[0])
+
+
 def test_histogram_types(cl, rng):
     import h2o3_tpu
     from h2o3_tpu.models import GBM
