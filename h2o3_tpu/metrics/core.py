@@ -50,7 +50,7 @@ def _binomial_hist_kernel(p1, y, w, nbins: int):
     pos_w = w * (y == 1)
     neg_w = w * (y == 0)
     n = p1.shape[0]
-    blk = min(n, 1 << 20)
+    blk = max(min(n, 1 << 20), 1)          # n == 0: zero-block scan
     nblk = -(-n // blk)
     pad = nblk * blk - n
     idxp = jnp.pad(idx, (0, pad)).reshape(nblk, blk)

@@ -238,7 +238,21 @@ class DataInfo:
         return jnp.where(codes < 0, -1, remap_dev[jnp.clip(codes, 0, None)])
 
     def response(self, frame: Frame) -> jax.Array:
-        """Response as float32 [padded]: cat codes for classifiers else values."""
+        """Response as float32 [padded]: cat codes for classifiers else values.
+
+        Memoized per frame (spill-evicted): the eager op chain costs a
+        dispatch round trip per op on a tunnelled backend."""
+        key = ("__response__", self.response_column,
+               tuple(self.response_domain) if self.response_domain is not None
+               else None, self._design_signature())
+        hit = frame._matrix_cache.get(key)
+        if hit is not None:
+            return hit
+        out = self._response_uncached(frame)
+        frame._matrix_cache[key] = out
+        return out
+
+    def _response_uncached(self, frame: Frame) -> jax.Array:
         rv = frame.vec(self.response_column)
         if self.response_domain is not None:
             if rv.type == T_CAT:
@@ -256,7 +270,21 @@ class DataInfo:
         return rv.numeric_data()
 
     def weights(self, frame: Frame) -> jax.Array:
-        """Row weights x validity mask — 0 on padding and (optionally) NA rows."""
+        """Row weights x validity mask — 0 on padding and (optionally) NA rows.
+
+        Memoized per frame (spill-evicted), like ``response``."""
+        key = ("__weights__", self.weights_column, self.response_column,
+               tuple(self.response_domain) if self.response_domain is not None
+               else None, self.missing_values_handling,
+               self._design_signature())
+        hit = frame._matrix_cache.get(key)
+        if hit is not None:
+            return hit
+        out = self._weights_uncached(frame)
+        frame._matrix_cache[key] = out
+        return out
+
+    def _weights_uncached(self, frame: Frame) -> jax.Array:
         w = frame.valid_mask().astype(jnp.float32)
         if self.weights_column is not None:
             w = w * jnp.nan_to_num(frame.vec(self.weights_column).numeric_data())

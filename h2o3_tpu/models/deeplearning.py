@@ -77,45 +77,49 @@ class DeepLearningParameters(Parameters):
     max_iterations: int = 10 ** 9        # unused; epochs governs
 
 
-@functools.lru_cache(maxsize=None)
-def _make_train_steps(activation: str, dropout_in: float, dropout_h: tuple,
-                      loss_kind: str, is_cls: bool, autoenc: bool,
-                      out_dim: int, l1: float, l2: float, opt_cfg: tuple,
-                      batch: int, steps_per_iter: int, n: int):
-    """Compiled training-interval program, CACHED ACROSS train() calls.
-
-    The per-call ``@jax.jit def train_steps`` pattern recompiled (and paid
-    the remote backend's multi-second first-execution penalty) on every
-    train() — bench.py's warmup model compiled a program the timed model
-    then could not reuse (measured on chip: the timed MNIST run spent most
-    of its wall clock there, reporting 2.7k samples/s).  Everything the
-    program closes over is reconstructed here from hashable config; the
-    data (X, y, w) are traced arguments, so any same-shaped training run
-    reuses the executable.  Returns (train_steps, tx).
-    """
+def _forward_pass(activation: str, params, X, deterministic=True, rng=None,
+                  dropout_in: float = 0.0, dropout_hidden=()):
+    """THE DL forward pass — shared by predict-time ``Model._forward`` and
+    the compiled training program (one implementation, so activation /
+    dropout semantics cannot drift between training and scoring)."""
     act = _activation_fn(activation)
     maxout = act is None
+    h = X
+    if not deterministic and dropout_in > 0:
+        rng, k = jax.random.split(rng)
+        h = h * jax.random.bernoulli(k, 1 - dropout_in, h.shape) \
+            / (1 - dropout_in)
+    for i, (W, b) in enumerate(params[:-1]):
+        z = h @ W + b
+        z = z.reshape(z.shape[0], -1, 2).max(axis=2) if maxout else act(z)
+        dr = dropout_hidden[i] if i < len(dropout_hidden) else 0.0
+        if not deterministic and dr > 0:
+            rng, k = jax.random.split(rng)
+            z = z * jax.random.bernoulli(k, 1 - dr, z.shape) / (1 - dr)
+        h = z
+    W, b = params[-1]
+    return h @ W + b
+
+
+def _build_train_steps(activation: str, dropout_in: float, dropout_h: tuple,
+                       loss_kind: str, is_cls: bool, autoenc: bool,
+                       out_dim: int, l1: float, l2: float, opt_cfg: tuple,
+                       batch: int, steps_per_iter: int, n: int,
+                       custom_loss=None):
+    """Build the compiled training-interval program (see _make_train_steps
+    for the caching story; ``custom_loss`` bypasses the cache)."""
 
     def forward(params, X, rng):
-        h = X
-        if dropout_in > 0:
-            rng, k = jax.random.split(rng)
-            h = h * jax.random.bernoulli(k, 1 - dropout_in, h.shape) \
-                / (1 - dropout_in)
-        for i, (W, b) in enumerate(params[:-1]):
-            z = h @ W + b
-            z = z.reshape(z.shape[0], -1, 2).max(axis=2) if maxout else act(z)
-            dr = dropout_h[i] if i < len(dropout_h) else 0.0
-            if dr > 0:
-                rng, k = jax.random.split(rng)
-                z = z * jax.random.bernoulli(k, 1 - dr, z.shape) / (1 - dr)
-            h = z
-        W, b = params[-1]
-        return h @ W + b
+        return _forward_pass(activation, params, X, deterministic=False,
+                             rng=rng, dropout_in=dropout_in,
+                             dropout_hidden=dropout_h)
 
     def loss_fn(params, xb, yb, wb, key):
         logits = forward(params, xb, key)
-        if autoenc:
+        if custom_loss is not None:
+            pred = logits if (is_cls or autoenc) else logits[:, 0]
+            per = custom_loss(pred, xb if autoenc else yb)
+        elif autoenc:
             per = jnp.mean((logits - xb) ** 2, axis=1)
         elif is_cls:
             yi = jnp.clip(yb.astype(jnp.int32), 0, out_dim - 1)
@@ -165,6 +169,27 @@ def _make_train_steps(activation: str, dropout_in: float, dropout_h: tuple,
     return train_steps, tx
 
 
+@functools.lru_cache(maxsize=None)
+def _make_train_steps(activation: str, dropout_in: float, dropout_h: tuple,
+                      loss_kind: str, is_cls: bool, autoenc: bool,
+                      out_dim: int, l1: float, l2: float, opt_cfg: tuple,
+                      batch: int, steps_per_iter: int, n: int):
+    """Compiled training-interval program, CACHED ACROSS train() calls.
+
+    The per-call ``@jax.jit def train_steps`` pattern recompiled (and paid
+    the remote backend's multi-second first-execution penalty) on every
+    train() — bench.py's warmup model compiled a program the timed model
+    then could not reuse (measured on chip: the timed MNIST run spent most
+    of its wall clock there, reporting 2.7k samples/s).  Everything the
+    program closes over is reconstructed from hashable config; the data
+    (X, y, w) are traced arguments, so any same-shaped training run reuses
+    the executable.  Returns (train_steps, tx).
+    """
+    return _build_train_steps(activation, dropout_in, dropout_h, loss_kind,
+                              is_cls, autoenc, out_dim, l1, l2, opt_cfg,
+                              batch, steps_per_iter, n)
+
+
 def _activation_fn(name: str):
     base = name.replace("_with_dropout", "")
     if base == "tanh":
@@ -181,27 +206,10 @@ class DeepLearningModel(Model):
 
     def _forward(self, params, X, deterministic=True, rng=None,
                  dropout_in=0.0, dropout_hidden=()):
-        p = self.params
-        act = _activation_fn(p.activation)
-        maxout = act is None
-        h = X
-        if not deterministic and dropout_in > 0:
-            rng, k = jax.random.split(rng)
-            h = h * jax.random.bernoulli(k, 1 - dropout_in, h.shape) / (1 - dropout_in)
-        n_hidden = len(params) - 1
-        for i, (W, b) in enumerate(params[:-1]):
-            z = h @ W + b
-            if maxout:
-                z = z.reshape(z.shape[0], -1, 2).max(axis=2)
-            else:
-                z = act(z)
-            dr = dropout_hidden[i] if i < len(dropout_hidden) else 0.0
-            if not deterministic and dr > 0:
-                rng, k = jax.random.split(rng)
-                z = z * jax.random.bernoulli(k, 1 - dr, z.shape) / (1 - dr)
-            h = z
-        W, b = params[-1]
-        return h @ W + b
+        return _forward_pass(self.params.activation, params, X,
+                             deterministic=deterministic, rng=rng,
+                             dropout_in=dropout_in,
+                             dropout_hidden=tuple(dropout_hidden))
 
     def _predict_raw(self, X: jax.Array) -> jax.Array:
         params = [(jnp.asarray(W), jnp.asarray(b))
@@ -327,45 +335,11 @@ class DeepLearning(ModelBuilder):
                 is_cls, p.autoencoder, out_dim, p.l1, p.l2, opt_cfg,
                 batch, steps_per_iter, n)
         else:
-            # custom python loss: not hashable, keep the per-call program
-            def loss_fn(params, xb, yb, wb, key):
-                logits = model._forward(
-                    params, xb, deterministic=False, rng=key,
-                    dropout_in=p.input_dropout_ratio,
-                    dropout_hidden=dropout_h)
-                pred = logits if (is_cls or p.autoencoder) else logits[:, 0]
-                per = p.custom_loss_func(pred, xb if p.autoencoder else yb)
-                loss = jnp.sum(per * wb) / jnp.maximum(jnp.sum(wb), 1e-12)
-                if p.l2 > 0 or p.l1 > 0:
-                    for W, _ in params:
-                        loss = loss + p.l2 * jnp.sum(W * W) \
-                            + p.l1 * jnp.sum(jnp.abs(W))
-                return loss
-
-            kind, *hp = opt_cfg
-            tx = optax.adadelta(1.0, rho=hp[0], eps=hp[1]) \
-                if kind == "adadelta" else optax.sgd(
-                    hp[0], momentum=hp[1] if kind == "sgd_momentum" else 0.0)
-
-            def sgd_step(Xa, ya, wa, carry, key):
-                params, opt_state = carry
-                k1, k2 = jax.random.split(key)
-                idx = jax.random.randint(k1, (batch,), 0, n)
-                loss, grads = jax.value_and_grad(loss_fn)(
-                    params, jnp.take(Xa, idx, axis=0), jnp.take(ya, idx),
-                    jnp.take(wa, idx), k2)
-                updates, opt_state = tx.update(grads, opt_state, params)
-                params = optax.apply_updates(params, updates)
-                return (params, opt_state), loss
-
-            @jax.jit
-            def train_steps(params, opt_state, rng0, it, Xa, ya, wa):
-                keys = jax.random.split(jax.random.fold_in(rng0, it),
-                                        steps_per_iter)
-                (params, opt_state), losses = jax.lax.scan(
-                    functools.partial(sgd_step, Xa, ya, wa),
-                    (params, opt_state), keys)
-                return params, opt_state, jnp.mean(losses)
+            # custom python loss: not hashable — same builder, uncached
+            train_steps, tx = _build_train_steps(
+                p.activation, p.input_dropout_ratio, dropout_h, loss_kind,
+                is_cls, p.autoencoder, out_dim, p.l1, p.l2, opt_cfg,
+                batch, steps_per_iter, n, custom_loss=p.custom_loss_func)
 
         opt_state = tx.init(params)
         # Commit params/opt_state to the replicated sharding explicitly:

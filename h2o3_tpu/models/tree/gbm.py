@@ -116,6 +116,7 @@ class GBM(SharedTree):
         w = di.weights(frame)
         from .shared import (resolve_checkpoint, checkpoint_binned,
                              prior_stacked, resolve_mono)
+        y, f0_dev = self._prep_targets(y, w, dist)
         mono = resolve_mono(p, di)
         if mono is not None and multinomial:
             raise ValueError(
@@ -131,7 +132,6 @@ class GBM(SharedTree):
         codes = binned.codes
         edges_mat = jnp.asarray(
             edges_matrix(binned.edges, p.nbins), jnp.float32)
-        y = jnp.where(jnp.isnan(y), 0.0, y)
         N = codes.shape[1]
         seed = p.effective_seed()
         rng = jax.random.PRNGKey(seed)
@@ -160,10 +160,10 @@ class GBM(SharedTree):
                 if valid is not None else None
             init_host = np.asarray(init)
         else:
-            f0 = dist.init_score(y, w) if prior is None \
-                else prior.output["init_score"]
-            F = jnp.full((N,), f0, jnp.float32)
-            F_v = jnp.full((Xv.shape[0],), f0, jnp.float32) \
+            f0 = f0_dev if prior is None else prior.output["init_score"]
+            F = jnp.broadcast_to(jnp.asarray(f0, jnp.float32), (N,))
+            F_v = jnp.broadcast_to(jnp.asarray(f0, jnp.float32),
+                                   (Xv.shape[0],)) \
                 if valid is not None else None
             init_host = float(f0)
         # Commit F to the replicated sharding the scan chunk outputs use:

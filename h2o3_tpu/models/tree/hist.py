@@ -45,9 +45,14 @@ def _make_pallas_hist(L: int, F: int, B: int, n_local: int,
     and gpu_hist's shared-memory atomics).
     """
     R = int(min(4096, max(256, ((n_local + 255) // 256) * 256)))
+    L3 = planes * L
+    # the A build materializes [R, L3] intermediates (int32 iota + f32
+    # selects + bf16 A ~ 12 B/elem) on the 16M scoped-VMEM stack; deep
+    # trees (large L) must shrink the row block (found on chip: L=256,
+    # R=4096 -> 18.6M scoped alloc, Mosaic OOM)
+    R = int(min(R, max(256, (6_291_456 // (12 * L3)) // 256 * 256)))
     nblk = (n_local + R - 1) // R
     pad_to = nblk * R
-    L3 = planes * L
     # bins per tile -> [F*TB, R] one-hot tile.  The [TB, F, R] compare
     # intermediate is laid out with F in the sublane dim, which pads to a
     # multiple of 8 — size TB against the PADDED F or small-F geometries
@@ -223,9 +228,12 @@ def _make_pallas_varbin_hist(L: int, F: int, bin_counts, B: int,
     R = int(min(4096, max(512, (4_194_304 // max(Q8 * 2, 1))
                           // 128 * 128)))
     R = min(R, max(512, ((n_local + 511) // 512) * 512))
+    L3 = planes * L
+    # deep-tree guard: A-build intermediates are [R, L3] (~12 B/elem) on
+    # the scoped-VMEM stack — see _make_pallas_hist
+    R = int(min(R, max(512, (6_291_456 // (12 * L3)) // 128 * 128)))
     nblk = (n_local + R - 1) // R
     pad_to = nblk * R
-    L3 = planes * L
     dt = jnp.bfloat16 if precision == "bf16" else jnp.float32
     # PROFILE.md roadmap: stream codes+leaf as int16 and stats as bf16 —
     # halves the kernel's HBM input bytes.  The VPU cannot compare
@@ -352,8 +360,12 @@ def make_varbin_hist_fn(L: int, F: int, bin_counts: tuple, B: int,
 
 
 def _make_einsum_hist(L: int, F: int, B: int, n_local: int, planes: int = 3):
-    """Portable XLA path (CPU mesh tests, non-TPU backends)."""
+    """Portable XLA path (CPU mesh tests, non-TPU backends, and the
+    deep-level fallback where [R, planes*L] exceeds scoped VMEM)."""
     blk = max((4 * 1024 * 1024) // max(F * B, 1), 256)
+    # deep levels: the [blk, L] leaf one-hot / [blk, planes, L] stats
+    # intermediates must stay bounded too
+    blk = max(min(blk, 8_388_608 // max(L, 1)), 64)
     blk = min(n_local, blk)
     nblk = (n_local + blk - 1) // blk
     pad_to = nblk * blk
@@ -408,7 +420,9 @@ def make_hist_fn(L: int, F: int, B: int, n_padded: int,
         inner = _make_pallas_hist(L, F, B, n_local, interpret=True,
                                   precision=precision, planes=planes)
     elif force_impl == "einsum" or platform != "tpu" \
-            or hist_bytes > 12 * 1024 * 1024:
+            or hist_bytes > 12 * 1024 * 1024 or planes * L > 2048:
+        # planes*L > 2048: even the minimum row block's [R, planes*L]
+        # A-build intermediates overflow the 16M scoped-VMEM stack
         inner = _make_einsum_hist(L, F, B, n_local, planes=planes)
     else:
         inner = _make_pallas_hist(L, F, B, n_local, precision=precision,
@@ -439,9 +453,11 @@ def _make_pallas_fine_hist(L: int, F: int, W: int, K: int, nbins: int,
     cost per row is F*K*(W+2) + 2L instead of the full pass's F*(nbins+1).
     """
     R = int(min(4096, max(256, ((n_local + 255) // 256) * 256)))
+    L3 = 3 * L
+    # deep-tree guard — see _make_pallas_hist
+    R = int(min(R, max(256, (6_291_456 // (12 * L3)) // 256 * 256)))
     nblk = (n_local + R - 1) // R
     pad_to = nblk * R
-    L3 = 3 * L
     FK = F * K
     # feature tile: the [TF, K, W, R] one-hot intermediate must fit VMEM
     TF = max(1, min(F, 4_194_304 // (K * W * R * 2)))

@@ -96,7 +96,6 @@ def main():
                    ms_per_tree=None)
 
     # finalize path: metrics from the final margin (no traverse)
-    from h2o3_tpu.models.metrics import make_metrics  # noqa: F401
     t0 = time.perf_counter()
     p = jax.nn.sigmoid(F)
     auc_in = np.asarray(jnp.stack([1 - p, p], axis=1))
