@@ -602,7 +602,9 @@ def make_fine_hist_fn(L: int, F: int, W: int, K: int, nbins: int,
         inner = _make_pallas_fine_hist(L, F, W, K, nbins, n_local,
                                        interpret=True, precision=precision)
     elif force_impl == "einsum" or platform != "tpu" \
-            or out_bytes > 12 * 1024 * 1024:
+            or out_bytes > 12 * 1024 * 1024 or 3 * L > 1024:
+        # 3L > 1024: the minimum row block's [R, 3L] A-build intermediates
+        # would overflow scoped VMEM (see make_hist_fn)
         inner = _make_einsum_fine_hist(L, F, W, K, nbins, n_local)
     else:
         inner = _make_pallas_fine_hist(L, F, W, K, nbins, n_local,

@@ -304,6 +304,31 @@ def traverse(levels, values, X):
 traverse_jit = jax.jit(traverse)
 
 
+def effective_max_depth(max_depth: int, nbins: int, F: int,
+                        n_padded: int) -> int:
+    """Dense-level depth cap, shared by EVERY consumer of the build
+    factories (the scan drivers and checkpoint validation must agree with
+    the tree builder on the level count).
+
+    Levels are FULL-WIDTH [2^d] arrays (that is what makes every per-level
+    op a dense matmul), so histogram memory doubles per level; the
+    reference's node-sparse trees have no such coupling and default to
+    depth 20 (DRF).  Cap where (a) a balanced tree would run out of rows
+    (2^d > n admits only chain-shaped deeper trees, which terminal-leaf
+    masking reproduces as no-op levels), and (b) the per-level histogram
+    would exceed a 64 MB device budget.  Growth virtually always stops
+    earlier via min_rows/purity (valid masking); configs asking for more
+    depth get the capped tree — a documented dense-design bound
+    (PROFILE.md round-4)."""
+    B = nbins + 1
+    row_cap = max(1, int(np.ceil(np.log2(max(n_padded, 2)))) + 1)
+    mem_cap = 1
+    while (mem_cap < 24
+           and F * B * 3 * 2 ** mem_cap * 4 <= 64 * 1024 * 1024):
+        mem_cap += 1
+    return max(1, min(max_depth, row_cap, mem_cap))
+
+
 @functools.lru_cache(maxsize=None)
 def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
                        hist_precision: str = "bf16", hier: bool = False,
@@ -332,23 +357,7 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
     if mono is not None and hier:
         raise ValueError("monotone constraints are not supported with "
                          "the hierarchical split search")
-    # Dense-level depth cap.  Levels are FULL-WIDTH [2^d] arrays (that is
-    # what makes every per-level op a dense matmul), so histogram memory
-    # doubles per level; the reference's node-sparse trees have no such
-    # coupling and default to depth 20 (DRF).  Cap where (a) a balanced
-    # tree would run out of rows (2^d > n has only chain-shaped deeper
-    # trees, which terminal-leaf masking reproduces as no-op levels), and
-    # (b) the per-level histogram would exceed a 64 MB device budget.
-    # Growth virtually always stops earlier via min_rows/purity (valid
-    # masking); configs asking for more depth than the cap get the capped
-    # tree — a documented dense-design bound, not silent truncation
-    # (see PROFILE.md round-4).
-    row_cap = max(1, int(np.ceil(np.log2(max(n_padded, 2)))) + 1)
-    mem_cap = 1
-    while (mem_cap < 24
-           and F * B * 3 * 2 ** mem_cap * 4 <= 64 * 1024 * 1024):
-        mem_cap += 1
-    max_depth = max(1, min(max_depth, row_cap, mem_cap))
+    max_depth = effective_max_depth(max_depth, nbins, F, n_padded)
     from ...runtime.cluster import cluster
     # per-feature packed bins (DHistogram-style): only the TPU Pallas path
     # has the ragged kernel; dense einsum covers CPU tests.  The packed
@@ -364,17 +373,21 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
                   <= 12 * 1024 * 1024
                   and sum(min(b, nbins) + 9 for b in bin_counts)
                   < F * (nbins + 1))
-    if use_varbin:
-        force = "" if on_tpu else "pallas_interpret"
-        hist_fns = [make_varbin_hist_fn(2 ** max(d - 1, 0), F,
-                                        tuple(bin_counts), B, n_padded,
-                                        precision=hist_precision,
-                                        force_impl=force)
+    # Per-LEVEL kernel choice: the varbin Pallas kernel has no einsum
+    # fallback and its minimum row block must keep [R, 3L] A-build
+    # intermediates inside scoped VMEM, so deep levels (3L > 1024) take
+    # the uniform path, which falls back to einsum past its own bound.
+    varbin_level = [use_varbin and 3 * 2 ** max(d - 1, 0) <= 1024
                     for d in range(max_depth)]
-    else:
-        hist_fns = [make_hist_fn(2 ** max(d - 1, 0), F, B, n_padded,
-                                 precision=hist_precision)
-                    for d in range(max_depth)]
+    force = "" if on_tpu else "pallas_interpret"
+    hist_fns = [
+        make_varbin_hist_fn(2 ** max(d - 1, 0), F, tuple(bin_counts), B,
+                            n_padded, precision=hist_precision,
+                            force_impl=force)
+        if varbin_level[d]
+        else make_hist_fn(2 ** max(d - 1, 0), F, B, n_padded,
+                          precision=hist_precision)
+        for d in range(max_depth)]
     if hier:
         S = 16 if nbins >= 128 else 8
         W = -(-nbins // S)
@@ -399,8 +412,8 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
         H_prev = None
         if hier:
             ccodes = jnp.where(codes >= nbins, S, codes // W)
-        hcodes = offset_codes(codes, bin_counts, nbins) if use_varbin \
-            else codes
+        hcodes = offset_codes(codes, bin_counts, nbins) \
+            if any(varbin_level) else codes
         for d in range(max_depth):
             L = 2 ** d
             per_split = jax.random.uniform(keys[d], (L, F)) < col_sample_rate
@@ -431,13 +444,15 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
                         min_child_weight)
             else:
                 if d == 0:
-                    H = hist_fns[0](hcodes, leaf, g, h, w)
+                    H = hist_fns[0](hcodes if varbin_level[0] else codes,
+                                    leaf, g, h, w)
                 else:
                     # parent-sibling subtraction (gpu_hist's trick): build
                     # only the left children's histograms; the right child
                     # is parent - left.  Halves the histogram work.
                     em = ((leaf & 1) == 0).astype(jnp.float32)
-                    Hl = hist_fns[d](hcodes, leaf >> 1,
+                    Hl = hist_fns[d](hcodes if varbin_level[d] else codes,
+                                     leaf >> 1,
                                      g * em, h * em, w * em)
                     Hr = H_prev - Hl
                     H = jnp.stack([Hl, Hr], axis=2).reshape(3, L, F, B)
@@ -618,6 +633,9 @@ def make_multinomial_scan_fn(K: int, max_depth: int, nbins: int, F: int,
     Returns (F_final [N, K], levels with leading [T, K, ...] dims, values
     [T, K, 2^depth], covers [T, K, 2^depth]).
     """
+    # the builder clamps internally; the level-stacking loop below must
+    # iterate the SAME effective count
+    max_depth = effective_max_depth(max_depth, nbins, F, n_padded)
     bt_fn = make_build_tree_fn(max_depth, nbins, F, n_padded,
                                hist_precision, hier=hier,
                                bin_counts=bin_counts)

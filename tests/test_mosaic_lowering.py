@@ -103,3 +103,32 @@ def test_export_catches_known_mosaic_violation():
     with pytest.raises(Exception, match="iota|Verification"):
         jexport.export(jax.jit(f), platforms=["tpu"])(
             jax.ShapeDtypeStruct((128, 1), jnp.float32))
+
+
+@pytest.mark.slow
+def test_aot_backend_compile_on_tpu_when_reachable():
+    """FULL backend compilation (not just the MLIR verifier) of the
+    geometries the round-4 chip session proved the export gate cannot
+    judge: the bf16 stat-select layout (apply-vector-layout rejects
+    non-32-bit minor-dim inserts) and the deep-level scoped-VMEM budget
+    (L=256 uniform kernel).  Runs only when a real TPU backend is
+    reachable — on the CPU CI mesh it skips; in a chip session it is the
+    cheap pre-flight that keeps kernel regressions from burning tunnel
+    time (VERDICT r03 next-step #2)."""
+    if jax.devices()[0].platform != "tpu":
+        pytest.skip("no TPU backend in this environment")
+    from h2o3_tpu.models.tree.hist import make_varbin_hist_fn, make_hist_fn
+
+    n = 512 * 1024                      # small rows: compile-only check
+    # varbin + int16 codes + bf16 stats (the bench path)
+    fn = make_varbin_hist_fn(32, F, BENCH_BIN_COUNTS, B, n)
+    args = [jax.ShapeDtypeStruct(s, d) for s, d in
+            (((F, n), jnp.int16), ((n,), jnp.int32), ((n,), jnp.float32),
+             ((n,), jnp.float32), ((n,), jnp.float32))]
+    fn.lower(*args).compile()
+    # deep-level uniform kernel (L=256 -> R shrunk against the VMEM stack)
+    fn2 = make_hist_fn(256, 3, 33, n)
+    args2 = [jax.ShapeDtypeStruct(s, d) for s, d in
+             (((3, n), jnp.int32), ((n,), jnp.int32), ((n,), jnp.float32),
+              ((n,), jnp.float32), ((n,), jnp.float32))]
+    fn2.lower(*args2).compile()

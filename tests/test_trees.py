@@ -181,6 +181,30 @@ def test_fit_bins_inf_stays_in_own_feature(cl, rng):
     assert codes[0, :n].max() <= len(bn.edges[0])
 
 
+def test_depth_cap_multinomial_and_default_depth_drf(cl, rng):
+    """Dense-level depth cap: a depth request above the cap must produce
+    a working (capped) model on every scan driver — the multinomial
+    stacking loop used the REQUESTED depth and crashed at trace time
+    (round-4 review finding), and default-depth DRF (max_depth=20) must
+    train (it Mosaic-OOM'd on chip before the cap existed)."""
+    import h2o3_tpu
+    from h2o3_tpu.models import GBM, DRF
+    from h2o3_tpu.models.tree.shared import effective_max_depth
+    n = 600
+    x = rng.normal(size=n)
+    y3 = np.array(["abc"[i % 3] for i in range(n)], dtype=object)
+    fr = h2o3_tpu.Frame.from_numpy({"x": x, "x2": rng.normal(size=n),
+                                    "y": y3})
+    eff = effective_max_depth(18, 16, 2, fr.padded_rows)
+    assert eff < 18
+    m = GBM(ntrees=2, max_depth=18, nbins=16, response_column="y",
+            seed=1).train(fr)                      # multinomial scan path
+    assert len(m.output["stacked"][0].levels if isinstance(
+        m.output["stacked"], list) else m.output["stacked"].levels) == eff
+    m2 = DRF(ntrees=2, nbins=16, response_column="y", seed=1).train(fr)
+    assert m2.predict(fr).nrows == n
+
+
 def test_histogram_types(cl, rng):
     import h2o3_tpu
     from h2o3_tpu.models import GBM
