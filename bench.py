@@ -107,6 +107,34 @@ def bench_deeplearning(Frame, DeepLearning):
     return epochs * n / dt
 
 
+REFERENCE_GLM_HIGGS_S = 47.0      # best of the higgs GLM intervals
+REFERENCE_GLM_HIGGS_ROWS = 11_000_000
+# (COORDINATE_DESCENT 47-54 s, IRLSM 65-73 s —
+#  compareBenchmarksStage.groovy:97-104; 11M rows x 28 numerics.
+#  The conservative best-of-either-solver bound is scaled linearly to the
+#  benched row count so reduced-shape smoke runs stay honest.)
+
+
+def bench_glm(Frame, GLM):
+    """Higgs-shape binomial GLM (IRLSM, lambda=0): train-time seconds."""
+    n, d = N_ROWS, 28
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    beta = rng.normal(size=d) * 0.3
+    logit = X @ beta - 0.2
+    yy = rng.random(n) < 1 / (1 + np.exp(-logit))
+    cols = {f"f{j}": X[:, j] for j in range(d)}
+    cols["y"] = np.where(yy, "s", "b").astype(object)
+    fr = Frame.from_numpy(cols)
+    kw = dict(family="binomial", response_column="y", lambda_=0.0)
+    GLM(**kw).train(fr)                               # warmup/compile
+    t0 = time.time()
+    GLM(**kw).train(fr)
+    dt = time.time() - t0
+    del fr
+    return dt
+
+
 def _sync(frame):
     """Force completion of a frame's device work (async dispatch barrier).
 
@@ -200,6 +228,15 @@ def worker_main():
             extra["deeplearning_samples_per_sec_mnist_shape"] = round(sps, 1)
         except Exception as e:                        # secondary: never fatal
             extra["deeplearning_error"] = repr(e)[:200]
+        try:
+            from h2o3_tpu.models import GLM
+            dt_glm = bench_glm(Frame, GLM)
+            glm_base = REFERENCE_GLM_HIGGS_S * N_ROWS \
+                / REFERENCE_GLM_HIGGS_ROWS
+            extra["glm_higgs_shape_sec"] = round(dt_glm, 3)
+            extra["glm_vs_baseline"] = round(glm_base / dt_glm, 2)
+        except Exception as e:                        # secondary: never fatal
+            extra["glm_error"] = repr(e)[:200]
         try:
             dt_sort, dt_merge = bench_rapids(Frame, sort, merge)
             extra["rapids_sort_10m_sec"] = round(dt_sort, 3)

@@ -648,8 +648,10 @@ class GLM(ModelBuilder):
         dev = np.inf
         for li, lam in enumerate(lambdas):
             for it in range(p.max_iterations):
-                gram, xtwz, dev_new = step(X, y, w, jnp.asarray(
-                    beta, dtype=jnp.float32), offset)
+                # one batched fetch per iteration (each separate fetch is a
+                # full round trip on a tunnelled backend)
+                gram, xtwz, dev_new = jax.device_get(step(
+                    X, y, w, jnp.asarray(beta, dtype=jnp.float32), offset))
                 gram = np.asarray(gram, np.float64)
                 xtwz = np.asarray(xtwz, np.float64)
                 new_beta = _solve_penalized(gram, xtwz, n, lam, p.alpha,
@@ -686,8 +688,10 @@ class GLM(ModelBuilder):
         lam = lambdas[-1]
         ll_prev = np.inf
         for it in range(p.max_iterations):
-            grams, xtwz, ll, _ = stats(X, y, w,
-                                       jnp.asarray(beta, jnp.float32), offset)
+            # batched fetch of the SMALL outputs only — [:3] keeps the
+            # [N, K] probs (4th return) on device
+            grams, xtwz, ll = jax.device_get(stats(
+                X, y, w, jnp.asarray(beta, jnp.float32), offset)[:3])
             grams = np.asarray(grams, np.float64)
             xtwz = np.asarray(xtwz, np.float64)
             delta = 0.0

@@ -90,16 +90,9 @@ class DRF(SharedTree):
         y = jnp.where(jnp.isnan(y), 0.0, y)
         N = codes.shape[1]
         if prior is not None:
-            # continuation chunks must match the checkpoint's level count
-            # (dense-level depth cap is frame-size dependent) — see gbm.py
-            from .shared import effective_max_depth
-            eff = effective_max_depth(p.max_depth, p.nbins, Fnum, N)
-            pd = prior_stacked(prior, 0 if K > 1 else None).depth
-            if pd != eff:
-                raise ValueError(
-                    f"checkpoint tree depth {pd} != effective depth {eff} "
-                    f"on this frame (dense-level depth cap); continue on a "
-                    f"similarly sized frame or lower max_depth to {pd}")
+            from .shared import validate_checkpoint_depth
+            validate_checkpoint_depth(prior, 0 if K > 1 else None,
+                                      p, Fnum, N)
         rng = jax.random.PRNGKey(p.effective_seed())
 
         if p.mtries == -1:

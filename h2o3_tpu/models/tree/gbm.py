@@ -134,21 +134,9 @@ class GBM(SharedTree):
             edges_matrix(binned.edges, p.nbins), jnp.float32)
         N = codes.shape[1]
         if prior is not None:
-            # chunks must stack at ONE depth: the dense-level cap depends
-            # on the frame size, so a continuation on a differently-sized
-            # frame could disagree with the checkpoint's level count —
-            # fail clearly instead of mis-stacking (shared.py
-            # effective_max_depth)
-            from .shared import effective_max_depth
-            eff = effective_max_depth(p.max_depth, p.nbins,
-                                      binned.nfeatures, N)
-            pd = prior_stacked(prior, 0).depth if multinomial \
-                else prior_stacked(prior).depth
-            if pd != eff:
-                raise ValueError(
-                    f"checkpoint tree depth {pd} != effective depth {eff} "
-                    f"on this frame (dense-level depth cap); continue on a "
-                    f"similarly sized frame or lower max_depth to {pd}")
+            from .shared import validate_checkpoint_depth
+            validate_checkpoint_depth(prior, 0 if multinomial else None,
+                                      p, binned.nfeatures, N)
         seed = p.effective_seed()
         rng = jax.random.PRNGKey(seed)
         nprng = np.random.default_rng(seed)

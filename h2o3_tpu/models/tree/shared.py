@@ -329,6 +329,20 @@ def effective_max_depth(max_depth: int, nbins: int, F: int,
     return max(1, min(max_depth, row_cap, mem_cap))
 
 
+def validate_checkpoint_depth(prior, k, params, F: int, n_padded: int):
+    """Continuation chunks must stack at ONE depth: the dense-level cap
+    depends on the frame size, so a continuation on a differently-sized
+    frame could disagree with the checkpoint's level count — fail clearly
+    instead of mis-stacking."""
+    eff = effective_max_depth(params.max_depth, params.nbins, F, n_padded)
+    pd = prior_stacked(prior, k).depth
+    if pd != eff:
+        raise ValueError(
+            f"checkpoint tree depth {pd} != effective depth {eff} on this "
+            f"frame (dense-level depth cap); continue on a similarly sized "
+            f"frame or lower max_depth to {pd}")
+
+
 @functools.lru_cache(maxsize=None)
 def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
                        hist_precision: str = "bf16", hier: bool = False,
@@ -369,16 +383,19 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
     impl_override = os.environ.get("H2O3_TPU_HIST_IMPL", "")
     use_varbin = (bin_counts is not None
                   and (on_tpu or impl_override == "varbin")
-                  and F * B * 3 * 2 ** max(max_depth - 1, 0) * 4
-                  <= 12 * 1024 * 1024
                   and sum(min(b, nbins) + 9 for b in bin_counts)
                   < F * (nbins + 1))
     # Per-LEVEL kernel choice: the varbin Pallas kernel has no einsum
-    # fallback and its minimum row block must keep [R, 3L] A-build
-    # intermediates inside scoped VMEM, so deep levels (3L > 1024) take
-    # the uniform path, which falls back to einsum past its own bound.
-    varbin_level = [use_varbin and 3 * 2 ** max(d - 1, 0) <= 1024
-                    for d in range(max_depth)]
+    # fallback, its minimum row block must keep [R, 3L] A-build
+    # intermediates inside scoped VMEM (3L <= 1024), and its whole-
+    # histogram output block must stage through VMEM (12 MB).  Deeper
+    # levels take the uniform path, which falls back to einsum past its
+    # own bound — the gate is per level so a deep tree keeps the fast
+    # kernel on its shallow levels.
+    varbin_level = [
+        use_varbin and 3 * 2 ** max(d - 1, 0) <= 1024
+        and F * B * 3 * 2 ** max(d - 1, 0) * 4 <= 12 * 1024 * 1024
+        for d in range(max_depth)]
     force = "" if on_tpu else "pallas_interpret"
     hist_fns = [
         make_varbin_hist_fn(2 ** max(d - 1, 0), F, tuple(bin_counts), B,
