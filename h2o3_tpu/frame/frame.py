@@ -237,7 +237,44 @@ class Frame:
         """h2o-py H2OFrame.describe() alias for summary()."""
         return self.summary()
 
+    def warm_rollups(self) -> None:
+        """Batch-compute rollups for every device column that lacks them —
+        ONE fused program + ONE fetch (RollupStats' lazy-compute contract,
+        but frame-wide: per-column eager rollups cost a dispatch round trip
+        each, measured ~0.4 s/column on a tunnelled TPU)."""
+        from .vec import RollupStats, _batch_rollup_kernel
+        # membership test must NOT touch v.data: the getter transparently
+        # restores spilled payloads, and restoring ALL columns up-front
+        # would defeat the spill mechanism (blocks restore lazily below)
+        todo = [v for v in self.vecs
+                if v._rollups is None
+                and (v._device is not None or v._spill is not None)
+                and not (v.type == T_TIME and v.host_data is not None)]
+        if len(todo) < 2:
+            return
+        import jax
+        # block the stack: a single [C, padded] copy of a wide frame near
+        # HBM capacity would defeat the Vec spill mechanism it exists for
+        blk = max(2, 268_435_456 // (4 * max(todo[0].padded_len, 1)))
+        for lo in range(0, len(todo), blk):
+            chunk = todo[lo: lo + blk]
+            X = jnp.stack([v.numeric_data() for v in chunk], axis=0)
+            cnt, mean, var, vmin, vmax, nzero = (
+                np.asarray(a) for a in jax.device_get(
+                    _batch_rollup_kernel(X, chunk[0].nrows)))
+            for i, v in enumerate(chunk):
+                n = int(cnt[i])
+                v._rollups = RollupStats(
+                    nrows=v.nrows, nmissing=v.nrows - n,
+                    mean=float(mean[i]) if n else float("nan"),
+                    sigma=(float(np.sqrt(max(float(var[i]), 0.0)))
+                           if n > 1 else float("nan")),
+                    vmin=float(vmin[i]) if n else float("nan"),
+                    vmax=float(vmax[i]) if n else float("nan"),
+                    nzero=int(nzero[i]))
+
     def summary(self) -> Dict[str, dict]:
+        self.warm_rollups()
         out = {}
         for name, v in zip(self.names, self.vecs):
             if v.data is None:

@@ -19,6 +19,7 @@ mirroring the reference's RollupStats contract.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import time
 from typing import Optional, Sequence
 
@@ -54,6 +55,28 @@ class RollupStats:
     @property
     def is_constant(self) -> bool:
         return self.nrows - self.nmissing > 0 and self.vmin == self.vmax
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _batch_rollup_kernel(X, n: int):
+    """Rollups for a whole [C, padded] column block in ONE fused pass —
+    per-column eager rollups cost a dispatch round trip each on a
+    tunnelled backend (measured 203 s for a 481-column frame)."""
+    iota = jax.lax.broadcasted_iota(jnp.int32, X.shape, 1)
+    present = (iota < n) & ~jnp.isnan(X)
+    x = jnp.where(present, X, 0.0)
+    cnt = jnp.sum(present, axis=1)
+    nf = jnp.maximum(cnt, 1).astype(jnp.float32)
+    s = jnp.sum(x, axis=1, dtype=jnp.float32)
+    ss = jnp.sum(x * x, axis=1, dtype=jnp.float32)
+    mean = s / nf
+    var = jnp.maximum(ss / nf - mean * mean, 0.0)
+    big = jnp.float32(np.finfo(np.float32).max)
+    vmin = jnp.min(jnp.where(present, X, big), axis=1)
+    vmax = jnp.max(jnp.where(present, X, -big), axis=1)
+    nzero = jnp.sum(present & (X == 0.0), axis=1)
+    return (cnt, mean, var * nf / jnp.maximum(nf - 1.0, 1.0), vmin, vmax,
+            nzero)
 
 
 @jax.jit

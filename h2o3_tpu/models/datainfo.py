@@ -97,6 +97,9 @@ class DataInfo:
             force_classification: bool = False) -> "DataInfo":
         skip = set(ignored_columns) | {response_column, weights_column,
                                        offset_column, None}
+        # one batched pass for every column's rollups — the per-column
+        # lazy path costs a dispatch round trip per column (wide frames)
+        frame.warm_rollups()
         specs: List[ColumnSpec] = []
         offset = 0
         for name, vec in zip(frame.names, frame.vecs):
@@ -206,18 +209,19 @@ class DataInfo:
         frame._matrix_cache[key] = mat
         return mat
 
-    def _design_signature(self) -> int:
-        """Compact memo key for the design layout, computed once per
-        DataInfo (repr(self) would rebuild every categorical domain list as
-        a string on every call)."""
+    def _design_signature(self) -> tuple:
+        """Memo key for the design layout, computed once per DataInfo.
+        The key is the signature TUPLE itself (hashable), not its hash():
+        a 64-bit hash collision between two layouts over the same Frame
+        would silently return the wrong cached design matrix."""
         sig = self.__dict__.get("_design_sig")
         if sig is None:
-            sig = hash((
+            sig = (
                 tuple((s.name, s.type, tuple(s.domain or ()), s.mean,
                        s.sigma, s.time_base, s.offset, s.width)
                       for s in self.specs),
                 self.use_all_factor_levels, self.add_intercept,
-                self.missing_values_handling))
+                self.missing_values_handling)
             object.__setattr__(self, "_design_sig", sig)
         return sig
 

@@ -224,6 +224,28 @@ class DeepLearningModel(Model):
             mu = mu * self.datainfo.response_sigma + self.datainfo.response_mean
         return mu
 
+    def predict(self, frame: Frame) -> Frame:
+        if not self.params.autoencoder:
+            return super().predict(frame)
+        # autoencoder predict = per-design-column reconstruction, named and
+        # un-scaled like the reference (DeepLearningModel.scoreAutoEncoder
+        # reverses standardization and names columns reconstr_<coef>)
+        from ..frame.vec import Vec, T_NUM, T_CAT
+        di = self.datainfo
+        R = np.asarray(self._predict_raw(
+            di.make_matrix(frame)))[: frame.nrows].astype(np.float64)
+        if di.standardize:
+            for s in di.specs:
+                if s.type != T_CAT:
+                    R[:, s.offset] = R[:, s.offset] * s.sigma + s.mean
+        cnames = di.coef_names
+        names, vecs = [], []
+        for j in range(R.shape[1]):
+            cn = cnames[j] if j < len(cnames) else str(j)
+            names.append(f"reconstr_{cn}")
+            vecs.append(Vec.from_numpy(R[:, j], T_NUM))
+        return Frame(names, vecs)
+
     def anomaly(self, frame: Frame) -> Frame:
         """Autoencoder per-row reconstruction MSE (DL anomaly detection)."""
         from ..frame.vec import Vec, T_NUM

@@ -89,22 +89,30 @@ class DRF(SharedTree):
         Fnum = binned.nfeatures
         y = jnp.where(jnp.isnan(y), 0.0, y)
         N = codes.shape[1]
+        from .shared import maybe_bundle
+        plan, wcodes, Fw, wbin_counts = maybe_bundle(binned, p, None,
+                                                     frame.nrows)
         if prior is not None:
             from .shared import validate_checkpoint_depth
             validate_checkpoint_depth(prior, 0 if K > 1 else None,
-                                      p, Fnum, N)
+                                      p, Fw, N)
         rng = jax.random.PRNGKey(p.effective_seed())
 
+        # mtries resolves against the WORKING feature count: the per-split
+        # mask is drawn over working features, so a rate computed from the
+        # original count would collapse to ~1 feature/split under bundling
         if p.mtries == -1:
-            m = math.isqrt(Fnum) if di.is_classifier else max(Fnum // 3, 1)
-            col_rate = max(min(m, Fnum), 1) / Fnum
+            m = math.isqrt(Fw) if di.is_classifier else max(Fw // 3, 1)
+            col_rate = max(min(m, Fw), 1) / Fw
         elif p.mtries == -2:
             col_rate = 1.0
         else:
-            col_rate = max(min(p.mtries, Fnum), 1) / Fnum
+            col_rate = max(min(p.mtries, Fw), 1) / Fw
 
         model = DRFModel(job.dest_key or dkv.make_key(self.algo), p, di)
         model.output["nclass_trees"] = K
+        from .shared import record_effective_depth
+        record_effective_depth(model, p, Fw, N)
 
         if K > 1:
             yi = jnp.clip(y.astype(jnp.int32), 0, K - 1)
@@ -152,10 +160,10 @@ class DRF(SharedTree):
         # same bootstrap sample per iteration (DRF.java samples once/tree).
         from .shared import use_hier_split_search
         scan_fn = make_tree_scan_fn(
-            "drf", 0.0, 0.0, 0.0, p.max_depth, p.nbins, Fnum, N,
+            "drf", 0.0, 0.0, 0.0, p.max_depth, p.nbins, Fw, N,
             p.effective_hist_precision, p.sample_rate, 1.0,
             hier=use_hier_split_search(p, N),
-            bin_counts=binned.bin_counts)
+            bin_counts=wbin_counts, plan=plan)
         scalars = (p.reg_lambda, p.min_rows, p.min_split_improvement, 1.0,
                    col_rate, p.reg_alpha, p.gamma, p.min_child_weight)
         chunks = [[] for _ in range(K)]
@@ -170,7 +178,7 @@ class DRF(SharedTree):
                 # same (rng, chunk_no) across classes -> same bootstrap per
                 # iteration (DRF.java samples once per tree); the salt
                 # decorrelates each class tree's per-split feature subsets
-                Fk, lv, vals, cov = scan_fn(codes, targets[k], w, Fk0,
+                Fk, lv, vals, cov = scan_fn(wcodes, targets[k], w, Fk0,
                                             edges_mat, rng, chunk_no, c,
                                             *scalars, k)
                 chunks[k].append(StackedTrees(lv, vals, cov))

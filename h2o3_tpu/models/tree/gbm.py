@@ -133,10 +133,15 @@ class GBM(SharedTree):
         edges_mat = jnp.asarray(
             edges_matrix(binned.edges, p.nbins), jnp.float32)
         N = codes.shape[1]
+        # EFB: wide/sparse frames train on bundled working codes (efb.py);
+        # the recorded trees stay in original feature space
+        from .shared import maybe_bundle
+        plan, wcodes, Fw, wbin_counts = maybe_bundle(binned, p, mono,
+                                                     frame.nrows)
         if prior is not None:
             from .shared import validate_checkpoint_depth
             validate_checkpoint_depth(prior, 0 if multinomial else None,
-                                      p, binned.nfeatures, N)
+                                      p, Fw, N)
         seed = p.effective_seed()
         rng = jax.random.PRNGKey(seed)
         nprng = np.random.default_rng(seed)
@@ -147,6 +152,11 @@ class GBM(SharedTree):
             else "multinomial"
         model.output["binning"] = {"nbins": p.nbins}
         model.output["nclass_trees"] = K
+        from .shared import record_effective_depth
+        record_effective_depth(model, p, Fw, N)
+        if plan is not None:
+            model.output["efb_bundles"] = sum(
+                1 for w in plan.working if w[0] == "bundle")
 
         if valid is not None:
             Xv = model._design(valid)
@@ -238,10 +248,10 @@ class GBM(SharedTree):
             # scoring interval of rounds per dispatch
             from .shared import make_multinomial_scan_fn
             scan_fn = make_multinomial_scan_fn(
-                K, p.max_depth, p.nbins, binned.nfeatures, N,
+                K, p.max_depth, p.nbins, Fw, N,
                 p.effective_hist_precision, p.sample_rate, p.col_sample_rate_per_tree,
                 hier=use_hier_split_search(p, N),
-                bin_counts=binned.bin_counts)
+                bin_counts=wbin_counts, plan=plan)
             scalars = (p.reg_lambda, p.min_rows, p.min_split_improvement,
                        p.learn_rate, p.col_sample_rate, p.reg_alpha, p.gamma,
                        p.min_child_weight)
@@ -250,7 +260,7 @@ class GBM(SharedTree):
             for chunk_no, (c, t_new, score_now) in enumerate(chunk_schedule(
                     p.ntrees - prior_nt, p.score_tree_interval)):
                 t_done = prior_nt + t_new
-                F, lv, vals, cov = scan_fn(codes, Y1, w, F, edges_mat,
+                F, lv, vals, cov = scan_fn(wcodes, Y1, w, F, edges_mat,
                                            rng, chunk_no, c, *scalars)
                 for k in range(K):
                     lv_k = [tuple(lvd[i][:, k] for i in range(4))
@@ -279,10 +289,10 @@ class GBM(SharedTree):
             # fast path: scan a whole scoring interval of trees per dispatch
             scan_fn = make_tree_scan_fn(
                 dist.name, p.tweedie_power, p.quantile_alpha, p.huber_alpha,
-                p.max_depth, p.nbins, binned.nfeatures, N, p.effective_hist_precision,
+                p.max_depth, p.nbins, Fw, N, p.effective_hist_precision,
                 p.sample_rate, p.col_sample_rate_per_tree,
                 hier=use_hier_split_search(p, N) and mono is None,
-                bin_counts=binned.bin_counts, mono=mono,
+                bin_counts=wbin_counts, mono=mono, plan=plan,
                 custom_fn=getattr(p, "custom_distribution_func", None))
             scalars = (p.reg_lambda, p.min_rows, p.min_split_improvement,
                        p.learn_rate, p.col_sample_rate, p.reg_alpha, p.gamma,
@@ -291,7 +301,7 @@ class GBM(SharedTree):
             for chunk_no, (c, t_new, score_now) in enumerate(chunk_schedule(
                     p.ntrees - prior_nt, p.score_tree_interval)):
                 t_done = prior_nt + t_new
-                F, lv, vals, cov = scan_fn(codes, y, w, F, edges_mat,
+                F, lv, vals, cov = scan_fn(wcodes, y, w, F, edges_mat,
                                            rng, chunk_no, c, *scalars, 0)
                 chunk = StackedTrees(lv, vals, cov)
                 chunks.append(chunk)

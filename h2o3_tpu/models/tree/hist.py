@@ -924,6 +924,36 @@ def table_lookup(tables, idx, L: int):
 
 
 @jax.jit
+def partition_ranged(codes, leaf, feat, lo, hi, inv, na_left, valid,
+                     na_bin: jnp.int32):
+    """``partition`` with a bin RANGE right-child condition:
+    right = inv XOR (lo < code <= hi).  EFB bundle splits are member
+    sub-ranges of the bundled bin axis (efb.py); ``inv`` flips the rule
+    when the member's default mass sits on the right of the cut (then the
+    LEFT child is the contiguous range).  A plain prefix split is lo=bin,
+    hi=+inf, inv=False."""
+    L = feat.shape[0]
+    tables = jnp.stack([feat.astype(jnp.float32), lo.astype(jnp.float32),
+                        hi.astype(jnp.float32), inv.astype(jnp.float32),
+                        na_left.astype(jnp.float32),
+                        valid.astype(jnp.float32)], axis=0)      # [6, L]
+    t = table_lookup(tables, leaf, L)                            # [6, N]
+    f = t[0].astype(jnp.int32)
+    blo = t[1].astype(jnp.int32)
+    bhi = t[2].astype(jnp.int32)
+    iv = t[3] > 0.5
+    nl = t[4] > 0.5
+    v = t[5] > 0.5
+    Fdim = codes.shape[0]
+    fiota = jax.lax.broadcasted_iota(jnp.int32, (Fdim, 1), 0)
+    c = jnp.sum(jnp.where(f[None, :] == fiota, codes, 0), axis=0)
+    is_na = c == na_bin
+    right = jnp.where(is_na, ~nl, iv ^ ((c > blo) & (c <= bhi)))
+    right = right & v
+    return (2 * leaf + right.astype(jnp.int32)).astype(jnp.int32)
+
+
+@jax.jit
 def partition(codes, leaf, feat, bin_, na_left, valid, na_bin: jnp.int32):
     """Send rows to child leaves: new_leaf = 2*leaf + went_right.
 

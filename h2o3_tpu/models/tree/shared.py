@@ -76,6 +76,9 @@ class SharedTreeParameters(Parameters):
     # results vary across different device counts, as in the reference
     # when node counts change
     reproducible: bool = False
+    # exclusive feature bundling for wide/sparse frames (efb.py):
+    # "auto" engages only when the packed-kernel cost drops enough to win
+    efb: str = "auto"                    # auto | off
 
     @property
     def effective_hist_precision(self) -> str:
@@ -152,6 +155,11 @@ class StackedTrees:
         5-chunk x 26-array case on the tunnel)."""
         if len(chunks) == 1:
             return chunks[0]
+        if any(c.depth != chunks[0].depth for c in chunks):
+            raise ValueError(
+                "StackedTrees.concat: chunks disagree on depth "
+                f"({[c.depth for c in chunks]}); continuation stacks must "
+                "share one effective depth (validate_checkpoint_depth)")
         host = jax.device_get([
             [[c.levels[d][i] for i in range(4)]
              for d in range(c.depth)] +
@@ -329,6 +337,25 @@ def effective_max_depth(max_depth: int, nbins: int, F: int,
     return max(1, min(max_depth, row_cap, mem_cap))
 
 
+def record_effective_depth(model, params, F: int, n_padded: int) -> int:
+    """Record requested vs effective depth in model.output and WARN when the
+    dense-level bound caps the user's max_depth — the divergence from the
+    reference's node-sparse trees (which honor depth 20+) must be visible,
+    not silent (ADVICE round-4 medium finding)."""
+    import warnings
+    eff = effective_max_depth(params.max_depth, params.nbins, F, n_padded)
+    model.output["requested_max_depth"] = params.max_depth
+    model.output["effective_max_depth"] = eff
+    if eff < params.max_depth:
+        warnings.warn(
+            f"max_depth={params.max_depth} is capped to {eff} on this frame "
+            f"by the dense-level depth bound (full-width [2^d] levels double "
+            f"histogram memory per level; {F} features x {params.nbins} bins "
+            f"x {n_padded} rows). Trees train at depth {eff}; lower "
+            f"max_depth to silence this.", stacklevel=3)
+    return eff
+
+
 def validate_checkpoint_depth(prior, k, params, F: int, n_padded: int):
     """Continuation chunks must stack at ONE depth: the dense-level cap
     depends on the frame size, so a continuation on a differently-sized
@@ -346,7 +373,8 @@ def validate_checkpoint_depth(prior, k, params, F: int, n_padded: int):
 @functools.lru_cache(maxsize=None)
 def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
                        hist_precision: str = "bf16", hier: bool = False,
-                       fine_k: int = 2, bin_counts=None, mono=None):
+                       fine_k: int = 2, bin_counts=None, mono=None,
+                       plan=None):
     """One compiled program that grows a whole tree on device.
 
     The level loop (SharedTree.buildLayer) is unrolled inside a single jit:
@@ -371,6 +399,10 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
     if mono is not None and hier:
         raise ValueError("monotone constraints are not supported with "
                          "the hierarchical split search")
+    if plan is not None and (mono is not None or hier):
+        raise ValueError("feature bundling (EFB) does not compose with "
+                         "monotone constraints or the hierarchical search; "
+                         "the drivers disable it automatically")
     max_depth = effective_max_depth(max_depth, nbins, F, n_padded)
     from ...runtime.cluster import cluster
     # per-feature packed bins (DHistogram-style): only the TPU Pallas path
@@ -446,7 +478,12 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
                     em = ((leaf & 1) == 0).astype(jnp.float32)
                     Hcl = coarse_fns[d](ccodes, leaf >> 1,
                                         g * em, h * em, w * em)
+                    # clamp the h/w planes at 0: per-level kernel routing can
+                    # pair differently-rounded kernels across the subtraction
+                    # (bf16 vs f32), and negative hessian/weight sums would
+                    # corrupt best_splits at the boundary level
                     Hcr = H_prev - Hcl
+                    Hcr = Hcr.at[1:].max(0.0)
                     Hc = jnp.stack([Hcl, Hcr], axis=2) \
                         .reshape(3, L, F, S + 1)
                 H_prev = Hc
@@ -471,13 +508,25 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
                     Hl = hist_fns[d](hcodes if varbin_level[d] else codes,
                                      leaf >> 1,
                                      g * em, h * em, w * em)
+                    # h/w planes clamped at 0 — see the hier-path comment
+                    # (differently-rounded kernels across the subtraction)
                     Hr = H_prev - Hl
+                    Hr = Hr.at[1:].max(0.0)
                     H = jnp.stack([Hl, Hr], axis=2).reshape(3, L, F, B)
                 H_prev = H
-                feat, bin_, na_left, gain, valid, children = best_splits(
-                    H, nbins, reg_lambda, min_rows, min_split_improvement,
-                    mask, reg_alpha, gamma, min_child_weight,
-                    mono=mono_arr if mono is not None else None)
+                if plan is not None:
+                    from .efb import best_splits_mixed
+                    (feat, bin_, na_left, gain, valid, children, wfeat,
+                     lo_w, hi_w, inv_w) = best_splits_mixed(
+                        H, nbins, plan, reg_lambda, min_rows,
+                        min_split_improvement, mask, reg_alpha, gamma,
+                        min_child_weight)
+                else:
+                    feat, bin_, na_left, gain, valid, children = best_splits(
+                        H, nbins, reg_lambda, min_rows,
+                        min_split_improvement, mask, reg_alpha, gamma,
+                        min_child_weight,
+                        mono=mono_arr if mono is not None else None)
             if mono is not None:
                 # propagate value bounds to the children (the clamp at the
                 # leaves is what guarantees global monotonicity, exactly
@@ -496,8 +545,14 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
                 lo = jnp.stack([lo_l, lo_r], axis=1).reshape(-1)
                 hi = jnp.stack([hi_l, hi_r], axis=1).reshape(-1)
             thr = edges_mat[feat, jnp.clip(bin_, 0, nbins - 1)]
-            leaf = partition(codes, leaf, feat, bin_, na_left, valid,
-                             jnp.int32(nbins))
+            if plan is not None:
+                from .hist import partition_ranged
+                leaf = partition_ranged(codes, leaf, wfeat, lo_w, hi_w,
+                                        inv_w, na_left, valid,
+                                        jnp.int32(nbins))
+            else:
+                leaf = partition(codes, leaf, feat, bin_, na_left, valid,
+                                 jnp.int32(nbins))
             levels.append((feat, thr, na_left, valid))
         # Newton leaf values from the last level's child sums — no extra
         # data pass (fitBestConstants from the histograms themselves)
@@ -550,6 +605,23 @@ def resolve_mono(params, di) -> Optional[tuple]:
     return tuple(vec)
 
 
+def maybe_bundle(binned, params, mono, nrows: int):
+    """Driver gate for EFB: plan bundles when the mode allows and the packed
+    cost model says bundling wins; None keeps the un-bundled pipeline.
+    Returns (plan, working_codes, F_w, working_bin_counts)."""
+    from .efb import plan_bundles, apply_bundles
+    mode = str(getattr(params, "efb", "auto")).lower()
+    plan = None
+    if mode not in ("off", "false", "0") and mono is None \
+            and not use_hier_split_search(params, nrows):
+        plan = plan_bundles(binned.codes, binned.bin_counts, binned.nbins,
+                            nrows)
+    if plan is None:
+        return None, binned.codes, binned.nfeatures, binned.bin_counts
+    return (plan, apply_bundles(binned.codes, plan), plan.n_working,
+            plan.bin_counts)
+
+
 def use_hier_split_search(params, n_padded: int) -> bool:
     """Policy gate for the hierarchical split-search path.
 
@@ -573,7 +645,7 @@ def make_tree_scan_fn(mode: str, tweedie_power: float, quantile_alpha: float,
                       huber_alpha: float, max_depth: int, nbins: int, F: int,
                       n_padded: int, hist_precision: str, sample_rate: float,
                       col_sample_rate_per_tree: float, hier: bool = False,
-                      bin_counts=None, mono=None, custom_fn=None):
+                      bin_counts=None, mono=None, custom_fn=None, plan=None):
     """Scan a CHUNK of boosting/bagging rounds in ONE device dispatch.
 
     The per-tree driver loop (gradients -> row/column sample -> grow ->
@@ -593,7 +665,8 @@ def make_tree_scan_fn(mode: str, tweedie_power: float, quantile_alpha: float,
             tweedie_power=tweedie_power, quantile_alpha=quantile_alpha,
             huber_alpha=huber_alpha, custom_distribution_func=custom_fn)
     bt_fn = make_build_tree_fn(max_depth, nbins, F, n_padded, hist_precision,
-                               hier=hier, bin_counts=bin_counts, mono=mono)
+                               hier=hier, bin_counts=bin_counts, mono=mono,
+                               plan=plan)
 
     def scan_fn(codes, y, w, F0, edges_mat, rng0, chunk_no, nchunk,
                 reg_lambda, min_rows, min_split_improvement, learn_rate,
@@ -640,7 +713,7 @@ def make_multinomial_scan_fn(K: int, max_depth: int, nbins: int, F: int,
                              n_padded: int, hist_precision: str,
                              sample_rate: float,
                              col_sample_rate_per_tree: float,
-                             hier: bool = False, bin_counts=None):
+                             hier: bool = False, bin_counts=None, plan=None):
     """Scan a chunk of multinomial boosting rounds in ONE dispatch.
 
     Each round grows K one-vs-rest trees on softmax gradients
@@ -655,7 +728,7 @@ def make_multinomial_scan_fn(K: int, max_depth: int, nbins: int, F: int,
     max_depth = effective_max_depth(max_depth, nbins, F, n_padded)
     bt_fn = make_build_tree_fn(max_depth, nbins, F, n_padded,
                                hist_precision, hier=hier,
-                               bin_counts=bin_counts)
+                               bin_counts=bin_counts, plan=plan)
 
     def scan_fn(codes, Y1, w, F0, edges_mat, rng0, chunk_no, nchunk,
                 reg_lambda, min_rows, min_split_improvement, learn_rate,

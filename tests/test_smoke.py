@@ -1,0 +1,289 @@
+"""Smoke tier: every algorithm trains + predicts on a tiny shape, fast.
+
+Run with ``pytest -m smoke`` (<90 s target).  This is the round-trip
+sanity gate — behavioral depth lives in the per-algo suites; this file
+only proves the end-to-end train->predict path stays alive for all 30
+reference algorithms (SURVEY.md §2.4).
+"""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu import Frame
+
+pytestmark = pytest.mark.smoke
+
+N = 400
+
+
+@pytest.fixture(scope="module")
+def bin_fr():
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(N, 4))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0)
+    return Frame.from_numpy({
+        **{f"x{j}": X[:, j] for j in range(4)},
+        "y": np.where(y, "yes", "no").astype(object)})
+
+
+@pytest.fixture(scope="module")
+def reg_fr():
+    rng = np.random.default_rng(8)
+    X = rng.normal(size=(N, 4))
+    y = X[:, 0] * 2 - X[:, 1] + 0.1 * rng.normal(size=N)
+    return Frame.from_numpy({**{f"x{j}": X[:, j] for j in range(4)}, "y": y})
+
+
+@pytest.fixture(scope="module")
+def num_fr():
+    rng = np.random.default_rng(9)
+    return Frame.from_numpy({f"x{j}": rng.normal(size=N) for j in range(4)})
+
+
+def _check_pred(model, fr):
+    pred = model.predict(fr)
+    assert pred.nrows == fr.nrows
+    return pred
+
+
+def test_smoke_gbm(cl, bin_fr):
+    from h2o3_tpu.models import GBM
+    m = GBM(response_column="y", ntrees=3, max_depth=2, nbins=16, seed=1).train(bin_fr)
+    _check_pred(m, bin_fr)
+
+
+def test_smoke_drf(cl, bin_fr):
+    from h2o3_tpu.models import DRF
+    m = DRF(response_column="y", ntrees=3, max_depth=2, nbins=16, seed=1).train(bin_fr)
+    _check_pred(m, bin_fr)
+
+
+def test_smoke_xgboost(cl, reg_fr):
+    from h2o3_tpu.models import XGBoost
+    m = XGBoost(response_column="y", ntrees=3, max_depth=2, nbins=16,
+                seed=1).train(reg_fr)
+    _check_pred(m, reg_fr)
+
+
+def test_smoke_decision_tree(cl, bin_fr):
+    from h2o3_tpu.models import DecisionTree
+    m = DecisionTree(response_column="y", max_depth=2, seed=1).train(bin_fr)
+    _check_pred(m, bin_fr)
+
+
+def test_smoke_uplift_drf(cl):
+    from h2o3_tpu.models import UpliftDRF
+    rng = np.random.default_rng(10)
+    X = rng.normal(size=(N, 3))
+    treat = rng.integers(0, 2, N)
+    y = (X[:, 0] + 0.5 * treat > 0.2)
+    fr = Frame.from_numpy({
+        **{f"x{j}": X[:, j] for j in range(3)},
+        "treatment": np.where(treat == 1, "t", "c").astype(object),
+        "y": np.where(y, "1", "0").astype(object)})
+    m = UpliftDRF(response_column="y", treatment_column="treatment",
+                  ntrees=3, max_depth=2, nbins=16, seed=1).train(fr)
+    _check_pred(m, fr)
+
+
+def test_smoke_isolation_forest(cl, num_fr):
+    from h2o3_tpu.models import IsolationForest
+    m = IsolationForest(ntrees=3, seed=1).train(num_fr)
+    _check_pred(m, num_fr)
+
+
+def test_smoke_ext_isolation_forest(cl, num_fr):
+    from h2o3_tpu.models import ExtendedIsolationForest
+    m = ExtendedIsolationForest(ntrees=3, seed=1).train(num_fr)
+    _check_pred(m, num_fr)
+
+
+def test_smoke_deeplearning(cl, bin_fr):
+    from h2o3_tpu.models import DeepLearning
+    m = DeepLearning(response_column="y", hidden=[8], epochs=2,
+                     seed=1).train(bin_fr)
+    _check_pred(m, bin_fr)
+
+
+def test_smoke_deeplearning_autoencoder(cl, num_fr):
+    from h2o3_tpu.models import DeepLearning
+    m = DeepLearning(autoencoder=True, hidden=[3], epochs=2,
+                     seed=1).train(num_fr)
+    _check_pred(m, num_fr)
+
+
+def test_smoke_glm(cl, reg_fr):
+    from h2o3_tpu.models import GLM
+    m = GLM(response_column="y", family="gaussian", lambda_=0.0).train(reg_fr)
+    _check_pred(m, reg_fr)
+
+
+def test_smoke_gam(cl, reg_fr):
+    from h2o3_tpu.models import GAM
+    m = GAM(response_column="y", gam_columns=["x0"],
+            family="gaussian").train(reg_fr)
+    _check_pred(m, reg_fr)
+
+
+def test_smoke_anovaglm(cl):
+    from h2o3_tpu.models import ANOVAGLM
+    rng = np.random.default_rng(11)
+    a = rng.integers(0, 2, N)
+    b = rng.integers(0, 3, N)
+    y = a * 1.0 + b * 0.5 + 0.2 * rng.normal(size=N)
+    fr = Frame.from_numpy({
+        "a": np.array(["a0", "a1"], dtype=object)[a],
+        "b": np.array(["b0", "b1", "b2"], dtype=object)[b], "y": y})
+    m = ANOVAGLM(response_column="y", family="gaussian").train(fr)
+    assert "anova_table" in m.output or m.output
+
+
+def test_smoke_modelselection(cl, reg_fr):
+    from h2o3_tpu.models import ModelSelection
+    m = ModelSelection(response_column="y", mode="forward",
+                       max_predictor_number=2).train(reg_fr)
+    assert m.output
+
+
+def test_smoke_coxph(cl):
+    from h2o3_tpu.models import CoxPH
+    rng = np.random.default_rng(12)
+    x = rng.normal(size=N)
+    t = rng.exponential(1.0 / np.exp(0.5 * x))
+    fr = Frame.from_numpy({"x": x, "time": t,
+                           "event": np.ones(N)})
+    m = CoxPH(stop_column="time", event_column="event",
+              standardize=False).train(fr)
+    assert "coef" in m.output
+
+
+def test_smoke_kmeans(cl, num_fr):
+    from h2o3_tpu.models import KMeans
+    m = KMeans(k=3, seed=1).train(num_fr)
+    _check_pred(m, num_fr)
+
+
+def test_smoke_pca(cl, num_fr):
+    from h2o3_tpu.models import PCA
+    m = PCA(k=2).train(num_fr)
+    _check_pred(m, num_fr)
+
+
+def test_smoke_svd(cl, num_fr):
+    from h2o3_tpu.models import SVD
+    m = SVD(nv=2).train(num_fr)
+    assert m.output
+
+
+def test_smoke_glrm(cl, num_fr):
+    from h2o3_tpu.models import GLRM
+    m = GLRM(k=2, max_iterations=5, seed=1).train(num_fr)
+    xfr = m.transform(num_fr)
+    assert xfr.nrows == num_fr.nrows
+
+
+def test_smoke_naive_bayes(cl, bin_fr):
+    from h2o3_tpu.models import NaiveBayes
+    m = NaiveBayes(response_column="y").train(bin_fr)
+    _check_pred(m, bin_fr)
+
+
+def test_smoke_psvm(cl, bin_fr):
+    from h2o3_tpu.models import PSVM
+    m = PSVM(response_column="y", max_iterations=10, seed=1).train(bin_fr)
+    _check_pred(m, bin_fr)
+
+
+def test_smoke_rulefit(cl, bin_fr):
+    from h2o3_tpu.models import RuleFit
+    m = RuleFit(response_column="y", rule_generation_ntrees=2,
+                max_rule_length=2, seed=1).train(bin_fr)
+    _check_pred(m, bin_fr)
+
+
+def test_smoke_isotonic(cl):
+    from h2o3_tpu.models import IsotonicRegression
+    rng = np.random.default_rng(13)
+    x = rng.uniform(-2, 2, N)
+    y = np.tanh(x) + 0.2 * rng.normal(size=N)
+    fr = Frame.from_numpy({"x": x, "y": y})
+    m = IsotonicRegression(response_column="y").train(fr)
+    _check_pred(m, fr)
+
+
+def test_smoke_adaboost(cl, bin_fr):
+    from h2o3_tpu.models import AdaBoost
+    m = AdaBoost(response_column="y", nlearners=3, seed=1).train(bin_fr)
+    _check_pred(m, bin_fr)
+
+
+def test_smoke_word2vec(cl):
+    from h2o3_tpu.models import Word2Vec
+    rng = np.random.default_rng(14)
+    vocab = ["cat", "dog", "car", "road"]
+    words = [vocab[i] for i in rng.integers(0, 4, 600)]
+    fr = Frame.from_numpy({"w": np.array(words, dtype=object)},
+                          types={"w": "str"})
+    m = Word2Vec(vec_size=4, epochs=2, min_word_freq=1, seed=1).train(fr)
+    assert m.output["vocab_size"] == 4
+
+
+def test_smoke_stacked_ensemble(cl, bin_fr):
+    from h2o3_tpu.models import GBM, GLM, StackedEnsemble
+    common = dict(response_column="y", nfolds=3, seed=1,
+                  keep_cross_validation_predictions=True)
+    g1 = GBM(ntrees=2, max_depth=2, nbins=16, **common).train(bin_fr)
+    g2 = GLM(family="binomial", lambda_=1e-4, **common).train(bin_fr)
+    se = StackedEnsemble(response_column="y",
+                         base_models=[g1.key, g2.key]).train(bin_fr)
+    _check_pred(se, bin_fr)
+
+
+def test_smoke_aggregator(cl, num_fr):
+    from h2o3_tpu.models import Aggregator
+    m = Aggregator(target_num_exemplars=20, seed=1).train(num_fr)
+    assert m.aggregated_frame.nrows <= 20
+
+
+def test_smoke_target_encoder(cl):
+    from h2o3_tpu.models import TargetEncoder
+    rng = np.random.default_rng(15)
+    g = rng.integers(0, 4, N)
+    fr = Frame.from_numpy({
+        "c": np.array([f"l{i}" for i in range(4)], dtype=object)[g],
+        "y": g + 0.1 * rng.normal(size=N)})
+    te = TargetEncoder(response_column="y").train(fr)
+    assert "c_te" in te.transform(fr).names
+
+
+def test_smoke_quantile(cl, num_fr):
+    from h2o3_tpu.models import Quantile
+    m = Quantile(probs=(0.25, 0.5, 0.75)).train(num_fr)
+    assert len(m.output["quantiles"]["x0"]) == 3
+
+
+def test_smoke_grep(cl, tmp_path):
+    from h2o3_tpu.models import Grep
+    p = tmp_path / "log.txt"
+    p.write_text("ok\nERROR one\nok\nERROR two\n")
+    m = Grep(regex="ERROR \\w+").train_on_path(str(p))
+    assert m.output["n_matches"] == 2
+
+
+def test_smoke_infogram(cl, bin_fr):
+    from h2o3_tpu.models import Infogram
+    m = Infogram(response_column="y", algorithm="glm").train(bin_fr)
+    assert m.output
+
+
+def test_smoke_generic_mojo_roundtrip(cl, reg_fr, tmp_path):
+    """Generic model: re-import an exported artifact and score."""
+    import h2o3_tpu
+    from h2o3_tpu.models import GBM
+    m = GBM(response_column="y", ntrees=3, max_depth=2, nbins=16, seed=1).train(reg_fr)
+    path = m.download_mojo(str(tmp_path / "m.zip"))
+    sm = h2o3_tpu.import_mojo(path)
+    out = sm.predict({f"x{j}": reg_fr.vec(f"x{j}").to_numpy()
+                      for j in range(4)})
+    ref = m.predict(reg_fr).vecs[0].to_numpy()
+    np.testing.assert_allclose(out["predict"], ref, atol=5e-4)
