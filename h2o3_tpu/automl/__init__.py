@@ -90,6 +90,9 @@ class AutoMLParameters:
     preprocessing: Sequence[str] = ()        # ("target_encoding",)
     auto_recovery_dir: Optional[str] = None  # resume point (Recovery.java:55)
     exploitation_ratio: float = 0.25         # grid share of the time budget
+    # concurrent modeling steps (ModelingStepsExecutor parallelism):
+    # 0 = auto (bounded pool), 1 = sequential, n = exactly n
+    parallelism: int = 0
 
 
 # --------------------------------------------------------- steps providers
@@ -344,26 +347,56 @@ class AutoML:
             return True
 
         spent_weight = 0
-        for step in plan:
-            if not budget_left(1):
-                break
-            # WorkAllocations: skip a step whose proportional time share is
-            # already exhausted (keeps late grid steps from starving SEs)
-            if p.max_runtime_secs:
-                elapsed = time.time() - t0
-                fair_share = p.max_runtime_secs * (
-                    spent_weight / total_weight)
-                if step["group"] == "grid" and elapsed > max(
-                        fair_share, p.max_runtime_secs
-                        * (1 - p.exploitation_ratio)):
-                    self.events.append({"step": step["id"],
-                                        "skipped": "work_allocation"})
-                    spent_weight += step["weight"]
-                    continue
-            spent_weight += step["weight"]
+        # Steps execute in WAVES of up to `parallelism` concurrent builds
+        # (ModelingStepsExecutor with a bounded pool); budgets and
+        # WorkAllocations fair-share checks run between waves.
+        from ..models.parallel import effective_parallelism, map_builds
+        par = effective_parallelism(p.parallelism, len(plan))
+
+        def run_step(step):
             try:
                 b = self._builder(step["algo"], step["params"])
                 m = b.train(frame, valid)
+                return step, m, None
+            except Exception as e:                      # noqa: BLE001
+                return step, None, e
+
+        i = 0
+        while i < len(plan):
+            if not budget_left(1):
+                break
+            wave = []
+            while i < len(plan) and len(wave) < par \
+                    and budget_left(len(wave) + 1):
+                step = plan[i]
+                # WorkAllocations: skip a step whose proportional time
+                # share is already exhausted (keeps late grid steps from
+                # starving SEs)
+                if p.max_runtime_secs:
+                    elapsed = time.time() - t0
+                    fair_share = p.max_runtime_secs * (
+                        spent_weight / total_weight)
+                    if step["group"] == "grid" and elapsed > max(
+                            fair_share, p.max_runtime_secs
+                            * (1 - p.exploitation_ratio)):
+                        self.events.append({"step": step["id"],
+                                            "skipped": "work_allocation"})
+                        spent_weight += step["weight"]
+                        i += 1
+                        continue
+                spent_weight += step["weight"]
+                wave.append(step)
+                i += 1
+            if not wave:
+                continue
+            for step, m, err in map_builds(
+                    [lambda s=s: run_step(s) for s in wave],
+                    min(par, len(wave))):
+                if err is not None:
+                    self.events.append({"step": step["id"],
+                                        "error": repr(err),
+                                        "t": time.time() - t0})
+                    continue
                 m.output["automl_step"] = step["id"]
                 self.models.append(m)
                 self._completed_steps.append(step["id"])
@@ -371,9 +404,6 @@ class AutoML:
                                     "t": time.time() - t0})
                 if p.auto_recovery_dir:
                     self._save_recovery(step["id"], m)
-            except Exception as e:                      # noqa: BLE001
-                self.events.append({"step": step["id"], "error": repr(e),
-                                    "t": time.time() - t0})
 
         if not self.models:
             raise RuntimeError(

@@ -64,6 +64,10 @@ class Parameters:
     # custom metric UDF: (predictions, y, w) -> (name, value)
     # (water/udf/CMetricFunc analog)
     custom_metric_func: Optional[Any] = None
+    # concurrent fold/member model building (hex/CVModelBuilder.java:16
+    # "parallelization" + hex/ParallelModelBuilder.java): 0 = auto
+    # (bounded pool), 1 = sequential, n>1 = exactly n builder threads
+    parallelism: int = 0
 
     def effective_seed(self) -> int:
         return np.random.default_rng().integers(2**31) if self.seed in (-1, None) \

@@ -5,10 +5,13 @@ ModelBuilder's CV code — build N fold models (optionally in parallel),
 aggregate the holdout predictions into the main model's CV metrics, then
 train the final model on all data.
 
-TPU-native redesign: fold models are independent compiled programs; holdout
-predictions are gathered host-side into one array and scored with the same
-fused metric kernels.  (Coarse model-parallelism across mesh slices — the
-SegmentModels pattern — can schedule fold models concurrently later.)
+TPU-native redesign: fold models are independent compiled programs with
+IDENTICAL shapes (holdout rows are weight-zeroed, not sliced), so every
+fold reuses the first fold's executables; fold builds run concurrently on
+a bounded thread pool (``models/parallel.py`` — the CVModelBuilder
+"parallelization" semantics), overlapping one fold's host-side work with
+another's device queue.  Holdout predictions are gathered host-side into
+one array and scored with the same fused metric kernels.
 """
 
 from __future__ import annotations
@@ -64,7 +67,6 @@ def cross_validate(builder, job: Job, frame: Frame, di, valid):
     nclasses = di.nclasses
     width = nclasses if di.is_classifier else 1
     holdout = np.full((frame.nrows, width), np.nan, dtype=np.float64)
-    cv_models = []
 
     # Constant-shape folds: rather than slicing rows per fold (which changes
     # the padded row count and forces XLA to recompile every program per
@@ -72,13 +74,17 @@ def cross_validate(builder, job: Job, frame: Frame, di, valid):
     # weights zeroed via a synthetic weight column.  Shapes stay identical
     # across folds, so every fold reuses the first fold's compilations.
     from ..frame.vec import Vec, T_NUM
+    from .parallel import effective_parallelism, map_builds
     base_w = np.ones(frame.nrows)
     if p.weights_column is not None:
         base_w = np.nan_to_num(frame.vec(p.weights_column).to_numpy())
     cv_w_col = "_cv_weights_"
     import dataclasses as _dc
-    X_full = None
-    for f in range(nfolds):
+    import threading
+    done = [0]
+    lock = threading.Lock()
+
+    def train_fold(f: int):
         w_f = np.where(folds != f, base_w, 0.0)
         fold_frame = Frame(list(frame.names) + [cv_w_col],
                            list(frame.vecs) + [Vec.from_numpy(w_f, T_NUM)])
@@ -89,13 +95,21 @@ def cross_validate(builder, job: Job, frame: Frame, di, valid):
         fold_job = Job(f"{builder.algo} cv fold {f}")
         m = fold_job.run(
             lambda j: fold_builder._fit(j, fold_frame, fold_di, None))
-        cv_models.append(m)
-        if X_full is None:
-            X_full = m._score_matrix(frame)
+        with lock:
+            done[0] += 1
+            job.update(0.7 * done[0] / nfolds,
+                       f"cv fold {done[0]}/{nfolds}")
+        return m
+
+    par = effective_parallelism(p.parallelism, nfolds)
+    cv_models = map_builds([lambda f=f: train_fold(f)
+                            for f in range(nfolds)], par)
+    X_full = cv_models[0]._score_matrix(frame)
+    for f, m in enumerate(cv_models):
         hold_idx = np.nonzero(folds == f)[0]
         raw = np.asarray(m._predict_raw(X_full))[: frame.nrows]
         holdout[hold_idx] = raw.reshape(frame.nrows, width)[hold_idx]
-        job.update(0.8 * (f + 1) / nfolds, f"cv fold {f + 1}/{nfolds}")
+        job.update(0.7 + 0.1 * (f + 1) / nfolds, f"cv holdout {f + 1}")
 
     # final model on all data
     model = builder._fit(job, frame, di, valid)

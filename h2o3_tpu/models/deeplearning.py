@@ -75,22 +75,43 @@ class DeepLearningParameters(Parameters):
     stopping_metric: str = "auto"
     stopping_tolerance: float = 0.0
     max_iterations: int = 10 ** 9        # unused; epochs governs
+    # bf16 MXU compute with f32 master weights/optimizer state (mixed
+    # precision — the TPU-native default); "f32" forces full precision
+    # (reproducible-mode analog for scale-sensitive losses)
+    precision: str = "bf16"
+    # rows are permuted once on device before training so the random-offset
+    # block sampler (see _build_train_steps) draws unbiased minibatches
+    # even from sorted frames; reference flag of the same name
+    shuffle_training_data: bool = True
 
 
 def _forward_pass(activation: str, params, X, deterministic=True, rng=None,
-                  dropout_in: float = 0.0, dropout_hidden=()):
+                  dropout_in: float = 0.0, dropout_hidden=(),
+                  compute_dtype=None):
     """THE DL forward pass — shared by predict-time ``Model._forward`` and
     the compiled training program (one implementation, so activation /
-    dropout semantics cannot drift between training and scoring)."""
+    dropout semantics cannot drift between training and scoring).
+
+    ``compute_dtype=bf16`` runs the matmuls on the MXU in bf16 with f32
+    accumulation (mixed precision); weights and biases stay f32 so the
+    optimizer state and the autodiff transpose remain full precision.
+    """
     act = _activation_fn(activation)
     maxout = act is None
+
+    def mm(h, W):
+        if compute_dtype is None:
+            return h @ W
+        return jnp.dot(h.astype(compute_dtype), W.astype(compute_dtype),
+                       preferred_element_type=jnp.float32)
+
     h = X
     if not deterministic and dropout_in > 0:
         rng, k = jax.random.split(rng)
         h = h * jax.random.bernoulli(k, 1 - dropout_in, h.shape) \
             / (1 - dropout_in)
     for i, (W, b) in enumerate(params[:-1]):
-        z = h @ W + b
+        z = mm(h, W) + b
         z = z.reshape(z.shape[0], -1, 2).max(axis=2) if maxout else act(z)
         dr = dropout_hidden[i] if i < len(dropout_hidden) else 0.0
         if not deterministic and dr > 0:
@@ -98,21 +119,22 @@ def _forward_pass(activation: str, params, X, deterministic=True, rng=None,
             z = z * jax.random.bernoulli(k, 1 - dr, z.shape) / (1 - dr)
         h = z
     W, b = params[-1]
-    return h @ W + b
+    return mm(h, W) + b
 
 
 def _build_train_steps(activation: str, dropout_in: float, dropout_h: tuple,
                        loss_kind: str, is_cls: bool, autoenc: bool,
                        out_dim: int, l1: float, l2: float, opt_cfg: tuple,
                        batch: int, steps_per_iter: int, n: int,
-                       custom_loss=None):
+                       custom_loss=None, compute_dtype=None):
     """Build the compiled training-interval program (see _make_train_steps
     for the caching story; ``custom_loss`` bypasses the cache)."""
 
     def forward(params, X, rng):
         return _forward_pass(activation, params, X, deterministic=False,
                              rng=rng, dropout_in=dropout_in,
-                             dropout_hidden=dropout_h)
+                             dropout_hidden=dropout_h,
+                             compute_dtype=compute_dtype)
 
     def loss_fn(params, xb, yb, wb, key):
         logits = forward(params, xb, key)
@@ -147,10 +169,19 @@ def _build_train_steps(activation: str, dropout_in: float, dropout_h: tuple,
     def sgd_step(X, y, w, carry, key):
         params, opt_state = carry
         k1, k2 = jax.random.split(key)
-        idx = jax.random.randint(k1, (batch,), 0, n)
-        xb = jnp.take(X, idx, axis=0)
-        yb = jnp.take(y, idx)
-        wb = jnp.take(w, idx)
+        # random-offset contiguous block instead of a per-row gather: a
+        # [batch]-row gather from a big table runs ~40M rows/s on TPU
+        # (PROFILE.md "small-table gathers are poison") and capped training
+        # at ~300k samples/s; dynamic_slice streams at HBM rate.  The rows
+        # were permuted once up front (shuffle_training_data) and the
+        # arrays carry a wraparound copy of the first `batch` rows
+        # (_extend_for_blocks), so offsets draw uniformly over [0, n) and
+        # every row has identical inclusion probability (a [0, n-batch]
+        # range would under-sample both array ends by up to batch x).
+        off = jax.random.randint(k1, (), 0, max(n, 1))
+        xb = jax.lax.dynamic_slice_in_dim(X, off, batch, axis=0)
+        yb = jax.lax.dynamic_slice_in_dim(y, off, batch, axis=0)
+        wb = jax.lax.dynamic_slice_in_dim(w, off, batch, axis=0)
         loss, grads = jax.value_and_grad(loss_fn)(params, xb, yb, wb, k2)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
@@ -170,10 +201,35 @@ def _build_train_steps(activation: str, dropout_in: float, dropout_h: tuple,
 
 
 @functools.lru_cache(maxsize=None)
+def _shuffle_fn(n: int, padded: int):
+    """One compiled row-permutation program per (n, padded) geometry."""
+    @jax.jit
+    def sh(X, y, w, key):
+        perm = jax.random.permutation(key, n)
+        idx = jnp.concatenate([perm, jnp.arange(n, padded)])
+        return (jnp.take(X, idx, axis=0), jnp.take(y, idx),
+                jnp.take(w, idx))
+    return sh
+
+
+@functools.lru_cache(maxsize=None)
+def _extend_fn(n: int, batch: int):
+    """Append a wraparound copy of the first `batch` rows so the block
+    sampler's dynamic_slice at any offset in [0, n) stays in bounds."""
+    @jax.jit
+    def ext(X, y, w):
+        return (jnp.concatenate([X[:n], X[:batch]], axis=0),
+                jnp.concatenate([y[:n], y[:batch]]),
+                jnp.concatenate([w[:n], w[:batch]]))
+    return ext
+
+
+@functools.lru_cache(maxsize=None)
 def _make_train_steps(activation: str, dropout_in: float, dropout_h: tuple,
                       loss_kind: str, is_cls: bool, autoenc: bool,
                       out_dim: int, l1: float, l2: float, opt_cfg: tuple,
-                      batch: int, steps_per_iter: int, n: int):
+                      batch: int, steps_per_iter: int, n: int,
+                      compute_dtype=None):
     """Compiled training-interval program, CACHED ACROSS train() calls.
 
     The per-call ``@jax.jit def train_steps`` pattern recompiled (and paid
@@ -187,7 +243,8 @@ def _make_train_steps(activation: str, dropout_in: float, dropout_h: tuple,
     """
     return _build_train_steps(activation, dropout_in, dropout_h, loss_kind,
                               is_cls, autoenc, out_dim, l1, l2, opt_cfg,
-                              batch, steps_per_iter, n)
+                              batch, steps_per_iter, n,
+                              compute_dtype=compute_dtype)
 
 
 def _activation_fn(name: str):
@@ -336,6 +393,12 @@ class DeepLearning(ModelBuilder):
             dropout_h = tuple(0.5 for _ in p.hidden)
 
         batch = min(p.mini_batch_size, n)
+        X0 = X                      # unshuffled view for final scoring
+        if p.shuffle_training_data:
+            rng, ks = jax.random.split(rng)
+            X, y, w = _shuffle_fn(n, X.shape[0])(X, y, w, ks)
+        X, y, w = _extend_fn(n, batch)(X, y, w)
+        cd = jnp.bfloat16 if p.precision == "bf16" else None
 
         # iteration sizing: train_samples_per_iteration semantics
         tspi = p.train_samples_per_iteration
@@ -355,13 +418,14 @@ class DeepLearning(ModelBuilder):
             train_steps, tx = _make_train_steps(
                 p.activation, p.input_dropout_ratio, dropout_h, loss_kind,
                 is_cls, p.autoencoder, out_dim, p.l1, p.l2, opt_cfg,
-                batch, steps_per_iter, n)
+                batch, steps_per_iter, n, compute_dtype=cd)
         else:
             # custom python loss: not hashable — same builder, uncached
             train_steps, tx = _build_train_steps(
                 p.activation, p.input_dropout_ratio, dropout_h, loss_kind,
                 is_cls, p.autoencoder, out_dim, p.l1, p.l2, opt_cfg,
-                batch, steps_per_iter, n, custom_loss=p.custom_loss_func)
+                batch, steps_per_iter, n, custom_loss=p.custom_loss_func,
+                compute_dtype=cd)
 
         opt_state = tx.init(params)
         # Commit params/opt_state to the replicated sharding explicitly:
@@ -432,9 +496,9 @@ class DeepLearning(ModelBuilder):
         model.output["samples_trained"] = seen
         model.scoring_history = history
         if not p.autoencoder:
-            raw = model._predict_raw(X)
+            raw = model._predict_raw(X0)
             yy = di.response(frame) if is_cls else jnp.nan_to_num(di.response(frame))
-            model.training_metrics = make_metrics(di, raw, yy, w)
+            model.training_metrics = make_metrics(di, raw, yy, di.weights(frame))
             if valid is not None:
                 model.validation_metrics = model.model_performance(valid)
         return model

@@ -130,14 +130,24 @@ class GridSearch:
     ``search_criteria``: {"strategy": "Cartesian"} (default) or
     {"strategy": "RandomDiscrete", "max_models": N, "max_runtime_secs": S,
     "seed": K, "stopping_rounds": R, "stopping_tolerance": T}.
+
+    ``parallelism`` (GridSearch.java "parallelism"): 0 = auto (bounded
+    pool), 1 = sequential, n = exactly n concurrent builds.  Parallel
+    grids build in WAVES of ``parallelism`` models: budgets (max_models /
+    max_runtime_secs) and sequence early-stopping are re-checked between
+    waves, so stopping semantics degrade gracefully (a wave may overshoot
+    by at most parallelism-1 models, exactly like the reference's
+    parallel walker).
     """
 
     def __init__(self, builder_cls, hyper_params: Dict[str, Sequence],
-                 search_criteria: Optional[dict] = None, **base_params):
+                 search_criteria: Optional[dict] = None,
+                 parallelism: int = 0, **base_params):
         self.builder_cls = builder_cls
         self.hyper_params = {k: list(v) for k, v in hyper_params.items()}
         self.search_criteria = dict(search_criteria or
                                     {"strategy": "Cartesian"})
+        self.parallelism = parallelism
         self.base_params = base_params
 
     def _combos(self) -> List[dict]:
@@ -163,30 +173,44 @@ class GridSearch:
         models, entries = [], []
         metric, decreasing = None, None
         series: List[float] = []
-        for combo in self._combos():
+        combos = self._combos()
+        from .parallel import effective_parallelism, map_builds
+        par = effective_parallelism(self.parallelism, len(combos))
+        pos = 0
+        while pos < len(combos):
             if max_models and len(models) >= max_models:
                 break
             if max_secs and time.time() - t0 > max_secs:
                 break
-            builder = self.builder_cls(**{**self.base_params, **combo})
-            m = builder.train(frame, valid)
-            models.append(m)
-            entries.append(combo)
-            if metric is None:
-                if sort_metric is None:
-                    metric, lower = default_sort_metric(m)
-                else:
-                    from .scorekeeper import METRIC_MAXIMIZE
-                    metric = sort_metric
-                    lower = not METRIC_MAXIMIZE.get(sort_metric, False)
-                decreasing = not lower
-            v = model_metric(m, metric)
-            if v is not None:
-                series.append(v)
-                # early stop over the *sequence of best-so-far* models
-                if stop_rounds and stop_early(
-                        series, stop_rounds, stop_tol, maximize=decreasing):
-                    break
+            wave = combos[pos: pos + par]
+            if max_models:
+                wave = wave[: max_models - len(models)]
+            pos += len(wave)
+
+            def build(combo):
+                builder = self.builder_cls(**{**self.base_params, **combo})
+                return builder.train(frame, valid)
+
+            for combo, m in zip(wave, map_builds(
+                    [lambda c=c: build(c) for c in wave], par)):
+                models.append(m)
+                entries.append(combo)
+                if metric is None:
+                    if sort_metric is None:
+                        metric, lower = default_sort_metric(m)
+                    else:
+                        from .scorekeeper import METRIC_MAXIMIZE
+                        metric = sort_metric
+                        lower = not METRIC_MAXIMIZE.get(sort_metric, False)
+                    decreasing = not lower
+                v = model_metric(m, metric)
+                if v is not None:
+                    series.append(v)
+            # early stop over the *sequence of best-so-far* models,
+            # checked between waves
+            if stop_rounds and series and stop_early(
+                    series, stop_rounds, stop_tol, maximize=decreasing):
+                break
         if not models:
             raise ValueError("grid search trained no models")
         return Grid(dkv.make_key("grid"), models, list(self.hyper_params),

@@ -57,9 +57,38 @@ class BundlePlan(NamedTuple):
         return len(self.working)
 
 
+@functools.lru_cache(maxsize=None)
+def _plan_stats_fn(F: int, nrows: int, S: int, stride: int, nbins: int):
+    """Device prepass for the bundle planner: per-feature NA count, sample
+    mode bin, non-default count, and the BIT-PACKED non-default sample
+    mask.  Fetching the raw [F, S] code sample cost ~10 s per train() on
+    a tunnelled backend (hundreds of MB); the packed mask is ~S/8 bytes
+    per feature — one small fetch."""
+
+    def stats(codes):
+        sub = jax.lax.slice(codes, (0, 0), (F, nrows), (1, stride))
+        na_cnt = jnp.sum(codes[:, :nrows] == nbins, axis=1)
+        # mode bin via per-bin compare-count (B small static loop on
+        # device; avoids materializing [F, S, B])
+        counts = jax.lax.map(
+            lambda b: jnp.sum((sub == b).astype(jnp.int32), axis=1),
+            jnp.arange(nbins + 1))                      # [B, F]
+        d_bin = jnp.argmax(counts, axis=0).astype(jnp.int32)
+        Z = sub != d_bin[:, None]
+        nz = jnp.sum(Z, axis=1)
+        S8 = (S + 7) // 8 * 8
+        Zp8 = jnp.pad(Z, [(0, 0), (0, S8 - S)]).reshape(F, S8 // 8, 8)
+        weights = jnp.asarray([128, 64, 32, 16, 8, 4, 2, 1], jnp.int32)
+        Zp = jnp.sum(Zp8.astype(jnp.int32) * weights, axis=2) \
+            .astype(jnp.uint8)
+        return na_cnt, d_bin, nz, Zp
+
+    return jax.jit(stats)
+
+
 def plan_bundles(codes, bin_counts, nbins: int, nrows: int,
                  sample: int = 16384, min_features: int = 32,
-                 min_reduction: float = 0.6) -> Optional[BundlePlan]:
+                 min_reduction: float = 0.85) -> Optional[BundlePlan]:
     """Greedy conflict-free packing of sparse features into bundles.
 
     ``codes``: [F, padded] device bin codes (NA == nbins).  Per-feature
@@ -74,18 +103,13 @@ def plan_bundles(codes, bin_counts, nbins: int, nrows: int,
     F = len(bin_counts)
     if F < min_features:
         return None
-    stride = max(1, nrows // sample)
-    sub = jax.lax.slice(codes, (0, 0), (F, nrows), (1, stride))
-    na_cnt = jnp.sum(codes[:, :nrows] == nbins, axis=1)
-    sub, na_cnt = jax.device_get((sub, na_cnt))
-    sub = np.asarray(sub)
-    S = sub.shape[1]
-    # per-feature mode bin (the default) from the sample
-    d_bin = np.zeros(F, np.int64)
-    for f in range(F):
-        d_bin[f] = np.bincount(sub[f], minlength=nbins + 1).argmax()
-    Z = sub != d_bin[:, None]            # non-default indicator
-    nz = Z.sum(axis=1)
+    stride = max(1, -(-nrows // sample))
+    S = len(range(0, nrows, stride))
+    na_cnt, d_bin, nz, Zp = jax.device_get(
+        _plan_stats_fn(F, nrows, S, stride, nbins)(codes))
+    d_bin = np.asarray(d_bin, np.int64)
+    nz = np.asarray(nz)
+    Zp = np.asarray(Zp)
     cand = [f for f in range(F)
             if na_cnt[f] == 0 and bin_counts[f] >= 2
             and d_bin[f] < nbins
@@ -94,32 +118,30 @@ def plan_bundles(codes, bin_counts, nbins: int, nrows: int,
     if len(cand) < 4:
         return None
     # greedy: heaviest features first, into the first conflict-free bundle
-    # with slot room (width cap = nbins so bundles fit the B = nbins+1 axis)
+    # with slot room (width cap = nbins so bundles fit the B = nbins+1
+    # axis).  Conflict masks are bit-packed so a probe is a ~S/8-byte
+    # AND — cheap enough to probe EVERY bundle: a capped probe count (the
+    # first version's max_probe=64) made a few hundred non-exclusive
+    # features fill the head of the bundle list and starve every later
+    # exclusive feature of its match (observed on the springleaf shape:
+    # 1200 one-hot columns, zero bundles formed).
     order = sorted(cand, key=lambda f: -int(nz[f]))
-    bundles = []           # [members: [(f, B_f, d_f)], mask, width]
-    # probe cap (LightGBM's max_search analog): without it, F mutually-
-    # conflicting sparse candidates cost O(F^2 * S) of boolean traffic in
-    # this host loop before packed_cost rejects the plan anyway
-    max_probe = 64
+    bundles = []           # [members: [(f, B_f, d_f)], packed mask, width]
     for f in order:
         need = bin_counts[f] - 1
         placed = False
-        probes = 0
         for b in bundles:
-            if b[2] + need > nbins:          # cheap width check, uncapped
+            if b[2] + need > nbins:          # cheap width check
                 continue
-            probes += 1
-            if probes > max_probe:
-                break
-            if not (b[1] & Z[f]).any():
+            if not np.bitwise_and(b[1], Zp[f]).any():
                 b[0].append((f, bin_counts[f], int(d_bin[f])))
-                b[1] |= Z[f]
+                b[1] |= Zp[f]
                 b[2] += need
                 placed = True
                 break
         if not placed:
             bundles.append([[(f, bin_counts[f], int(d_bin[f]))],
-                            Z[f].copy(), 1 + need])
+                            Zp[f].copy(), 1 + need])
     bundled = {f for b in bundles if len(b[0]) > 1 for f, _, _ in b[0]}
     if not bundled:
         return None
@@ -141,6 +163,10 @@ def plan_bundles(codes, bin_counts, nbins: int, nrows: int,
     def packed_cost(bcs):
         return sum(((min(b, nbins) + 2) + 7) // 8 * 8 for b in bcs)
 
+    # engage whenever the packed kernel cost meaningfully drops: besides
+    # the VPU slot count, the working-feature count drives varbin kernel
+    # COMPILE time (statically unrolled per-feature compares) and the
+    # per-level split-search width, so even a ~15% slot reduction wins
     if packed_cost(wbins) > min_reduction * packed_cost(bin_counts):
         return None
     return BundlePlan(tuple(working), tuple(wbins))

@@ -38,7 +38,7 @@ class _Tok:
 
     def next_token(self) -> str:
         c = self.peek()
-        if c in "()[]":
+        if c in "()[]{}":
             self.i += 1
             return c
         if c in "'\"":
@@ -54,7 +54,7 @@ class _Tok:
             return ("str", "".join(out))
         j = self.i
         while j < len(self.text) and not self.text[j].isspace() \
-                and self.text[j] not in "()[]":
+                and self.text[j] not in "()[]{}":
             j += 1
         tok = self.text[self.i: j]
         self.i = j
@@ -83,7 +83,21 @@ def parse(text: str):
                 out.append(read())
             tok.next_token()
             return out
-        if t == ")" or t == "]":
+        if t == "{":
+            # AstFunction syntax: { id1 id2 . body }  (AstFunction.java:63)
+            ids = []
+            while True:
+                nxt = read()
+                if nxt == ".":
+                    break
+                if not isinstance(nxt, str):
+                    raise ValueError(f"lambda formal must be an id: {nxt!r}")
+                ids.append(nxt)
+            body = read()
+            if tok.next_token() != "}":
+                raise ValueError("unbalanced {")
+            return ["__lambda__", ids, body]
+        if t in (")", "]", "}"):
             raise ValueError(f"unexpected {t}")
         if isinstance(t, tuple):
             return ("str", t[1])
@@ -110,6 +124,16 @@ def _numeric(fr: Frame) -> jnp.ndarray:
 
 def _binop(op, l, r):
     """Elementwise arithmetic over frames/vecs/scalars — fused on device."""
+    if not isinstance(l, (Frame, Vec)) and not isinstance(r, (Frame, Vec)):
+        import operator as _o
+        fn = {"+": _o.add, "-": _o.sub, "*": _o.mul, "/": _o.truediv,
+              "^": _o.pow, "%": _o.mod, "intDiv": _o.floordiv,
+              "<": _o.lt, "<=": _o.le, ">": _o.gt, ">=": _o.ge,
+              "==": _o.eq, "!=": _o.ne,
+              "&": lambda a, b: bool(a) and bool(b),
+              "|": lambda a, b: bool(a) or bool(b)}[op]
+        return float(fn(float(l), float(r)))
+
     def arr(x):
         if isinstance(x, Frame):
             return _numeric(x)
@@ -162,8 +186,22 @@ _AGG = {
 _AGG["cor"] = None  # matrix-only: handled before the scalar reduction
 
 
+class Lambda:
+    """A Rapids function value — ``{ ids . body }`` (AstFunction.java:16)."""
+
+    def __init__(self, ids: List[str], body):
+        self.ids = list(ids)
+        self.body = body
+
+    def __repr__(self):
+        return f"<lambda ({' '.join(self.ids)})>"
+
+
 class Session:
     """One Rapids session: evaluates ASTs against the DKV."""
+
+    def __init__(self):
+        self._env: List[dict] = []       # lexical frames, innermost last
 
     def eval(self, text: str):
         return self._ev(parse(text))
@@ -174,6 +212,14 @@ class Session:
         if fr is None:
             raise KeyError(f"no frame {key!r}")
         return fr
+
+    def call(self, lam: Lambda, vals: List) -> Any:
+        """Apply a lambda: bind formals, evaluate the body."""
+        self._env.append(dict(zip(lam.ids, vals)))
+        try:
+            return self._ev(lam.body)
+        finally:
+            self._env.pop()
 
     def _ev(self, node) -> Any:
         if isinstance(node, float):
@@ -188,13 +234,24 @@ class Session:
                 return 0.0
             if node in ("NA", "NaN", "nan"):
                 return float("nan")
-            # bare identifier: a DKV key
+            # lexical binding (lambda formal), then DKV key
+            for frame in reversed(self._env):
+                if node in frame:
+                    return frame[node]
             return self._frame(node)
         if not isinstance(node, list):
             raise ValueError(f"bad node {node!r}")
         if node and node[0] == "__list__":
             return [self._ev(x) for x in node[1:]]
+        if node and node[0] == "__lambda__":
+            return Lambda(node[1], node[2])
         op, *args = node
+        if isinstance(op, list):
+            # immediate application: ({x . body} arg ...)
+            fn = self._ev(op)
+            if not isinstance(fn, Lambda):
+                raise ValueError(f"cannot apply non-function {fn!r}")
+            return self.call(fn, [self._ev(a) for a in args])
         return self._apply(op, args)
 
     def _apply(self, op: str, args: List) -> Any:
@@ -394,6 +451,27 @@ class Session:
                     "scale: per-column center/scale lists not supported; "
                     "pass booleans")
             return ops.scale(fr, center=bool(center), scale_=bool(sc))
+        if op == "apply":
+            return self._apply_margin(args)
+        if op == "ddply":
+            return self._ddply(args)
+        if op == "cut":
+            fr = _vecframe(ev(args[0]))
+            breaks = [float(b) for b in ev(args[1])]
+            labels = ev(args[2]) if len(args) > 2 and args[2] is not None \
+                else None
+            if isinstance(labels, list) and not labels:
+                labels = None
+            include_lowest = bool(ev(args[3])) if len(args) > 3 else False
+            right = bool(ev(args[4])) if len(args) > 4 else True
+            digits = int(ev(args[5])) if len(args) > 5 else 3
+            del digits                   # label precision: numpy repr used
+            return _vecframe(ops.cut(
+                fr.vecs[0], breaks, labels=labels,
+                include_lowest=include_lowest, right=right))
+        from .prims import PRIMS
+        if op in PRIMS:
+            return PRIMS[op](self, args)
         if op in ("h2o.impute", "impute"):
             fr = ev(args[0])
             col = ev(args[1])
@@ -412,6 +490,93 @@ class Session:
             return ops.impute(fr, col, method=method,
                               combine_method=combine)
         raise ValueError(f"unknown rapids op {op!r}")
+
+    def _apply_margin(self, args) -> Any:
+        """(apply frame margin fun) — AstApply.  margin 2 = per column
+        (the fun sees each single-column frame); margin 1 = per row,
+        evaluated VECTORIZED: the fun's body runs once with the formal
+        bound to the whole frame, which is exact for elementwise bodies
+        (the h2o-py lambda pattern); a bare reducer name ("mean", "sum",
+        ...) reduces row-wise."""
+        ev = self._ev
+        fr = ev(args[0])
+        margin = int(ev(args[1]))
+        fun = ev(args[2])
+        import jax.numpy as _jnp
+        if isinstance(fun, str) or isinstance(fun, float):
+            name = str(fun)
+            fns = {"mean": jnp.nanmean, "sum": jnp.nansum,
+                   "max": jnp.nanmax, "min": jnp.nanmin,
+                   "median": jnp.nanmedian,
+                   "sd": lambda x, axis: jnp.nanstd(x, axis=axis, ddof=1),
+                   "var": lambda x, axis: jnp.nanvar(x, axis=axis, ddof=1)}
+            if name not in fns:
+                raise ValueError(f"apply: unknown function {name!r}")
+            X = _numeric(fr)
+            mask = jnp.arange(X.shape[0]) < fr.nrows
+            Xv = jnp.where(mask[:, None], X, jnp.nan)
+            if margin == 1:              # per row
+                out = fns[name](Xv, axis=1)
+                return Frame(["C1"], [Vec(out.astype(_jnp.float32),
+                                          T_NUM, fr.nrows)])
+            out = fns[name](Xv, axis=0)[None, :]
+            return Frame(list(fr.names),
+                         [Vec(out[:, j].astype(_jnp.float32), T_NUM, 1)
+                          for j in range(out.shape[1])])
+        if not isinstance(fun, Lambda):
+            raise ValueError(f"apply: not a function: {fun!r}")
+        if margin == 1:
+            res = self.call(fun, [fr])
+            return _vecframe(res) if isinstance(res, (Frame, Vec)) else res
+        outs = []
+        for name in fr.names:
+            res = self.call(fun, [fr[[name]]])
+            if isinstance(res, (int, float)):
+                res = Frame([name], [Vec.from_numpy(
+                    np.asarray([float(res)]), T_NUM)])
+            outs.append(_vecframe(res, name))
+        return ops.cbind(*outs)
+
+    def _ddply(self, args) -> Any:
+        """(ddply frame [group_cols] fun) — AstDdply: per-group lambda."""
+        ev = self._ev
+        fr = ev(args[0])
+        by = self._col_names(fr, ev(args[1]))
+        fun = ev(args[2])
+        if not isinstance(fun, Lambda):
+            raise ValueError("ddply needs a function argument")
+        from .prims import _decoded
+        keys = [_decoded(fr.vec(c))[: fr.nrows] for c in by]
+        key_strs = np.asarray([tuple(str(k[i]) for k in keys)
+                               for i in range(fr.nrows)], object)
+        uniq, inverse = np.unique(
+            np.asarray(["\x00".join(t) for t in key_strs], object),
+            return_inverse=True)
+        rows_out: List[list] = []
+        for g, label in enumerate(uniq):
+            idx = np.flatnonzero(inverse == g)
+            sub = fr.rows(idx)
+            res = self.call(fun, [sub])
+            if isinstance(res, Frame):
+                vals = [float(np.asarray(v.to_numpy(), np.float64)[0])
+                        for v in res.vecs]
+            elif isinstance(res, list):
+                vals = [float(x) for x in res]
+            else:
+                vals = [float(res)]
+            rows_out.append(list(label.split("\x00")) + vals)
+        nvals = len(rows_out[0]) - len(by) if rows_out else 0
+        cols: dict = {}
+        for j, c in enumerate(by):
+            src = fr.vec(c)
+            col = np.asarray([r[j] for r in rows_out], object)
+            if src.type not in (T_CAT,):
+                col = np.asarray([float(x) for x in col])
+            cols[c] = col
+        for v in range(nvals):
+            cols[f"ddply_C{v + 1}"] = np.asarray(
+                [r[len(by) + v] for r in rows_out])
+        return Frame.from_numpy(cols)
 
     def _col_names(self, fr: Frame, sel) -> List[str]:
         if isinstance(sel, str):

@@ -246,3 +246,41 @@ def test_automl_explain(cl, rng):
     # the "leader" bundle explains the metric-ranked leader, and the
     # heatmap's first model column is the leader too
     assert b["varimp_heatmap"]["model"][0] == aml.leader.key
+
+
+def test_parallel_cv_matches_sequential(cl, rng):
+    """CVModelBuilder parallelization (hex/CVModelBuilder.java:16): fold
+    models built on a thread pool produce the same CV metrics as the
+    sequential build, and the fold count is intact."""
+    fr = _binary_frame(rng, n=1200)
+    seq = GBM(response_column="y", ntrees=5, max_depth=3, nfolds=3,
+              seed=7, parallelism=1).train(fr)
+    par = GBM(response_column="y", ntrees=5, max_depth=3, nfolds=3,
+              seed=7, parallelism=3).train(fr)
+    assert len(par.output["cv_fold_models"]) == 3
+    assert np.isclose(par.cross_validation_metrics.auc,
+                      seq.cross_validation_metrics.auc, atol=1e-6)
+
+
+def test_parallel_grid_matches_sequential(cl, rng):
+    fr = _binary_frame(rng, n=900)
+    hp = {"max_depth": [2, 3], "ntrees": [3, 5]}
+    g1 = GridSearch(GBM, hp, response_column="y", seed=5,
+                    parallelism=1).train(fr)
+    g4 = GridSearch(GBM, hp, response_column="y", seed=5,
+                    parallelism=4).train(fr)
+    assert len(g4.models) == len(g1.models) == 4
+    m1 = {tuple(sorted(e.items())): g1.models[i].training_metrics.auc
+          for i, e in enumerate(g1.entries)}
+    m4 = {tuple(sorted(e.items())): g4.models[i].training_metrics.auc
+          for i, e in enumerate(g4.entries)}
+    for k in m1:
+        assert np.isclose(m1[k], m4[k], atol=1e-6)
+
+
+def test_automl_parallel_steps(cl, rng):
+    fr = _binary_frame(rng, n=800)
+    aml = AutoML(response_column="y", max_models=3, nfolds=0, seed=3,
+                 parallelism=3)
+    aml.train(fr)
+    assert len(aml.models) >= 2

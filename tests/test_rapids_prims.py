@@ -1,0 +1,284 @@
+"""Per-category tests for the Rapids breadth tier (rapids/prims.py).
+
+Reference op tokens: ``water/rapids/ast/prims/*/Ast*.java`` ``str()``
+values; lambda syntax ``{ ids . body }`` per ``AstFunction.java:63``.
+"""
+
+import datetime
+
+import numpy as np
+import pytest
+
+from h2o3_tpu import Frame
+from h2o3_tpu.frame.vec import Vec, T_STR, T_CAT
+from h2o3_tpu.rapids.ast import rapids
+
+
+@pytest.fixture
+def fr():
+    return Frame.from_numpy(
+        {"a": np.array([1.0, 2, 3, 4]), "b": np.array([5.0, 6, 7, 8])},
+        key="pfr")
+
+
+def col(res, j=0):
+    return np.asarray(res.vecs[j].to_numpy(), np.float64)[: res.nrows]
+
+
+# ------------------------------------------------------------------ math
+def test_math_extra(fr):
+    assert np.allclose(col(rapids("(acosh pfr)"))[:2],
+                       np.arccosh([1.0, 2.0]))
+    assert np.allclose(col(rapids("(cospi pfr)")),
+                       np.cos(np.pi * np.array([1.0, 2, 3, 4])), atol=1e-5)
+    assert np.isclose(col(rapids("(lgamma pfr)"))[3],
+                      np.log(6.0), atol=1e-4)   # lgamma(4) = log(3!)
+    sig = col(rapids("(signif pfr 1)"))
+    assert sig[0] == 1.0
+
+
+def test_logical_aliases(fr):
+    out = rapids("(%% pfr 2)")
+    assert np.allclose(col(out), [1, 0, 1, 0])
+    out = rapids("(%/% pfr 2)")
+    assert np.allclose(col(out), [0, 1, 1, 2])
+
+
+# ------------------------------------------------------------------ reducers
+def test_reducers(fr):
+    assert rapids("(prod pfr)") == float(np.prod([1, 2, 3, 4, 5, 6, 7, 8]))
+    assert rapids("(all (> pfr 0))") == 1.0
+    assert rapids("(any (> pfr 7))") == 1.0
+    assert rapids("(any.na pfr)") == 0.0
+    assert rapids("(naCnt pfr)") == 0.0
+    assert np.allclose(col(rapids("(cumsum pfr 0)")), [1, 3, 6, 10])
+    assert np.allclose(col(rapids("(cummax pfr 0)")), [1, 2, 3, 4])
+    assert np.allclose(col(rapids("(cummin pfr 0)")), [1, 1, 1, 1])
+    mad = rapids("(h2o.mad pfr)")
+    assert mad > 0
+
+
+def test_topn(fr):
+    out = rapids("(topn pfr 1 50 0)")       # top 50% of col b
+    assert out.nrows == 2
+    assert col(out, 1)[0] == 8.0            # largest first
+
+
+def test_sumaxis(fr):
+    rows = rapids("(sumaxis pfr 0 1)")
+    assert np.allclose(col(rows), [6, 8, 10, 12])
+
+
+# ------------------------------------------------------------------ matrix
+def test_matrix(fr):
+    t = rapids("(t pfr)")
+    assert t.nrows == 2 and t.ncols == 4
+    mm = rapids("(x pfr (t pfr))")
+    A = np.array([[1, 5], [2, 6], [3, 7], [4, 8.0]])
+    assert np.allclose(np.column_stack([col(mm, j) for j in range(4)]),
+                       A @ A.T)
+
+
+# ------------------------------------------------------------------ search
+def test_search(fr):
+    assert np.allclose(col(rapids("(which (> pfr 2))")), [2, 3])
+    assert np.allclose(col(rapids("(which.max pfr 0 1)")), [1, 1, 1, 1])
+    assert np.allclose(col(rapids("(match pfr [2 3] -1 1)")),
+                       [-1, 1, 2, -1])
+
+
+# ------------------------------------------------------------------ repeaters
+def test_repeaters():
+    assert np.allclose(col(rapids("(seq 1 5 1)")), [1, 2, 3, 4, 5])
+    assert np.allclose(col(rapids("(seq_len 3)")), [1, 2, 3])
+    assert np.allclose(col(rapids("(rep_len 7 4)")), [7, 7, 7, 7])
+
+
+# ------------------------------------------------------------------ advmath
+def test_advmath(fr):
+    assert abs(rapids("(mode pfr)") - 1.0) < 5   # unique values: any mode
+    sk = rapids("(skewness pfr)")
+    assert isinstance(sk, (float, list))
+    fold = rapids("(kfold_column pfr 2 42)")
+    assert set(col(fold)) <= {0.0, 1.0}
+    mod = rapids("(modulo_kfold_column pfr 2)")
+    assert np.allclose(col(mod), [0, 1, 0, 1])
+    d = rapids("(distance pfr pfr 'l2')")
+    assert d.nrows == 4 and abs(col(d)[0]) < 1e-5
+
+
+def test_runif(fr):
+    r = rapids("(h2o.runif pfr 17)")
+    assert r.nrows == 4 and np.all((col(r) >= 0) & (col(r) < 1))
+
+
+# ------------------------------------------------------------------ mungers
+def test_munger_predicates(fr):
+    assert rapids("(any.factor pfr)") == 0.0
+    assert rapids("(is.numeric (cols pfr 0))") == 1.0
+    assert rapids("(is.factor (cols pfr 0))") == 0.0
+
+
+def test_na_omit():
+    Frame.from_numpy({"x": np.array([1.0, np.nan, 3.0])}, key="nfr")
+    out = rapids("(na.omit nfr)")
+    assert out.nrows == 2
+
+
+def test_melt_pivot():
+    Frame.from_numpy({"id": np.array([1.0, 2.0]),
+                      "p": np.array([10.0, 20.0]),
+                      "q": np.array([30.0, 40.0])}, key="mfr")
+    melted = rapids("(melt mfr [0] [1 2] 'variable' 'value' False)")
+    assert melted.nrows == 4
+    assert set(melted.names) == {"id", "variable", "value"}
+    melted2 = Frame(melted.names, melted.vecs, key="melted")
+    piv = rapids("(pivot melted 'id' 'variable' 'value')")
+    assert piv.nrows == 2 and "p" in piv.names and "q" in piv.names
+
+
+def test_fillna():
+    Frame.from_numpy({"x": np.array([1.0, np.nan, np.nan, 4.0])},
+                     key="ffr")
+    out = rapids("(h2o.fillna ffr 'forward' 0 1)")
+    assert np.allclose(col(out), [1, 1, np.nan, 4], equal_nan=True)
+
+
+def test_getrow_flatten(fr):
+    assert rapids("(flatten (cols (rows pfr [0]) 0))") == 1.0
+    assert rapids("(getrow (rows pfr [1]))") == [2.0, 6.0]
+
+
+def test_rect_assign(fr):
+    out = rapids("(:= pfr 99 [0] [1 2])")
+    assert np.allclose(col(out), [1, 99, 99, 4])
+
+
+def test_append(fr):
+    out = rapids("(append pfr (* (cols pfr 0) 2) 'dbl')")
+    assert "dbl" in out.names
+    assert np.allclose(col(out, 2), [2, 4, 6, 8])
+
+
+def test_levels_domain():
+    Frame(["c"], [Vec.from_numpy(
+        np.array(["x", "y", "x"], object), T_CAT)], key="cfr")
+    lv = rapids("(levels cfr)")
+    assert list(lv.vecs[0].to_numpy()[:2]) == ["x", "y"]
+    assert rapids("(nlevels cfr)") == 2.0
+    out = rapids("(setDomain cfr False ['xx' 'yy'])")
+    assert list(out.vecs[0].decoded()[:3]) == ["xx", "yy", "xx"]
+    rl = rapids("(relevel cfr 'y')")
+    assert rl.vecs[0].domain[0] == "y"
+
+
+def test_cut(fr):
+    out = rapids("(cut (cols pfr 0) [0 2 5] [] False True 3)")
+    v = out.vecs[0]
+    assert v.type == T_CAT and len(v.domain) == 2
+
+
+# ------------------------------------------------------------------ lambdas
+def test_lambda_apply(fr):
+    assert rapids("({x . (+ x 1)} 41)") == 42.0
+    per_col = rapids("(apply pfr 2 {x . (sum x)})")
+    assert np.allclose([col(per_col, 0)[0], col(per_col, 1)[0]], [10, 26])
+    per_row = rapids("(apply pfr 1 'mean')")
+    assert np.allclose(col(per_row), [3, 4, 5, 6])
+    vec_row = rapids("(apply pfr 1 {row . (+ (cols row 0) (cols row 1))})")
+    assert np.allclose(col(vec_row), [6, 8, 10, 12])
+
+
+def test_ddply():
+    Frame.from_numpy({"g": np.array([0.0, 0, 1, 1]),
+                      "v": np.array([1.0, 3, 5, 9])}, key="dfr")
+    out = rapids("(ddply dfr [0] {g . (mean (cols g 1))})")
+    assert out.nrows == 2
+    assert np.allclose(sorted(col(out, 1)), [2, 7])
+
+
+# ------------------------------------------------------------------ string
+def test_tokenize_grep_entropy():
+    Frame(["t"], [Vec.from_numpy(
+        np.array(["hello world", "foo bar", None], object), T_STR)],
+        key="sfr")
+    tok = rapids("(tokenize sfr ' ')")
+    toks = list(tok.vecs[0].to_numpy()[: tok.nrows])
+    assert toks[:2] == ["hello", "world"] and toks[2] is None
+    g = rapids("(grep sfr 'foo' 0 0 0)")
+    assert list(col(g)) == [1.0]
+    e = rapids("(entropy sfr)")
+    assert col(e)[0] > 0
+    sl = rapids("(strlen sfr)")
+    assert col(sl)[0] == 11.0
+
+
+def test_str_distance():
+    Frame(["a"], [Vec.from_numpy(np.array(["kitten"], object), T_STR)],
+          key="sda")
+    Frame(["b"], [Vec.from_numpy(np.array(["sitting"], object), T_STR)],
+          key="sdb")
+    d = rapids("(strDistance sda sdb 'lv' False)")
+    assert col(d)[0] == 3.0
+
+
+# ------------------------------------------------------------------ time
+def test_time_fields():
+    from h2o3_tpu.frame.vec import T_TIME
+    ms = datetime.datetime(2021, 7, 4, 12, 30, 15,
+                           tzinfo=datetime.timezone.utc).timestamp() * 1000
+    Frame(["t"], [Vec.from_numpy(np.array([ms]), T_TIME)], key="tfr2")
+    vals = {op: col(rapids(f"({op} tfr2)"))[0]
+            for op in ("year", "month", "day", "hour", "minute", "second")}
+    assert vals == {"year": 2021, "month": 7, "day": 4, "hour": 12,
+                    "minute": 30, "second": 15}
+    # 2021-07-04 is a Sunday -> dayOfWeek 6 (Mon=0)
+    assert col(rapids("(dayOfWeek tfr2)"))[0] == 6.0
+
+
+def test_mktime_roundtrip():
+    # months/days are 0-based (AstMktime.java:55-56)
+    out = rapids("(mktime 2021 6 3 12 30 15 0)")
+    ms = col(out)[0]
+    dt = datetime.datetime.fromtimestamp(ms / 1000.0,
+                                         tz=datetime.timezone.utc)
+    assert (dt.year, dt.month, dt.day, dt.hour) == (2021, 7, 4, 12)
+
+
+def test_as_date():
+    Frame(["d"], [Vec.from_numpy(
+        np.array(["2020-01-31"], object), T_STR)], key="adf")
+    out = rapids("(as.Date adf 'yyyy-MM-dd')")
+    dt = datetime.datetime.fromtimestamp(col(out)[0] / 1000.0,
+                                         tz=datetime.timezone.utc)
+    assert (dt.year, dt.month, dt.day) == (2020, 1, 31)
+
+
+# ------------------------------------------------------------------ ts/misc
+def test_timeseries(fr):
+    d = rapids("(difflag1 (cols pfr 0))")
+    assert np.allclose(col(d), [1, 1, 1])
+    sax = rapids("(isax pfr 2 4 0)")
+    assert "iSax_index" in sax.names
+
+
+def test_ls(fr):
+    out = rapids("(ls)")
+    assert out.nrows >= 1
+
+
+def test_prim_count_target():
+    """SURVEY/VERDICT coverage gate: >= 120 prims total."""
+    from h2o3_tpu.rapids import ast as ast_mod
+    from h2o3_tpu.rapids.prims import PRIMS
+    import inspect
+    src = inspect.getsource(ast_mod.Session._apply)
+    core_ops = set()
+    import re
+    for m in re.finditer(r'op (?:==|in) \(?([^)\n:]+)\)?:', src):
+        for tok in re.findall(r'"([^"]+)"', m.group(1)):
+            core_ops.add(tok)
+    for table in (ast_mod._UNARY, ast_mod._STRING, ast_mod._AGG):
+        core_ops.update(table)
+    total = len(core_ops | set(PRIMS))
+    assert total >= 120, f"only {total} rapids prims"

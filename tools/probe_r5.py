@@ -1,0 +1,89 @@
+"""Round-5 on-chip probe: per-phase timing of the new bench shapes.
+
+Usage: python tools/probe_r5.py [springleaf|redhat|higgs|dl|glmpath|parse]
+Each phase prints its wall clock so budget blowups are attributable.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+# repo root on sys.path at runtime (PYTHONPATH breaks axon plugin discovery)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def t(label, fn):
+    t0 = time.time()
+    out = fn()
+    print(f"  {label}: {time.time() - t0:.2f}s", flush=True)
+    return out
+
+
+def main():
+    what = sys.argv[1] if len(sys.argv) > 1 else "springleaf"
+    rows = int(sys.argv[2]) if len(sys.argv) > 2 else 0
+    import bench
+    import h2o3_tpu
+    from h2o3_tpu import Frame
+    from h2o3_tpu.frame.vec import T_CAT
+    h2o3_tpu.init()
+    import jax
+    print("platform:", jax.devices()[0].platform, flush=True)
+
+    if what == "springleaf":
+        n = rows or 145_000
+        cols, ty, dom = t("gen", lambda: bench.make_springleaf_like(
+            Frame, T_CAT, n))
+        ty = {k: T_CAT for k in ty}
+        fr = t("frame", lambda: Frame.from_numpy(cols, types=ty,
+                                                 domains=dom))
+        from h2o3_tpu.models import GBM
+        cfg = dict(bench._GBM_GATE, response_column="target")
+        t("warmup10", lambda: GBM(**{**cfg, "ntrees": 10}).train(fr))
+        m = t("train50", lambda: GBM(**cfg).train(fr))
+        print("  efb_bundles:", m.output.get("efb_bundles", "none"),
+              flush=True)
+    elif what == "redhat":
+        n = rows or 2_200_000
+        cols, ty, dom = t("gen", lambda: bench.make_redhat_like(
+            Frame, T_CAT, n))
+        ty = {k: T_CAT for k in ty}
+        fr = t("frame", lambda: Frame.from_numpy(cols, types=ty,
+                                                 domains=dom))
+        from h2o3_tpu.models import GBM
+        cfg = dict(bench._GBM_GATE, response_column="outcome")
+        t("warmup10", lambda: GBM(**{**cfg, "ntrees": 10}).train(fr))
+        t("train50", lambda: GBM(**cfg).train(fr))
+    elif what == "higgs":
+        n = rows or 10_000_000
+        fr = t("gen+frame", lambda: bench.make_higgs_like(Frame, n))
+        from h2o3_tpu.models import GBM
+        cfg = dict(bench._GBM_GATE, response_column="y")
+        t("warmup10", lambda: GBM(**{**cfg, "ntrees": 10}).train(fr))
+        t("train50", lambda: GBM(**cfg).train(fr))
+    elif what == "glmpath":
+        n = rows or 10_000_000
+        fr = t("gen+frame", lambda: bench.make_higgs_like(Frame, n))
+        from h2o3_tpu.models import GLM
+        kw = dict(family="binomial", response_column="y",
+                  lambda_search=True, nlambdas=100, alpha=0.5)
+        t("warmup", lambda: GLM(**kw).train(fr))
+        t("timed", lambda: GLM(**kw).train(fr))
+    elif what == "dl":
+        from h2o3_tpu.models import DeepLearning
+        import bench as b
+        b.N_ROWS = rows or 10_000_000
+        sps = t("dl", lambda: b.bench_deeplearning(Frame, DeepLearning))
+        print(f"  samples/s: {sps:,.0f}", flush=True)
+    elif what == "parse":
+        import tempfile
+        from h2o3_tpu.frame.parse import parse_csv
+        dt, mb = bench.bench_parse(parse_csv, tempfile.gettempdir())
+        print(f"  parse: {dt:.2f}s for {mb:.0f}MB = {mb/dt:.0f} MB/s",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()
