@@ -752,6 +752,247 @@ class Api:
         files = _expand_paths(path)
         return {"files": files, "destination_frames": files}
 
+    # ------------------------------------------------ round-5 route breadth
+    def frame_columns(self, key: str) -> dict:
+        """GET /3/Frames/{id}/columns (FramesHandler.columns)."""
+        from ..runtime import dkv
+        fr = dkv.get(key)
+        if fr is None:
+            raise KeyError(f"no frame {key!r}")
+        cols = []
+        for n, v in zip(fr.names, fr.vecs):
+            cols.append({"label": n, "type": v.type,
+                         "domain": v.domain,
+                         "missing_count": int(v.rollups().nmissing)
+                         if v.is_numeric or v.type == "cat" else 0})
+        return {"frame_id": {"name": key}, "columns": cols}
+
+    def frame_column_summary(self, key: str, col: str) -> dict:
+        """GET /3/Frames/{id}/columns/{col}/summary."""
+        from ..runtime import dkv
+        fr = dkv.get(key)
+        if fr is None:
+            raise KeyError(f"no frame {key!r}")
+        v = fr.vec(col)
+        out = {"label": col, "type": v.type, "domain": v.domain}
+        if v.is_numeric:
+            r = v.rollups()
+            out.update({"mins": [r.min], "maxs": [r.max], "mean": r.mean,
+                        "sigma": r.sigma, "missing_count": r.nmissing})
+        return {"frames": [{"columns": [out]}]}
+
+    def frame_light(self, key: str) -> dict:
+        """GET /3/Frames/{id}/light — metadata without data preview."""
+        from ..runtime import dkv
+        fr = dkv.get(key)
+        if fr is None:
+            raise KeyError(f"no frame {key!r}")
+        return {"frames": [{"frame_id": {"name": key}, "rows": fr.nrows,
+                            "column_count": fr.ncols,
+                            "columns": [{"label": n} for n in fr.names]}]}
+
+    def download_dataset(self, frame_id: str, **kw) -> bytes:
+        """GET /3/DownloadDataset — frame as CSV bytes."""
+        import io as _io
+        from ..runtime import dkv
+        from ..frame.parse import export_file
+        fr = dkv.get(frame_id)
+        if fr is None:
+            raise KeyError(f"no frame {frame_id!r}")
+        import tempfile
+        with tempfile.NamedTemporaryFile(suffix=".csv", delete=False) as f:
+            tmp = f.name
+        try:
+            export_file(fr, tmp)
+            return open(tmp, "rb").read()
+        finally:
+            os.unlink(tmp)
+
+    def model_java(self, key: str) -> bytes:
+        """GET /3/Models.java/{id} — POJO source download."""
+        from ..runtime import dkv
+        from ..export.pojo import export_pojo
+        m = dkv.get(key)
+        if m is None:
+            raise KeyError(f"no model {key!r}")
+        import tempfile
+        with tempfile.NamedTemporaryFile(suffix=".java",
+                                         delete=False) as f:
+            tmp = f.name
+        try:
+            export_pojo(m, tmp)
+            return open(tmp, "rb").read()
+        finally:
+            os.unlink(tmp)
+
+    def model_metrics_stored(self, key: str) -> dict:
+        """GET /3/ModelMetrics/models/{id} — training/cv metrics."""
+        from ..runtime import dkv
+        m = dkv.get(key)
+        if m is None:
+            raise KeyError(f"no model {key!r}")
+        out = []
+        for kind, mm in (("training", m.training_metrics),
+                         ("validation", m.validation_metrics),
+                         ("cross_validation",
+                          m.cross_validation_metrics)):
+            if mm is None:
+                continue
+            d = mm.describe() if hasattr(mm, "describe") else (
+                mm if isinstance(mm, dict) else {})
+            out.append({"kind": kind,
+                        **{k: v for k, v in d.items()
+                           if isinstance(v, (int, float, str))}})
+        return {"model_metrics": out}
+
+    def word2vec_synonyms(self, model: str, word: str,
+                          count: int = 20, **kw) -> dict:
+        """GET /3/Word2VecSynonyms (Word2VecHandler.findSynonyms)."""
+        from ..runtime import dkv
+        m = dkv.get(model)
+        if m is None:
+            raise KeyError(f"no model {model!r}")
+        syn = m.find_synonyms(word, int(count))
+        return {"synonyms": list(syn.keys()),
+                "scores": [float(s) for s in syn.values()]}
+
+    def word2vec_transform(self, model: str, words_frame: str,
+                           aggregate_method: str = "NONE", **kw) -> dict:
+        """GET /3/Word2VecTransform — embed a string column."""
+        from ..runtime import dkv
+        m = dkv.get(model)
+        fr = dkv.get(words_frame)
+        if m is None or fr is None:
+            raise KeyError(f"missing {model!r} or {words_frame!r}")
+        out = m.transform(fr, aggregate_method=aggregate_method.lower())
+        out.key = dkv.make_key("w2v_transform")
+        dkv.put(out.key, out)
+        return {"vectors_frame": {"name": out.key}}
+
+    def grid_export(self, key: str, export_dir: str, **kw) -> dict:
+        """POST /99/Grids/{id}/export (GridImportExportHandler)."""
+        from ..runtime import dkv
+        g = dkv.get(key)
+        if g is None:
+            raise KeyError(f"no grid {key!r}")
+        g.save(f"{export_dir.rstrip('/')}/{key}")
+        return {"grid_id": key, "export_dir": export_dir}
+
+    def grid_import(self, grid_path: str, **kw) -> dict:
+        """POST /99/Grids.bin/import."""
+        from ..models.grid import Grid
+        g = Grid.load(grid_path)
+        return {"grid_id": g.key, "n_models": len(g.models)}
+
+    def capabilities(self) -> dict:
+        """GET /3/Capabilities (CapabilitiesHandler)."""
+        from ..runtime.extensions import loaded
+        return {"capabilities": [{"name": e} for e in loaded()]}
+
+    def endpoints(self) -> dict:
+        """GET /3/Metadata/endpoints — the live route table."""
+        out = []
+        for verb, table in (("GET", _Handler.routes_get),
+                            ("POST", _Handler.routes_post),
+                            ("DELETE", _Handler.routes_delete)):
+            for pat in table:
+                out.append({"http_method": verb, "url_pattern": pat})
+        return {"routes": out, "count": len(out)}
+
+    def init_id(self) -> dict:
+        """GET /3/InitID — session handshake (h2o-py connection boot)."""
+        import uuid
+        return {"session_key": f"_sid_{uuid.uuid4().hex[:12]}"}
+
+    def session_start(self) -> dict:
+        """POST /4/sessions (the /4 tier session API)."""
+        import uuid
+        return {"session_key": f"_sid_{uuid.uuid4().hex[:12]}"}
+
+    def ping(self) -> dict:
+        """GET /3/Ping — liveness + cloud health (PingHandler)."""
+        from ..runtime.cluster import cluster
+        cl = cluster()
+        return {"cloud_healthy": True,
+                "n_devices": len(getattr(cl, "devices", []) or [1])}
+
+    def garbage_collect(self) -> dict:
+        """POST /3/GarbageCollect (GarbageCollectHandler)."""
+        import gc
+        gc.collect()
+        import jax
+        jax.clear_caches()
+        return {"status": "done"}
+
+    def log_and_echo(self, message: str = "", **kw) -> dict:
+        """POST /3/LogAndEcho — write into the server log."""
+        from ..runtime.observability import record
+        record("log_and_echo", message=message)
+        return {"message": message}
+
+    def recovery_resume(self, recovery_dir: str, **kw) -> dict:
+        """POST /3/Recovery/resume (RecoveryHandler — Recovery.java:72)."""
+        from ..runtime.recovery import resume
+        resumed = resume(recovery_dir)
+        return {"resumed": [getattr(m, "key", str(m)) for m in resumed]}
+
+    _nps: dict = {}
+
+    def nps_put(self, category: str, name: str, value: str = "",
+                **kw) -> dict:
+        """POST /3/NodePersistentStorage/{cat}/{name}."""
+        self._nps[(category, name)] = value
+        return {"category": category, "name": name}
+
+    def nps_get(self, category: str, name: str) -> dict:
+        """GET /3/NodePersistentStorage/{cat}/{name}."""
+        if (category, name) not in self._nps:
+            raise KeyError(f"no NPS entry {category}/{name}")
+        return {"category": category, "name": name,
+                "value": self._nps[(category, name)]}
+
+    def nps_list(self, category: str) -> dict:
+        """GET /3/NodePersistentStorage/{cat}."""
+        return {"entries": [{"category": c, "name": n}
+                            for (c, n) in self._nps
+                            if c == category]}
+
+    def import_sql_table(self, connection_url: str, table: str = "",
+                         select_query: str = "", username: str = "",
+                         password: str = "", **kw) -> dict:
+        """POST /99/ImportSQLTable (water/jdbc SQLManager analog)."""
+        from ..frame.sql import import_sql_table
+        fr = import_sql_table(connection_url, table=table or None,
+                              select_query=select_query or None,
+                              username=username or None,
+                              password=password or None)
+        return {"frames": [{"frame_id": {"name": fr.key}}]}
+
+    def frame_chunks(self, key: str) -> dict:
+        """GET /3/FrameChunks — per-shard row layout (ChunkSummary)."""
+        from ..runtime import dkv
+        fr = dkv.get(key)
+        if fr is None:
+            raise KeyError(f"no frame {key!r}")
+        from ..runtime.cluster import cluster
+        cl = cluster()
+        ndev = max(len(cl.mesh.devices.flat), 1) \
+            if hasattr(cl, "mesh") else 1
+        per = -(-fr.nrows // ndev)
+        chunks = [{"chunk_id": i,
+                   "row_count": min(per, max(fr.nrows - i * per, 0))}
+                  for i in range(ndev)]
+        return {"frame_id": {"name": key}, "chunks": chunks}
+
+    def shutdown(self, **kw) -> dict:
+        """POST /3/Shutdown — the reference stops the cloud; here the
+        server thread stops accepting after the in-flight reply."""
+        import threading as _t
+        srv = getattr(self, "_server_ref", None)
+        if srv is not None:
+            _t.Thread(target=srv.stop, daemon=True).start()
+        return {"status": "shutting down"}
+
     def timeline(self) -> dict:
         """GET /3/Timeline — recent runtime events (TimelineHandler:12)."""
         from ..runtime.observability import timeline_events
@@ -862,6 +1103,28 @@ class H2OServer:
             r"/3/Typeahead/files": lambda a, **kw: a.typeahead(**kw),
             r"/3/JStack": lambda a: a.jstack(),
             r"/3/NetworkTest": lambda a: a.network_test(),
+            r"/3/Frames/([^/]+)/columns": lambda a, k: a.frame_columns(k),
+            r"/3/Frames/([^/]+)/columns/([^/]+)/summary":
+                lambda a, k, c: a.frame_column_summary(k, c),
+            r"/3/Frames/([^/]+)/light": lambda a, k: a.frame_light(k),
+            r"/3/DownloadDataset": lambda a, **kw:
+                a.download_dataset(**kw),
+            r"/3/Models\.java/([^/]+)": lambda a, k: a.model_java(k),
+            r"/3/ModelMetrics/models/([^/]+)":
+                lambda a, k: a.model_metrics_stored(k),
+            r"/3/Word2VecSynonyms": lambda a, **kw:
+                a.word2vec_synonyms(**kw),
+            r"/3/Word2VecTransform": lambda a, **kw:
+                a.word2vec_transform(**kw),
+            r"/3/Capabilities": lambda a: a.capabilities(),
+            r"/3/Metadata/endpoints": lambda a: a.endpoints(),
+            r"/3/InitID": lambda a: a.init_id(),
+            r"/3/Ping": lambda a: a.ping(),
+            r"/3/NodePersistentStorage/([^/]+)/([^/]+)":
+                lambda a, c, n: a.nps_get(c, n),
+            r"/3/NodePersistentStorage/([^/]+)":
+                lambda a, c: a.nps_list(c),
+            r"/3/FrameChunks/([^/]+)": lambda a, k: a.frame_chunks(k),
         }
         _Handler.routes_post = {
             r"/3/Parse": lambda a, **kw: a.parse(**kw),
@@ -888,6 +1151,19 @@ class H2OServer:
             r"/3/Interaction": lambda a, **kw: a.interaction(**kw),
             r"/99/Tabulate": lambda a, **kw: a.tabulate(**kw),
             r"/99/DCTTransformer": lambda a, **kw: a.dct_transform(**kw),
+            r"/99/Grids/([^/]+)/export": lambda a, k, **kw:
+                a.grid_export(k, **kw),
+            r"/99/Grids\.bin/import": lambda a, **kw: a.grid_import(**kw),
+            r"/4/sessions": lambda a, **kw: a.session_start(),
+            r"/3/GarbageCollect": lambda a, **kw: a.garbage_collect(),
+            r"/3/LogAndEcho": lambda a, **kw: a.log_and_echo(**kw),
+            r"/3/Recovery/resume": lambda a, **kw:
+                a.recovery_resume(**kw),
+            r"/3/NodePersistentStorage/([^/]+)/([^/]+)":
+                lambda a, c, n, **kw: a.nps_put(c, n, **kw),
+            r"/99/ImportSQLTable": lambda a, **kw:
+                a.import_sql_table(**kw),
+            r"/3/Shutdown": lambda a, **kw: a.shutdown(**kw),
         }
         _Handler.routes_delete = {
             r"/3/DKV/([^/]+)": lambda a, k: a.remove(k),
@@ -897,6 +1173,7 @@ class H2OServer:
             port = config().port
         self.httpd = _Server(("127.0.0.1", port), _Handler)
         self.httpd.api = self.api
+        self.api._server_ref = self
         self.httpd.authenticator = self._authn
         self.httpd.sessions = self._sessions
         if self._https:

@@ -650,6 +650,164 @@ class H2OMojoWord2VecModel(H2OMojoModel):
         return {"embeddings": self.transform(list(col))}
 
 
+class H2OMojoDeepLearningModel(H2OMojoModel):
+    """DeepLearning MOJO — DeeplearningMojoModel.score0: one-hot cats
+    (cat_offsets / use_all_factor_levels / NA->extra level or mode),
+    normalized nums, MLP forward with per-layer [out, in]-major weights
+    read from model.ini (DeeplearningMojoReader.readModelData)."""
+
+    def __init__(self, ar: MojoArchive):
+        super().__init__(ar)
+        info = ar.info
+        self.cats = int(info.get("cats", 0))
+        self.nums = int(info.get("nums", 0))
+        self.catoffsets = [int(x) for x in
+                           (info.get("cat_offsets") or [0])]
+        self.normsub = np.asarray(info.get("norm_sub") or [], float)
+        self.normmul = np.asarray(info.get("norm_mul") or [], float)
+        self.normrespsub = info.get("norm_resp_sub")
+        self.normrespmul = info.get("norm_resp_mul")
+        self.use_all = bool(info.get("use_all_factor_levels", False))
+        self.units = [int(u) for u in info["neural_network_sizes"]]
+        self.activation = str(info["activation"])
+        self.impute_means = bool(info.get("mean_imputation", False))
+        self.cat_modes = [int(x) for x in (info.get("cat_modes") or [])]
+        self.family = str(info.get("distribution", "gaussian"))
+        self.layers = []
+        for k in range(len(self.units) - 1):
+            W = np.asarray(info[f"weight_layer{k}"], float) \
+                .reshape(self.units[k + 1], self.units[k])
+            b = np.asarray(info[f"bias_layer{k}"], float)
+            self.layers.append((W, b))
+
+    def _assemble(self, X: np.ndarray) -> np.ndarray:
+        """[n, cats+nums] codes/values -> [n, units[0]] network input."""
+        n = X.shape[0]
+        A = np.zeros((n, self.units[0]))
+        ncat_inputs = self.catoffsets[-1] if self.cats else 0
+        for c in range(self.cats):
+            val = X[:, c].copy()
+            if self.impute_means and self.cat_modes:
+                val = np.where(np.isnan(val), self.cat_modes[c], val)
+            base = self.catoffsets[c]
+            width = self.catoffsets[c + 1] - base
+            idx = val - (0 if self.use_all else 1)
+            ok = (~np.isnan(val)) & (idx >= 0) & (idx < width)
+            rows = np.flatnonzero(ok)
+            A[rows, base + idx[ok].astype(int)] = 1.0
+        for j in range(self.nums):
+            x = X[:, self.cats + j]
+            if len(self.normsub):
+                x = np.where(np.isnan(x), self.normsub[j], x)
+                x = (x - self.normsub[j]) * self.normmul[j]
+            else:
+                x = np.nan_to_num(x)
+            A[:, ncat_inputs + j] = x
+        return A
+
+    @staticmethod
+    def _act(name: str, z: np.ndarray) -> np.ndarray:
+        base = name.replace("WithDropout", "")
+        if base == "Rectifier":
+            return np.maximum(z, 0.0)
+        if base == "Tanh":
+            return np.tanh(z)
+        if base == "Maxout":
+            return z.reshape(z.shape[0], -1, 2).max(axis=2)
+        raise NotImplementedError(f"activation {name!r}")
+
+    def _score_raw(self, X: np.ndarray) -> np.ndarray:
+        h = self._assemble(X)
+        for W, b in self.layers[:-1]:
+            h = self._act(self.activation, h @ W.T + b)
+        W, b = self.layers[-1]
+        out = h @ W.T + b
+        if self.nclasses >= 2:
+            e = np.exp(out - out.max(axis=1, keepdims=True))
+            return e / e.sum(axis=1, keepdims=True)
+        mu = out[:, :1]
+        if self.normrespmul is not None:
+            mu = mu / float(self.normrespmul) + float(self.normrespsub)
+        return mu
+
+
+class H2OMojoPcaModel(H2OMojoModel):
+    """PCA MOJO — PCAMojoModel.score0: normalize, project onto the
+    eigenvector blob ([eigenvector_size, k] big-endian doubles)."""
+
+    def __init__(self, ar: MojoArchive):
+        super().__init__(ar)
+        info = ar.info
+        self.k = int(info["k"])
+        self.ncats = int(info.get("ncats", 0))
+        self.nnums = int(info.get("nnums", 0))
+        self.normsub = np.asarray(info.get("normSub") or [], float)
+        self.normmul = np.asarray(info.get("normMul") or [], float)
+        size = int(info["eigenvector_size"])
+        self.V = np.frombuffer(ar.blob("eigenvectors_raw"),
+                               dtype=">f8").astype(float) \
+            .reshape(size, self.k)
+
+    def predict(self, data) -> dict:
+        X = self._matrix(data)
+        Z = np.empty((X.shape[0], self.nnums))
+        for j in range(self.nnums):
+            x = X[:, self.ncats + j]
+            x = np.where(np.isnan(x), self.normsub[j], x)
+            Z[:, j] = (x - self.normsub[j]) * self.normmul[j]
+        proj = Z @ self.V[-self.nnums:]
+        return {"projection": proj,
+                **{f"PC{i + 1}": proj[:, i] for i in range(self.k)}}
+
+
+class H2OMojoCoxPHModel(H2OMojoModel):
+    """CoxPH MOJO — CoxPHMojoModel.score0 (no strata / interactions):
+    lp = coef . features - lpBase, cats one-hot then nums."""
+
+    def __init__(self, ar: MojoArchive):
+        super().__init__(ar)
+        info = ar.info
+        self.coef = np.asarray(info["coef"], float)
+        self.cats = int(info.get("cats", 0))
+        self.cat_offsets = [int(x) for x in
+                            (info.get("cat_offsets") or [0])]
+        self.nums = int(info.get("num_numerical_columns", 0))
+        self.num_offsets = [int(x) for x in
+                            (info.get("num_offsets") or [])]
+        self.use_all = bool(info.get("use_all_factor_levels", False))
+        s1 = int(info.get("x_mean_cat_size1", 0))
+        s2 = int(info.get("x_mean_cat_size2", 0))
+        mc = np.frombuffer(ar.blob("x_mean_cat"), dtype=">f8") \
+            .reshape(s1, s2) if s1 else np.zeros((1, 0))
+        s1n = int(info.get("x_mean_num_size1", 0))
+        s2n = int(info.get("x_mean_num_size2", 0))
+        mn = np.frombuffer(ar.blob("x_mean_num"), dtype=">f8") \
+            .reshape(s1n, s2n) if s1n else np.zeros((1, 0))
+        num_start = mc.shape[1]
+        self.lp_base = float(
+            np.dot(mc[0], self.coef[: num_start])
+            + np.dot(mn[0], self.coef[num_start: num_start + mn.shape[1]]))
+
+    def predict(self, data) -> dict:
+        X = self._matrix(data)
+        n = X.shape[0]
+        lp = np.zeros(n)
+        for c in range(self.cats):
+            val = X[:, c]
+            idx = val - (0 if self.use_all else 1)
+            base = self.cat_offsets[c]
+            width = self.cat_offsets[c + 1] - base
+            ok = (~np.isnan(val)) & (idx >= 0) & (idx < width)
+            rows = np.flatnonzero(ok)
+            lp[rows] += self.coef[base + idx[ok].astype(int)]
+            lp[np.isnan(val)] = np.nan
+        for j in range(self.nums):
+            x = X[:, self.cats + j]
+            lp += self.coef[self.num_offsets[j]] * x
+        lp -= self.lp_base
+        return {"predict": lp, "lp": lp}
+
+
 def load_h2o_mojo(path_or_bytes, backend=None) -> H2OMojoModel:
     """Open a reference-produced MOJO (zip or extracted directory) —
     ModelMojoReader.load analog."""
@@ -669,9 +827,16 @@ def load_h2o_mojo(path_or_bytes, backend=None) -> H2OMojoModel:
         return H2OMojoEnsembleModel(ar)
     if algo == "word2vec":
         return H2OMojoWord2VecModel(ar)
+    if algo == "deeplearning":
+        return H2OMojoDeepLearningModel(ar)
+    if algo == "pca":
+        return H2OMojoPcaModel(ar)
+    if algo == "coxph":
+        return H2OMojoCoxPHModel(ar)
     raise NotImplementedError(
         f"H2O MOJO algo {algo!r} not supported (gbm, drf, glm, kmeans, "
-        "svm, isolationforest, stackedensemble, word2vec are)")
+        "svm, isolationforest, stackedensemble, word2vec, deeplearning, "
+        "pca, coxph are)")
 
 
 def is_h2o_mojo(path) -> bool:

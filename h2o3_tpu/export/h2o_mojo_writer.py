@@ -124,20 +124,28 @@ def _build_ini(info: dict, columns: List[str], domains: dict) -> str:
     return "\n".join(lines)
 
 
+def _write_entries(zf: zipfile.ZipFile, info: dict, columns: List[str],
+                   domains: dict, blobs: dict, prefix: str = "") -> None:
+    """Write one logical MOJO archive into ``zf`` under ``prefix``
+    (nested archives — StackedEnsemble submodels — use a dir prefix the
+    reader's _PrefixBackend mirrors)."""
+    zf.writestr(prefix + "model.ini", _build_ini(info, columns, domains))
+    for k, idx in enumerate(sorted(domains)):
+        for lvl in domains[idx]:
+            if "\n" in str(lvl):
+                raise ValueError(
+                    f"domain level with newline not exportable: {lvl!r}")
+        zf.writestr(prefix + f"domains/d{k:03d}.txt",
+                    "\n".join(str(x) for x in domains[idx]))
+    for name, data in blobs.items():
+        zf.writestr(prefix + name, data)
+
+
 def _write_archive(path: str, info: dict, columns: List[str],
                    domains: dict, blobs: dict) -> str:
     """domains: {col_index: levels}; blobs: {zip_name: bytes}."""
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
-        zf.writestr("model.ini", _build_ini(info, columns, domains))
-        for k, idx in enumerate(sorted(domains)):
-            for lvl in domains[idx]:
-                if "\n" in str(lvl):
-                    raise ValueError(
-                        f"domain level with newline not exportable: {lvl!r}")
-            zf.writestr(f"domains/d{k:03d}.txt",
-                        "\n".join(str(x) for x in domains[idx]))
-        for name, data in blobs.items():
-            zf.writestr(name, data)
+        _write_entries(zf, info, columns, domains, blobs)
     return path
 
 
@@ -186,7 +194,12 @@ def _tree_matrix(model) -> List[List]:
 
 
 def write_tree_mojo(model, path: str) -> str:
-    """GBM / DRF / XGBoost model -> reference-format shared-tree MOJO zip.
+    """GBM / DRF / XGBoost model -> reference-format shared-tree MOJO zip."""
+    return _write_archive(path, *_tree_entries(model))
+
+
+def _tree_entries(model):
+    """GBM / DRF / XGBoost -> (info, columns, domains, blobs).
 
     XGBoost models export with ``algo = gbm`` — this framework's XGBoost is
     the same additive-margin family (sigmoid/identity link over summed
@@ -242,11 +255,16 @@ def write_tree_mojo(model, path: str) -> str:
         for cls, tree in enumerate(per_class):
             blobs[f"trees/t{cls:02d}_{group:03d}.bin"] = \
                 encode_tree(tree, depth)
-    return _write_archive(path, info, columns, domains, blobs)
+    return info, columns, domains, blobs
 
 
 def write_glm_mojo(model, path: str) -> str:
-    """GLM model -> reference-format GLM MOJO (coefficients in model.ini).
+    """GLM model -> reference-format GLM MOJO (coefficients in model.ini)."""
+    return _write_archive(path, *_glm_entries(model))
+
+
+def _glm_entries(model):
+    """GLM -> (info, columns, domains, blobs).
 
     Columns are emitted categoricals-first (the reference GLM layout,
     ``GlmMojoModel.java:26``); the learned per-cat NA-bucket coefficient has
@@ -312,15 +330,353 @@ def write_glm_mojo(model, path: str) -> str:
         "num_means": [float(s.mean) for s in num_specs],
         "cat_modes": [-1.0] * len(cat_specs),
     }
-    return _write_archive(path, info, columns, domains, {})
+    return info, columns, domains, {}
+
+
+# ------------------------------------------------------------- more algos
+
+def _unsup_info(model, algo: str, version: str) -> tuple:
+    """(info, columns, domains) for unsupervised families (no response)."""
+    from ..frame.vec import T_CAT
+    di = model.datainfo
+    specs = list(di.specs)
+    columns = [s.name for s in specs]
+    domains = {j: list(s.domain) for j, s in enumerate(specs)
+               if s.type == T_CAT and s.domain}
+    info = {
+        "h2o_version": "3.46.0.1",
+        "mojo_version": version,
+        "license": "Apache License Version 2.0",
+        "algo": algo,
+        "endianness": "LITTLE_ENDIAN",
+        "category": "Unknown",
+        "supervised": False,
+        "n_features": len(specs),
+        "n_classes": 1,
+        "n_columns": len(columns),
+        "n_domains": len(domains),
+        "balance_classes": False,
+        "default_threshold": 0.5,
+    }
+    return info, columns, domains
+
+
+def _kmeans_entries(model):
+    """KMeans -> reference format (KMeansMojoReader: center_num,
+    center_i rows in STANDARDIZED space, standardize means/mults)."""
+    from ..frame.vec import T_CAT
+    di = model.datainfo
+    if any(s.type == T_CAT for s in di.specs):
+        raise ValueError(
+            "reference KMeans MOJO export supports numeric columns only "
+            "(this framework clusters one-hot cats; the reference format "
+            "stores per-column cat modes)")
+    info, columns, domains = _unsup_info(model, "kmeans", "1.00")
+    centers_std = np.asarray(model.output["centers_std"], np.float64)
+    info["center_num"] = len(centers_std)
+    for i, row in enumerate(centers_std):
+        info[f"center_{i}"] = [float(x) for x in row]
+    info["standardize"] = bool(di.standardize)
+    if di.standardize:
+        info["standardize_means"] = [float(s.mean) for s in di.specs]
+        info["standardize_mults"] = [
+            1.0 / float(s.sigma) if s.sigma else 1.0 for s in di.specs]
+        info["standardize_modes"] = [-1] * len(di.specs)
+    return info, columns, domains, {}
+
+
+def _isofor_entries(model):
+    """IsolationForest -> reference format (IsolationForestMojoModel:
+    summed per-tree path lengths normalized by min/max path length).
+
+    The reference records min/max path length over TRAINING scores; here
+    they are the trees' structural bounds (sum of each tree's min/max
+    leaf), a documented delta — per-row path lengths are exact either
+    way, only the affine normalization differs.
+    """
+    info, columns, domains = _unsup_info(model, "isolationforest",
+                                         _MOJO_TREE_VERSION)
+    trees = list(model.output["trees"])
+    depth = model.params.max_depth
+    lo = sum(float(np.min(np.asarray(t.values))) for t in trees)
+    hi = sum(float(np.max(np.asarray(t.values))) for t in trees)
+    info.update({
+        "n_trees": len(trees), "n_trees_per_class": 1,
+        "min_path_length": lo, "max_path_length": hi,
+        "distribution": "gaussian", "link_function": "identity",
+        "init_f": 0.0,
+    })
+    blobs = {f"trees/t00_{g:03d}.bin": encode_tree(t, depth)
+             for g, t in enumerate(trees)}
+    return info, columns, domains, blobs
+
+
+def _word2vec_entries(model):
+    """Word2Vec -> reference format (Word2VecMojoReader: vocabulary text
+    + BIG-endian float32 vectors — Java ByteBuffer default order)."""
+    E = np.asarray(model.output["embeddings"], np.float32)
+    words = list(model.output["words"])
+    info = {
+        "h2o_version": "3.46.0.1",
+        "mojo_version": "1.00",
+        "license": "Apache License Version 2.0",
+        "algo": "word2vec",
+        "endianness": "LITTLE_ENDIAN",
+        "category": "Unknown",
+        "supervised": False,
+        "n_features": 1,
+        "n_classes": 1,
+        "n_columns": 1,
+        "n_domains": 0,
+        "balance_classes": False,
+        "default_threshold": 0.5,
+        "vec_size": int(E.shape[1]),
+        "vocab_size": len(words),
+    }
+    vocab_txt = "\n".join(str(w).replace("\n", "\\n") for w in words)
+    blobs = {"vocabulary": vocab_txt.encode(),
+             "vectors": E[: len(words)].astype(">f4").tobytes()}
+    return info, ["word"], {}, blobs
+
+
+def _deeplearning_entries(model):
+    """DeepLearning MLP -> reference format (DeeplearningMojoReader:
+    everything in model.ini — neural_network_sizes, norm stats, per-layer
+    ``weight_layerK``/``bias_layerK`` flattened [out, in]-major).
+
+    The framework's design layout interleaves each categorical's one-hot
+    block (with a trailing NA bucket) at its column position; the
+    reference expects cats-first one-hot (no NA bucket) then numerics, so
+    input-layer weight rows are permuted and NA-bucket rows dropped
+    (exact for rows without missing categoricals, the GLM-writer rule).
+    """
+    from ..frame.vec import T_CAT
+    di = model.datainfo
+    p = model.params
+    if p.autoencoder:
+        raise ValueError("reference DL MOJO export: autoencoder scoring "
+                         "is unsupported by genmodel itself")
+    cat_specs = [s for s in di.specs if s.type == T_CAT]
+    num_specs = [s for s in di.specs if s.type != T_CAT]
+    # input permutation: reference order = cats' one-hot then nums
+    perm = []
+    for s in cat_specs:
+        perm.extend(range(s.offset, s.offset + s.width - 1))  # drop NA
+    for s in num_specs:
+        perm.append(s.offset)
+    weights = [(np.asarray(W, np.float64), np.asarray(b, np.float64))
+               for W, b in model.output["weights"]]
+    W0, b0 = weights[0]
+    if di.add_intercept:
+        # the design matrix carries a constant-1 intercept column (last
+        # row of W0) with no MOJO representation — fold it into the bias
+        b0 = b0 + W0[-1, :]
+    W0 = W0[perm, :]
+    weights[0] = (W0, b0)
+
+    specs = cat_specs + num_specs
+    columns = [s.name for s in specs]
+    domains = {j: list(s.domain) for j, s in enumerate(specs)
+               if s.type == T_CAT and s.domain}
+    nclasses = di.nclasses
+    if di.response_column:
+        columns.append(di.response_column)
+        if di.response_domain:
+            domains[len(specs)] = list(di.response_domain)
+    cat_offsets = [0]
+    for s in cat_specs:
+        cat_offsets.append(cat_offsets[-1] + s.width - 1)
+    units = [len(perm)] + [W.shape[1] for W, _ in weights]
+    act = {"rectifier": "Rectifier", "tanh": "Tanh", "maxout": "Maxout",
+           "rectifier_with_dropout": "RectifierWithDropout",
+           "tanh_with_dropout": "TanhWithDropout",
+           "maxout_with_dropout": "MaxoutWithDropout"}[p.activation]
+    dist = ("bernoulli" if nclasses == 2 else
+            "multinomial" if nclasses > 2 else "gaussian")
+    info = {
+        "h2o_version": "3.46.0.1",
+        "mojo_version": "1.10",
+        "license": "Apache License Version 2.0",
+        "algo": "deeplearning",
+        "endianness": "LITTLE_ENDIAN",
+        "category": ("Binomial" if nclasses == 2 else
+                     "Multinomial" if nclasses > 2 else "Regression"),
+        "supervised": True,
+        "n_features": len(specs),
+        "n_classes": max(nclasses, 1),
+        "n_columns": len(columns),
+        "n_domains": len(domains),
+        "balance_classes": False,
+        "default_threshold": float(model.default_threshold())
+        if nclasses == 2 else 0.5,
+        "mini_batch_size": int(p.mini_batch_size),
+        "nums": len(num_specs),
+        "cats": len(cat_specs),
+        "cat_offsets": [int(x) for x in cat_offsets],
+        "use_all_factor_levels": bool(di.use_all_factor_levels),
+        "activation": act,
+        "mean_imputation": True,
+        "cat_modes": [0] * len(cat_specs),
+        "distribution": dist,
+        "neural_network_sizes": [int(u) for u in units],
+        "hidden_dropout_ratios": [float(x) for x in
+                                  (p.hidden_dropout_ratios or [])],
+        "_genmodel_encoding": "AUTO",
+    }
+    if di.standardize:
+        info["norm_sub"] = [float(s.mean) for s in num_specs]
+        info["norm_mul"] = [1.0 / float(s.sigma) if s.sigma else 1.0
+                            for s in num_specs]
+        if nclasses <= 1 and di.response_sigma:
+            info["norm_resp_sub"] = float(di.response_mean)
+            info["norm_resp_mul"] = 1.0 / float(di.response_sigma)
+    for k, (W, b) in enumerate(weights):
+        info[f"weight_layer{k}"] = [float(x) for x in W.T.ravel()]
+        info[f"bias_layer{k}"] = [float(x) for x in b]
+    return info, columns, domains, {}
+
+
+def _pca_entries(model):
+    """PCA -> reference format (PCAMojoReader: eigenvectors_raw blob of
+    big-endian doubles, [eigenvector_size, k])."""
+    from ..frame.vec import T_CAT
+    di = model.datainfo
+    if any(s.type == T_CAT for s in di.specs):
+        raise ValueError("reference PCA MOJO export supports numeric "
+                         "columns only in this framework")
+    info, columns, domains = _unsup_info(model, "pca", "1.00")
+    V = np.asarray(model.output["eigenvectors"], np.float64)  # [P, k]
+    mu = np.asarray(model.output["_mu"], np.float64)
+    sd = np.asarray(model.output["_sd"], np.float64)  # multiplier form
+    info.update({
+        "use_all_factor_levels": bool(di.use_all_factor_levels),
+        "pca_methods": str(model.params.pca_method),
+        "pca_impl": "mtj_svd_densematrix",
+        "k": int(V.shape[1]),
+        "permutation": list(range(len(di.specs))),
+        "ncats": 0,
+        "nnums": len(di.specs),
+        "normSub": [float(x) for x in mu],
+        "normMul": [float(x) for x in sd],
+        "catOffsets": [0],
+        "eigenvector_size": int(V.shape[0]),
+    })
+    blobs = {"eigenvectors_raw": V.astype(">f8").tobytes()}
+    return info, columns, domains, blobs
+
+
+def _coxph_entries(model):
+    """CoxPH -> reference format (CoxPHMojoReader: raw-space coef +
+    per-column means; lp = coef . (x - mean), matching this framework's
+    standardized ``X_std @ beta_std``)."""
+    from ..frame.vec import T_CAT
+    di = model.datainfo
+    cat_specs = [s for s in di.specs if s.type == T_CAT]
+    num_specs = [s for s in di.specs if s.type != T_CAT]
+    beta = np.asarray(model.output["beta_std"], np.float64)
+    coef, means_num, means_cat = [], [], []
+    cat_offsets = [0]
+    for s in cat_specs:
+        for k in range(s.width - 1):
+            coef.append(float(beta[s.offset + k]))
+        means_cat.append([0.0] * (s.width - 1))
+        cat_offsets.append(cat_offsets[-1] + s.width - 1)
+    num_offsets = []
+    for s in num_specs:
+        num_offsets.append(len(coef))
+        sig = float(s.sigma) if di.standardize and s.sigma else 1.0
+        coef.append(float(beta[s.offset]) / sig)
+        means_num.append([float(s.mean) if di.standardize else 0.0])
+    specs = cat_specs + num_specs
+    columns = [s.name for s in specs]
+    domains = {j: list(s.domain) for j, s in enumerate(specs)
+               if s.type == T_CAT and s.domain}
+    n_cat_coef = sum(len(r) for r in means_cat)
+    num_means_flat = [r[0] for r in means_num]
+    info, _, _ = _unsup_info(model, "coxph", "1.00")
+    info.update({
+        "n_features": len(specs),
+        "n_columns": len(columns),
+        "n_domains": len(domains),
+        "coef": coef,
+        "cats": len(cat_specs),
+        "cat_offsets": [int(x) for x in cat_offsets],
+        "num_numerical_columns": len(num_specs),
+        "num_offsets": [int(x) for x in num_offsets],
+        "use_all_factor_levels": bool(di.use_all_factor_levels),
+        "strata_count": 0,
+        # rectangular-array convention (ModelMojoReader:232): _size1/_size2
+        # ini keys + a big-endian double blob, [1 strata row x coefs]
+        "x_mean_cat_size1": 1, "x_mean_cat_size2": n_cat_coef,
+        "x_mean_num_size1": 1, "x_mean_num_size2": len(num_means_flat),
+    })
+    blobs = {
+        "x_mean_cat": np.asarray([0.0] * n_cat_coef,
+                                 np.float64).astype(">f8").tobytes(),
+        "x_mean_num": np.asarray(num_means_flat,
+                                 np.float64).astype(">f8").tobytes(),
+    }
+    return info, columns, domains, blobs
+
+
+_ENTRY_BUILDERS = {
+    "gbm": _tree_entries, "drf": _tree_entries, "xgboost": _tree_entries,
+    "glm": _glm_entries, "kmeans": _kmeans_entries,
+    "isolationforest": _isofor_entries, "isofor": _isofor_entries,
+    "word2vec": _word2vec_entries, "deeplearning": _deeplearning_entries,
+    "pca": _pca_entries, "coxph": _coxph_entries,
+}
+
+
+def write_ensemble_mojo(model, path: str) -> str:
+    """StackedEnsemble -> reference format: nested base-model archives
+    under ``models/<dir>/`` + metalearner, keyed exactly as
+    StackedEnsembleMojoReader expects (submodel_count/submodel_key_i/
+    submodel_dir_i/base_model{i}/metalearner)."""
+    from ..runtime import dkv
+    base_keys = list(model.output["base_model_keys"])
+    meta_key = model.output["metalearner_key"]
+    subs = []
+    for key in base_keys + [meta_key]:
+        m = dkv.get(key)
+        if m is None:
+            raise ValueError(f"base model {key!r} not in DKV")
+        builder = _ENTRY_BUILDERS.get(m.algo)
+        if builder is None or m.algo not in ("gbm", "drf", "xgboost",
+                                             "glm", "deeplearning"):
+            raise ValueError(
+                f"StackedEnsemble MOJO export: base model algo {m.algo!r} "
+                "has no reference-format writer")
+        subs.append((key, m, builder))
+    di = model.datainfo
+    info, columns, domains = _common_info(model, "stackedensemble")
+    info["mojo_version"] = "1.00"
+    info["submodel_count"] = len(subs)
+    info["base_models_num"] = len(base_keys)
+    info["metalearner"] = meta_key
+    info["metalearner_transform"] = "NONE"
+    del di
+    blobs: dict = {}
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        for i, (key, m, builder) in enumerate(subs):
+            prefix = f"models/m{i}/"
+            info[f"submodel_key_{i}"] = key
+            info[f"submodel_dir_{i}"] = prefix
+            if i < len(base_keys):
+                info[f"base_model{i}"] = key
+            _write_entries(zf, *builder(m), prefix=prefix)
+        _write_entries(zf, info, columns, domains, blobs)
+    return path
 
 
 def write_h2o_mojo(model, path: str) -> str:
     """Dispatch: model trained here -> reference-format MOJO archive."""
-    if model.algo in ("gbm", "drf", "xgboost"):
-        return write_tree_mojo(model, path)
-    if model.algo == "glm":
-        return write_glm_mojo(model, path)
-    raise ValueError(
-        f"no reference MOJO format writer for algo {model.algo!r} "
-        "(gbm, drf, xgboost, glm are supported)")
+    if model.algo == "stackedensemble":
+        return write_ensemble_mojo(model, path)
+    builder = _ENTRY_BUILDERS.get(model.algo)
+    if builder is None:
+        raise ValueError(
+            f"no reference MOJO format writer for algo {model.algo!r} "
+            f"(supported: {sorted(set(_ENTRY_BUILDERS))} + "
+            "stackedensemble)")
+    return _write_archive(path, *builder(model))

@@ -144,11 +144,80 @@ def _domains_java(di) -> List[str]:
     return rows
 
 
+def _glm_score_body(model, lang: dict) -> List[str]:
+    """GLM linear predictor + link inverse as generated conditionals.
+
+    Raw-space coefficients (``output["beta"]`` — the destandardized
+    vector) over the POJO input convention; the learned NA buckets and
+    mean imputation are kept, so scoring matches the in-framework model
+    on every row including missing values.  Reference analog:
+    ``GLMModel.toJavaPredictBody``.
+    """
+    from ..frame.vec import T_CAT
+    di = model.datainfo
+    fam = model.output["family"]
+    if fam in ("multinomial", "ordinal"):
+        raise ValueError(
+            "GLM POJO export covers binomial/regression families")
+    beta = np.asarray(model.output["beta"], np.float64)
+    isnan = lang["isnan"]
+    out = []
+    intercept = float(beta[-1]) if di.add_intercept else 0.0
+    out.append(f"    double lp = {_fmt(intercept)};")
+    out.append("    double v;")
+    lo = 0 if di.use_all_factor_levels else 1
+    for j, s in enumerate(di.specs):
+        out.append(f"    v = data[{j}];")
+        if s.type == T_CAT:
+            width = s.width - 1          # one-hot slots before the NA slot
+            na_b = float(beta[s.offset + width])
+            out.append(f"    if ({isnan}(v) || v < 0) "
+                       f"lp += {_fmt(na_b)};")
+            out.append("    else {")
+            out.append(f"      int k = (int) v - {lo};")
+            betas = ", ".join(_fmt(float(b))
+                              for b in beta[s.offset: s.offset + width])
+            if lang is _JAVA:
+                out.append(f"      double[] cb = new double[] {{{betas}}};")
+            else:
+                out.append(f"      const double cb[] = {{{betas}}};")
+            out.append(f"      if (k >= 0 && k < {width}) lp += cb[k];")
+            out.append("    }")
+        else:
+            b = float(beta[s.offset])
+            out.append(f"    if ({isnan}(v)) v = {_fmt(float(s.mean))};")
+            out.append(f"    lp += {_fmt(b)} * v;")
+    link = {"binomial": "logit", "quasibinomial": "logit",
+            "fractionalbinomial": "logit", "poisson": "log",
+            "gamma": "log", "tweedie": "log",
+            "negativebinomial": "log"}.get(fam, "identity")
+    if link == "logit":
+        out.append("    double mu = 1.0 / (1.0 + exp(-lp));"
+                   if lang is _C else
+                   "    double mu = 1.0 / (1.0 + Math.exp(-lp));")
+    elif link == "log":
+        out.append("    double mu = exp(lp);" if lang is _C else
+                   "    double mu = Math.exp(lp);")
+    else:
+        out.append("    double mu = lp;")
+    if di.nclasses == 2:
+        thr = float(model.default_threshold())
+        out.append("    preds[1] = 1.0 - mu;")
+        out.append("    preds[2] = mu;")
+        out.append(f"    preds[0] = mu >= {_fmt(thr)} ? 1 : 0;")
+    else:
+        out.append("    preds[0] = mu;")
+    return out
+
+
 def export_pojo(model, path: str, class_name: Optional[str] = None) -> str:
-    """Write a dependency-free Java scoring class (TreeJCodeGen analog)."""
+    """Write a dependency-free Java scoring class (TreeJCodeGen analog;
+    GLM via the generic Model.toJava pattern, Model.java:2484)."""
+    if model.algo == "glm":
+        return _export_pojo_glm_java(model, path, class_name)
     if model.algo not in ("gbm", "drf", "xgboost"):
         raise ValueError("POJO export covers tree ensembles "
-                         "(gbm/drf/xgboost)")
+                         "(gbm/drf/xgboost) and GLM")
     di = model.datainfo
     matrix, K = _model_trees(model)
     depth = model.params.max_depth
@@ -191,13 +260,58 @@ def export_pojo(model, path: str, class_name: Optional[str] = None) -> str:
     return path
 
 
+def _export_pojo_glm_java(model, path: str,
+                          class_name: Optional[str] = None) -> str:
+    di = model.datainfo
+    cname = class_name or "".join(
+        ch if ch.isalnum() else "_" for ch in model.key)
+    if not cname[0].isalpha():
+        cname = "M_" + cname
+    names = ", ".join(f'"{s.name}"' for s in di.specs)
+    nclasses = max(di.nclasses, 1)
+    preds_len = 1 if nclasses == 1 else nclasses + 1
+    parts = [
+        "// Generated GLM scoring POJO — self-contained, no h2o-genmodel",
+        "// dependency.  Columns: data[j] = NAMES[j]; categorical columns",
+        "// carry the code of the level in DOMAINS[j] (NaN = missing).",
+        f"public class {cname} {{",
+        f"  public static final String[] NAMES = new String[] {{{names}}};",
+        "  public static final String[][] DOMAINS = new String[][] {",
+        *_domains_java(di),
+        "  };",
+        f"  public static final int NCLASSES = {nclasses};",
+        "",
+        "  public static double[] score0(double[] data, double[] preds) {",
+        *_glm_score_body(model, _JAVA),
+        "    return preds;",
+        "  }",
+        "",
+        "  public static double[] score0(double[] data) {",
+        f"    return score0(data, new double[{preds_len}]);",
+        "  }",
+        "}",
+    ]
+    with open(path, "w") as fh:
+        fh.write("\n".join(parts) + "\n")
+    return path
+
+
 def export_pojo_c(model, path: str) -> str:
     """The same generated trees as a C translation unit exporting
     ``score0(const double* data, double* preds)`` — compiled by the test
     suite to validate the codegen, and usable as a native scorer."""
+    if model.algo == "glm":
+        parts = ["#include <math.h>", "",
+                 "double* score0(const double* data, double* preds) {",
+                 *_glm_score_body(model, _C),
+                 "  return preds;",
+                 "}"]
+        with open(path, "w") as fh:
+            fh.write("\n".join(parts) + "\n")
+        return path
     if model.algo not in ("gbm", "drf", "xgboost"):
         raise ValueError("POJO export covers tree ensembles "
-                         "(gbm/drf/xgboost)")
+                         "(gbm/drf/xgboost) and GLM")
     matrix, K = _model_trees(model)
     depth = model.params.max_depth
     body = _score_body(model, matrix, K, _C)

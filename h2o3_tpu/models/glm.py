@@ -226,6 +226,95 @@ def _make_irls_step(family: _Family):
     return step
 
 
+def _make_path_runner(family: _Family, l1_mode: bool, max_iter: int,
+                      max_inner: int = 100):
+    """The WHOLE regularization path as one device program.
+
+    The host loop pays a device->host round trip per IRLS iteration
+    (~67 ms on a tunnelled backend — measured 18.7 s for a 100-lambda
+    path at 2M rows, entirely fetch-bound).  Here lambdas run under
+    ``lax.scan`` with warm-started betas, IRLS under ``lax.while_loop``
+    (beta_epsilon early exit), and the penalized solve on device: one
+    linear solve for pure L2, cyclic coordinate descent (the reference's
+    COD, GLM.java:2840) under a while_loop for any L1.  One fetch at the
+    end returns per-lambda betas/deviances/iteration counts + the final
+    Gram (p-values).
+    """
+
+    def irls_gram(X, y, w, beta, offset):
+        eta = X @ beta + offset
+        mu = family.linkinv(eta)
+        g = jnp.maximum(family.dlinkinv(eta, mu), 1e-10)
+        var = jnp.maximum(family.variance(mu), 1e-10)
+        z = (eta - offset) + (y - mu) / g
+        wi = w * g * g / var
+        Xw = X * wi[:, None]
+        return Xw.T @ X, Xw.T @ z, family.deviance(y, mu, w)
+
+    @jax.jit
+    def run(X, y, w, offset, lambdas, alpha, penalize, beta0, n,
+            beta_eps):
+        P = beta0.shape[0]
+
+        def solve(G, c, lam, warm):
+            l2 = lam * (1 - alpha) * penalize
+            if not l1_mode:
+                A = G + jnp.diag(l2 + 1e-10)
+                return jnp.linalg.solve(A, c)
+            l1 = lam * alpha * penalize
+            d = jnp.diag(G)
+
+            def sweep(state):
+                beta, _, it = state
+
+                def upd(j, bd):
+                    b, delta = bd
+                    r = c[j] - (G[j] @ b - d[j] * b[j])
+                    bj = jnp.where(
+                        penalize[j] > 0,
+                        jnp.sign(r) * jnp.maximum(jnp.abs(r) - l1[j], 0.0)
+                        / (d[j] + l2[j] + 1e-12),
+                        r / (d[j] + 1e-12))
+                    delta = jnp.maximum(delta, jnp.abs(bj - b[j]))
+                    return b.at[j].set(bj), delta
+
+                beta2, delta = jax.lax.fori_loop(
+                    0, P, upd, (beta, jnp.float32(0.0)))
+                return beta2, delta, it + 1
+
+            def cond(state):
+                _, delta, it = state
+                return (it < max_inner) & (delta > 1e-8)
+
+            beta, _, _ = jax.lax.while_loop(
+                cond, sweep, (warm, jnp.float32(jnp.inf), 0))
+            return beta
+
+        def per_lambda(beta, lam):
+            def body(state):
+                beta, _, it, _ = state
+                gram, xtwz, dev = irls_gram(X, y, w, beta, offset)
+                nb = solve(gram / n, xtwz / n, lam, beta)
+                delta = jnp.max(jnp.abs(nb - beta))
+                return nb, delta, it + 1, dev
+
+            def cond(state):
+                _, delta, it, _ = state
+                return (it < max_iter) & (delta >= beta_eps)
+
+            beta, _, iters, dev = jax.lax.while_loop(
+                cond, body, (beta, jnp.float32(jnp.inf), 0,
+                             jnp.float32(0.0)))
+            return beta, (beta, dev, iters)
+
+        beta_fin, (betas, devs, iters) = jax.lax.scan(
+            per_lambda, beta0, lambdas)
+        gram_fin, _, dev_fin = irls_gram(X, y, w, beta_fin, offset)
+        return betas, devs, iters, gram_fin, dev_fin
+
+    return run
+
+
 def _make_softmax_stats(nclasses: int):
     @jax.jit
     def stats(X, y, w, beta, offset):
@@ -643,6 +732,29 @@ class GLM(ModelBuilder):
         if di.add_intercept:
             eta0 = fam.init_eta(y, w)
             beta[-1] = float(eta0[0])
+        if len(lambdas) > 1 and getattr(self, "_nonneg", None) is None:
+            # lambda path: one fused device program (no per-iteration
+            # round trips); the host loop below keeps per-iteration
+            # history + non_negative support for the single-solve case
+            runner = _make_path_runner(fam, l1_mode=p.alpha > 0,
+                                       max_iter=p.max_iterations)
+            betas, devs, iters, gram_fin, dev_fin = jax.device_get(runner(
+                X, y, w, offset, jnp.asarray(lambdas, jnp.float32),
+                jnp.float32(p.alpha), jnp.asarray(penalize, jnp.float32),
+                jnp.asarray(beta, jnp.float32), jnp.float32(n),
+                jnp.float32(p.beta_epsilon)))
+            hist = [{"lambda": float(lam), "iteration": int(iters[li]),
+                     "deviance": float(devs[li]), "delta": float("nan")}
+                    for li, lam in enumerate(lambdas)]
+            for li, lam in enumerate(lambdas):
+                job.update((li + 1) / len(lambdas),
+                           f"lambda={lam:.3g} dev={float(devs[li]):.4g}")
+            model = GLMModel(job.dest_key or dkv.make_key(self.algo), p, di)
+            self._finalize(model, di, np.asarray(betas[-1], np.float64),
+                           fam_name, X, y, w, offset, n, float(devs[-1]),
+                           hist, lambdas[-1], frame, valid,
+                           gram_last=np.asarray(gram_fin, np.float64))
+            return model
         best = None
         hist = []
         dev = np.inf

@@ -65,12 +65,32 @@ def load():
         lib.fastcsv_ncols.restype = ctypes.c_int
         lib.fastcsv_ncols.argtypes = [ctypes.c_char_p, ctypes.c_longlong,
                                       ctypes.c_char]
+        lib.fastcsv_parse_range.restype = ctypes.c_longlong
+        lib.fastcsv_parse_range.argtypes = [
+            ctypes.c_char_p, ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.c_char, ctypes.c_int, ctypes.c_longlong,
+            ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_longlong)]
+        lib.fastcsv_count_lines.restype = ctypes.c_longlong
+        lib.fastcsv_count_lines.argtypes = [
+            ctypes.c_char_p, ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.POINTER(ctypes.c_int)]
         _lib = lib
         return _lib
 
 
-def parse_bytes(data: bytes, sep: str = ",", ncols: Optional[int] = None):
-    """Tokenize a CSV byte buffer natively.
+def parse_bytes(data: bytes, sep: str = ",", ncols: Optional[int] = None,
+                threads: Optional[int] = None):
+    """Tokenize a CSV byte buffer natively, multi-threaded when safe.
+
+    Quote-free buffers split at newline boundaries into per-thread byte
+    ranges parsed concurrently (ctypes releases the GIL) — the
+    MultiFileParseTask chunk layout (ParseDataset.java:688) on one host.
+    A buffer containing any double-quote parses single-threaded: quoted
+    cells may hide newlines, so ranges cannot be aligned safely.
 
     Returns (values [rows, ncols] f64 with NaN for non-numeric, flags
     [rows, ncols] uint8 text markers, offsets [rows, ncols, 2] byte
@@ -83,21 +103,80 @@ def parse_bytes(data: bytes, sep: str = ",", ncols: Optional[int] = None):
     n = len(data)
     if n > (1 << 31) - 16:               # int32 offsets: pre-split or defer
         return None
+    sepc = sep.encode()[0:1]
     if ncols is None:
-        ncols = int(lib.fastcsv_ncols(data, n, sep.encode()[0:1]))
-    max_rows = max(data.count(b"\n") + 2, 4)
+        ncols = int(lib.fastcsv_ncols(data, n, sepc))
+    has_quotes = ctypes.c_int(0)
+    total_lines = int(lib.fastcsv_count_lines(data, 0, n,
+                                              ctypes.byref(has_quotes)))
+    max_rows = max(total_lines + 2, 4)
     values = np.empty(ncols * max_rows, np.float64)
     flags = np.zeros(ncols * max_rows, np.uint8)
     offsets = np.zeros(ncols * max_rows * 2, np.int32)
-    consumed = ctypes.c_longlong(0)
-    rows = lib.fastcsv_parse(
-        data, n, sep.encode()[0:1], ncols, max_rows,
-        values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
-        flags.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-        ctypes.byref(consumed))
-    rows = int(rows)
-    vals = values.reshape(ncols, max_rows).T[:rows]
-    flg = flags.reshape(ncols, max_rows).T[:rows]
-    offs = offsets.reshape(ncols, max_rows, 2).transpose(1, 0, 2)[:rows]
-    return vals, flg, offs, int(consumed.value)
+    vp = values.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+    fp = flags.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    op = offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+    if threads is None:
+        threads = min(16, os.cpu_count() or 1)
+    if has_quotes.value or threads <= 1 or n < (1 << 22):
+        consumed = ctypes.c_longlong(0)
+        rows = int(lib.fastcsv_parse_range(
+            data, 0, n, sepc, ncols, max_rows, 0, max_rows, vp, fp, op,
+            ctypes.byref(consumed)))
+        keep = [(0, rows)]
+        tail = int(consumed.value)
+    else:
+        # newline-aligned byte ranges
+        bounds = [0]
+        for t in range(1, threads):
+            pos = data.find(b"\n", n * t // threads)
+            pos = n if pos < 0 else pos + 1
+            if pos > bounds[-1]:
+                bounds.append(pos)
+        bounds.append(n)
+        ranges = [(bounds[i], bounds[i + 1])
+                  for i in range(len(bounds) - 1)
+                  if bounds[i + 1] > bounds[i]]
+        # row_base per range = cumulative newline counts (upper bound:
+        # blank lines produce gaps, compacted below)
+        counts = [int(lib.fastcsv_count_lines(data, a, b, None))
+                  for a, b in ranges]
+        counts[-1] += 1 if not data.endswith(b"\n") else 0
+        bases = np.concatenate([[0], np.cumsum(counts)])[:-1]
+
+        import concurrent.futures
+
+        def work(k):
+            a, b = ranges[k]
+            consumed = ctypes.c_longlong(0)
+            got = int(lib.fastcsv_parse_range(
+                data, a, b, sepc, ncols, max_rows, int(bases[k]),
+                int(bases[k]) + counts[k], vp, fp, op,
+                ctypes.byref(consumed)))
+            return got, int(consumed.value)
+
+        with concurrent.futures.ThreadPoolExecutor(len(ranges)) as ex:
+            results = list(ex.map(work, range(len(ranges))))
+        keep = [(int(bases[k]), results[k][0]) for k in range(len(ranges))]
+        # a range that stopped early (over-wide row) invalidates the
+        # later ranges' row_bases — fall back to the strict engines
+        for k in range(len(ranges) - 1):
+            if results[k][1] != ranges[k][1]:
+                return None
+        tail = results[-1][1]
+    keep = [(b, c) for b, c in keep if c > 0]
+    contiguous = all(keep[i][0] + keep[i][1] == keep[i + 1][0]
+                     for i in range(len(keep) - 1))
+    V = values.reshape(ncols, max_rows)
+    F = flags.reshape(ncols, max_rows)
+    O = offsets.reshape(ncols, max_rows, 2)
+    if keep and contiguous:
+        # the common case (no blank lines): strided VIEWS, no gather copy
+        a = keep[0][0]
+        b = keep[-1][0] + keep[-1][1]
+        return V.T[a:b], F.T[a:b], O.transpose(1, 0, 2)[a:b], tail
+    rows_idx = np.concatenate([np.arange(b, b + c) for b, c in keep]) \
+        if keep else np.zeros(0, np.int64)
+    return (V.T[rows_idx], F.T[rows_idx],
+            O.transpose(1, 0, 2)[rows_idx], tail)

@@ -2,10 +2,18 @@
 //
 // Reference: the parse fast path in water/parser/CsvParser.java — a
 // byte-level tokenizer over raw chunks that never materializes Java
-// Strings for numeric cells.  This is its native analog for the TPU
-// framework's coordinator: one pass over the buffer, quote-aware, writing
-// numeric cells straight into a preallocated double column-major matrix
-// and flagging cells that need host-side (string/categorical) handling.
+// Strings for numeric cells — and the distributed layout of
+// MultiFileParseTask (ParseDataset.java:688): raw byte ranges parsed
+// independently.  This is the native analog for the TPU framework's
+// coordinator: one pass over the buffer, quote-aware, writing numeric
+// cells straight into a preallocated double column-major matrix and
+// flagging cells that need host-side (string/categorical) handling.
+// `fastcsv_parse_range` takes (start, row_base) so quote-free buffers
+// tokenize in parallel threads over newline-aligned byte ranges.
+//
+// Number parsing: a hand-rolled digits/exponent scanner (~20 ns/cell)
+// for the forms that dominate real CSVs; anything else (inf, nan, hex
+// floats, >18 significant digits) falls back to strtod for exactness.
 //
 // Exposed as a C ABI for ctypes (no pybind11 in this image).
 
@@ -14,25 +22,82 @@
 #include <cstring>
 #include <cmath>
 
+namespace {
+
+const double kPow10[] = {
+    1e0, 1e1, 1e2, 1e3, 1e4, 1e5, 1e6, 1e7, 1e8, 1e9, 1e10, 1e11, 1e12,
+    1e13, 1e14, 1e15, 1e16, 1e17, 1e18, 1e19, 1e20, 1e21, 1e22};
+
+// Parse [s, e) as a double.  Returns false when the cell is not a plain
+// decimal/scientific number (caller flags it as text or retries strtod).
+inline bool parse_num(const char* s, const char* e, double* out) {
+    if (s == e) return false;
+    bool neg = false;
+    if (*s == '+' || *s == '-') { neg = *s == '-'; ++s; if (s == e) return false; }
+    uint64_t mant = 0;
+    int digits = 0, frac = 0;
+    bool any = false;
+    while (s < e && *s >= '0' && *s <= '9') {
+        if (digits < 18) { mant = mant * 10 + (*s - '0'); ++digits; }
+        else return false;                       // too long: strtod path
+        any = true; ++s;
+    }
+    if (s < e && *s == '.') {
+        ++s;
+        while (s < e && *s >= '0' && *s <= '9') {
+            if (digits < 18) { mant = mant * 10 + (*s - '0'); ++digits; ++frac; }
+            else return false;
+            any = true; ++s;
+        }
+    }
+    if (!any) return false;
+    int exp10 = -frac;
+    if (s < e && (*s == 'e' || *s == 'E')) {
+        ++s;
+        bool eneg = false;
+        if (s < e && (*s == '+' || *s == '-')) { eneg = *s == '-'; ++s; }
+        if (s == e) return false;
+        int ev = 0;
+        while (s < e && *s >= '0' && *s <= '9') {
+            ev = ev * 10 + (*s - '0');
+            if (ev > 400) return false;
+            ++s;
+        }
+        exp10 += eneg ? -ev : ev;
+    }
+    if (s != e) return false;
+    double v = (double)mant;
+    // one multiply/divide by an exact power of ten keeps the result
+    // correctly rounded for |exp10| <= 22 and mant < 2^53 (Clinger)
+    if (exp10 > 0) {
+        if (exp10 > 22) return false;
+        v *= kPow10[exp10];
+    } else if (exp10 < 0) {
+        if (exp10 < -22) return false;
+        v /= kPow10[-exp10];
+    }
+    *out = neg ? -v : v;
+    return true;
+}
+
+}  // namespace
+
 extern "C" {
 
-// Tokenize up to max_rows lines of `buf` (len bytes) with `ncols` columns.
-// Outputs:
-//   values  [max_rows * ncols] column-major doubles (NaN when not numeric)
-//   flags   [max_rows * ncols] uint8: 0 = numeric/empty, 1 = text cell
-//   offsets [max_rows * ncols * 2] int32 (start, end) byte ranges per cell
-//           (callers must keep buffers under 2 GB or pre-split them)
-// Returns number of complete rows parsed; *consumed is set to the number
-// of bytes consumed (ending on a row boundary).  A row WIDER than ncols
-// stops the parse at that row (consumed < len) so callers fail over to a
-// stricter engine instead of silently truncating cells.
-long long fastcsv_parse(const char* buf, long long len, char sep,
-                        int ncols, long long max_rows,
-                        double* values, uint8_t* flags,
-                        int32_t* offsets, long long* consumed) {
-    long long row = 0;
-    long long i = 0;
-    while (row < max_rows && i < len) {
+// Tokenize rows of buf[start, end) with `ncols` columns, writing row
+// row_base onward.  values/flags are column-major with stride max_rows;
+// offsets hold absolute (into buf) byte ranges per cell.  Returns rows
+// parsed; *consumed = absolute end position (on a row boundary).
+long long fastcsv_parse_range(const char* buf, long long start,
+                              long long end, char sep, int ncols,
+                              long long max_rows, long long row_base,
+                              long long row_cap,
+                              double* values, uint8_t* flags,
+                              int32_t* offsets, long long* consumed) {
+    long long row = row_base;
+    long long i = start;
+    long long len = end;
+    while (row < row_cap && i < len) {
         long long line_start = i;
         int col = 0;
         bool in_quotes = false;
@@ -53,7 +118,6 @@ long long fastcsv_parse(const char* buf, long long len, char sep,
             if (c == sep || c == '\n' || c == '\r') {
                 if (col < ncols) {
                     long long s = cell_start, e = i;
-                    // trim spaces and symmetric quotes
                     while (s < e && (buf[s] == ' ' || buf[s] == '\t')) ++s;
                     while (e > s && (buf[e-1] == ' ' || buf[e-1] == '\t')) --e;
                     if (e - s >= 2 && buf[s] == '"' && buf[e-1] == '"') {
@@ -66,31 +130,36 @@ long long fastcsv_parse(const char* buf, long long len, char sep,
                         values[idx] = NAN;
                         flags[idx] = 0;
                     } else {
-                        char* endp = nullptr;
-                        // strtod needs NUL-terminated input; copy small cell
-                        char tmp[64];
-                        long long m = e - s;
-                        if (m < 63) {
-                            memcpy(tmp, buf + s, m);
-                            tmp[m] = 0;
-                            double v = strtod(tmp, &endp);
-                            if (endp == tmp + m) {
-                                values[idx] = v;
-                                flags[idx] = 0;
+                        double v;
+                        if (parse_num(buf + s, buf + e, &v)) {
+                            values[idx] = v;
+                            flags[idx] = 0;
+                        } else {
+                            // exotic forms (inf/nan/hex/long mantissas):
+                            // strtod on a NUL-terminated copy
+                            char tmp[64];
+                            long long m = e - s;
+                            char* endp = nullptr;
+                            if (m < 63) {
+                                memcpy(tmp, buf + s, m);
+                                tmp[m] = 0;
+                                double sv = strtod(tmp, &endp);
+                                if (endp == tmp + m) {
+                                    values[idx] = sv;
+                                    flags[idx] = 0;
+                                } else {
+                                    values[idx] = NAN;
+                                    flags[idx] = 1;    // text cell
+                                }
                             } else {
                                 values[idx] = NAN;
-                                flags[idx] = 1;        // text cell
+                                flags[idx] = 1;
                             }
-                        } else {
-                            values[idx] = NAN;
-                            flags[idx] = 1;
                         }
                     }
                 }
                 ++col;
                 if (c == sep) { ++i; cell_start = i; continue; }
-                // end of line (real newline, or the synthetic one at EOF
-                // that closes a final unterminated row)
                 if (i < len) {
                     if (c == '\r' && i + 1 < len && buf[i + 1] == '\n') ++i;
                     ++i;
@@ -108,7 +177,6 @@ long long fastcsv_parse(const char* buf, long long len, char sep,
             break;
         }
         if (!saw_any && col <= 1) continue;             // blank line
-        // short rows: pad remaining cells with NA
         for (int c2 = col; c2 < ncols; ++c2) {
             long long idx = (long long)c2 * max_rows + row;
             values[idx] = NAN;
@@ -118,7 +186,16 @@ long long fastcsv_parse(const char* buf, long long len, char sep,
         ++row;
     }
     *consumed = (i > len) ? len : i;
-    return row;
+    return row - row_base;
+}
+
+// Single-range compatibility entry (the original ABI).
+long long fastcsv_parse(const char* buf, long long len, char sep,
+                        int ncols, long long max_rows,
+                        double* values, uint8_t* flags,
+                        int32_t* offsets, long long* consumed) {
+    return fastcsv_parse_range(buf, 0, len, sep, ncols, max_rows, 0,
+                               max_rows, values, flags, offsets, consumed);
 }
 
 // Count columns of the first line (quote-aware) — ParseSetup's guess.
@@ -136,6 +213,25 @@ int fastcsv_ncols(const char* buf, long long len, char sep) {
         else if (c == '\n' || c == '\r') break;
     }
     return cols;
+}
+
+// memchr-rate scan: newline count in [start, end) and whether any quote
+// appears anywhere (quotes may hide newlines -> single-thread parse).
+long long fastcsv_count_lines(const char* buf, long long start,
+                              long long end, int* has_quotes) {
+    long long n = 0;
+    const char* p = buf + start;
+    const char* stop = buf + end;
+    if (has_quotes) {
+        *has_quotes = memchr(p, '"', (size_t)(stop - p)) != nullptr;
+    }
+    while (p < stop) {
+        const char* q = (const char*)memchr(p, '\n', (size_t)(stop - p));
+        if (!q) break;
+        ++n;
+        p = q + 1;
+    }
+    return n;
 }
 
 }  // extern "C"

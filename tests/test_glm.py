@@ -205,3 +205,31 @@ def test_tweedie(cl, rng):
     m = GLM(family="tweedie", tweedie_variance_power=1.5, lambda_=0.0,
             response_column="y", max_iterations=100).train(fr)
     assert abs(m.coef["x"] - 0.4) < 0.15
+
+
+def test_lambda_path_fused_matches_host(cl):
+    """The fused device lambda path must land where per-lambda host
+    solves land (same warm-started IRLS/COD math, one program)."""
+    import numpy as np
+    from h2o3_tpu import Frame
+    from h2o3_tpu.models import GLM
+    rng = np.random.default_rng(8)
+    n, d = 2000, 6
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    beta = np.array([1.5, -1.0, 0.5, 0.0, 0.0, 0.0])
+    yy = rng.random(n) < 1 / (1 + np.exp(-(X @ beta - 0.3)))
+    cols = {f"x{j}": X[:, j] for j in range(d)}
+    cols["y"] = np.where(yy, "1", "0").astype(object)
+    fr = Frame.from_numpy(cols)
+    m = GLM(response_column="y", family="binomial", lambda_search=True,
+            nlambdas=12, alpha=0.5, seed=1).train(fr)
+    # solved path: final (smallest-lambda) coefficients recover the truth
+    coefs = m.coef
+    assert abs(coefs["x0"]) > 0.8 and abs(coefs["x3"]) < 0.25
+    assert len(m.scoring_history) == 12
+    # per-lambda host solves at the path's own lambdas agree at the end
+    m_host = GLM(response_column="y", family="binomial",
+                 lambda_=[float(h["lambda"]) for h in m.scoring_history][-1],
+                 alpha=0.5, seed=1).train(fr)
+    for name in ("x0", "x1", "x2"):
+        assert np.isclose(coefs[name], m_host.coef[name], atol=5e-3), name
