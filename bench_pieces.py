@@ -20,6 +20,7 @@ CPU smoke:    JAX_PLATFORMS=cpu H2O3_PIECES_ROWS=100000 python bench_pieces.py
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -160,5 +161,39 @@ def main():
                               "see PROFILE.md round-2 table"}), flush=True)
 
 
+def parse_piece():
+    """Standalone ingest bench: bench.py's 568 MB parse line (same file,
+    same warmup methodology) without the ~1091 s full suite.
+
+    Usage:      python bench_pieces.py parse
+    CPU smoke:  JAX_PLATFORMS=cpu H2O3_BENCH_ROWS=100000 \\
+                python bench_pieces.py parse
+
+    Prints one JSON line with MB/s, vs_baseline (reference: 580 MB in
+    4.9 s on 5 nodes), and the pipeline's per-stage wall times
+    (mmap / scan / tokenize / device / decode / vec).
+    """
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import tempfile
+
+    import h2o3_tpu
+    import bench
+    from h2o3_tpu.frame.parse import parse_csv, last_parse_stats
+    h2o3_tpu.init()
+    dt, mb = bench.bench_parse(parse_csv, tempfile.gettempdir())
+    print(json.dumps({
+        "piece": "parse", "sec": round(dt, 3), "mb": round(mb, 1),
+        "mb_per_sec": round(mb / dt, 1),
+        "vs_baseline": round(
+            (bench.REFERENCE_PARSE_S * mb / bench.REFERENCE_PARSE_MB) / dt,
+            2),
+        "stages": dict(last_parse_stats)}), flush=True)
+
+
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "parse":
+        parse_piece()
+    else:
+        main()

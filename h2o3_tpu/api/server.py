@@ -777,7 +777,7 @@ class Api:
         out = {"label": col, "type": v.type, "domain": v.domain}
         if v.is_numeric:
             r = v.rollups()
-            out.update({"mins": [r.min], "maxs": [r.max], "mean": r.mean,
+            out.update({"mins": [r.vmin], "maxs": [r.vmax], "mean": r.mean,
                         "sigma": r.sigma, "missing_count": r.nmissing})
         return {"frames": [{"columns": [out]}]}
 

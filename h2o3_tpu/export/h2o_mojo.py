@@ -577,18 +577,30 @@ class H2OMojoEnsembleModel(H2OMojoModel):
     def predict(self, data) -> dict:
         n = len(next(iter(data.values())))
         base = np.zeros((n, len(self.base_models)))
+        is_prob = np.zeros(len(self.base_models), dtype=bool)
         for i, bm in enumerate(self.base_models):
             if bm is None:                    # pruned slot: 0.0 column
                 continue
             out = bm.predict(data)
-            if self.nclasses == 2:
+            # level-one column per base, mirroring training's
+            # _base_columns: classifiers contribute p(positive); other
+            # algos their single raw output (cluster id, CoxPH lp, PC1)
+            if self.nclasses == 2 and "probabilities" in out:
                 base[:, i] = out["probabilities"][:, 1]
-            else:
+                is_prob[i] = True
+            elif "predict" in out:
                 base[:, i] = np.asarray(out["predict"], dtype=float)
+            elif "projection" in out:         # PCA base (k=1 level-one col)
+                base[:, i] = np.asarray(out["projection"])[:, 0]
+            else:
+                raise NotImplementedError(
+                    f"ensemble base model produced no usable level-one "
+                    f"column (outputs: {sorted(out)})")
         if self.logit_transform and self.nclasses == 2:
             # score0 logit-transforms only the classification branches;
-            # regression base predictions feed the metalearner raw
-            base = self._logit(base)
+            # regression/unsupervised base predictions feed the
+            # metalearner raw
+            base[:, is_prob] = self._logit(base[:, is_prob])
         meta_data = {name: base[:, j].tolist() for j, name in
                      enumerate(self.metalearner.feature_names)}
         out = self.metalearner.predict(meta_data)

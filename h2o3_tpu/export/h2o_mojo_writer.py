@@ -641,12 +641,15 @@ def write_ensemble_mojo(model, path: str) -> str:
         m = dkv.get(key)
         if m is None:
             raise ValueError(f"base model {key!r} not in DKV")
+        # any algo with a reference-format writer may appear as a base
+        # model (KMeans/PCA/CoxPH included — their readers contribute a
+        # single level-one column exactly as training did)
         builder = _ENTRY_BUILDERS.get(m.algo)
-        if builder is None or m.algo not in ("gbm", "drf", "xgboost",
-                                             "glm", "deeplearning"):
+        if builder is None:
             raise ValueError(
                 f"StackedEnsemble MOJO export: base model algo {m.algo!r} "
-                "has no reference-format writer")
+                "has no reference-format writer "
+                f"(supported: {sorted(set(_ENTRY_BUILDERS))})")
         subs.append((key, m, builder))
     di = model.datainfo
     info, columns, domains = _common_info(model, "stackedensemble")

@@ -80,30 +80,46 @@ def _byte_assignments(paths: Sequence[str], sizes: Sequence[int],
     return assign
 
 
-def _read_span(path: str, lo: int, hi: int, skip_header: bool) -> bytes:
-    """Read the lines of ``path`` whose first byte lies in [lo, hi).
+def _read_span(path: str, lo: int, hi: int, skip_header: bool):
+    """The lines of ``path`` whose first byte lies in [lo, hi).
 
     A reader owns every line that STARTS in its span: if ``lo > 0`` it skips
     the line already in progress, and it reads past ``hi`` to finish the
     last line it owns.  ``skip_header`` drops the file's header row (only
     meaningful for the span containing byte 0).
+
+    Local files return a zero-copy uint8 view over an mmap (the ranged
+    pipeline's no-copy contract); persist URIs return bytes from range
+    reads.
     """
     if "://" in path:
         return _read_span_persist(path, lo, hi, skip_header)
+    import mmap as _mmap
     with open(path, "rb") as f:
-        if lo > 0:
-            f.seek(lo - 1)
-            if f.read(1) != b"\n":
-                f.readline()          # line in progress belongs upstream
-        elif skip_header:
-            f.readline()
-        start = f.tell()
-        if start >= hi:
+        try:
+            mm = _mmap.mmap(f.fileno(), 0, access=_mmap.ACCESS_READ)
+        except ValueError:                # empty file
             return b""
-        buf = f.read(hi - start)
-        if not buf.endswith(b"\n"):
-            buf += f.readline()
-        return buf
+    size = len(mm)
+    start = lo
+    if lo > 0:
+        if mm[lo - 1:lo] != b"\n":
+            nl = mm.find(b"\n", lo)       # line in progress belongs upstream
+            if nl < 0:
+                return b""
+            start = nl + 1
+    elif skip_header:
+        nl = mm.find(b"\n", 0)
+        if nl < 0:
+            return b""
+        start = nl + 1
+    if start >= hi:
+        return b""
+    end = min(hi, size)
+    if end < size and mm[end - 1:end] != b"\n":
+        nl = mm.find(b"\n", end)          # finish the last owned line
+        end = size if nl < 0 else nl + 1
+    return np.frombuffer(mm, np.uint8)[start:end]
 
 
 _TAIL_CHUNK = 1 << 20
@@ -151,36 +167,44 @@ class _Span:
 
     __slots__ = ("data", "cols", "offs", "nrows")
 
-    def __init__(self, data: bytes, cols: Dict[str, np.ndarray],
+    def __init__(self, data, cols: Dict[str, np.ndarray],
                  offs: Optional[np.ndarray], nrows: int):
-        self.data = data
+        self.data = data                  # bytes or zero-copy uint8 view
         self.cols = cols
         self.offs = offs
         self.nrows = nrows
 
 
-def _tokenize(data: bytes, sepc: str,
+def _span_bytes(data) -> bytes:
+    """Materialize a span as bytes (pandas/stdlib fallbacks only)."""
+    return data if isinstance(data, bytes) else bytes(memoryview(data))
+
+
+def _tokenize(data, sepc: str,
               names: List[str]) -> Tuple[Optional[_Span], bool]:
-    """Tokenize a headerless CSV byte span.  Returns (span, suspect).
+    """Tokenize a headerless CSV byte span (bytes or uint8 view).
+    Returns (span, suspect).
 
     ``suspect`` signals the byte-split cannot be trusted (quoted newlines /
     tokenizer failure) — the caller falls back to a replicated parse.
     """
-    if data.count(b'"') % 2 == 1:
+    if isinstance(data, bytes):
+        odd_quotes = data.count(b'"') % 2 == 1
+    else:
+        odd_quotes = int(np.count_nonzero(data == 0x22)) % 2 == 1
+    if odd_quotes:
         return None, True             # unbalanced quotes: split mid-field
     try:
         from .. import native
-        out = native.parse_bytes(data, sepc, ncols=len(names))
+        out = native.parse_view(native._as_view(data), sepc,
+                                ncols=len(names))
     except Exception:
         out = None
     if out is not None:
         vals, flags, offs, consumed = out
         if consumed != len(data):
             return None, True         # unterminated quote etc.
-        if vals.shape[1] == len(names) and not (
-                flags.size and flags.mean() > 0.25):
-            # string-heavy spans defer to the pandas C reader below — the
-            # per-cell decode loop loses (same heuristic as parse.py)
+        if vals.shape[1] == len(names):
             cols = {}
             for j, nm in enumerate(names):
                 if flags[:, j].any():
@@ -191,8 +215,9 @@ def _tokenize(data: bytes, sepc: str,
     try:
         import pandas as pd
         try:
-            df = pd.read_csv(io.BytesIO(data), sep=sepc, header=None,
-                             names=names, na_values=sorted(_NA),
+            df = pd.read_csv(io.BytesIO(_span_bytes(data)), sep=sepc,
+                             header=None, names=names,
+                             na_values=sorted(_NA),
                              keep_default_na=True, engine="c",
                              low_memory=False)
         except Exception:
@@ -203,8 +228,8 @@ def _tokenize(data: bytes, sepc: str,
         return _Span(data, cols, None, len(df)), False
     except ImportError:
         import csv
-        rows = list(csv.reader(io.StringIO(data.decode(errors="replace")),
-                               delimiter=sepc))
+        rows = list(csv.reader(io.StringIO(
+            _span_bytes(data).decode(errors="replace")), delimiter=sepc))
         if rows and any(len(r) != len(names) for r in rows):
             return None, True
         cols = {n: np.array([r[i] for r in rows], dtype=object)
@@ -224,14 +249,15 @@ def _raw_column(span: _Span, names: List[str], name: str,
         return _decode_text_column(span.data, span.offs, j)
     try:
         import pandas as pd
-        df = pd.read_csv(io.BytesIO(span.data), sep=sepc, header=None,
-                         names=names, usecols=[name], dtype=str,
-                         na_filter=False, engine="c")
+        df = pd.read_csv(io.BytesIO(_span_bytes(span.data)), sep=sepc,
+                         header=None, names=names, usecols=[name],
+                         dtype=str, na_filter=False, engine="c")
         return df[name].to_numpy(dtype=object)
     except ImportError:
         import csv
         rows = list(csv.reader(io.StringIO(
-            span.data.decode(errors="replace")), delimiter=sepc))
+            _span_bytes(span.data).decode(errors="replace")),
+            delimiter=sepc))
         return np.array([r[j] for r in rows], dtype=object)
 
 
@@ -354,12 +380,11 @@ def _convert(arr: np.ndarray, vtype: str, domain, ms_cache):
     svals = arr.astype(str)
     na = np.isin(svals, list(_NA))
     if vtype == T_CAT:
-        lookup = {s: i for i, s in enumerate(domain)}
-        return np.array(
-            [-1 if m else lookup.get(s, -1) for s, m in zip(svals, na)],
-            np.int32)
-    return np.array([None if m else s for s, m in zip(svals, na)],
-                    dtype=object)                   # T_STR
+        from .vec import encode_domain
+        return encode_domain(svals, domain, na_mask=na)
+    out = svals.astype(object)
+    out[na] = None
+    return out                                      # T_STR
 
 
 # -------------------------------------------------------- global assembly
@@ -503,7 +528,7 @@ def parse_files_distributed(paths: Sequence[str],
     for path, lo, hi in assign[me]:
         data = _read_span(path, lo, hi, skip_header=has_header and lo == 0)
         bytes_tokenized += len(data)
-        if not data:
+        if len(data) == 0:
             continue
         span, bad = _tokenize(data, sepc, names)
         if bad:

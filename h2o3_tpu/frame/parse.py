@@ -6,14 +6,18 @@ types; (2) ``MultiFileParseTask`` (an MRTask) tokenizes each raw chunk on its
 home node, writes compressed NewChunks, and merges categorical domains
 cluster-wide in the reduce (ParseDataset.java:501-600).
 
-TPU-native redesign: tokenization is host CPU work either way, so phase 2 uses
-the fastest host path available (pandas' C reader when present, stdlib csv
-otherwise) into numpy buffers, then a SINGLE device_put per column lays the
-data out row-sharded across the mesh — the "chunk homing" step.  Type
-guessing (phase 1) mirrors ParseSetup: numeric > time > categorical > string,
-with a cardinality heuristic for cat-vs-str.  Categorical domains are unified
-globally by construction (single host pass) — the analog of the reference's
-domain-merge reduce.
+TPU-native redesign: the hot path is a parallel mmap'd pipeline — the file
+is mapped (never copied), split at newline-aligned byte ranges, and the
+native tokenizer (``native/fastcsv.cpp``) fans the ranges over a bounded
+thread pool (ctypes releases the GIL).  As each range's tokenization lands,
+its numeric columns start their async device transfer, so ``device_put`` of
+early ranges hides tokenization of later ones; text columns take a
+vectorized host pass (fixed-width byte gather + ``np.unique``) instead of
+per-cell Python.  pandas' C reader and the stdlib tokenizer remain the
+strict fallback engines.  Type guessing (phase 1) mirrors ParseSetup:
+numeric > time > categorical > string, with a cardinality heuristic for
+cat-vs-str.  Categorical domains are unified globally by construction
+(single host pass) — the analog of the reference's domain-merge reduce.
 """
 
 from __future__ import annotations
@@ -21,6 +25,7 @@ from __future__ import annotations
 import csv
 import io
 import os
+import time
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -30,6 +35,17 @@ from .vec import Vec, T_CAT, T_NUM, T_STR, T_TIME
 from ..runtime import dkv
 
 _NA = {"", "na", "n/a", "nan", "null", "none", "?", "-", "NA", "NaN", "NULL", "None"}
+
+# Per-stage wall times of the most recent native-path parse on this process
+# (PROFILE.md measurement hook + test assertion surface): mmap, scan,
+# tokenize, device-dispatch, decode/typing, total.
+last_parse_stats: Dict[str, float] = {}
+
+
+class _DeviceChunks(list):
+    """Per-range on-device float32 pieces of one numeric column, in row
+    order — produced by the tokenize/transfer overlap, concatenated on
+    device at Vec-assembly time."""
 
 # cat-vs-str heuristic: mostly-unique, high-cardinality text is a string column
 _STR_UNIQUE_RATIO = 0.95
@@ -98,22 +114,54 @@ def _column_to_vec(values: np.ndarray, name: str,
     if coltype != T_CAT and (coltype == T_STR or (
             len(uniq) >= _STR_MIN_CARD and
             len(uniq) > _STR_UNIQUE_RATIO * max(len(nz), 1))):
-        host = np.array([None if m else s for s, m in zip(svals, na)], dtype=object)
+        host = svals.astype(object)
+        host[na] = None
         return Vec(None, T_STR, len(host), host_data=host)
-    lookup = {s: i for i, s in enumerate(uniq)}
-    codes = np.array([-1 if m else lookup[s] for s, m in zip(svals, na)],
-                     dtype=np.int32)
+    # vectorized factorization: uniq is sorted, so searchsorted IS the
+    # code lookup (the per-cell dict loop cost seconds at bench scale)
+    codes = np.searchsorted(uniq, svals).astype(np.int32)
+    codes[na] = -1
     return Vec.from_numpy(codes, T_CAT, domain=[str(u) for u in uniq])
 
 
-def _decode_text_column(body: bytes, offs: np.ndarray, j: int) -> np.ndarray:
+_GATHER_MAX_WIDTH = 512          # cells wider than this take the slow loop
+
+
+def _decode_text_column(body, offs: np.ndarray, j: int) -> np.ndarray:
     """Decode one column's raw cell bytes (native tokenizer offsets) to
-    Python strings, applying RFC-4180 quote unescaping."""
+    Python strings, applying RFC-4180 quote unescaping.
+
+    Vectorized: the native fixed-width gather packs the cells into an
+    ``|S width|`` column decoded in one ``np.char.decode`` call; only
+    cells holding escaped quotes (or trailing NUL bytes, which the S
+    dtype cannot represent) fall back to per-cell handling.  ``body``
+    may be bytes or a zero-copy uint8 view (mmap).
+    """
+    from .. import native
     nrows = len(offs)
+    starts = offs[:, j, 0]
+    ends = offs[:, j, 1]
+    width = int((ends - starts).max()) if nrows else 0
+    if 0 < width <= _GATHER_MAX_WIDTH:
+        fixed = native.gather_cells(body, starts, ends, width)
+        if fixed is not None:
+            col = np.char.decode(fixed, "utf-8", "replace").astype(object)
+            redo = np.char.find(fixed, b'""') >= 0
+            # trailing NULs vanish under the S dtype: re-decode those too
+            redo |= np.char.str_len(fixed) != np.minimum(
+                np.maximum(ends - starts, 0), width)
+            if redo.any():
+                view = memoryview(body)
+                for i in np.flatnonzero(redo):
+                    cell = bytes(view[starts[i]:ends[i]]) \
+                        .decode(errors="replace")
+                    col[i] = cell.replace('""', '"')
+            return col
+    view = memoryview(body) if not isinstance(body, bytes) else body
     col = np.empty(nrows, dtype=object)
     for i in range(nrows):
         s, e = offs[i, j]
-        cell = body[s:e].decode(errors="replace")
+        cell = bytes(view[s:e]).decode(errors="replace")
         if '""' in cell:
             cell = cell.replace('""', '"')
         col[i] = cell
@@ -130,50 +178,64 @@ def _pandas_safe() -> bool:
     return threading.current_thread() is threading.main_thread()
 
 
-def _parse_csv_native(path_or_buf, header, sep, col_names):
-    """Native tokenizer path (h2o3_tpu/native/fastcsv.cpp via ctypes).
+def _parse_csv_native(path_or_buf, header, sep, col_names,
+                      col_types: Optional[Dict[str, str]] = None,
+                      overlap_device: bool = True):
+    """Native tokenizer path — the parallel mmap'd pipeline.
 
-    Returns (names, cols) or None when the native library is unavailable
-    or the input shape doesn't fit its fast path.
+    Paths are mmap'd (no full-file ``read()`` copy); buffers/streams get a
+    zero-copy uint8 view.  Newline-aligned byte ranges tokenize in
+    parallel (``native.parse_view``); as each range completes, its
+    pure-numeric columns are dispatched to the device as float32 chunks,
+    overlapping transfer of early ranges with tokenization of later ones.
+    Text-flagged columns fall out as vectorized host decodes.
+
+    Returns (names, cols) — ``cols`` values are numpy arrays or
+    ``_DeviceChunks`` (already on device, row order) — or None when the
+    native library is unavailable or the input doesn't fit its fast path.
     """
     from .. import native
+    if native.load() is None:
+        return None
     sepc = sep if sep is not None else ","
     if len(sepc) != 1:
         return None
-    data = path_or_buf if isinstance(path_or_buf, bytes) else None
-    if data is None:
-        if isinstance(path_or_buf, str):
-            with open(path_or_buf, "rb") as f:
-                data = f.read()
-        else:
+    col_types = col_types or {}
+    stats: Dict[str, float] = {}
+    t_all = time.perf_counter()
+    mm = None
+    if isinstance(path_or_buf, str):
+        import mmap as _mmap
+        t0 = time.perf_counter()
+        with open(path_or_buf, "rb") as f:
+            try:
+                mm = _mmap.mmap(f.fileno(), 0, access=_mmap.ACCESS_READ)
+            except ValueError:           # empty file: defer to fallbacks
+                return None
+        view = np.frombuffer(mm, np.uint8)
+        first_nl = mm.find(b"\n")
+        stats["mmap_s"] = round(time.perf_counter() - t0, 4)
+    else:
+        data = path_or_buf if isinstance(path_or_buf, bytes) else None
+        if data is None:
             data = path_or_buf.read()
             if isinstance(data, str):
                 data = data.encode()
-    first_nl = data.find(b"\n")
-    first = data[: first_nl if first_nl >= 0 else len(data)] \
+        if not len(data):
+            return None
+        view = np.frombuffer(data, np.uint8)
+        first_nl = data.find(b"\n")
+    first = bytes(view[: first_nl if first_nl >= 0 else len(view)]) \
         .decode(errors="replace")
     head_cells = [c.strip().strip('"') for c in first.split(sepc)]
     has_header = (not _guess_numeric(head_cells)) if header is None \
         else bool(header)
-    body = data[first_nl + 1:] if has_header and first_nl >= 0 else data
-    out = native.parse_bytes(body, sepc)
-    if out is None:
+    body = view[first_nl + 1:] if has_header and first_nl >= 0 else view
+    if not len(body):
         return None
-    vals, flags, offs, consumed = out
-    if consumed != len(body):
-        return None              # unterminated quote etc.: defer to pandas
-    # string-heavy inputs: the per-cell decode loop below loses to the
-    # pandas C reader — defer when text cells dominate AND pandas is
-    # safe to call here (see _pandas_safe: it segfaults off-main-thread
-    # under jax in this image, so REST handler threads keep the native
-    # path regardless of text share)
-    if flags.size and flags.mean() > 0.25 and _pandas_safe():
-        try:
-            import pandas  # noqa: F401
-            return None
-        except ImportError:
-            pass
-    nrows, ncols = vals.shape
+    ncols = native.ncols_of(body, sepc)
+    if not ncols:
+        return None
     if col_names:                        # explicit names override a header
         names = list(col_names)
     elif has_header:
@@ -182,13 +244,63 @@ def _parse_csv_native(path_or_buf, header, sep, col_names):
         names = [f"C{i+1}" for i in range(ncols)]
     if len(names) != ncols:
         return None
+
+    # tokenize -> device-transfer overlap: numeric columns of each
+    # completed range start their (async) placement while later ranges
+    # are still tokenizing on the pool
+    dev_chunks: List[Optional[list]] = [
+        [] if (overlap_device and col_types.get(nm) in (None, T_NUM))
+        else None
+        for nm in names]
+    dev_time = [0.0]
+
+    def on_range(row_lo, nrows, Vt, Ft):
+        t0 = time.perf_counter()
+        try:
+            import jax.numpy as jnp
+        except Exception:
+            for j in range(ncols):
+                dev_chunks[j] = None
+            return
+        for j in range(ncols):
+            if dev_chunks[j] is None:
+                continue
+            if Ft[:, j].any():           # text seen: column is host-bound
+                dev_chunks[j] = None
+                continue
+            dev_chunks[j].append(
+                (row_lo, jnp.asarray(np.asarray(Vt[:, j], np.float32))))
+        dev_time[0] += time.perf_counter() - t0
+
+    out = native.parse_view(body, sepc, ncols=ncols,
+                            on_range=on_range if overlap_device else None,
+                            stats=stats)
+    if out is None:
+        return None
+    vals, flags, offs, consumed = out
+    if consumed != len(body):
+        return None              # unterminated quote etc.: defer to pandas
+    nrows = len(vals)
+    t0 = time.perf_counter()
     cols = {}
     for j, name in enumerate(names):
-        if flags[:, j].any():
+        chunks = dev_chunks[j]
+        if chunks is not None and nrows and \
+                sum(int(c.shape[0]) for _, c in chunks) == nrows:
+            cols[name] = _DeviceChunks(
+                c for _, c in sorted(chunks, key=lambda rc: rc[0]))
+        elif flags[:, j].any():
             # numeric cells keep their text form for uniform type guessing
             cols[name] = _decode_text_column(body, offs, j)
         else:
             cols[name] = vals[:, j]
+    stats["device_s"] = round(dev_time[0], 4)
+    stats["decode_s"] = round(time.perf_counter() - t0, 4)
+    stats["native_total_s"] = round(time.perf_counter() - t_all, 4)
+    stats["rows"] = nrows
+    stats["bytes"] = int(len(view))
+    last_parse_stats.clear()
+    last_parse_stats.update(stats)
     return names, cols
 
 
@@ -202,18 +314,23 @@ def parse_csv(path_or_buf, destination_frame: Optional[str] = None,
     become Python objects), then pandas' reader, then the stdlib fallback.
     """
     col_types = col_types or {}
+    last_parse_stats.clear()             # fallbacks leave no stale stats
     # read streams ONCE up front so the native attempt cannot exhaust a
-    # non-seekable input before a fallback runs
+    # non-seekable input before a fallback runs; paths are mmap'd inside
+    # the native pipeline (no full-file copy)
     source = path_or_buf
     raw: Optional[bytes] = None
-    if not isinstance(path_or_buf, str):
+    if isinstance(path_or_buf, bytes):
+        raw = source = path_or_buf
+    elif not isinstance(path_or_buf, str):
         raw = path_or_buf.read()
         if isinstance(raw, str):
             raw = raw.encode()
         source = raw
     names = cols = None
     try:
-        parsed = _parse_csv_native(source, header, sep, col_names)
+        parsed = _parse_csv_native(source, header, sep, col_names,
+                                   col_types=col_types)
         if parsed is not None:
             names, cols = parsed
     except Exception:
@@ -251,11 +368,27 @@ def parse_csv(path_or_buf, destination_frame: Optional[str] = None,
             sd = io.StringIO(raw.decode(errors="replace")) \
                 if raw is not None else path_or_buf
             names, cols = _parse_csv_stdlib(sd, header, sep, col_names)
-    vecs = [_column_to_vec(cols[n], n, col_types.get(n)) for n in names]
+    t0 = time.perf_counter()
+    vecs = [_assemble_vec(cols[n], n, col_types.get(n)) for n in names]
+    if last_parse_stats:
+        last_parse_stats["vec_s"] = round(time.perf_counter() - t0, 4)
+        from ..runtime.observability import record
+        record("parse", **last_parse_stats)
     key = destination_frame or dkv.make_key(
         os.path.basename(str(path_or_buf)) if isinstance(path_or_buf, str)
         else "frame")
     return Frame(names, vecs, key=key)
+
+
+def _assemble_vec(col, name: str, coltype: Optional[str]) -> Vec:
+    """Vec from one parsed column: device chunks concatenate in place
+    (their transfer already overlapped tokenization); host arrays go
+    through the type guesser."""
+    if isinstance(col, _DeviceChunks):
+        import jax.numpy as jnp
+        data = jnp.concatenate(list(col)) if len(col) > 1 else col[0]
+        return _device_numeric_vec(data)
+    return _column_to_vec(col, name, coltype)
 
 
 def _parse_csv_stdlib(path_or_buf, header, sep, col_names):
@@ -342,14 +475,16 @@ def parse_files(paths: Sequence[str],
                 chunksize: int = 1_000_000) -> Frame:
     """Parse many CSV shards into ONE Frame — MultiFileParseTask analog.
 
-    Each shard streams through pandas in ``chunksize``-row chunks.  Numeric
-    chunks are ``device_put`` immediately and the host copy dropped, so host
-    RSS stays bounded by ~chunksize rows for numeric data (the reference
-    keeps raw chunks in the DKV and parses in place —
-    ParseDataset.java:688).  Text/categorical columns accumulate host-side:
-    their global domain must be built before codes exist, mirroring the
-    reference's cluster-wide categorical domain merge
-    (ParseDataset.java:501-600).
+    Local uncompressed shards take the same ranged-parallel mmap pipeline
+    as ``parse_csv`` (``_parse_csv_native``): numeric columns arrive as
+    on-device chunks whose transfer overlapped tokenization.  Remote or
+    compressed shards stream through pandas in ``chunksize``-row chunks.
+    Numeric chunks are ``device_put`` immediately and the host copy
+    dropped, so host RSS stays bounded (the reference keeps raw chunks in
+    the DKV and parses in place — ParseDataset.java:688).
+    Text/categorical columns accumulate host-side: their global domain
+    must be built before codes exist, mirroring the reference's
+    cluster-wide categorical domain merge (ParseDataset.java:501-600).
     """
     import jax.numpy as jnp
     col_types = col_types or {}
@@ -372,7 +507,15 @@ def parse_files(paths: Sequence[str],
             raise ValueError(
                 f"shard schema mismatch: {df_names} vs {names}")
         for n in names:
-            arr = np.asarray(df_cols[n])
+            raw_col = df_cols[n]
+            if isinstance(raw_col, _DeviceChunks):
+                # ranged native pipeline already placed these on device
+                if host_chunks[n]:     # column went host in an earlier shard
+                    host_chunks[n].extend(np.asarray(c) for c in raw_col)
+                else:
+                    dev_chunks[n].extend(raw_col)
+                continue
+            arr = np.asarray(raw_col)
             want = col_types.get(n)
             if arr.dtype.kind in "if" and want in (None, T_NUM) \
                     and not host_chunks[n]:
@@ -383,7 +526,24 @@ def parse_files(paths: Sequence[str],
                     dev_chunks[n] = []
                 host_chunks[n].append(arr)
 
+    def _ranged_ok(uri: str) -> bool:
+        return "://" not in uri and not uri.lower().endswith(
+            (".gz", ".zip", ".bz2", ".xz"))
+
     for uri in paths:
+        if _ranged_ok(uri):
+            # pandas treats header=None as "every shard has a header":
+            # mirror that so engine choice can't change the result
+            parsed = None
+            try:
+                parsed = _parse_csv_native(
+                    uri, header in (None, True), sep, col_names,
+                    col_types=col_types)
+            except Exception:
+                parsed = None
+            if parsed is not None:
+                eat(*parsed)
+                continue
         fh = _open_decompressed(uri)
         if pd is not None:
             reader = pd.read_csv(

@@ -732,12 +732,18 @@ class GLM(ModelBuilder):
         if di.add_intercept:
             eta0 = fam.init_eta(y, w)
             beta[-1] = float(eta0[0])
-        if len(lambdas) > 1 and getattr(self, "_nonneg", None) is None:
-            # lambda path: one fused device program (no per-iteration
-            # round trips); the host loop below keeps per-iteration
-            # history + non_negative support for the single-solve case
-            runner = _make_path_runner(fam, l1_mode=p.alpha > 0,
-                                       max_iter=p.max_iterations)
+        if getattr(self, "_nonneg", None) is None:
+            # every fit (single lambda included) runs as one fused device
+            # program — the host loop below pays a device->host round trip
+            # per IRLS iteration (~67 ms on a tunnelled backend; VERDICT r5
+            # measured the plain fit 5x slower than the 100-lambda path
+            # because only lambda_search took this route).  The host loop
+            # remains only for non_negative (per-coordinate projection).
+            # l1_mode only when L1 is actually active: the CD sweep costs
+            # a while_loop per IRLS step that a plain solve doesn't.
+            runner = _make_path_runner(
+                fam, l1_mode=p.alpha > 0 and float(np.max(lambdas)) > 0,
+                max_iter=p.max_iterations)
             betas, devs, iters, gram_fin, dev_fin = jax.device_get(runner(
                 X, y, w, offset, jnp.asarray(lambdas, jnp.float32),
                 jnp.float32(p.alpha), jnp.asarray(penalize, jnp.float32),

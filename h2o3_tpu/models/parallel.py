@@ -32,12 +32,21 @@ from typing import Callable, List, Sequence
 
 
 def effective_parallelism(requested: int, n_tasks: int) -> int:
-    """Resolve the ``parallelism`` parameter (0 auto / 1 sequential / n)."""
+    """Resolve the ``parallelism`` parameter (0 auto / 1 sequential / n).
+
+    Auto is capped by the host core count: on a single-core host the
+    pipelining win does not exist, and concurrent eager dispatch from
+    several build threads has been observed to stall XLA:CPU's single
+    execution stream for minutes (explicit ``parallelism`` requests are
+    still honored as given).
+    """
     if n_tasks <= 1 or requested == 1:
         return 1
     if requested and requested > 1:
         return min(int(requested), n_tasks)
-    return min(n_tasks, int(os.environ.get("H2O3_PARALLEL_BUILDS", "4")))
+    auto = int(os.environ.get("H2O3_PARALLEL_BUILDS", 0)) \
+        or min(4, os.cpu_count() or 1)
+    return max(1, min(n_tasks, auto))
 
 
 def map_builds(thunks: Sequence[Callable[[], object]],

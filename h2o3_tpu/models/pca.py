@@ -65,6 +65,13 @@ class _ProjectionMixin:
         sd = jnp.asarray(self.output["_sd"], jnp.float32)
         return (X - mu[None, :]) * sd[None, :]
 
+    def _score_matrix(self, frame: Frame) -> jax.Array:
+        # _predict_raw projects in the fitted transform's space; generic
+        # callers (StackedEnsemble level-one assembly, base scorer) must
+        # feed it the same standardized matrix predict() uses, or stacked
+        # PCA/SVD columns disagree with every exported representation
+        return self._std_matrix(frame)
+
 
 class PCAModel(_ProjectionMixin, Model):
     algo = "pca"

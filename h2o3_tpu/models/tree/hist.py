@@ -25,11 +25,29 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:                       # jax<0.5: experimental namespace,
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    def shard_map(*args, check_vma=None, **kw):   # check_vma spelled check_rep
+        if check_vma is not None:
+            kw["check_rep"] = check_vma
+        return _shard_map_exp(*args, **kw)
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ...runtime.cluster import cluster, ROW_AXIS
+
+
+def _row_sds(shape, dtype):
+    """ShapeDtypeStruct carrying the rows-varying VMA mark; jax<0.5 has
+    no VMA typing, where the plain struct is equivalent."""
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype,
+                                    vma=frozenset({ROW_AXIS}))
+    except TypeError:
+        return jax.ShapeDtypeStruct(shape, dtype)
 
 def _make_pallas_hist(L: int, F: int, B: int, n_local: int,
                       interpret: bool = False, precision: str = "bf16",
@@ -140,8 +158,7 @@ def _make_pallas_hist(L: int, F: int, B: int, n_local: int,
             ],
             out_specs=pl.BlockSpec((n_fb * FBT, L3), lambda i, j: (0, 0),
                                    memory_space=pltpu.VMEM),
-            out_shape=jax.ShapeDtypeStruct((n_fb * FBT, L3), jnp.float32,
-                                           vma=frozenset({ROW_AXIS})),
+            out_shape=_row_sds((n_fb * FBT, L3), jnp.float32),
             scratch_shapes=[pltpu.VMEM((R, L3), dt)],
             interpret=interpret,
         )
@@ -157,8 +174,7 @@ def _make_pallas_hist(L: int, F: int, B: int, n_local: int,
             ],
             out_specs=pl.BlockSpec((FBT, L3), lambda j, i: (j, 0),
                                    memory_space=pltpu.VMEM),
-            out_shape=jax.ShapeDtypeStruct((n_fb * FBT, L3), jnp.float32,
-                                           vma=frozenset({ROW_AXIS})),
+            out_shape=_row_sds((n_fb * FBT, L3), jnp.float32),
             interpret=interpret,
         )
 
@@ -285,8 +301,7 @@ def _make_pallas_varbin_hist(L: int, F: int, bin_counts, B: int,
         ],
         out_specs=pl.BlockSpec((Q8, L3), lambda i: (0, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((Q8, L3), jnp.float32,
-                                       vma=frozenset({ROW_AXIS})),
+        out_shape=_row_sds((Q8, L3), jnp.float32),
         interpret=interpret,
     )
 
@@ -389,7 +404,8 @@ def _make_einsum_hist(L: int, F: int, B: int, n_local: int, planes: int = 3):
             acc = acc + jnp.einsum("rsl,frb->slfb", PS, OH)
             return acc, None
         H0 = jnp.zeros((planes, L, F, B), jnp.float32)
-        H0 = jax.lax.pcast(H0, (ROW_AXIS,), to='varying')
+        if hasattr(jax.lax, "pcast"):     # jax<0.5 has no VMA typing
+            H0 = jax.lax.pcast(H0, (ROW_AXIS,), to='varying')
         H, _ = jax.lax.scan(body, H0, (codes, leaf, S))
         return H
 
@@ -515,8 +531,7 @@ def _make_pallas_fine_hist(L: int, F: int, W: int, K: int, nbins: int,
         ],
         out_specs=pl.BlockSpec((TF * K * W, L3), lambda j, i: (j, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((n_ft * TF * K * W, L3), jnp.float32,
-                                       vma=frozenset({ROW_AXIS})),
+        out_shape=_row_sds((n_ft * TF * K * W, L3), jnp.float32),
         interpret=interpret,
     )
 
@@ -578,7 +593,8 @@ def _make_einsum_fine_hist(L: int, F: int, W: int, K: int, nbins: int,
             acc = acc + jnp.einsum("rsl,rfkt->slfkt", PS, OH)
             return acc, None
         H0 = jnp.zeros((3, L, F, K, W), jnp.float32)
-        H0 = jax.lax.pcast(H0, (ROW_AXIS,), to='varying')
+        if hasattr(jax.lax, "pcast"):     # jax<0.5 has no VMA typing
+            H0 = jax.lax.pcast(H0, (ROW_AXIS,), to='varying')
         H, _ = jax.lax.scan(body, H0, (codes, leaf, S))
         return H
 

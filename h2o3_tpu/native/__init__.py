@@ -5,6 +5,15 @@ water/parser/CsvParser fast-path analog): numeric cells go straight into
 column-major double buffers with no per-cell Python objects; text cells
 are flagged with byte ranges for the host-side categorical/string pass.
 
+The buffer API is pointer-based (``c_void_p`` + length), so the same
+entry points tokenize plain ``bytes`` AND zero-copy ``mmap`` views (a
+1-D ``np.uint8`` array over the mapping) — the parse pipeline never
+materializes a second copy of the file.  ``parse_view`` fans
+newline-aligned byte ranges over a bounded thread pool (ctypes releases
+the GIL, so ranges tokenize truly in parallel) and invokes an optional
+``on_range`` callback as each range lands, letting the caller overlap
+device transfer of early ranges with tokenization of later ones.
+
 The shared object builds on first use with the in-image g++ (cached next
 to the source); every caller must handle ``load() is None`` and fall back
 to the portable tokenizer — builds can be unavailable in stripped
@@ -17,7 +26,7 @@ import ctypes
 import os
 import subprocess
 import threading
-from typing import Optional
+from typing import Callable, Optional
 
 import numpy as np
 
@@ -56,18 +65,18 @@ def load():
             return None
         lib.fastcsv_parse.restype = ctypes.c_longlong
         lib.fastcsv_parse.argtypes = [
-            ctypes.c_char_p, ctypes.c_longlong, ctypes.c_char,
+            ctypes.c_void_p, ctypes.c_longlong, ctypes.c_char,
             ctypes.c_int, ctypes.c_longlong,
             ctypes.POINTER(ctypes.c_double),
             ctypes.POINTER(ctypes.c_uint8),
             ctypes.POINTER(ctypes.c_int32),
             ctypes.POINTER(ctypes.c_longlong)]
         lib.fastcsv_ncols.restype = ctypes.c_int
-        lib.fastcsv_ncols.argtypes = [ctypes.c_char_p, ctypes.c_longlong,
+        lib.fastcsv_ncols.argtypes = [ctypes.c_void_p, ctypes.c_longlong,
                                       ctypes.c_char]
         lib.fastcsv_parse_range.restype = ctypes.c_longlong
         lib.fastcsv_parse_range.argtypes = [
-            ctypes.c_char_p, ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.c_void_p, ctypes.c_longlong, ctypes.c_longlong,
             ctypes.c_char, ctypes.c_int, ctypes.c_longlong,
             ctypes.c_longlong, ctypes.c_longlong,
             ctypes.POINTER(ctypes.c_double),
@@ -76,21 +85,89 @@ def load():
             ctypes.POINTER(ctypes.c_longlong)]
         lib.fastcsv_count_lines.restype = ctypes.c_longlong
         lib.fastcsv_count_lines.argtypes = [
-            ctypes.c_char_p, ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.c_void_p, ctypes.c_longlong, ctypes.c_longlong,
             ctypes.POINTER(ctypes.c_int)]
+        lib.fastcsv_find_newline.restype = ctypes.c_longlong
+        lib.fastcsv_find_newline.argtypes = [
+            ctypes.c_void_p, ctypes.c_longlong, ctypes.c_longlong]
+        lib.fastcsv_count_quotes.restype = ctypes.c_longlong
+        lib.fastcsv_count_quotes.argtypes = [
+            ctypes.c_void_p, ctypes.c_longlong, ctypes.c_longlong]
+        lib.fastcsv_gather_cells.restype = None
+        lib.fastcsv_gather_cells.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_longlong,
+            ctypes.c_int, ctypes.c_void_p]
         _lib = lib
         return _lib
 
 
-def parse_bytes(data: bytes, sep: str = ",", ncols: Optional[int] = None,
-                threads: Optional[int] = None):
-    """Tokenize a CSV byte buffer natively, multi-threaded when safe.
+def _as_view(data) -> np.ndarray:
+    """Zero-copy 1-D uint8 view over bytes / mmap / numpy input."""
+    if isinstance(data, np.ndarray):
+        if data.dtype != np.uint8 or data.ndim != 1 \
+                or not data.flags.c_contiguous:
+            raise ValueError("parse view must be a contiguous 1-D uint8 "
+                             "array")
+        return data
+    return np.frombuffer(data, dtype=np.uint8)
 
-    Quote-free buffers split at newline boundaries into per-thread byte
-    ranges parsed concurrently (ctypes releases the GIL) — the
-    MultiFileParseTask chunk layout (ParseDataset.java:688) on one host.
-    A buffer containing any double-quote parses single-threaded: quoted
-    cells may hide newlines, so ranges cannot be aligned safely.
+
+def gather_cells(view, starts: np.ndarray, ends: np.ndarray,
+                 width: int) -> Optional[np.ndarray]:
+    """Gather variable-length cells into a fixed-width ``|S width|`` column.
+
+    Returns an ``[n]``-shaped bytes array (NUL-padded) whose vectorized
+    ``np.unique``/compare path replaces the per-cell Python decode loop,
+    or None when the native library is unavailable.
+    """
+    lib = load()
+    if lib is None:
+        return None
+    view = _as_view(view)
+    starts = np.ascontiguousarray(starts, dtype=np.int32)
+    ends = np.ascontiguousarray(ends, dtype=np.int32)
+    n = len(starts)
+    width = max(int(width), 1)
+    out = np.empty(n * width, dtype=np.uint8)
+    lib.fastcsv_gather_cells(
+        view.ctypes.data,
+        starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ends.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        n, width, out.ctypes.data)
+    return out.view(dtype=f"S{width}")
+
+
+def ncols_of(view, sep: str = ",") -> Optional[int]:
+    lib = load()
+    if lib is None:
+        return None
+    view = _as_view(view)
+    return int(lib.fastcsv_ncols(view.ctypes.data, len(view),
+                                 sep.encode()[0:1]))
+
+
+def parse_view(view, sep: str = ",", ncols: Optional[int] = None,
+               threads: Optional[int] = None,
+               on_range: Optional[Callable] = None,
+               stats: Optional[dict] = None):
+    """Tokenize a CSV byte view natively, multi-threaded when safe.
+
+    ``view`` is a contiguous 1-D uint8 array — over ``bytes`` or an mmap,
+    so no full-file copy is ever made.  Quote-free buffers split at
+    newline boundaries into per-thread byte ranges parsed concurrently
+    (ctypes releases the GIL) — the MultiFileParseTask chunk layout
+    (ParseDataset.java:688) on one host.  A buffer containing any
+    double-quote parses single-threaded: quoted cells may hide newlines,
+    so ranges cannot be aligned safely.
+
+    ``on_range(row_lo, nrows, values_T, flags_T)`` fires on the calling
+    thread as each range's tokenization completes (in completion order),
+    with zero-copy row-major views of that range's rows — callers use it
+    to start device transfers of early ranges while later ranges still
+    tokenize.  Ranges whose callbacks already fired are never invalidated:
+    a misaligned range (over-wide row mid-buffer) aborts the whole parse
+    (returns None) and callers fall back to the strict engines.
 
     Returns (values [rows, ncols] f64 with NaN for non-numeric, flags
     [rows, ncols] uint8 text markers, offsets [rows, ncols, 2] byte
@@ -100,49 +177,84 @@ def parse_bytes(data: bytes, sep: str = ",", ncols: Optional[int] = None,
     lib = load()
     if lib is None:
         return None
-    n = len(data)
+    view = _as_view(view)
+    n = len(view)
     if n > (1 << 31) - 16:               # int32 offsets: pre-split or defer
         return None
+    addr = view.ctypes.data
     sepc = sep.encode()[0:1]
     if ncols is None:
-        ncols = int(lib.fastcsv_ncols(data, n, sepc))
+        ncols = int(lib.fastcsv_ncols(addr, n, sepc))
+    import time as _time
+    t0 = _time.perf_counter()
     has_quotes = ctypes.c_int(0)
-    total_lines = int(lib.fastcsv_count_lines(data, 0, n,
+    total_lines = int(lib.fastcsv_count_lines(addr, 0, n,
                                               ctypes.byref(has_quotes)))
+    t_scan = _time.perf_counter() - t0
     max_rows = max(total_lines + 2, 4)
+    # np.empty everywhere: every returned row slot is written by the
+    # tokenizer (missing trailing columns included), and zero-filling
+    # ~2.6x the input volume costs real first-touch page time at scale
     values = np.empty(ncols * max_rows, np.float64)
-    flags = np.zeros(ncols * max_rows, np.uint8)
-    offsets = np.zeros(ncols * max_rows * 2, np.int32)
+    flags = np.empty(ncols * max_rows, np.uint8)
+    offsets = np.empty(ncols * max_rows * 2, np.int32)
     vp = values.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
     fp = flags.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
     op = offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+    V = values.reshape(ncols, max_rows)
+    F = flags.reshape(ncols, max_rows)
+    O = offsets.reshape(ncols, max_rows, 2)
 
     if threads is None:
-        threads = min(16, os.cpu_count() or 1)
-    if has_quotes.value or threads <= 1 or n < (1 << 22):
+        threads = int(os.environ.get("H2O3_PARSE_THREADS", 0)) \
+            or min(16, os.cpu_count() or 1)
+    # buffers below this size take the single-range path (pool overhead
+    # dominates); tests shrink it to force ranged parsing on tiny files
+    range_min = int(os.environ.get("H2O3_PARSE_RANGE_MIN", 1 << 22))
+    t0 = _time.perf_counter()
+    if threads <= 1 or n < range_min:
         consumed = ctypes.c_longlong(0)
         rows = int(lib.fastcsv_parse_range(
-            data, 0, n, sepc, ncols, max_rows, 0, max_rows, vp, fp, op,
+            addr, 0, n, sepc, ncols, max_rows, 0, max_rows, vp, fp, op,
             ctypes.byref(consumed)))
         keep = [(0, rows)]
         tail = int(consumed.value)
+        if on_range is not None and rows > 0:
+            on_range(0, rows, V.T[:rows], F.T[:rows])
     else:
-        # newline-aligned byte ranges
+        # newline-aligned byte ranges (per-process span logic from
+        # dparse._byte_assignments, applied intra-host: even byte cuts,
+        # each aligned forward to the next line start)
         bounds = [0]
         for t in range(1, threads):
-            pos = data.find(b"\n", n * t // threads)
+            pos = int(lib.fastcsv_find_newline(addr, n * t // threads, n))
             pos = n if pos < 0 else pos + 1
             if pos > bounds[-1]:
                 bounds.append(pos)
         bounds.append(n)
+        if has_quotes.value and len(bounds) > 2:
+            # quoted cells may hide newlines: a cut whose quote-count
+            # prefix parity is ODD sits inside a quoted field (the ""
+            # escape preserves parity) — merge it into the previous
+            # range.  Benign quoting (no embedded newlines) keeps every
+            # cut, so writer-quoted files still tokenize in parallel.
+            safe = [0]
+            parity = 0
+            for k in range(1, len(bounds) - 1):
+                parity += int(lib.fastcsv_count_quotes(
+                    addr, bounds[k - 1], bounds[k]))
+                if parity % 2 == 0:
+                    safe.append(bounds[k])
+            safe.append(n)
+            bounds = safe
         ranges = [(bounds[i], bounds[i + 1])
                   for i in range(len(bounds) - 1)
                   if bounds[i + 1] > bounds[i]]
         # row_base per range = cumulative newline counts (upper bound:
         # blank lines produce gaps, compacted below)
-        counts = [int(lib.fastcsv_count_lines(data, a, b, None))
+        counts = [int(lib.fastcsv_count_lines(addr, a, b, None))
                   for a, b in ranges]
-        counts[-1] += 1 if not data.endswith(b"\n") else 0
+        counts[-1] += 0 if view[-1] == 0x0A else 1
         bases = np.concatenate([[0], np.cumsum(counts)])[:-1]
 
         import concurrent.futures
@@ -151,13 +263,23 @@ def parse_bytes(data: bytes, sep: str = ",", ncols: Optional[int] = None,
             a, b = ranges[k]
             consumed = ctypes.c_longlong(0)
             got = int(lib.fastcsv_parse_range(
-                data, a, b, sepc, ncols, max_rows, int(bases[k]),
+                addr, a, b, sepc, ncols, max_rows, int(bases[k]),
                 int(bases[k]) + counts[k], vp, fp, op,
                 ctypes.byref(consumed)))
-            return got, int(consumed.value)
+            return k, got, int(consumed.value)
 
+        results = [None] * len(ranges)
         with concurrent.futures.ThreadPoolExecutor(len(ranges)) as ex:
-            results = list(ex.map(work, range(len(ranges))))
+            futs = [ex.submit(work, k) for k in range(len(ranges))]
+            for fut in concurrent.futures.as_completed(futs):
+                k, got, consumed_k = fut.result()
+                results[k] = (got, consumed_k)
+                if on_range is not None and got > 0:
+                    # a later-discovered misaligned range aborts the whole
+                    # parse (None below), so eagerly-fired chunks can never
+                    # leak into a successful result they don't belong to
+                    b0 = int(bases[k])
+                    on_range(b0, got, V.T[b0:b0 + got], F.T[b0:b0 + got])
         keep = [(int(bases[k]), results[k][0]) for k in range(len(ranges))]
         # a range that stopped early (over-wide row) invalidates the
         # later ranges' row_bases — fall back to the strict engines
@@ -165,12 +287,14 @@ def parse_bytes(data: bytes, sep: str = ",", ncols: Optional[int] = None,
             if results[k][1] != ranges[k][1]:
                 return None
         tail = results[-1][1]
+    if stats is not None:
+        stats["scan_s"] = round(t_scan, 4)
+        stats["tokenize_s"] = round(_time.perf_counter() - t0, 4)
+        stats["ranges"] = len(keep)
+        stats["has_quotes"] = bool(has_quotes.value)
     keep = [(b, c) for b, c in keep if c > 0]
     contiguous = all(keep[i][0] + keep[i][1] == keep[i + 1][0]
                      for i in range(len(keep) - 1))
-    V = values.reshape(ncols, max_rows)
-    F = flags.reshape(ncols, max_rows)
-    O = offsets.reshape(ncols, max_rows, 2)
     if keep and contiguous:
         # the common case (no blank lines): strided VIEWS, no gather copy
         a = keep[0][0]
@@ -180,3 +304,14 @@ def parse_bytes(data: bytes, sep: str = ",", ncols: Optional[int] = None,
         if keep else np.zeros(0, np.int64)
     return (V.T[rows_idx], F.T[rows_idx],
             O.transpose(1, 0, 2)[rows_idx], tail)
+
+
+def parse_bytes(data: bytes, sep: str = ",", ncols: Optional[int] = None,
+                threads: Optional[int] = None):
+    """Tokenize a CSV byte buffer natively — ``parse_view`` over bytes.
+
+    Kept as the stable entry point for callers holding materialized
+    buffers (dparse spans, REST PostFile bodies); the mmap'd file path
+    goes straight to ``parse_view`` with no copy.
+    """
+    return parse_view(_as_view(data), sep, ncols=ncols, threads=threads)

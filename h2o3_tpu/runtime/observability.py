@@ -115,7 +115,10 @@ def network_test(sizes=(1_024, 1_048_576, 16_777_216)) -> List[Dict]:
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:                   # jax<0.5: experimental namespace
+        from jax.experimental.shard_map import shard_map
     from .cluster import cluster, ROW_AXIS
 
     cl = cluster()

@@ -50,15 +50,22 @@ def rng():
 # the full suite remains the merge gate.
 _SLOW_MODULES = {
     "test_trees", "test_trees_ext", "test_hist_kernel", "test_multiprocess",
-    "test_deeplearning", "test_tree_explain", "test_orchestration",
+    "test_deeplearning", "test_tree_explain",
     "test_algos3",
 }
+# test_orchestration left the set: its tests now run tiny shapes by
+# default with the original full shapes behind @pytest.mark.heavy, so the
+# fast variants contribute tier-1 coverage.
 
 
 def pytest_collection_modifyitems(config, items):
     for item in items:
         mod = item.nodeid.split("::")[0].rsplit("/", 1)[-1].removesuffix(".py")
         if mod in _SLOW_MODULES:
+            item.add_marker(pytest.mark.slow)
+        # heavy tests never belong in the smoke tier either — implying
+        # `slow` keeps `-m 'not slow'` runs inside their budget too
+        if item.get_closest_marker("heavy") is not None:
             item.add_marker(pytest.mark.slow)
 
 

@@ -97,8 +97,11 @@ def test_unknown_routes_404(cl, server):
     assert e.value.code == 404
 
 
+@pytest.mark.heavy
 def test_deploy_serve_launcher(cl, tmp_path):
-    """The launcher boots the runtime + REST and shuts down on SIGTERM."""
+    """The launcher boots the runtime + REST and shuts down on SIGTERM.
+
+    heavy: boots a full second interpreter + jax runtime (up to 90 s)."""
     import json
     import os
     import signal
@@ -177,10 +180,15 @@ def test_about_config_and_extensions(cl, monkeypatch):
         cfg.reload()
 
 
+@pytest.mark.heavy
 def test_full_remote_workflow(cl, server, rng, tmp_path):
     """The whole h2o-py user journey purely over HTTP via client.py:
     import -> munge (/99/Rapids) -> grid -> automl -> explain ->
-    checkpoint -> artifact download/upload round trips."""
+    checkpoint -> artifact download/upload round trips.
+
+    heavy: trains ~10 models over HTTP (~2+ min CPU);
+    test_remote_workflow_fast covers the same route surface at tiny
+    shape inside the tier-1 budget."""
     from h2o3_tpu import client as h2oc
     n = 400
     X = rng.normal(size=(n, 3))
@@ -262,6 +270,47 @@ def test_full_remote_workflow(cl, server, rng, tmp_path):
     del munged
 
 
+def test_remote_workflow_fast(cl, server, rng, tmp_path):
+    """Tiny-shape variant of test_full_remote_workflow: the same client
+    route surface (import -> rapids -> metadata -> grid -> checkpoint ->
+    artifact round trips) in seconds, so tier-1 keeps the coverage."""
+    from h2o3_tpu import client as h2oc
+    n = 120
+    X = rng.normal(size=(n, 2))
+    y = (X[:, 0] > 0).astype(int)
+    csv = tmp_path / "wf_fast.csv"
+    with open(csv, "w") as f:
+        f.write("a,b,y\n")
+        for i in range(n):
+            f.write(f"{X[i,0]:.5f},{X[i,1]:.5f},"
+                    f"{'yes' if y[i] else 'no'}\n")
+    conn = h2oc.connect(server.url)
+    fr = conn.import_file(str(csv), destination_frame="wf_fast")
+    (fr.lazy()["a"] * 2.0).execute()         # rapids round trip
+    mb = conn.model_builders("gbm")
+    assert any(p["name"] == "ntrees" for p in mb["gbm"]["parameters"])
+    grid = conn.grid("gbm", {"max_depth": [2, 3]}, fr,
+                     response_column="y", ntrees=2, seed=1)
+    assert len(grid.model_ids) == 2
+    best = grid.best_model
+    assert grid.refresh().model_ids == grid.model_ids
+    m2 = conn.train("gbm", fr, response_column="y", ntrees=1, seed=1,
+                    max_depth=2)
+    m3 = conn.train("gbm", fr, response_column="y", ntrees=3, seed=1,
+                    max_depth=2, checkpoint=m2.key)
+    assert m3.schema["output"]["ntrees_trained"] == 3
+    vi = best.varimp()
+    assert vi and {"variable", "relative_importance"} <= set(vi[0])
+    local = tmp_path / "model.bin"
+    best.download(str(local))
+    re_up = conn.upload_model(str(local))
+    assert re_up.predict(fr).nrows == n
+    mojo = tmp_path / "model.zip"
+    best.download_mojo(str(mojo))
+    import zipfile
+    assert zipfile.is_zipfile(mojo)
+
+
 def test_model_upload_rejects_pickle_gadgets(cl, server, tmp_path):
     """POST /3/Models.upload.bin must refuse pickles that reference
     globals outside the model-artifact allowlist (RCE gadget defense)."""
@@ -314,7 +363,7 @@ def test_model_java_and_metrics_stored(cl, server):
     out = _post(server, "/3/ModelBuilders/gbm",
                 {"training_frame": "rest5_tf", "response_column": "y",
                  "ntrees": 3, "max_depth": 3})
-    mid = out["job"]["dest"]
+    mid = out["job"]["dest"]["name"]
     with urllib.request.urlopen(
             server.url + f"/3/Models.java/{mid}") as r:
         src = r.read().decode()
@@ -338,7 +387,7 @@ def test_word2vec_synonyms_over_rest(cl, server):
     out = _post(server, "/3/ModelBuilders/word2vec",
                 {"training_frame": "rest5_tok", "vec_size": 8,
                  "epochs": 1})
-    mid = out["job"]["dest"]
+    mid = out["job"]["dest"]["name"]
     syn = _get(server,
                f"/3/Word2VecSynonyms?model={mid}&word=red&count=3")
     assert len(syn["synonyms"]) == 3 and "red" not in syn["synonyms"]
@@ -353,7 +402,7 @@ def test_grid_export_import_over_rest(cl, server, tmp_path):
     out = _post(server, "/99/Grid/gbm",
                 {"training_frame": "rest5_gf", "response_column": "y",
                  "hyper_parameters": {"max_depth": [2, 3]}, "ntrees": 2})
-    gid = out["grid_id"]
+    gid = out["grid_id"]["name"]
     _post(server, f"/99/Grids/{gid}/export",
           {"export_dir": str(tmp_path)})
     imp = _post(server, "/99/Grids.bin/import",
