@@ -270,16 +270,8 @@ def bench_parse(parse_csv, tmpdir):
     return dt, mb
 
 
-def _sync(frame):
-    """Force completion of a frame's device work (async dispatch barrier).
-
-    A one-element fetch of each output column blocks until its whole buffer
-    exists; block_until_ready does NOT synchronize over the axon tunnel
-    (PROFILE.md), so a tiny real fetch is the reliable sync point.
-    """
-    for v in frame.vecs:
-        if v.data is not None:
-            np.asarray(v.data[:1])
+# tunnel-safe small-fetch sync, shared with bench_pieces.py (bench_util.py)
+from bench_util import sync_frame as _sync  # noqa: E402
 
 
 def bench_rapids(Frame, sort, merge):

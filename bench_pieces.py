@@ -53,27 +53,11 @@ def main():
                           "platform": platform, "rows": n, **extra}),
               flush=True)
 
-    def sync(x):
-        np.asarray(jax.device_get(jnp.ravel(x)[:1]))
+    # shared tunnel-safe sync + fori_loop amortization (bench_util.py)
+    from bench_util import timed_amortized
 
     def timed(fn_build, *args):
-        """fn_build(acc, *args) -> new scalar acc; time REPS dependent
-        iterations inside one jit."""
-
-        @jax.jit
-        def reps(*a):
-            def body(i, acc):
-                return fn_build(acc, *a)
-            return jax.lax.fori_loop(0, REPS, body, jnp.float32(0.0))
-
-        out = reps(*args)          # compile + warmup
-        sync(out)
-        out = reps(*args)          # absorb first-exec anomaly
-        sync(out)
-        t0 = time.perf_counter()
-        out = reps(*args)
-        sync(out)
-        return (time.perf_counter() - t0) / REPS * 1e3
+        return timed_amortized(fn_build, *args, reps=REPS)
 
     # device-generated inputs (no host transfer, producer-fused layouts)
     key = jax.random.PRNGKey(0)
@@ -161,6 +145,114 @@ def main():
                               "see PROFILE.md round-2 table"}), flush=True)
 
 
+def hist_piece():
+    """Standalone per-level histogram comparison: uniform vs varbin vs
+    smaller-sibling subtraction (hist.make_subtract_level_fn), without the
+    ~1091 s full bench.
+
+    Per level d (children L = 2^d) three JSON lines land:
+      - ``uniform_L*``   — the uniform kernel over ALL rows at the parent
+        slot count (what the pre-varbin driver paid per level),
+      - ``varbin_L*``    — the varbin kernel over ALL rows (the masked
+        left-sibling path every level below the root paid before this
+        round),
+      - ``subtract_L*``  — compaction + varbin over the <= N/2
+        smaller-sibling prefix + reconstruction (the shipping default),
+    plus a ``hist_summary`` line with the varbin/subtract speedup per
+    level.  Skews the per-level splits (70/30) so the compacted side is a
+    realistic minority, and chains the carries level to level exactly like
+    the tree driver.
+
+    Usage (chip): python bench_pieces.py hist
+    CPU smoke:    JAX_PLATFORMS=cpu H2O3_PIECES_ROWS=200000 \\
+                  python bench_pieces.py hist
+    (CPU runs the same Pallas kernels in interpret mode — relative
+    numbers are methodology checks, not projections; see PROFILE.md.)
+    """
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import jax
+    import jax.numpy as jnp
+
+    import h2o3_tpu
+    from bench_util import timed_amortized
+    cl = h2o3_tpu.init()
+    platform = jax.devices()[0].platform
+    n = N_ROWS - (N_ROWS % (512 * cl.n_row_shards))
+
+    from h2o3_tpu.models.tree.hist import (make_hist_fn, make_varbin_hist_fn,
+                                           make_subtract_level_fn,
+                                           offset_codes)
+
+    def emit(**rec):
+        print(json.dumps({**rec, "platform": platform, "rows": n}),
+              flush=True)
+
+    force = "" if platform == "tpu" else "pallas_interpret"
+    key = jax.random.PRNGKey(0)
+    ks = jax.random.split(key, 16)
+    codes = jnp.stack([
+        jax.random.randint(ks[f], (n,), 0, min(bc, NBINS), dtype=jnp.int32)
+        for f, bc in enumerate(BIN_COUNTS)], axis=0)
+    gcodes = offset_codes(codes, BIN_COUNTS, NBINS)
+    g = jax.random.normal(ks[8], (n,), jnp.float32)
+    h = jnp.abs(jax.random.normal(ks[9], (n,), jnp.float32)) + 0.1
+    w = jnp.ones((n,), jnp.float32)
+
+    # consistent leaf chain (child of the previous level's leaf, 70/30
+    # split) + the subtraction carries, built once outside the timed loop
+    leaves, carries = [jnp.zeros(n, jnp.int32)], []
+    Hg, carry = make_subtract_level_fn(
+        0, F, B, n, bin_counts=BIN_COUNTS, force_impl=force)(
+        gcodes, leaves[0], g, h, w)
+    carries.append(carry)
+    summary = {}
+    for d in range(1, 6):
+        Lp = 2 ** (d - 1)
+        bit = (jax.random.uniform(ks[10 + (d % 6)], (n,)) < 0.3) \
+            .astype(jnp.int32)
+        leaf = 2 * leaves[-1] + bit
+        leaves.append(leaf)
+
+        ufn = make_hist_fn(Lp, F, B, n, force_impl=force, precision="f32") \
+            if force else make_hist_fn(Lp, F, B, n)
+
+        def run_u(acc, lf, _fn=ufn):
+            H = _fn(codes, lf, g + acc * 0.0, h, w)
+            return H[0, 0, 0, 0] * 1e-30
+
+        ms_u = timed_amortized(run_u, leaf >> 1, reps=REPS)
+        emit(piece=f"uniform_L{2 ** d}", ms=round(ms_u, 3))
+
+        vfn = make_varbin_hist_fn(Lp, F, BIN_COUNTS, B, n, force_impl=force)
+
+        def run_v(acc, lf, _fn=vfn):
+            H = _fn(gcodes, lf, g + acc * 0.0, h, w)
+            return H[0, 0, 0, 0] * 1e-30
+
+        ms_v = timed_amortized(run_v, leaf >> 1, reps=REPS)
+        emit(piece=f"varbin_L{2 ** d}", ms=round(ms_v, 3),
+             kernel="all-rows (masked-sibling path)")
+
+        sfn = make_subtract_level_fn(d, F, B, n, bin_counts=BIN_COUNTS,
+                                     force_impl=force)
+
+        def run_s(acc, lf, cr, _fn=sfn):
+            H, _ = _fn(gcodes, lf, g + acc * 0.0, h, w, cr)
+            return H[0, 0, 0, 0] * 1e-30
+
+        ms_s = timed_amortized(run_s, leaf, carries[-1], reps=REPS)
+        emit(piece=f"subtract_L{2 ** d}", ms=round(ms_s, 3),
+             kernel="compact+varbin+reconstruct")
+        summary[f"L{2 ** d}"] = round(ms_v / ms_s, 2) if ms_s > 0 else None
+        _, carry = sfn(gcodes, leaf, g, h, w, carries[-1])
+        carries.append(carry)
+
+    emit(piece="hist_summary", varbin_over_subtract=summary,
+         note="ratio > 1: subtraction beats the all-rows masked path")
+
+
 def parse_piece():
     """Standalone ingest bench: bench.py's 568 MB parse line (same file,
     same warmup methodology) without the ~1091 s full suite.
@@ -195,5 +287,7 @@ def parse_piece():
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "parse":
         parse_piece()
+    elif len(sys.argv) > 1 and sys.argv[1] == "hist":
+        hist_piece()
     else:
         main()

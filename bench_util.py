@@ -1,0 +1,64 @@
+"""Shared bench timing/sync helpers for bench.py and bench_pieces.py.
+
+One home for the two hard-won measurement rules (PROFILE.md round-2
+methodology), previously copy-pasted across the bench entry points:
+
+ - **Sync is a tiny REAL device->host fetch.**  ``jax.block_until_ready``
+   does NOT synchronize over the axon tunnel — PROFILE.md measured a
+   1.1 TFLOP matmul at "0.03 ms" with it — so every sync point here
+   fetches one element, which blocks until the whole buffer exists.
+ - **Per-dispatch overhead is ~4 ms on the tunnel**: single-call timings
+   are meaningless below ~10 ms.  ``timed_amortized`` runs REPS dependent
+   invocations inside ONE jit (the carry feeds back into an operand so
+   XLA cannot CSE or reorder the calls) and divides.
+
+jax imports stay inside the functions: bench.py's orchestrator must be
+importable before any backend is initialized (it rewrites JAX_PLATFORMS
+for the worker subprocess).
+"""
+
+import time
+
+
+def device_sync(x):
+    """Block until ``x``'s buffer exists: a one-element device->host fetch,
+    the tunnel-safe sync point."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    np.asarray(jax.device_get(jnp.ravel(x)[:1]))
+
+
+def sync_frame(frame):
+    """Force completion of a frame's device work (async dispatch barrier):
+    one tiny fetch per output column."""
+    for v in frame.vecs:
+        if v.data is not None:
+            device_sync(v.data)
+
+
+def timed_amortized(fn_build, *args, reps: int = 20) -> float:
+    """Milliseconds per invocation of ``fn_build(acc, *args) -> new acc``,
+    timed as ``reps`` dependent iterations inside one jit.
+
+    Runs the jitted loop three times: compile+warmup, a second pass to
+    absorb the remote backend's first-execution anomaly (~6-17 s observed
+    after each fresh compile on the tunnel), then the timed pass.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def _reps(*a):
+        def body(i, acc):
+            return fn_build(acc, *a)
+        return jax.lax.fori_loop(0, reps, body, jnp.float32(0.0))
+
+    out = _reps(*args)            # compile + warmup
+    device_sync(out)
+    out = _reps(*args)            # absorb first-exec anomaly
+    device_sync(out)
+    t0 = time.perf_counter()
+    out = _reps(*args)
+    device_sync(out)
+    return (time.perf_counter() - t0) / reps * 1e3

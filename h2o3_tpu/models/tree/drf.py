@@ -29,7 +29,8 @@ from ..scorekeeper import stop_early, metric_direction
 from .binning import fit_bins, edges_matrix
 from .shared import (SharedTree, SharedTreeModel, SharedTreeParameters,
                      StackedTrees, TreeList, chunk_schedule,
-                     make_tree_scan_fn, traverse_jit)
+                     make_tree_scan_fn, resolve_hist_mode,
+                     run_hist_crosscheck, traverse_jit)
 from ...metrics.core import make_metrics
 
 
@@ -159,11 +160,24 @@ class DRF(SharedTree):
         # per-tree keys are reused across classes so every class sees the
         # same bootstrap sample per iteration (DRF.java samples once/tree).
         from .shared import use_hier_split_search
+        hist_mode = resolve_hist_mode(p)
+        if hist_mode == "check":
+            # driver assert: the forest's mean-fit gradients (g=-y, h=1)
+            # through both histogram paths must grow the same tree
+            run_hist_crosscheck(
+                wcodes, -targets[0] * w, w, w, edges_mat, rng,
+                max_depth=p.max_depth, nbins=p.nbins, F=Fw, n_padded=N,
+                bin_counts=wbin_counts, plan=plan,
+                reg_lambda=p.reg_lambda, min_rows=p.min_rows,
+                min_split_improvement=p.min_split_improvement,
+                learn_rate=1.0, reg_alpha=p.reg_alpha, gamma=p.gamma,
+                min_child_weight=p.min_child_weight)
+            hist_mode = "subtract"
         scan_fn = make_tree_scan_fn(
             "drf", 0.0, 0.0, 0.0, p.max_depth, p.nbins, Fw, N,
             p.effective_hist_precision, p.sample_rate, 1.0,
             hier=use_hier_split_search(p, N),
-            bin_counts=wbin_counts, plan=plan)
+            bin_counts=wbin_counts, plan=plan, hist_mode=hist_mode)
         scalars = (p.reg_lambda, p.min_rows, p.min_split_improvement, 1.0,
                    col_rate, p.reg_alpha, p.gamma, p.min_child_weight)
         chunks = [[] for _ in range(K)]

@@ -32,8 +32,9 @@ from ..scorekeeper import stop_early, metric_direction
 from .binning import fit_bins, edges_matrix
 from .shared import (SharedTree, SharedTreeModel, SharedTreeParameters,
                      StackedTrees, Tree, TreeList, build_tree,
-                     chunk_schedule, make_tree_scan_fn, stack_trees,
-                     traverse_jit, use_hier_split_search)
+                     chunk_schedule, make_tree_scan_fn, resolve_hist_mode,
+                     run_hist_crosscheck, stack_trees, traverse_jit,
+                     use_hier_split_search)
 from ...metrics.core import make_metrics
 
 
@@ -243,6 +244,27 @@ class GBM(SharedTree):
         fused = not multinomial and not dart
         fused_multi = multinomial and not dart
 
+        # hist_mode="check" — the driver assert: one tree grown with both
+        # the subtraction path and the full oracle on the REAL first-tree
+        # gradients must agree (shared.run_hist_crosscheck), then training
+        # proceeds on the subtraction path.
+        hist_mode = resolve_hist_mode(p)
+        if hist_mode == "check":
+            if multinomial:
+                g0, h0 = grads_multi(Y1, F)
+                g0, h0 = g0[:, 0], h0[:, 0]
+            else:
+                g0, h0 = grads_single(y, F)
+            run_hist_crosscheck(
+                wcodes, g0 * w, h0 * w, w, edges_mat, rng,
+                max_depth=p.max_depth, nbins=p.nbins, F=Fw, n_padded=N,
+                bin_counts=wbin_counts, mono=mono, plan=plan,
+                reg_lambda=p.reg_lambda, min_rows=p.min_rows,
+                min_split_improvement=p.min_split_improvement,
+                learn_rate=p.learn_rate, reg_alpha=p.reg_alpha,
+                gamma=p.gamma, min_child_weight=p.min_child_weight)
+            hist_mode = "subtract"
+
         if fused_multi:
             # multinomial fast path: K class trees per round, a whole
             # scoring interval of rounds per dispatch
@@ -251,7 +273,7 @@ class GBM(SharedTree):
                 K, p.max_depth, p.nbins, Fw, N,
                 p.effective_hist_precision, p.sample_rate, p.col_sample_rate_per_tree,
                 hier=use_hier_split_search(p, N),
-                bin_counts=wbin_counts, plan=plan)
+                bin_counts=wbin_counts, plan=plan, hist_mode=hist_mode)
             scalars = (p.reg_lambda, p.min_rows, p.min_split_improvement,
                        p.learn_rate, p.col_sample_rate, p.reg_alpha, p.gamma,
                        p.min_child_weight)
@@ -293,7 +315,8 @@ class GBM(SharedTree):
                 p.sample_rate, p.col_sample_rate_per_tree,
                 hier=use_hier_split_search(p, N) and mono is None,
                 bin_counts=wbin_counts, mono=mono, plan=plan,
-                custom_fn=getattr(p, "custom_distribution_func", None))
+                custom_fn=getattr(p, "custom_distribution_func", None),
+                hist_mode=hist_mode)
             scalars = (p.reg_lambda, p.min_rows, p.min_split_improvement,
                        p.learn_rate, p.col_sample_rate, p.reg_alpha, p.gamma,
                        p.min_child_weight)
@@ -376,7 +399,8 @@ class GBM(SharedTree):
                         p.col_sample_rate, tree_mask,
                         p.reg_alpha, p.gamma, p.min_child_weight,
                     hist_precision=p.effective_hist_precision,
-                        hier=use_hier_split_search(p, N))
+                        hier=use_hier_split_search(p, N),
+                        hist_mode=hist_mode)
                     if dart:
                         tree.values = tree.values * b_scale
                     ktrees.append(tree)
@@ -403,7 +427,8 @@ class GBM(SharedTree):
                     p.col_sample_rate, tree_mask,
                     p.reg_alpha, p.gamma, p.min_child_weight, mono=mono,
                     hist_precision=p.effective_hist_precision,
-                    hier=use_hier_split_search(p, N) and mono is None)
+                    hier=use_hier_split_search(p, N) and mono is None,
+                    hist_mode=hist_mode)
                 tree.values = tree.values * b_scale
                 trees.append(tree)
                 from .hist import table_lookup

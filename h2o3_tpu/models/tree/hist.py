@@ -456,6 +456,145 @@ def make_hist_fn(L: int, F: int, B: int, n_padded: int,
     return jax.jit(f)
 
 
+def _local_hist_impl(L: int, F: int, B: int, n_local: int, bin_counts=None,
+                     force_impl: str = "", precision: str = "bf16"):
+    """Per-shard local histogram (PRE-psum) at an (L, n_local) geometry.
+
+    The kernel-selection rules of make_hist_fn / make_varbin_hist_fn
+    factored out so the subtraction level driver can run the same kernels
+    over a compacted (smaller-sibling) row prefix.  With ``bin_counts`` the
+    varbin kernel is used (codes must be pre-offset packed ids) and the
+    packed [Q8, 3L] result is re-expanded to the dense [3, L, F, B]
+    contract; otherwise the uniform Pallas kernel with the einsum fallback
+    (CPU mesh, deep levels — same bounds as make_hist_fn).
+    ``force_impl="pallas"`` pins the REAL (non-interpret) kernel off-TPU —
+    the AOT Mosaic export gate needs it to lower the true code path from a
+    CPU host (tests/test_mosaic_lowering.py).
+    """
+    platform = cluster().mesh.devices.flat[0].platform
+    if bin_counts is not None:
+        _, _, _, qmap = varbin_layout(bin_counts, B)
+        interpret = force_impl == "pallas_interpret" or \
+            (platform != "tpu" and force_impl != "pallas")
+        raw = _make_pallas_varbin_hist(L, F, bin_counts, B, n_local,
+                                       interpret=interpret,
+                                       precision=precision)
+        qmap_dense = jnp.asarray(
+            np.asarray(qmap)[:, list(range(B - 1)) + [B]].reshape(-1))
+
+        def inner(codes, leaf, g, h, w):
+            out = raw(codes, leaf, g, h, w)                # [Q8, 3L]
+            H = out[qmap_dense]                            # [F*B, 3L]
+            return H.reshape(F, B, L, 3).transpose(3, 2, 0, 1)
+
+        return inner
+    hist_bytes = F * B * 3 * L * 4
+    if force_impl == "pallas_interpret":
+        return _make_pallas_hist(L, F, B, n_local, interpret=True,
+                                 precision=precision)
+    if force_impl != "pallas" and (
+            force_impl == "einsum" or platform != "tpu"
+            or hist_bytes > 12 * 1024 * 1024 or 3 * L > 2048):
+        return _make_einsum_hist(L, F, B, n_local)
+    return _make_pallas_hist(L, F, B, n_local, precision=precision)
+
+
+@functools.lru_cache(maxsize=None)
+def make_subtract_level_fn(d: int, F: int, B: int, n_padded: int,
+                           bin_counts=None, force_impl: str = "",
+                           precision: str = "bf16"):
+    """Level-``d`` histogram via smaller-sibling row COMPACTION + parent
+    subtraction — DHistogram / LightGBM / gpu_hist's classic halving,
+    TPU-shaped (arXiv:1706.08359 §3.2).
+
+    The masked-left subtraction this replaces still streamed ALL N rows
+    through the one-hot kernel every level (the stats were zeroed, the VPU
+    compare work was not).  Here each shard (a) picks, per parent, the
+    child with fewer LOCAL physical rows, (b) compacts those rows into a
+    dense prefix of length ``n_local // 2`` (sum over parents of
+    min(left, right) can never exceed half the shard — the bound is exact
+    because orientation is per-shard), (c) histograms only the prefix at
+    the parent-slot geometry, and (d) reconstructs the larger siblings as
+    ``H_parent_local - H_small_local`` in f32 before the cross-shard psum.
+    The compaction itself is a cumsum-positioned monotonic scatter over the
+    packed code/leaf/stat planes — one bandwidth-bound pass, NOT a per-row
+    gather (PROFILE.md fix #1).
+
+    The per-shard parent histogram needed for the subtraction rides along
+    as a carry: each call returns ``(H_global, H_carry)`` where ``H_carry``
+    is the [n_shards, 3, L, F, B] stack of pre-psum shard-local histograms
+    that the NEXT level consumes.  ``d == 0`` takes
+    ``(codes, leaf, g, h, w)`` (full build, all rows in leaf 0); ``d >= 1``
+    additionally takes the previous level's carry.  Accumulation stays f32
+    end to end (kernel outputs f32; h/w planes of the reconstructed side
+    are clamped at 0 — see the driver's rounding note), so the dense
+    [3, 2^d, F, B] contract matches the full build to f32 tolerance and
+    split search is unchanged.
+    """
+    cl = cluster()
+    n_local = n_padded // cl.n_row_shards
+    Lp = 2 ** max(d - 1, 0)            # parent slots the kernel histograms
+    Lc = 2 ** d                        # children at this level
+    cap = n_local // 2 if d > 0 else n_local
+    inner = _local_hist_impl(Lp, F, B, cap, bin_counts=bin_counts,
+                             force_impl=force_impl, precision=precision)
+    specs_row = (P(None, ROW_AXIS), P(ROW_AXIS), P(ROW_AXIS), P(ROW_AXIS),
+                 P(ROW_AXIS))
+
+    if d == 0:
+        def local0(codes, leaf, g, h, w):
+            Hl = inner(codes, leaf, g, h, w)
+            return jax.lax.psum(Hl, ROW_AXIS), Hl[None]
+
+        f = shard_map(local0, mesh=cl.mesh, in_specs=specs_row,
+                      out_specs=(P(), P(ROW_AXIS)), check_vma=False)
+        return jax.jit(f)
+
+    def locald(codes, leaf, g, h, w, carry):
+        Hp = carry[0]                              # this shard's [3,Lp,F,B]
+        # local physical row count per child — orientation only (weighted
+        # counts can't bound the compaction buffer: w=0 sampled-out rows
+        # still occupy kernel lanes).  The compare fuses into the reduce.
+        cidx = jax.lax.broadcasted_iota(jnp.int32, (Lc, 1), 0)
+        cnt = jnp.sum(cidx == leaf[None, :], axis=1, dtype=jnp.int32)
+        small_is_left = cnt[0::2] <= cnt[1::2]                 # [Lp]
+        chosen_child = jnp.stack(
+            [small_is_left, ~small_is_left], axis=1).reshape(-1)   # [Lc]
+        # per-row smaller-sibling flag via the MXU one-hot product —
+        # per-row gathers are poison (PROFILE.md fix #1)
+        chosen = table_lookup(
+            chosen_child.astype(jnp.float32)[None], leaf, Lc)[0] > 0.5
+        # dense-prefix positions; unchosen rows target the out-of-bounds
+        # slot ``cap`` and are dropped by the scatter
+        target = jnp.where(chosen,
+                           jnp.cumsum(chosen.astype(jnp.int32)) - 1, cap)
+        ccodes = jnp.zeros((F, cap), codes.dtype) \
+            .at[:, target].set(codes, mode="drop", unique_indices=True)
+        pleaf = jnp.zeros((cap,), jnp.int32) \
+            .at[target].set((leaf >> 1).astype(jnp.int32), mode="drop",
+                            unique_indices=True)
+        st = jnp.zeros((3, cap), jnp.float32) \
+            .at[:, target].set(
+                jnp.stack([g, h, w]).astype(jnp.float32), mode="drop",
+                unique_indices=True)
+        Hs = inner(ccodes, pleaf, st[0], st[1], st[2])     # [3, Lp, F, B]
+        Ho = Hp - Hs
+        # clamp the h/w planes at 0: per-level kernel routing can pair
+        # differently-rounded kernels across the subtraction (bf16 vs f32),
+        # and negative hessian/weight sums would corrupt best_splits
+        Ho = Ho.at[1:].max(0.0)
+        sl = small_is_left[None, :, None, None]
+        Hl_ = jnp.where(sl, Hs, Ho)
+        Hr_ = jnp.where(sl, Ho, Hs)
+        Hloc = jnp.stack([Hl_, Hr_], axis=2).reshape(3, Lc, F, B)
+        return jax.lax.psum(Hloc, ROW_AXIS), Hloc[None]
+
+    f = shard_map(locald, mesh=cl.mesh,
+                  in_specs=specs_row + (P(ROW_AXIS),),
+                  out_specs=(P(), P(ROW_AXIS)), check_vma=False)
+    return jax.jit(f)
+
+
 def _make_pallas_fine_hist(L: int, F: int, W: int, K: int, nbins: int,
                            n_local: int, interpret: bool = False,
                            precision: str = "bf16"):

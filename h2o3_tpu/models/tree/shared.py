@@ -33,8 +33,8 @@ from ..scorekeeper import stop_early, metric_direction
 from ..distributions import make_distribution
 from .binning import BinnedFrame, fit_bins, encode_bins
 from .hist import (make_hist_fn, make_fine_hist_fn, make_varbin_hist_fn,
-                   offset_codes, best_splits, best_splits_hier,
-                   select_superbins, partition)
+                   make_subtract_level_fn, offset_codes, best_splits,
+                   best_splits_hier, select_superbins, partition)
 
 
 @dataclasses.dataclass
@@ -66,6 +66,14 @@ class SharedTreeParameters(Parameters):
     standardize: bool = False            # trees never standardize
     hist_precision: str = "bf16"         # f32 for exact reproducibility
     split_search: str = "auto"           # auto | exact | hier (see shared.py)
+    # histogram build strategy per level (DHistogram/gpu_hist sibling trick):
+    #   "subtract" (default) — compact each parent's SMALLER child into a
+    #     dense row prefix, histogram only those <= N/2 rows, reconstruct
+    #     the larger sibling as parent - small (hist.make_subtract_level_fn);
+    #   "full"     — histogram every child from all N rows (the oracle);
+    #   "check"    — driver assert mode: grow one tree both ways on the
+    #     real data and raise on divergence, then train with "subtract".
+    hist_mode: str = "subtract"
     # probability calibration (hex/tree CalibrationHelper)
     calibrate_model: bool = False
     calibration_frame: Optional[object] = None
@@ -374,7 +382,7 @@ def validate_checkpoint_depth(prior, k, params, F: int, n_padded: int):
 def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
                        hist_precision: str = "bf16", hier: bool = False,
                        fine_k: int = 2, bin_counts=None, mono=None,
-                       plan=None):
+                       plan=None, hist_mode: str = "subtract"):
     """One compiled program that grows a whole tree on device.
 
     The level loop (SharedTree.buildLayer) is unrolled inside a single jit:
@@ -383,6 +391,15 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
     the driver-loop latency budget demands on a remote TPU.  Returns
     (per-level (feat, thr, na_left, valid) tuples, leaf values, final leaf
     assignment), all device-resident.
+
+    ``hist_mode`` picks the per-level histogram strategy (non-hier path):
+    ``"subtract"`` (default) compacts each parent's smaller child into a
+    dense row prefix, histograms only those <= N/2 rows and reconstructs
+    the larger sibling by f32 subtraction from a per-shard parent carry
+    (hist.make_subtract_level_fn — the DHistogram/gpu_hist sibling trick
+    with the row stream actually halved, not just masked); ``"full"``
+    histograms every child from all N rows and is kept as the exactness
+    oracle (run_hist_crosscheck / the hist_mode="check" driver assert).
 
     ``hier=True`` takes the hierarchical split-search path: a coarse
     super-bin histogram (S = 8/16) + fine refinement of the ``fine_k`` most
@@ -393,7 +410,8 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
     boundaries, so it can (rarely) choose a different split than the full
     pass when the best split hides far from every top coarse boundary.
     Drivers therefore enable it only at benchmark scale
-    (split_search="auto" gate) or on request.
+    (split_search="auto" gate) or on request.  ``hier`` keeps its own
+    coarse-level subtraction; ``hist_mode`` does not apply to it.
     """
     B = nbins + 1
     if mono is not None and hier:
@@ -403,6 +421,10 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
         raise ValueError("feature bundling (EFB) does not compose with "
                          "monotone constraints or the hierarchical search; "
                          "the drivers disable it automatically")
+    if hist_mode not in ("subtract", "full"):
+        raise ValueError(
+            f"hist_mode={hist_mode!r}: use 'subtract' or 'full' here "
+            "('check' is a driver mode — see run_hist_crosscheck)")
     max_depth = effective_max_depth(max_depth, nbins, F, n_padded)
     from ...runtime.cluster import cluster
     # per-feature packed bins (DHistogram-style): only the TPU Pallas path
@@ -423,20 +445,32 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
     # histogram output block must stage through VMEM (12 MB).  Deeper
     # levels take the uniform path, which falls back to einsum past its
     # own bound — the gate is per level so a deep tree keeps the fast
-    # kernel on its shallow levels.
+    # kernel on its shallow levels.  The subtract path histograms at the
+    # PARENT slot count (2^(d-1)); the full oracle at the child count.
+    kern_L = [2 ** d if hist_mode == "full" else 2 ** max(d - 1, 0)
+              for d in range(max_depth)]
     varbin_level = [
-        use_varbin and 3 * 2 ** max(d - 1, 0) <= 1024
-        and F * B * 3 * 2 ** max(d - 1, 0) * 4 <= 12 * 1024 * 1024
+        use_varbin and 3 * kern_L[d] <= 1024
+        and F * B * 3 * kern_L[d] * 4 <= 12 * 1024 * 1024
         for d in range(max_depth)]
     force = "" if on_tpu else "pallas_interpret"
-    hist_fns = [
-        make_varbin_hist_fn(2 ** max(d - 1, 0), F, tuple(bin_counts), B,
-                            n_padded, precision=hist_precision,
-                            force_impl=force)
-        if varbin_level[d]
-        else make_hist_fn(2 ** max(d - 1, 0), F, B, n_padded,
-                          precision=hist_precision)
-        for d in range(max_depth)]
+    if not hier and hist_mode == "subtract":
+        level_fns = [
+            make_subtract_level_fn(
+                d, F, B, n_padded,
+                bin_counts=tuple(bin_counts) if varbin_level[d] else None,
+                force_impl=force if varbin_level[d] else "",
+                precision=hist_precision)
+            for d in range(max_depth)]
+    else:
+        hist_fns = [
+            make_varbin_hist_fn(kern_L[d], F, tuple(bin_counts), B,
+                                n_padded, precision=hist_precision,
+                                force_impl=force)
+            if varbin_level[d]
+            else make_hist_fn(kern_L[d], F, B, n_padded,
+                              precision=hist_precision)
+            for d in range(max_depth)]
     if hier:
         S = 16 if nbins >= 128 else 8
         W = -(-nbins // S)
@@ -459,6 +493,7 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
             lo = jnp.full((1,), -jnp.inf)                    # per-node value
             hi = jnp.full((1,), jnp.inf)                     # bounds
         H_prev = None
+        H_carry = None            # subtract path: per-shard local hist stack
         if hier:
             ccodes = jnp.where(codes >= nbins, S, codes // W)
         hcodes = offset_codes(codes, bin_counts, nbins) \
@@ -497,23 +532,20 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
                         min_split_improvement, mask, reg_alpha, gamma,
                         min_child_weight)
             else:
-                if d == 0:
-                    H = hist_fns[0](hcodes if varbin_level[0] else codes,
-                                    leaf, g, h, w)
+                lcodes = hcodes if varbin_level[d] else codes
+                if hist_mode == "subtract":
+                    # smaller-sibling compaction + parent subtraction: the
+                    # kernel streams only the <= N/2 rows of each parent's
+                    # smaller child; the larger sibling is reconstructed
+                    # from the per-shard parent carry (hist.py)
+                    if d == 0:
+                        H, H_carry = level_fns[0](lcodes, leaf, g, h, w)
+                    else:
+                        H, H_carry = level_fns[d](lcodes, leaf, g, h, w,
+                                                  H_carry)
                 else:
-                    # parent-sibling subtraction (gpu_hist's trick): build
-                    # only the left children's histograms; the right child
-                    # is parent - left.  Halves the histogram work.
-                    em = ((leaf & 1) == 0).astype(jnp.float32)
-                    Hl = hist_fns[d](hcodes if varbin_level[d] else codes,
-                                     leaf >> 1,
-                                     g * em, h * em, w * em)
-                    # h/w planes clamped at 0 — see the hier-path comment
-                    # (differently-rounded kernels across the subtraction)
-                    Hr = H_prev - Hl
-                    Hr = Hr.at[1:].max(0.0)
-                    H = jnp.stack([Hl, Hr], axis=2).reshape(3, L, F, B)
-                H_prev = H
+                    # "full" oracle: every child histogrammed from all rows
+                    H = hist_fns[d](lcodes, leaf, g, h, w)
                 if plan is not None:
                     from .efb import best_splits_mixed
                     (feat, bin_, na_left, gain, valid, children, wfeat,
@@ -640,12 +672,76 @@ def use_hier_split_search(params, n_padded: int) -> bool:
     return False
 
 
+def resolve_hist_mode(params) -> str:
+    """Validate + normalize the ``hist_mode`` knob (drivers call this once;
+    ``"check"`` is resolved to ``"subtract"`` AFTER run_hist_crosscheck)."""
+    mode = str(getattr(params, "hist_mode", "subtract")).lower()
+    if mode not in ("subtract", "full", "check"):
+        raise ValueError(f"hist_mode={mode!r}: use subtract | full | check")
+    return mode
+
+
+def run_hist_crosscheck(codes, g, h, w, edges_mat, rng_key, *, max_depth,
+                        nbins, F, n_padded, hist_precision="f32",
+                        bin_counts=None, mono=None, plan=None,
+                        reg_lambda=0.0, min_rows=1.0,
+                        min_split_improvement=1e-5, learn_rate=0.1,
+                        reg_alpha=0.0, gamma=0.0, min_child_weight=0.0,
+                        atol=1e-4):
+    """The hist_mode="check" driver assert: grow ONE tree with the
+    subtraction path and one with the full oracle on identical inputs and
+    raise AssertionError on any divergence in split structure, row routing
+    or leaf values.
+
+    Runs on the caller's real (codes, gradients, weights) at the real
+    padded shape, so it validates the exact kernel geometry + compaction
+    the training run will use; cost is one extra tree build.  Exactly-tied
+    gains are the one legitimate divergence source (f32 subtraction
+    rounding can reorder equal gains) — that trips the assert by design:
+    "byte-exact or provably within tolerance" is the contract checked.
+    """
+    outs = {}
+    tm = jnp.ones((F,), bool)
+    for mode in ("subtract", "full"):
+        fn = make_build_tree_fn(max_depth, nbins, F, n_padded,
+                                hist_precision, bin_counts=bin_counts,
+                                mono=mono, plan=plan, hist_mode=mode)
+        levels, vals, cover, leaf = fn(
+            codes, g, h, w, edges_mat, rng_key, reg_lambda, min_rows,
+            min_split_improvement, learn_rate, 1.0, tm, reg_alpha, gamma,
+            min_child_weight)
+        outs[mode] = jax.device_get([[list(lv) for lv in levels], vals,
+                                     leaf])
+    lv_s, v_s, leaf_s = outs["subtract"]
+    lv_f, v_f, leaf_f = outs["full"]
+    for d, (ls, lf) in enumerate(zip(lv_s, lv_f)):
+        for name, i in (("feat", 0), ("na_left", 2), ("valid", 3)):
+            if not np.array_equal(ls[i], lf[i]):
+                raise AssertionError(
+                    f"hist_mode='check': subtraction and full builds "
+                    f"disagree on {name} at level {d}: "
+                    f"{np.asarray(ls[i])} vs {np.asarray(lf[i])}")
+        if not np.allclose(ls[1], lf[1], atol=atol, rtol=1e-5):
+            raise AssertionError(
+                f"hist_mode='check': split thresholds diverge at level {d}")
+    if not np.array_equal(leaf_s, leaf_f):
+        raise AssertionError(
+            "hist_mode='check': final leaf routing differs between the "
+            "subtraction and full histogram builds")
+    if not np.allclose(v_s, v_f, atol=atol, rtol=1e-4):
+        raise AssertionError(
+            "hist_mode='check': leaf values diverge beyond tolerance "
+            f"(max abs diff "
+            f"{np.max(np.abs(np.asarray(v_s) - np.asarray(v_f)))})")
+
+
 @functools.lru_cache(maxsize=None)
 def make_tree_scan_fn(mode: str, tweedie_power: float, quantile_alpha: float,
                       huber_alpha: float, max_depth: int, nbins: int, F: int,
                       n_padded: int, hist_precision: str, sample_rate: float,
                       col_sample_rate_per_tree: float, hier: bool = False,
-                      bin_counts=None, mono=None, custom_fn=None, plan=None):
+                      bin_counts=None, mono=None, custom_fn=None, plan=None,
+                      hist_mode: str = "subtract"):
     """Scan a CHUNK of boosting/bagging rounds in ONE device dispatch.
 
     The per-tree driver loop (gradients -> row/column sample -> grow ->
@@ -666,7 +762,7 @@ def make_tree_scan_fn(mode: str, tweedie_power: float, quantile_alpha: float,
             huber_alpha=huber_alpha, custom_distribution_func=custom_fn)
     bt_fn = make_build_tree_fn(max_depth, nbins, F, n_padded, hist_precision,
                                hier=hier, bin_counts=bin_counts, mono=mono,
-                               plan=plan)
+                               plan=plan, hist_mode=hist_mode)
 
     def scan_fn(codes, y, w, F0, edges_mat, rng0, chunk_no, nchunk,
                 reg_lambda, min_rows, min_split_improvement, learn_rate,
@@ -713,7 +809,8 @@ def make_multinomial_scan_fn(K: int, max_depth: int, nbins: int, F: int,
                              n_padded: int, hist_precision: str,
                              sample_rate: float,
                              col_sample_rate_per_tree: float,
-                             hier: bool = False, bin_counts=None, plan=None):
+                             hier: bool = False, bin_counts=None, plan=None,
+                             hist_mode: str = "subtract"):
     """Scan a chunk of multinomial boosting rounds in ONE dispatch.
 
     Each round grows K one-vs-rest trees on softmax gradients
@@ -728,7 +825,8 @@ def make_multinomial_scan_fn(K: int, max_depth: int, nbins: int, F: int,
     max_depth = effective_max_depth(max_depth, nbins, F, n_padded)
     bt_fn = make_build_tree_fn(max_depth, nbins, F, n_padded,
                                hist_precision, hier=hier,
-                               bin_counts=bin_counts, plan=plan)
+                               bin_counts=bin_counts, plan=plan,
+                               hist_mode=hist_mode)
 
     def scan_fn(codes, Y1, w, F0, edges_mat, rng0, chunk_no, nchunk,
                 reg_lambda, min_rows, min_split_improvement, learn_rate,
@@ -811,7 +909,7 @@ def build_tree(codes, g, h, w, edges, nbins: int, max_depth: int,
                tree_col_mask: Optional[np.ndarray] = None,
                reg_alpha: float = 0.0, gamma: float = 0.0,
                min_child_weight: float = 0.0, hist_precision: str = "bf16",
-               hier: bool = False, mono=None):
+               hier: bool = False, mono=None, hist_mode: str = "subtract"):
     """Grow one tree — convenience wrapper around make_build_tree_fn.
 
     ``edges`` may be the per-feature edge list (converted to the dense
@@ -827,7 +925,7 @@ def build_tree(codes, g, h, w, edges, nbins: int, max_depth: int,
     tm = jnp.asarray(tree_col_mask, bool) if tree_col_mask is not None \
         else jnp.ones(F, bool)
     fn = make_build_tree_fn(max_depth, nbins, F, N, hist_precision,
-                            hier=hier, mono=mono)
+                            hier=hier, mono=mono, hist_mode=hist_mode)
     levels, vals, cover, leaf = fn(codes, g, h, w, edges_mat, rng_key,
                                    reg_lambda, min_rows,
                                    min_split_improvement, learn_rate,

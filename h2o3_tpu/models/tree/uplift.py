@@ -28,9 +28,11 @@ from ...runtime.job import Job
 from ..base import Model, ModelBuilder
 from ..datainfo import DataInfo
 from .binning import fit_bins, edges_matrix
-from .hist import make_hist_fn, partition, table_lookup
+from .hist import (make_hist_fn, make_subtract_level_fn, partition,
+                   table_lookup)
 from .shared import (SharedTreeModel, SharedTree, SharedTreeParameters,
-                     StackedTrees, Tree, TreeList, traverse_jit)
+                     StackedTrees, Tree, TreeList, resolve_hist_mode,
+                     traverse_jit)
 
 _EPS = 1e-6
 
@@ -185,7 +187,20 @@ class UpliftDRF(SharedTree):
         F, N = codes.shape
         B = p.nbins + 1
         rng = jax.random.PRNGKey(p.effective_seed())
-        hist_fns = [make_hist_fn(2 ** d, F, B, N) for d in range(p.max_depth)]
+        # Treatment/control histograms ride the shared subtraction level
+        # driver: the two stat triples share one leaf assignment, so each
+        # level compacts the smaller siblings twice (once per arm) and
+        # reconstructs the larger arm histograms from the per-shard parent
+        # carries — the same <= N/2 row stream as GBM/DRF.  hist_mode="full"
+        # keeps the oracle (the old always-full build); "check" grows the
+        # first tree both ways and asserts identical splits.
+        hist_mode = resolve_hist_mode(p)
+        level_fns = [make_subtract_level_fn(d, F, B, N)
+                     for d in range(p.max_depth)] \
+            if hist_mode in ("subtract", "check") else None
+        full_fns = [make_hist_fn(2 ** d, F, B, N)
+                    for d in range(p.max_depth)] \
+            if hist_mode in ("full", "check") else None
 
         col_rate = 1.0 if p.mtries == -2 else \
             max(min(p.mtries if p.mtries > 0 else int(np.sqrt(F)), F), 1) / F
@@ -204,22 +219,27 @@ class UpliftDRF(SharedTree):
             pc = jnp.where(nc > 0, y1c / jnp.maximum(nc, _EPS), 0.0)
             return pt.astype(jnp.float32), pc.astype(jnp.float32)
 
-        trees_t: List[Tree] = []
-        trees_c: List[Tree] = []
-        for t_i in range(p.ntrees):
-            rng, ks, km = jax.random.split(rng, 3)
-            wv = w
-            if p.sample_rate < 1.0:
-                wv = w * jax.random.bernoulli(ks, p.sample_rate, w.shape)
+        def grow_tree(wv, keys, mode):
+            """One uplift tree's level loop under the given hist_mode."""
             leaf = jnp.zeros(N, jnp.int32)
             levels = []
-            keys = jax.random.split(km, p.max_depth)
+            gt, nt = wv * y * treat, wv * treat
+            gc, nc = wv * y * (1 - treat), wv * (1 - treat)
+            Ht_carry = Hc_carry = None
             for d in range(p.max_depth):
                 L = 2 ** d
-                Ht = hist_fns[d](codes, leaf, wv * y * treat, wv * treat,
-                                 wv * treat)
-                Hc = hist_fns[d](codes, leaf, wv * y * (1 - treat),
-                                 wv * (1 - treat), wv * (1 - treat))
+                if mode == "subtract":
+                    if d == 0:
+                        Ht, Ht_carry = level_fns[0](codes, leaf, gt, nt, nt)
+                        Hc, Hc_carry = level_fns[0](codes, leaf, gc, nc, nc)
+                    else:
+                        Ht, Ht_carry = level_fns[d](codes, leaf, gt, nt, nt,
+                                                    Ht_carry)
+                        Hc, Hc_carry = level_fns[d](codes, leaf, gc, nc, nc,
+                                                    Hc_carry)
+                else:
+                    Ht = full_fns[d](codes, leaf, gt, nt, nt)
+                    Hc = full_fns[d](codes, leaf, gc, nc, nc)
                 mask = jax.random.uniform(keys[d], (L, F)) < col_rate
                 mask = mask.at[:, 0].set(mask[:, 0] | ~mask.any(axis=1))
                 feat, bin_, valid, gain = _uplift_best_splits(
@@ -229,6 +249,37 @@ class UpliftDRF(SharedTree):
                 leaf = partition(codes, leaf, feat, bin_, na_left, valid,
                                  jnp.int32(p.nbins))
                 levels.append((feat, thr, na_left, valid))
+            return levels, leaf
+
+        trees_t: List[Tree] = []
+        trees_c: List[Tree] = []
+        for t_i in range(p.ntrees):
+            rng, ks, km = jax.random.split(rng, 3)
+            wv = w
+            if p.sample_rate < 1.0:
+                wv = w * jax.random.bernoulli(ks, p.sample_rate, w.shape)
+            keys = jax.random.split(km, p.max_depth)
+            if hist_mode == "check" and t_i == 0:
+                # driver assert: first tree grown both ways must agree
+                lv_s, leaf_s = grow_tree(wv, keys, "subtract")
+                lv_f, leaf_f = grow_tree(wv, keys, "full")
+                host = jax.device_get([lv_s, leaf_s, lv_f, leaf_f])
+                for d, (a, b) in enumerate(zip(host[0], host[2])):
+                    for i, nm in ((0, "feat"), (1, "thr"), (3, "valid")):
+                        if not np.allclose(a[i], b[i]):
+                            raise AssertionError(
+                                f"hist_mode='check': uplift subtraction "
+                                f"and full builds disagree on {nm} at "
+                                f"level {d}")
+                if not np.array_equal(host[1], host[3]):
+                    raise AssertionError(
+                        "hist_mode='check': uplift final leaf routing "
+                        "differs between histogram builds")
+                levels, leaf = lv_s, leaf_s
+            else:
+                levels, leaf = grow_tree(
+                    wv, keys,
+                    "full" if hist_mode == "full" else "subtract")
             pt_vals, pc_vals = leaf_stats(leaf, wv)
             lv = [tuple(x) if not isinstance(x, tuple) else x
                   for x in levels]

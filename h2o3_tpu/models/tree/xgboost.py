@@ -11,7 +11,10 @@ constraint, sparsity-aware NA direction — hist.py:best_splits) on the
 tpu_hist MXU histogram kernels; ICI psum replaces Rabit.  ``booster='dart'``
 runs libxgboost's DART dropout/renormalization inside the shared GBM driver.
 The h2o alias surface (eta/subsample/colsample_bytree/...) is accepted
-verbatim so estimator code ports 1:1.
+verbatim so estimator code ports 1:1.  Like gpu_hist, levels below the root
+histogram only each parent's smaller child and derive the sibling by
+subtraction (``hist_mode="subtract"``, the default; "full" is the exactness
+oracle and "check" asserts their agreement on the first tree — shared.py).
 """
 
 from __future__ import annotations
@@ -105,6 +108,8 @@ class XGBoost(GBM):
             raise ValueError(
                 f"booster={params.booster!r} not supported (gbtree, dart); "
                 "gblinear maps to GLM in this framework")
+        from .shared import resolve_hist_mode
+        resolve_hist_mode(params)        # fail fast on a bad hist_mode
         ModelBuilder.__init__(self, params)
 
     def train(self, frame, valid=None):

@@ -86,6 +86,27 @@ def test_hier_fine_kernel_lowers_for_tpu():
     _lower_tpu(fn, codes, leaf, g, h, w, sel)
 
 
+def test_subtract_level_lowers_for_tpu():
+    """The smaller-sibling subtraction level program — count one-hot,
+    cumsum-scatter compaction, varbin kernel over the N/2 prefix,
+    reconstruction — as ONE exported TPU program at bench geometry.
+    The compaction is plain XLA (scatter), but it composes with the
+    Pallas custom call inside one shard_mapped jit; this proves the whole
+    per-level program lowers for TPU from a CPU host."""
+    from h2o3_tpu.models.tree.hist import make_subtract_level_fn
+    from h2o3_tpu.runtime.cluster import cluster
+    shards = cluster().n_row_shards
+    for d in (1, 5):
+        Lp = 2 ** (d - 1)
+        fn = make_subtract_level_fn(d, F, B, N_PADDED,
+                                    bin_counts=BENCH_BIN_COUNTS,
+                                    force_impl="pallas")
+        codes = ((F, N_PADDED), jnp.int16)
+        leaf, g, h, w = _stat_shapes(N_PADDED)[1:]
+        carry = ((shards, 3, Lp, F, B), jnp.float32)
+        _lower_tpu(fn, codes, leaf, g, h, w, carry)
+
+
 def test_export_catches_known_mosaic_violation():
     """Meta-test: the gate actually rejects the iota form PROFILE.md
     documents as interpret-accepted / chip-rejected — proving the gate
