@@ -936,6 +936,18 @@ class Api:
         resumed = resume(recovery_dir)
         return {"resumed": [getattr(m, "key", str(m)) for m in resumed]}
 
+    def recovery_status(self, recovery_dir: str = "", **kw) -> dict:
+        """GET /3/Recovery — journal + progress-snapshot state: which jobs
+        are resumable, from which snapshot/cursor (operator view of the
+        survivable-training pipeline; defaults to H2O3_TPU_RECOVERY_DIR)."""
+        from ..runtime.recovery import journal_status
+        entries = journal_status(recovery_dir or None)
+        return {"recovery_dir": recovery_dir or
+                os.environ.get("H2O3_TPU_RECOVERY_DIR", ""),
+                "entries": entries,
+                "resumable": sum(1 for e in entries
+                                 if e.get("status") == "running")}
+
     _nps: dict = {}
 
     def nps_put(self, category: str, name: str, value: str = "",
@@ -1125,6 +1137,7 @@ class H2OServer:
             r"/3/NodePersistentStorage/([^/]+)":
                 lambda a, c: a.nps_list(c),
             r"/3/FrameChunks/([^/]+)": lambda a, k: a.frame_chunks(k),
+            r"/3/Recovery": lambda a, **kw: a.recovery_status(**kw),
         }
         _Handler.routes_post = {
             r"/3/Parse": lambda a, **kw: a.parse(**kw),

@@ -355,6 +355,8 @@ class AutoML:
 
         def run_step(step):
             try:
+                from ..runtime import failure
+                failure.maybe_inject("automl_member")
                 b = self._builder(step["algo"], step["params"])
                 m = b.train(frame, valid)
                 return step, m, None

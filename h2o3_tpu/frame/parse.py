@@ -255,6 +255,8 @@ def _parse_csv_native(path_or_buf, header, sep, col_names,
     dev_time = [0.0]
 
     def on_range(row_lo, nrows, Vt, Ft):
+        from ..runtime import failure
+        failure.maybe_inject("parse_range")
         t0 = time.perf_counter()
         try:
             import jax.numpy as jnp

@@ -51,6 +51,11 @@ class Parameters:
     # checkpointing (hex/Model.java:521,543)
     checkpoint: Optional[str] = None
     export_checkpoints_dir: Optional[str] = None
+    # in-training progress snapshots (runtime/snapshot.py): min seconds
+    # between snapshot writes for THIS job; -1 defers to the cluster-wide
+    # H2O3_TPU_SNAPSHOT_INTERVAL (default 30), 0 snapshots at every
+    # opportunity.  Only effective when H2O3_TPU_RECOVERY_DIR is active.
+    snapshot_interval: float = -1.0
     # class balancing (hex/Model.Parameters _balance_classes): applied
     # as per-class weights (deterministic equivalent of the reference's
     # oversampling) folded into the weights column for training+metrics
@@ -339,6 +344,7 @@ class ModelBuilder:
             from ..runtime import recovery
             journal = recovery.journal_start(
                 self, frame, job, params=orig_params)
+            job.journal_uri = journal      # gates in-training snapshots
             try:
                 model = self._driver_body(job, frame, di, valid, journal)
             except BaseException as e:

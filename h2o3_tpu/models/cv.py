@@ -85,6 +85,8 @@ def cross_validate(builder, job: Job, frame: Frame, di, valid):
     lock = threading.Lock()
 
     def train_fold(f: int):
+        from ..runtime import failure
+        failure.maybe_inject("cv_fold")
         w_f = np.where(folds != f, base_w, 0.0)
         fold_frame = Frame(list(frame.names) + [cv_w_col],
                            list(frame.vecs) + [Vec.from_numpy(w_f, T_NUM)])

@@ -459,6 +459,20 @@ class DeepLearning(ModelBuilder):
             params, opt_state, mean_loss = train_steps(params, opt_state,
                                                        rng, it, X, y, w)
             seen += steps_per_iter * batch
+            # progress snapshot: weights-so-far + remaining-epochs cursor;
+            # resume() restores weights via the checkpoint path and trains
+            # only the remaining epochs (throttled/async/best-effort)
+            from ..runtime import snapshot as _snapshot
+            _snapshot.maybe_snapshot(
+                job, model,
+                {"epochs_done": seen / n, "iteration": it,
+                 "resume_params": {
+                     "epochs": max(p.epochs - seen / n, 1e-3)}},
+                lambda ps=params: {
+                    "weights": [(np.asarray(W), np.asarray(b))
+                                for W, b in ps],
+                    "epochs_trained": seen / n,
+                    "samples_trained": seen})
             if p.stopping_rounds:
                 entry = {"iteration": it, "epochs": seen / n,
                          "samples": seen, "training_loss": float(mean_loss),

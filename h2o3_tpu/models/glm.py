@@ -741,6 +741,8 @@ class GLM(ModelBuilder):
             # remains only for non_negative (per-coordinate projection).
             # l1_mode only when L1 is actually active: the CD sweep costs
             # a while_loop per IRLS step that a plain solve doesn't.
+            from ..runtime import failure
+            failure.maybe_inject("glm_lambda")
             runner = _make_path_runner(
                 fam, l1_mode=p.alpha > 0 and float(np.max(lambdas)) > 0,
                 max_iter=p.max_iterations)
@@ -764,7 +766,15 @@ class GLM(ModelBuilder):
         best = None
         hist = []
         dev = np.inf
+        from ..runtime import failure, snapshot
         for li, lam in enumerate(lambdas):
+            # the host lambda loop journals its position: the in-progress
+            # state (warm-start beta) is not a loadable model, so this is
+            # a cursor-only progress record (bounded-rework accounting +
+            # the /3/Recovery status view), throttled like full snapshots
+            failure.maybe_inject("glm_lambda")
+            snapshot.progress(job, {"lambda_index": li,
+                                    "lambda": float(lam)})
             for it in range(p.max_iterations):
                 # one batched fetch per iteration (each separate fetch is a
                 # full round trip on a tunnelled backend)
@@ -805,7 +815,10 @@ class GLM(ModelBuilder):
         hist = []
         lam = lambdas[-1]
         ll_prev = np.inf
+        from ..runtime import failure, snapshot
         for it in range(p.max_iterations):
+            failure.maybe_inject("glm_lambda")
+            snapshot.progress(job, {"iteration": it})
             # batched fetch of the SMALL outputs only — [:3] keeps the
             # [N, K] probs (4th return) on device
             grams, xtwz, ll = jax.device_get(stats(

@@ -188,6 +188,10 @@ class GridSearch:
             pos += len(wave)
 
             def build(combo):
+                # each member journals (and snapshots) itself through
+                # ModelBuilder.train — the per-member resumability path
+                from ..runtime import failure
+                failure.maybe_inject("grid_member")
                 builder = self.builder_cls(**{**self.base_params, **combo})
                 return builder.train(frame, valid)
 

@@ -205,6 +205,17 @@ class DRF(SharedTree):
                     if valid is not None:
                         F_v = F_v + traverse_jit(lv, vals, Xv)
             job.update(t_done / p.ntrees, f"tree {t_done}/{p.ntrees}")
+            from ...runtime import snapshot
+            from .shared import (tree_snapshot_state,
+                                 tree_snapshot_state_multi)
+            init0 = np.zeros(K) if K > 1 else 0.0
+            snapshot.maybe_snapshot(
+                job, model, {"trees_done": t_done},
+                (lambda c=[list(ch) for ch in chunks]:
+                    tree_snapshot_state_multi(c, init0, binned.edges))
+                if K > 1 else
+                (lambda c=list(chunks[0]): tree_snapshot_state(
+                    c, init0, binned.edges)))
             if not score_now:
                 continue
 

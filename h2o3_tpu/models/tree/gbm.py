@@ -293,6 +293,13 @@ class GBM(SharedTree):
                         F_v = F_v.at[:, k].add(
                             traverse_jit(chunk.levels, chunk.values, Xv))
                 job.update(t_done / p.ntrees, f"tree {t_done}/{p.ntrees}")
+                from ...runtime import snapshot
+                from .shared import tree_snapshot_state_multi
+                snapshot.maybe_snapshot(
+                    job, model, {"trees_done": t_done},
+                    lambda c=[list(ch) for ch in chunks_k]:
+                        tree_snapshot_state_multi(c, init_host,
+                                                  binned.edges))
                 if not score_now:
                     continue
                 vstate = (F_v, y_v, w_v) if valid is not None else None
@@ -329,6 +336,12 @@ class GBM(SharedTree):
                 chunk = StackedTrees(lv, vals, cov)
                 chunks.append(chunk)
                 job.update(t_done / p.ntrees, f"tree {t_done}/{p.ntrees}")
+                from ...runtime import snapshot
+                from .shared import tree_snapshot_state
+                snapshot.maybe_snapshot(
+                    job, model, {"trees_done": t_done},
+                    lambda c=list(chunks): tree_snapshot_state(
+                        c, init_host, binned.edges))
                 if valid is not None:
                     F_v = F_v + traverse_jit(chunk.levels, chunk.values, Xv)
                 if not score_now:

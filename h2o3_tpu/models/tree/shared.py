@@ -487,6 +487,15 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
         N = codes.shape[1]
         leaf = jnp.zeros(N, jnp.int32)
         levels = []
+        # terminality invariant: once a node fails to split, every
+        # descendant slot is dead too.  Without this mask a dead node's
+        # rows (which keep flowing left through the dense [2^d] levels)
+        # could be re-split at a deeper level when a fresh per-level
+        # column draw (DRF mtries) samples a feature the failed level
+        # missed — the node-sparse exporters (POJO/MOJO/SHAP/tree API)
+        # all assume the first invalid node is a leaf, so such "revived"
+        # splits made exported scorers diverge from device traversal.
+        alive = jnp.ones((1,), bool)
         keys = jax.random.split(rng_key, max_depth)
         if mono is not None:
             mono_arr = jnp.asarray(mono, jnp.float32)        # [F] in {-1,0,1}
@@ -559,6 +568,22 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
                         min_split_improvement, mask, reg_alpha, gamma,
                         min_child_weight,
                         mono=mono_arr if mono is not None else None)
+            if d > 0:
+                valid = valid & alive
+                # collapse the child stats of dead slots back to "all rows
+                # left" (full totals = left + right of whatever candidate
+                # split best_splits picked), so final-level leaf values
+                # cover every row that drains through a dead chain
+                gl, hl, cl2 = children[:, 0], children[:, 1], children[:, 2]
+                gr, hr, cr2 = children[:, 3], children[:, 4], children[:, 5]
+                children = jnp.stack(
+                    [jnp.where(valid, gl, gl + gr),
+                     jnp.where(valid, hl, hl + hr),
+                     jnp.where(valid, cl2, cl2 + cr2),
+                     jnp.where(valid, gr, 0.0),
+                     jnp.where(valid, hr, 0.0),
+                     jnp.where(valid, cr2, 0.0)], axis=1)
+            alive = jnp.stack([valid, valid], axis=1).reshape(-1)
             if mono is not None:
                 # propagate value bounds to the children (the clamp at the
                 # leaves is what guarantees global monotonicity, exactly
@@ -882,6 +907,25 @@ def make_multinomial_scan_fn(K: int, max_depth: int, nbins: int, F: int,
 # their key — custom UDF distributions bypass these)
 _PREDS_JIT_CACHE: dict = {}
 _PREP_JIT_CACHE: dict = {}
+
+
+def tree_snapshot_state(chunks, init_host, edges) -> dict:
+    """Model-so-far output override for a progress snapshot of a fused
+    single-class tree build (runtime/snapshot.py): concatenates the
+    trained chunks host-side (tree metadata — kilobytes) into exactly the
+    fields ``resolve_checkpoint`` needs to continue the run."""
+    st = StackedTrees.concat(list(chunks))
+    return {"trees": TreeList(st), "ntrees_trained": st.ntrees,
+            "init_score": init_host, "edges": edges}
+
+
+def tree_snapshot_state_multi(chunks_k, init_host, edges) -> dict:
+    """Multinomial variant of ``tree_snapshot_state`` (K per-class
+    chunk lists -> TreeListMulti)."""
+    stacks = [StackedTrees.concat(list(ch)) for ch in chunks_k]
+    return {"trees": TreeListMulti(stacks),
+            "ntrees_trained": stacks[0].ntrees,
+            "init_score": init_host, "edges": edges}
 
 
 def chunk_schedule(ntrees: int, score_tree_interval: int,

@@ -29,6 +29,16 @@ class Config:
     # internode TLS (PEM paths)
     tls_cert: Optional[str] = None
     tls_key: Optional[str] = None
+    # DKV control-plane retry (dkv._rpc): extra attempts after the first,
+    # exponential backoff base/cap, and a per-op total-seconds budget
+    dkv_retries: int = 5
+    dkv_backoff_base_s: float = 0.05
+    dkv_backoff_max_s: float = 2.0
+    dkv_retry_budget_s: float = 30.0
+    # in-training progress snapshots (runtime/snapshot.py): min seconds
+    # between writes per job (0 = every opportunity), async writer thread
+    snapshot_interval_s: float = 30.0
+    snapshot_async: bool = True
 
     @staticmethod
     def from_env() -> "Config":
@@ -42,6 +52,13 @@ class Config:
             extensions=e("H2O3_TPU_EXTENSIONS", ""),
             tls_cert=e("H2O3_TPU_TLS_CERT"),
             tls_key=e("H2O3_TPU_TLS_KEY"),
+            dkv_retries=int(e("H2O3_TPU_DKV_RETRIES", 5)),
+            dkv_backoff_base_s=float(e("H2O3_TPU_DKV_BACKOFF_BASE", 0.05)),
+            dkv_backoff_max_s=float(e("H2O3_TPU_DKV_BACKOFF_MAX", 2.0)),
+            dkv_retry_budget_s=float(e("H2O3_TPU_DKV_RETRY_BUDGET", 30.0)),
+            snapshot_interval_s=float(e("H2O3_TPU_SNAPSHOT_INTERVAL", 30.0)),
+            snapshot_async=e("H2O3_TPU_SNAPSHOT_ASYNC", "1")
+            not in ("0", "false", "no"),
         )
 
     def describe(self) -> dict:

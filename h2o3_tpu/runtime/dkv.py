@@ -188,9 +188,8 @@ def _recvall(sock: socket.socket, n: int) -> bytes:
     return buf
 
 
-def _rpc(op: str, **kw) -> Any:
-    payload = pickle.dumps({"op": op, **kw},
-                           protocol=pickle.HIGHEST_PROTOCOL)
+def _rpc_once(payload: bytes) -> dict:
+    """One TCP/TLS round trip to the coordinator (no retry)."""
     with socket.create_connection(_remote, timeout=60) as raw:
         s = _client_ssl.wrap_socket(raw, server_hostname=_remote[0]) \
             if _client_ssl is not None else raw
@@ -199,6 +198,52 @@ def _rpc(op: str, **kw) -> Any:
         resp = pickle.loads(_recvall(s, n))
         if s is not raw:
             s.close()
+    return resp
+
+
+def _rpc(op: str, **kw) -> Any:
+    """Coordinator RPC with per-op retry: exponential backoff + jitter
+    under a retry budget.
+
+    A transient coordinator hiccup (restart, connection reset, listen
+    backlog overflow) used to kill the first heartbeat/journal/job RPC
+    that hit it — the reference survives these via UDP retransmit; the
+    TCP control plane needs explicit retries.  Only transport errors are
+    retried; an error REPORTED by the coordinator (``resp["err"]``) is
+    authoritative and raises immediately.  Knobs: ``H2O3_TPU_DKV_RETRIES``
+    (extra attempts, default 5), ``H2O3_TPU_DKV_BACKOFF_BASE`` /
+    ``H2O3_TPU_DKV_BACKOFF_MAX`` (seconds, default 0.05/2.0), and
+    ``H2O3_TPU_DKV_RETRY_BUDGET`` (total seconds across one op's
+    retries, default 30).
+    """
+    import random
+
+    from .config import config
+    payload = pickle.dumps({"op": op, **kw},
+                           protocol=pickle.HIGHEST_PROTOCOL)
+    cfg = config()
+    deadline = time.time() + cfg.dkv_retry_budget_s
+    attempt = 0
+    while True:
+        try:
+            from . import failure
+            failure.maybe_inject("dkv_rpc")
+            resp = _rpc_once(payload)
+            break
+        except (ConnectionError, TimeoutError, ssl.SSLError, OSError) as e:
+            attempt += 1
+            now = time.time()
+            if attempt > cfg.dkv_retries or now >= deadline:
+                raise
+            from .observability import log, record
+            sleep = min(cfg.dkv_backoff_base_s * (2 ** (attempt - 1)),
+                        cfg.dkv_backoff_max_s)
+            sleep *= 0.5 + random.random()          # jitter in [0.5x, 1.5x)
+            sleep = min(sleep, max(deadline - now, 0.01))
+            record("dkv_retry", op=op, attempt=attempt, error=repr(e))
+            log.warning("DKV %s RPC failed (%r); retry %d/%d in %.2fs",
+                        op, e, attempt, cfg.dkv_retries, sleep)
+            time.sleep(sleep)
     if resp.get("err"):
         raise RuntimeError(f"DKV coordinator error: {resp['err']}")
     return resp.get("value")
@@ -276,6 +321,7 @@ def serve(host: str = "127.0.0.1", port: int = 0) -> int:
             return _server.server_address[1]
         # explicit re-serve on a different port: restart the service
         _server.shutdown()
+        _server.server_close()            # release the listen socket too
         _server = None
     _server = _DKVServer((host, port), _Handler)
     srv_ctx, _ = _tls_contexts()
@@ -308,4 +354,5 @@ def detach() -> None:
     _remote = None
     if _server is not None:
         _server.shutdown()
+        _server.server_close()            # release the listen socket too
         _server = None

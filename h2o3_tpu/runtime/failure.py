@@ -19,10 +19,21 @@ thread polls the heartbeat view; when a member decays to ``dead`` it
    restarts (the reference's auto-recovery contract).
 
 Fault injection (SURVEY.md §5 explicitly asks the rebuild to add hooks
-the reference lacks): set ``H2O3_TPU_FAULT_INJECT="point:proc:nth"`` to
-hard-kill (``os._exit(137)``) process index ``proc`` at the ``nth`` hit
-of the named injection point.  Training loops call
-``maybe_inject("tree_chunk")`` / ``maybe_inject("dl_iter")``.
+the reference lacks): ``H2O3_TPU_FAULT_INJECT`` holds a comma-separated
+list of ``point:proc:nth[:action[:arg][:repeat]]`` specs.  ``action``:
+
+- ``kill`` (default) — ``os._exit(137)`` at every hit from the nth on,
+- ``raise`` — raise :class:`InjectedFault` (a deterministic failure the
+  journal must mark ``failed``, never resurrect),
+- ``delay:<ms>`` — sleep, modelling a slow worker / network stall,
+- ``dkv_drop`` — raise ``ConnectionError``, modelling a transient
+  control-plane RPC drop (the DKV client's retry loop must absorb it).
+
+Non-kill actions fire ``repeat`` times (default 1) starting at the nth
+hit, so a transient fault heals and retry paths can be proven to
+converge.  Injection points: ``tree_chunk``, ``dl_iter``, ``dkv_rpc``,
+``parse_range``, ``cv_fold``, ``grid_member``, ``automl_member``,
+``glm_lambda``, ``snapshot_write``.
 """
 
 from __future__ import annotations
@@ -39,6 +50,10 @@ FAILURES_PREFIX = "!failures/"
 
 class NodeFailedError(RuntimeError):
     """A cluster member stopped heartbeating mid-job."""
+
+
+class InjectedFault(RuntimeError):
+    """Deliberately injected failure (H2O3_TPU_FAULT_INJECT action=raise)."""
 
 
 _thread: Optional[threading.Thread] = None
@@ -137,28 +152,54 @@ def reset() -> None:
 # ------------------------------------------------------------ fault injection
 
 def maybe_inject(point: str) -> None:
-    """Kill THIS process at the configured injection point.
+    """Act on the configured injection matrix at ``point`` (module
+    docstring has the ``H2O3_TPU_FAULT_INJECT`` spec grammar).  No-op
+    when unset; costs one env lookup on the hot path."""
+    env = os.environ.get("H2O3_TPU_FAULT_INJECT")
+    if not env:
+        return
+    for i, spec in enumerate(env.split(",")):
+        _inject_one(point, spec.strip(), i)
 
-    ``H2O3_TPU_FAULT_INJECT="<point>:<process_index>:<nth>"`` — exits
-    with status 137 (SIGKILL convention) at the nth hit of ``point`` on
-    the named process.  No-op otherwise; costs one env lookup.
-    """
-    spec = os.environ.get("H2O3_TPU_FAULT_INJECT")
-    if not spec:
+
+def _inject_one(point: str, spec: str, slot: int) -> None:
+    parts = spec.split(":")
+    if len(parts) < 3:
         return
     try:
-        pt, pidx, nth = spec.split(":")
-        pidx, nth = int(pidx), int(nth)
+        pt, pidx, nth = parts[0], int(parts[1]), int(parts[2])
     except ValueError:
         return
     if pt != point:
         return
+    rest = parts[3:]
+    action = rest[0] if rest else "kill"
+    args = rest[1:]
+    try:
+        delay_ms = float(args.pop(0)) if action == "delay" and args else 0.0
+        repeat = int(args.pop(0)) if args else (None if action == "kill"
+                                                else 1)
+    except ValueError:
+        return
+    if action not in ("kill", "raise", "delay", "dkv_drop"):
+        return
     import jax
     if jax.process_index() != pidx:
         return
-    _inject_counts[point] = _inject_counts.get(point, 0) + 1
-    if _inject_counts[point] >= nth:
-        from .observability import log
+    key = (point, slot)
+    _inject_counts[key] = count = _inject_counts.get(key, 0) + 1
+    if count < nth or (repeat is not None and count >= nth + repeat):
+        return
+    from .observability import log, record
+    record("fault_injected", point=point, action=action, hit=count)
+    if action == "kill":
         log.error("FAULT INJECTION: killing process %d at %s #%d",
-                  pidx, point, nth)
+                  pidx, point, count)
         os._exit(137)
+    log.warning("FAULT INJECTION: %s at %s #%d", action, point, count)
+    if action == "raise":
+        raise InjectedFault(f"injected fault at {point} (hit #{count})")
+    if action == "dkv_drop":
+        raise ConnectionError(
+            f"injected DKV drop at {point} (hit #{count})")
+    time.sleep(delay_ms / 1000.0)

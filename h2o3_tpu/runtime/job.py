@@ -46,6 +46,9 @@ class Job:
         self.end_time: Optional[float] = None
         self._cancel_requested = threading.Event()
         self._done = threading.Event()
+        # recovery-journal entry URI (set by the training driver when
+        # H2O3_TPU_RECOVERY_DIR is active); gates progress snapshots
+        self.journal_uri: Optional[str] = None
         self._queued = False                 # on a scheduler queue
         self._thread: Optional[threading.Thread] = None
         self.result: Any = None

@@ -8,6 +8,14 @@ before fitting and marks it done after; ``resume()`` re-trains every
 entry still marked running, provided its training frame has been
 re-imported under the same key (the reference's contract too — data is
 not journaled, only the work description).
+
+Beyond the reference: long-running builders also persist in-training
+progress snapshots (runtime/snapshot.py) and the journal entry tracks
+the latest one (``snapshot_uri`` + ``snapshot_cursor``).  ``resume()``
+reloads the snapshot and continues through the builder's ``checkpoint``
+continuation machinery instead of re-training from zero — an
+interrupted 500-tree GBM restarts from the last snapshotted tree, with
+rework bounded by the snapshot cadence.
 """
 
 from __future__ import annotations
@@ -32,6 +40,12 @@ def _write_entry(uri: str, entry: dict) -> None:
         f.write(json.dumps(entry).encode())
 
 
+def _read_entry(uri: str) -> dict:
+    from .. import persist
+    with persist.open_read(uri) as f:
+        return json.loads(f.read().decode())
+
+
 def journal_start(builder, frame, job=None, params=None) -> Optional[str]:
     """Record a training job about to run; returns the entry URI."""
     base = _dir()
@@ -40,18 +54,19 @@ def journal_start(builder, frame, job=None, params=None) -> Optional[str]:
     from .observability import log
     # only JSON-clean params are journaled: a repr-stringified callable
     # or array would resume into a silently broken builder
-    params, skipped = {}, []
-    for k, v in dataclasses.asdict(params or builder.params).items():
+    jparams, skipped = {}, []
+    for k, v in dataclasses.asdict(
+            params if params is not None else builder.params).items():
         if hasattr(v, "item"):
             v = v.item()
         try:
             json.dumps(v)
-            params[k] = v
+            jparams[k] = v
         except TypeError:
             skipped.append(k)
     entry = {
         "algo": type(builder).__name__,
-        "params": params,
+        "params": jparams,
         "skipped_params": skipped,
         "frame_key": getattr(frame, "key", None),
         # import provenance: lets resume() re-import the data itself
@@ -74,10 +89,17 @@ def journal_start(builder, frame, job=None, params=None) -> Optional[str]:
 
 
 def journal_done(uri: Optional[str]) -> None:
-    """Mark a journal entry finished (entry removed — job completed)."""
+    """Mark a journal entry finished (entry removed — job completed).
+    Its progress snapshot, now superseded by the real model, goes too."""
     if not uri:
         return
     from .. import persist
+    try:
+        snap = _read_entry(uri).get("snapshot_uri")
+        if snap:
+            persist.delete(snap)
+    except Exception:                          # noqa: BLE001
+        pass
     try:
         persist.delete(uri)
     except Exception:                          # noqa: BLE001
@@ -89,10 +111,8 @@ def journal_fail(uri: Optional[str], error: str) -> None:
     jobs must NOT be resurrected — only process-death leaves 'running'."""
     if not uri:
         return
-    from .. import persist
     try:
-        with persist.open_read(uri) as f:
-            entry = json.loads(f.read().decode())
+        entry = _read_entry(uri)
         entry["status"] = "failed"
         entry["error"] = error[:500]
         _write_entry(uri, entry)
@@ -100,17 +120,89 @@ def journal_fail(uri: Optional[str], error: str) -> None:
         pass
 
 
+def journal_update_snapshot(uri: Optional[str], snapshot_uri: Optional[str],
+                            cursor: dict) -> Optional[str]:
+    """Point a journal entry at its latest progress snapshot (called by
+    the snapshot writer; ``snapshot_uri=None`` records a cursor-only
+    progress update).  Returns the PREVIOUS snapshot uri so the caller
+    can delete it once the journal references the new one."""
+    if not uri:
+        return None
+    import time
+    try:
+        entry = _read_entry(uri)
+        prev = entry.get("snapshot_uri")
+        if snapshot_uri is not None:
+            entry["snapshot_uri"] = snapshot_uri
+        entry["snapshot_cursor"] = cursor
+        entry["snapshot_ts"] = time.time()
+        _write_entry(uri, entry)
+        return prev
+    except Exception:                          # noqa: BLE001 — best-effort
+        return None
+
+
+def journal_status(recovery_dir: Optional[str] = None) -> List[dict]:
+    """Journal + snapshot state for every entry — the ``/3/Recovery``
+    status view (entries in 'running' state are resumable)."""
+    from .. import persist
+    base = recovery_dir or _dir()
+    if not base:
+        return []
+    out = []
+    for uri in persist.list_uris(f"{base.rstrip('/')}/job_*.json"):
+        try:
+            entry = _read_entry(uri)
+        except Exception as e:                 # noqa: BLE001
+            out.append({"entry_uri": uri, "error": repr(e)})
+            continue
+        out.append({
+            "entry_uri": uri,
+            "algo": entry.get("algo"),
+            "status": entry.get("status"),
+            "frame_key": entry.get("frame_key"),
+            "frame_source": entry.get("frame_source"),
+            "snapshot_uri": entry.get("snapshot_uri"),
+            "snapshot_cursor": entry.get("snapshot_cursor"),
+            "snapshot_ts": entry.get("snapshot_ts"),
+            "error": entry.get("error"),
+        })
+    return out
+
+
+def _load_snapshot_prior(entry: dict, uri: str):
+    """Best-effort snapshot reload for one journal entry: returns the
+    prior Model (DKV-registered) or None, never raises."""
+    from .observability import log
+    snap = entry.get("snapshot_uri")
+    if not snap:
+        return None
+    try:
+        from .snapshot import load_model
+        prior = load_model(snap)
+        log.info("recovery: resuming %s from snapshot %s (cursor=%s)",
+                 entry.get("algo"), snap, entry.get("snapshot_cursor"))
+        return prior
+    except Exception as e:                     # noqa: BLE001
+        log.warning("recovery: snapshot %s unusable (%r); %s restarts "
+                    "from scratch", snap, e, uri)
+        return None
+
+
 def resume(recovery_dir: Optional[str] = None) -> List[str]:
     """Re-train every journaled job still marked running.
 
     The training frame must already be back in the DKV under its
-    original key (re-import with the same destination_frame).  Returns
-    the keys of the models produced; entries whose frame is missing are
-    left in the journal and reported via the log.
+    original key (re-import with the same destination_frame) — or carry
+    a journaled ``frame_source``, which is re-imported automatically.
+    Entries with a progress snapshot continue from it via the builder's
+    ``checkpoint`` machinery.  Returns the keys of the models produced;
+    entries whose frame is missing are left in the journal and reported
+    via the log.
     """
     from .. import persist
     from . import dkv
-    from .observability import log
+    from .observability import log, record
     base = recovery_dir or _dir()
     if not base:
         return []
@@ -118,8 +210,7 @@ def resume(recovery_dir: Optional[str] = None) -> List[str]:
     done: List[str] = []
     for uri in persist.list_uris(f"{base.rstrip('/')}/job_*.json"):
         try:
-            with persist.open_read(uri) as f:
-                entry = json.loads(f.read().decode())
+            entry = _read_entry(uri)
         except Exception as e:                 # noqa: BLE001
             log.warning("recovery: unreadable journal entry %s: %r", uri, e)
             continue
@@ -148,6 +239,25 @@ def resume(recovery_dir: Optional[str] = None) -> List[str]:
             continue
         params = {k: v for k, v in entry["params"].items()
                   if v is not None}
+        prior = _load_snapshot_prior(entry, uri)
+        cursor = entry.get("snapshot_cursor") or {}
+        if prior is None and params.get("checkpoint") \
+                and dkv.get(params["checkpoint"]) is None:
+            # a resumed run that died again before its first snapshot
+            # journaled a checkpoint key that no longer resolves —
+            # fall back to a from-scratch retrain instead of failing
+            log.warning("recovery: journaled checkpoint %r not in DKV; "
+                        "%s restarts from scratch",
+                        params["checkpoint"], uri)
+            params.pop("checkpoint")
+        if prior is not None:
+            params["checkpoint"] = prior.key
+            # builder-specific continuation adjustments journaled with
+            # the cursor (e.g. deeplearning's remaining epochs)
+            for k, v in (cursor.get("resume_params") or {}).items():
+                params[k] = v
+            record("resume_from_snapshot", entry=uri,
+                   snapshot=entry.get("snapshot_uri"), cursor=cursor)
         try:
             model = cls(**params).train(frame)
         except Exception as e:                 # noqa: BLE001
@@ -155,6 +265,15 @@ def resume(recovery_dir: Optional[str] = None) -> List[str]:
                         "failed", uri, e)
             journal_fail(uri, repr(e))
             continue
+        if prior is not None:
+            model.output["resumed_from_snapshot"] = {
+                "snapshot_uri": entry.get("snapshot_uri"),
+                "cursor": cursor}
+            try:
+                dkv.remove(prior.key)
+                persist.delete(entry["snapshot_uri"])
+            except Exception:                  # noqa: BLE001
+                pass
         done.append(model.key)
         persist.delete(uri)
     return done

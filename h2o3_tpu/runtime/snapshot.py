@@ -1,0 +1,202 @@
+"""In-training progress snapshots — bounded-rework crash recovery.
+
+Reference gap: ``hex/faulttolerance/Recovery.java:72-81`` replays only the
+*work description* after a cluster restart, so an interrupted 500-tree GBM
+restarts from tree 0.  Here the long-running builders periodically persist
+a lightweight snapshot (model-so-far + progress cursor) next to their
+recovery-journal entry; ``recovery.resume()`` reloads the snapshot and
+continues through the existing ``checkpoint`` continuation machinery
+(models/tree/shared.py resolve_checkpoint, deeplearning's weight restore),
+bounding retrained work by the snapshot cadence instead of the job length.
+
+Contract (all three properties are load-bearing):
+
+- **throttled** — at most one write per ``H2O3_TPU_SNAPSHOT_INTERVAL``
+  seconds per job (default 30; 0 = every opportunity, used by tests), so
+  snapshot cost never competes with training throughput.  The payload
+  builder is only invoked when a write is actually due.
+- **async** — the pickle is built on the training thread (cheap: model
+  metadata, kilobytes-to-megabytes), the persist write happens on a
+  single daemon writer thread (``H2O3_TPU_SNAPSHOT_ASYNC=0`` forces
+  synchronous writes for deterministic tests).
+- **best-effort** — a failed snapshot write must NEVER fail training.
+  Every exception is swallowed into a log line; the journal keeps
+  pointing at the previous complete snapshot, so a write torn by a
+  crash is invisible to ``resume()``.
+
+Write ordering: snapshot file first (generation-numbered name), then the
+journal entry is re-pointed at it, then the previous generation is
+deleted — the journal never references a partial file.
+"""
+
+from __future__ import annotations
+
+import pickle
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+_lock = threading.Lock()
+_last_write: Dict[str, float] = {}      # journal uri -> monotonic ts
+_gen: Dict[str, int] = {}               # journal uri -> generation counter
+_worker: Optional[threading.Thread] = None
+_queue: "queue.Queue" = queue.Queue()
+_idle = threading.Event()
+_idle.set()
+
+
+def reset() -> None:
+    """Forget throttle/generation state (tests)."""
+    flush()
+    with _lock:
+        _last_write.clear()
+        _gen.clear()
+
+
+def _due(journal_uri: str, interval: Optional[float] = None) -> bool:
+    from .config import config
+    if interval is None or interval < 0:
+        interval = config().snapshot_interval_s
+    with _lock:
+        now = time.monotonic()
+        if now - _last_write.get(journal_uri, -1e18) < interval:
+            return False
+        _last_write[journal_uri] = now
+        return True
+
+
+def _snapshot_uri(journal_uri: str) -> str:
+    with _lock:
+        g = _gen[journal_uri] = _gen.get(journal_uri, 0) + 1
+    base, _, name = journal_uri.rpartition("/")
+    stem = name[: -len(".json")] if name.endswith(".json") else name
+    return f"{base}/snap_{stem[len('job_'):] or stem}_{g}.bin"
+
+
+def model_state_bytes(model, extra_output: Optional[dict] = None) -> bytes:
+    """Pickle a model-so-far in exactly ``Model.save``'s on-disk format
+    (so ``Model.load`` reads it back), with ``extra_output`` overriding
+    output fields the builder has not finalized yet.  The snapshot gets
+    a ``<key>_snap`` key so loading it never clobbers the real model."""
+    import jax
+    import numpy as np
+    state = model.__dict__.copy()
+    state.pop("_interval_metrics", None)   # transient scoring cache
+    out = dict(state.get("output") or {})
+    out.update(extra_output or {})
+    out.pop("stacked", None)            # rebuilt lazily after load
+    state["output"] = out
+    state["key"] = f"{model.key}_snap"
+    state = jax.tree.map(
+        lambda v: np.asarray(v) if isinstance(v, jax.Array) else v, state)
+    return pickle.dumps((type(model), state))
+
+
+def maybe_snapshot(job, model, cursor: dict,
+                   state_fn: Callable[[], dict]) -> Optional[str]:
+    """Builder-facing entry point: persist a progress snapshot when due.
+
+    ``job.journal_uri`` (set by the training driver when
+    ``H2O3_TPU_RECOVERY_DIR`` is active) gates the whole feature — no
+    journal, no snapshots.  ``state_fn`` returns the output-dict override
+    for the model-so-far (only called when a write is due — it may cost a
+    device fetch).  ``cursor`` is the journaled progress record; its
+    optional ``resume_params`` dict is applied onto the journaled params
+    by ``resume()`` (e.g. deeplearning's remaining-epoch count).
+    Never raises.  Returns the snapshot URI when a write was queued.
+    """
+    journal_uri = getattr(job, "journal_uri", None) if job is not None \
+        else None
+    if not journal_uri:
+        return None
+    from .observability import log
+    try:
+        interval = float(getattr(model.params, "snapshot_interval", -1.0))
+        if not _due(journal_uri, interval):
+            return None
+        extra = state_fn()
+        payload = model_state_bytes(model, extra)
+    except Exception as e:                 # noqa: BLE001 — best-effort
+        log.warning("snapshot build for %s failed: %r", journal_uri, e)
+        return None
+    uri = _snapshot_uri(journal_uri)
+    task = (uri, payload, journal_uri, dict(cursor))
+    from .config import config
+    if config().snapshot_async:
+        _ensure_worker()
+        _idle.clear()
+        _queue.put(task)
+    else:
+        _write_task(task)
+    return uri
+
+
+def progress(job, cursor: dict) -> None:
+    """Cursor-only journal update (no model payload) for builders whose
+    in-progress state is not yet a loadable model (GLM lambda path).
+    Throttled and best-effort like ``maybe_snapshot``."""
+    journal_uri = getattr(job, "journal_uri", None) if job is not None \
+        else None
+    if not journal_uri or not _due(journal_uri):
+        return
+    from . import recovery
+    recovery.journal_update_snapshot(journal_uri, None, dict(cursor))
+
+
+def flush(timeout: float = 30.0) -> None:
+    """Block until queued writes have drained (tests / orderly shutdown)."""
+    deadline = time.time() + timeout
+    while not _idle.is_set() and time.time() < deadline:
+        _idle.wait(0.05)
+
+
+def _ensure_worker() -> None:
+    global _worker
+    with _lock:
+        if _worker is not None and _worker.is_alive():
+            return
+        _worker = threading.Thread(target=_drain, daemon=True,
+                                   name="snapshot-writer")
+        _worker.start()
+
+
+def _drain() -> None:
+    while True:
+        task = _queue.get()
+        try:
+            _write_task(task)
+        except Exception:                  # noqa: BLE001 — never die
+            pass
+        finally:
+            if _queue.empty():
+                _idle.set()
+
+
+def _write_task(task) -> None:
+    uri, payload, journal_uri, cursor = task
+    from . import failure, recovery
+    from .observability import log, record
+    t0 = time.time()
+    try:
+        failure.maybe_inject("snapshot_write")
+        from .. import persist
+        with persist.open_write(uri) as f:
+            f.write(payload)
+        prev = recovery.journal_update_snapshot(journal_uri, uri, cursor)
+        record("snapshot_write", uri=uri, bytes=len(payload),
+               cursor=cursor, duration_s=round(time.time() - t0, 4))
+        if prev and prev != uri:
+            try:
+                persist.delete(prev)
+            except Exception:              # noqa: BLE001
+                pass
+    except Exception as e:                 # noqa: BLE001 — best-effort
+        log.warning("snapshot write %s failed: %r", uri, e)
+
+
+def load_model(uri: str):
+    """Load a snapshot back into a Model (DKV-registered under its
+    ``_snap`` key) — resume()'s side of the contract."""
+    from ..models.base import Model
+    return Model.load(uri)

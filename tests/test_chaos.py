@@ -1,0 +1,183 @@
+"""Chaos matrix: kill a training process mid-GBM, restart, resume, and
+prove the resumed model matches the uninterrupted run.
+
+This is the end-to-end acceptance scenario for survivable training:
+``H2O3_TPU_FAULT_INJECT`` hard-kills (exit 137) a real subprocess at
+tree-chunk k, the journal keeps the entry 'running' with the snapshot
+taken at the last chunk boundary, a FRESH process re-imports the frame
+and ``resume()``s — training continues from the snapshot (the log and
+resume provenance prove it was not tree 0) and final predictions match
+a never-interrupted run.  ``tools/chaos.sh`` is the operator entry
+point for this suite.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+
+NTREES = 12
+KILL_AT_CHUNK = 3          # chunks are 2 trees: snapshot covers 4 trees
+
+
+def _chaos_env(tmp_path, extra=None):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "H2O3_TPU_RECOVERY_DIR": str(tmp_path),
+        "H2O3_TPU_SNAPSHOT_INTERVAL": "0",
+        "H2O3_TPU_SNAPSHOT_ASYNC": "0",
+        "H2O3_TPU_LOG_STDERR": "1",
+    })
+    env.update(extra or {})
+    return env
+
+
+def _write_csv(path, seed=11, n=600):
+    rng = np.random.default_rng(seed)
+    X = rng.random((n, 4))
+    y = (10 * np.sin(np.pi * X[:, 0]) + 5 * X[:, 1] ** 2
+         + 3 * X[:, 2] + 0.1 * rng.normal(size=n))
+    rows = np.column_stack([X, y])
+    path.write_text("x0,x1,x2,x3,y\n" + "\n".join(
+        ",".join(f"{v:.9g}" for v in r) for r in rows))
+    return str(path)
+
+
+_TRAIN = textwrap.dedent("""
+    import sys
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import h2o3_tpu
+    h2o3_tpu.init()
+    from h2o3_tpu.frame.parse import import_file
+    from h2o3_tpu.models import GBM
+    fr = import_file(sys.argv[1], destination_frame="chaos_fr")
+    m = GBM(response_column="y", ntrees={nt}, max_depth=3, learn_rate=0.2,
+            seed=7, score_tree_interval=2).train(fr)
+    np.save(sys.argv[2], m.predict(fr).to_numpy()[:, 0])
+    print("TRAINED", m.output["ntrees_trained"])
+""").format(nt=NTREES)
+
+_RESUME = textwrap.dedent("""
+    import json
+    import sys
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import h2o3_tpu
+    h2o3_tpu.init()
+    from h2o3_tpu.frame.parse import import_file
+    from h2o3_tpu.runtime import dkv, recovery
+    fr = import_file(sys.argv[1], destination_frame="chaos_fr")
+    done = recovery.resume()
+    assert len(done) == 1, f"expected 1 resumed model, got {{done}}"
+    m = dkv.get(done[0])
+    from h2o3_tpu.runtime.observability import recent_logs
+    resumed_lines = [l for l in recent_logs()
+                     if "resuming GBM from snapshot" in l]
+    print("RESUME_INFO", json.dumps({{
+        "ntrees": m.output["ntrees_trained"],
+        "cursor": m.output["resumed_from_snapshot"]["cursor"],
+        "log_proof": len(resumed_lines)}}))
+    np.save(sys.argv[2], m.predict(fr).to_numpy()[:, 0])
+""").format()
+
+
+def _run(script, env, *args, expect_rc=0, timeout=420):
+    proc = subprocess.run(
+        [sys.executable, "-c", script, *args],
+        env=env, cwd=os.path.dirname(os.path.dirname(__file__)),
+        capture_output=True, text=True, timeout=timeout)
+    assert proc.returncode == expect_rc, (
+        f"rc={proc.returncode} (wanted {expect_rc})\n"
+        f"stdout:\n{proc.stdout[-3000:]}\nstderr:\n{proc.stderr[-3000:]}")
+    return proc
+
+
+def test_kill_resume_verify_gbm(cl, tmp_path):
+    """The full scenario: baseline run, killed run (exit 137 at chunk 3),
+    fresh-process resume, predictions equal, resumed-from-snapshot
+    proven by cursor + log."""
+    csv = _write_csv(tmp_path / "chaos.csv")
+    base_dir = tmp_path / "base_recovery"
+    base_dir.mkdir()
+
+    # 1. uninterrupted baseline (own journal dir: completes + cleans up)
+    base_npy = str(tmp_path / "base.npy")
+    out = _run(_TRAIN, _chaos_env(base_dir), csv, base_npy)
+    assert f"TRAINED {NTREES}" in out.stdout
+    assert not list(base_dir.glob("job_*.json"))
+
+    # 2. killed run: SIGKILL-style exit 137 at the 3rd tree chunk
+    kill_dir = tmp_path / "kill_recovery"
+    kill_dir.mkdir()
+    kill_npy = str(tmp_path / "kill.npy")
+    _run(_TRAIN,
+         _chaos_env(kill_dir,
+                    {"H2O3_TPU_FAULT_INJECT":
+                     f"tree_chunk:0:{KILL_AT_CHUNK}"}),
+         csv, kill_npy, expect_rc=137)
+    assert not os.path.exists(kill_npy)          # it really died mid-train
+    entries = list(kill_dir.glob("job_*.json"))
+    assert len(entries) == 1
+    entry = json.loads(entries[0].read_text())
+    assert entry["status"] == "running"
+    assert entry["frame_source"] == csv
+    assert entry["snapshot_uri"]
+    assert entry["snapshot_cursor"]["trees_done"] == 2 * (KILL_AT_CHUNK - 1)
+    assert list(kill_dir.glob("snap_*.bin"))
+
+    # 3. fresh process: re-import under the original key, resume()
+    res_npy = str(tmp_path / "resumed.npy")
+    out = _run(_RESUME, _chaos_env(kill_dir), csv, res_npy)
+    info = json.loads(
+        next(line for line in out.stdout.splitlines()
+             if line.startswith("RESUME_INFO ")).split(" ", 1)[1])
+    assert info["ntrees"] == NTREES
+    assert info["cursor"]["trees_done"] == 2 * (KILL_AT_CHUNK - 1)
+    assert info["log_proof"] >= 1                # "resuming GBM from snapshot"
+    # journal + snapshot cleaned up after the successful resume
+    assert not list(kill_dir.glob("job_*.json"))
+    assert not list(kill_dir.glob("snap_*.bin"))
+
+    # 4. the resumed model equals the uninterrupted one
+    base = np.load(base_npy)
+    resumed = np.load(res_npy)
+    np.testing.assert_allclose(resumed, base, rtol=1e-4, atol=1e-4)
+
+
+def test_kill_without_snapshot_still_resumes_from_zero(cl, tmp_path):
+    """Matrix row 2: killed before the first snapshot could land
+    (snapshot_write is the kill point) — the journal has no snapshot_uri
+    and resume() falls back to the from-scratch retrain contract."""
+    csv = _write_csv(tmp_path / "chaos0.csv")
+    kill_dir = tmp_path / "kill0_recovery"
+    kill_dir.mkdir()
+    _run(_TRAIN,
+         _chaos_env(kill_dir,
+                    {"H2O3_TPU_FAULT_INJECT": "snapshot_write:0:1"}),
+         csv, str(tmp_path / "unused.npy"), expect_rc=137)
+    (entry_path,) = kill_dir.glob("job_*.json")
+    entry = json.loads(entry_path.read_text())
+    assert entry["status"] == "running"
+    assert entry.get("snapshot_uri") is None
+
+    res_npy = str(tmp_path / "resumed0.npy")
+    out = _run(_RESUME.replace(
+        'm.output["resumed_from_snapshot"]["cursor"]',
+        'm.output.get("resumed_from_snapshot", {"cursor": None})["cursor"]'),
+        _chaos_env(kill_dir), csv, res_npy)
+    info = json.loads(
+        next(line for line in out.stdout.splitlines()
+             if line.startswith("RESUME_INFO ")).split(" ", 1)[1])
+    assert info["ntrees"] == NTREES and info["cursor"] is None
+    assert not list(kill_dir.glob("job_*.json"))

@@ -1,0 +1,110 @@
+"""DKV control-plane retry: a transient coordinator outage shorter than
+the retry budget must be invisible to callers (zero job failures)."""
+
+import socket
+import threading
+import time
+
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.runtime import dkv, failure
+from h2o3_tpu.runtime.config import reload as config_reload
+
+
+@pytest.fixture()
+def fast_retry(monkeypatch):
+    monkeypatch.setenv("H2O3_TPU_DKV_RETRIES", "6")
+    monkeypatch.setenv("H2O3_TPU_DKV_BACKOFF_BASE", "0.05")
+    monkeypatch.setenv("H2O3_TPU_DKV_BACKOFF_MAX", "0.3")
+    monkeypatch.setenv("H2O3_TPU_DKV_RETRY_BUDGET", "10")
+    config_reload()
+    failure.reset()
+    yield
+    dkv.detach()
+    failure.reset()
+    for k in ("H2O3_TPU_DKV_RETRIES", "H2O3_TPU_DKV_BACKOFF_BASE",
+              "H2O3_TPU_DKV_BACKOFF_MAX", "H2O3_TPU_DKV_RETRY_BUDGET",
+              "H2O3_TPU_FAULT_INJECT"):
+        monkeypatch.delenv(k, raising=False)
+    config_reload()
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_coordinator_outage_below_budget_causes_zero_failures(
+        cl, fast_retry):
+    """Kill the coordinator mid-session, restart it 0.5s later on the
+    same port: in-flight ops retry with backoff and succeed — the
+    acceptance contract for the DKV retry budget."""
+    from h2o3_tpu.runtime.observability import timeline_events
+    port = dkv.serve(port=0)
+    dkv.attach("127.0.0.1", port)
+    try:
+        assert dkv._rpc("incr", key="!retry_ctr", delta=1) == 1.0
+        dkv._server.shutdown()            # coordinator goes away
+        dkv._server.server_close()        # listen socket released: refused
+        dkv._server = None
+
+        def revive():
+            time.sleep(0.5)
+            dkv.serve(port=port)
+
+        threading.Thread(target=revive, daemon=True).start()
+        t0 = time.time()
+        # same-process store survives; the op still crosses the (dead,
+        # then revived) TCP control plane because _remote is set
+        assert dkv._rpc("incr", key="!retry_ctr", delta=1) == 2.0
+        assert time.time() - t0 >= 0.3    # it actually waited the outage out
+        retries = [e for e in timeline_events(2000)
+                   if e["kind"] == "dkv_retry"]
+        assert retries, "retry events must hit the timeline"
+    finally:
+        dkv.detach()
+        dkv.remove("!retry_ctr")
+
+
+def test_retry_budget_exhaustion_raises(cl, fast_retry, monkeypatch):
+    """Nothing listening and no revival: the op fails after the attempt
+    budget instead of hanging forever."""
+    monkeypatch.setenv("H2O3_TPU_DKV_RETRIES", "2")
+    monkeypatch.setenv("H2O3_TPU_DKV_BACKOFF_BASE", "0.01")
+    config_reload()
+    dkv._remote = ("127.0.0.1", _free_port())
+    try:
+        t0 = time.time()
+        with pytest.raises(OSError):
+            dkv._rpc("ping")
+        assert time.time() - t0 < 5.0
+    finally:
+        dkv._remote = None
+
+
+def test_injected_dkv_drops_are_absorbed(cl, fast_retry, monkeypatch):
+    """The dkv_drop injection point: two transient drops on the client
+    side retry through; a permanent drop (repeat beyond the attempt
+    budget) surfaces as ConnectionError."""
+    port = dkv.serve(port=0)
+    dkv.attach("127.0.0.1", port)
+    try:
+        failure.reset()
+        monkeypatch.setenv("H2O3_TPU_FAULT_INJECT",
+                           "dkv_rpc:0:1:dkv_drop:2")
+        assert dkv._rpc("ping") == "pong"
+        failure.reset()
+        monkeypatch.setenv("H2O3_TPU_DKV_RETRIES", "2")
+        monkeypatch.setenv("H2O3_TPU_FAULT_INJECT",
+                           "dkv_rpc:0:1:dkv_drop:99")
+        config_reload()
+        with pytest.raises(ConnectionError):
+            dkv._rpc("ping")
+    finally:
+        monkeypatch.delenv("H2O3_TPU_FAULT_INJECT")
+        failure.reset()
+        dkv.detach()

@@ -1,0 +1,351 @@
+"""Survivable training: progress snapshots, journal state, resume.
+
+In-process half of the chaos matrix (the process-kill half lives in
+test_chaos.py): a training run interrupted while the cluster is degraded
+leaves a 'running' journal entry pointing at its latest progress
+snapshot; ``recovery.resume()`` continues from the snapshot through the
+checkpoint machinery instead of retraining from zero.
+"""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.runtime import dkv, failure, recovery, snapshot
+from h2o3_tpu.runtime.config import reload as config_reload
+
+
+@pytest.fixture()
+def recovery_env(cl, tmp_path, monkeypatch):
+    """Recovery dir + snapshot-every-opportunity + synchronous writes."""
+    monkeypatch.setenv("H2O3_TPU_RECOVERY_DIR", str(tmp_path))
+    monkeypatch.setenv("H2O3_TPU_SNAPSHOT_INTERVAL", "0")
+    monkeypatch.setenv("H2O3_TPU_SNAPSHOT_ASYNC", "0")
+    config_reload()
+    snapshot.reset()
+    failure.reset()
+    yield tmp_path
+    snapshot.reset()
+    failure.reset()
+    monkeypatch.delenv("H2O3_TPU_RECOVERY_DIR", raising=False)
+    monkeypatch.delenv("H2O3_TPU_SNAPSHOT_INTERVAL", raising=False)
+    monkeypatch.delenv("H2O3_TPU_SNAPSHOT_ASYNC", raising=False)
+    monkeypatch.delenv("H2O3_TPU_FAULT_INJECT", raising=False)
+    config_reload()
+
+
+_FR_SEQ = [0]
+
+
+def _reg_frame(seed=3, n=600, destination_frame=None):
+    rng = np.random.default_rng(seed)
+    X = rng.random((n, 4))
+    y = (10 * np.sin(np.pi * X[:, 0]) + 5 * X[:, 1] ** 2
+         + 3 * X[:, 2] + 0.1 * rng.normal(size=n))
+    cols = {f"x{j}": X[:, j] for j in range(4)}
+    cols["y"] = y
+    if destination_frame is None:
+        _FR_SEQ[0] += 1
+        destination_frame = f"snaprec_fr_{seed}_{n}_{_FR_SEQ[0]}"
+    return h2o3_tpu.H2OFrame(cols, destination_frame=destination_frame)
+
+
+def _crash_gbm_mid_train(tmp_path, monkeypatch, fr, ntrees=12):
+    """Interrupt a GBM at the 3rd chunk while the cluster looks degraded:
+    the journal entry must stay 'running' with a snapshot recorded."""
+    from h2o3_tpu.models import GBM
+    monkeypatch.setenv("H2O3_TPU_FAULT_INJECT", "tree_chunk:0:3:raise")
+    failure.reset()
+    failure._handled.add("ghost")        # degraded: keep journal resumable
+    kw = dict(response_column="y", ntrees=ntrees, max_depth=3,
+              learn_rate=0.2, seed=7, score_tree_interval=2)
+    with pytest.raises(failure.InjectedFault):
+        GBM(**kw).train(fr)
+    monkeypatch.delenv("H2O3_TPU_FAULT_INJECT")
+    failure.reset()
+    entries = list(tmp_path.glob("job_*.json"))
+    assert len(entries) == 1
+    entry = json.loads(entries[0].read_text())
+    assert entry["status"] == "running"
+    return entry, kw
+
+
+def test_gbm_resume_from_snapshot_matches_uninterrupted(
+        recovery_env, monkeypatch):
+    """The headline contract: interrupted at tree 4 of 12, resume()
+    continues from the snapshot (not tree 0) and the final predictions
+    match an uninterrupted 12-tree run."""
+    from h2o3_tpu.models import GBM
+    tmp_path = recovery_env
+    fr = _reg_frame()
+    entry, kw = _crash_gbm_mid_train(tmp_path, monkeypatch, fr)
+    # chunks of 2 trees; killed at the 3rd chunk -> snapshot covers 4
+    assert entry["snapshot_uri"]
+    assert entry["snapshot_cursor"]["trees_done"] == 4
+    snap_files = list(tmp_path.glob("snap_*.bin"))
+    assert len(snap_files) == 1          # superseded generations deleted
+
+    done = recovery.resume(str(tmp_path))
+    assert len(done) == 1
+    model = dkv.get(done[0])
+    assert model.output["ntrees_trained"] == 12
+    # proof the run continued instead of restarting: the resume
+    # provenance carries the snapshot cursor
+    resumed = model.output["resumed_from_snapshot"]
+    assert resumed["cursor"]["trees_done"] == 4
+    from h2o3_tpu.runtime.observability import recent_logs
+    assert any("resuming GBM from snapshot" in line
+               for line in recent_logs())
+    # journal + snapshot are cleaned up after a successful resume
+    assert not list(tmp_path.glob("job_*.json"))
+    assert not list(tmp_path.glob("snap_*.bin"))
+
+    straight = GBM(**kw).train(fr)
+    p_resumed = model.predict(fr).vec("predict").to_numpy()
+    p_straight = straight.predict(fr).vec("predict").to_numpy()
+    np.testing.assert_allclose(p_resumed, p_straight, rtol=1e-4, atol=1e-4)
+
+
+def test_resume_reimports_frame_from_journaled_source(
+        recovery_env, monkeypatch, tmp_path_factory):
+    """The frame re-import path: the journaled frame_source is re-imported
+    under the original key when the DKV lost the frame (fresh process)."""
+    csv_dir = tmp_path_factory.mktemp("reimport_data")
+    fr0 = _reg_frame(seed=5)
+    csv = csv_dir / "re.csv"
+    cols = {n: fr0.vec(n).to_numpy() for n in fr0.names}
+    header = ",".join(cols)
+    rows = np.stack(list(cols.values()), axis=1)
+    csv.write_text(header + "\n" + "\n".join(
+        ",".join(f"{v:.9g}" for v in r) for r in rows))
+    from h2o3_tpu.frame.parse import import_file
+    fr = import_file(str(csv), destination_frame="reimport_fr")
+    assert fr.source_uri == str(csv)
+
+    entry, _ = _crash_gbm_mid_train(recovery_env, monkeypatch, fr)
+    assert entry["frame_key"] == "reimport_fr"
+    assert entry["frame_source"] == str(csv)
+
+    dkv.remove("reimport_fr")            # simulate the restarted cluster
+    done = recovery.resume(str(recovery_env))
+    assert len(done) == 1
+    assert dkv.get("reimport_fr") is not None
+    model = dkv.get(done[0])
+    assert model.output["ntrees_trained"] == 12
+    assert model.output["resumed_from_snapshot"]["cursor"]["trees_done"] == 4
+
+
+def test_drf_and_xgboost_resume_from_snapshot(recovery_env, monkeypatch):
+    """The other tree builders share GBM's fused-chunk snapshot wiring:
+    interrupted DRF/XGBoost runs continue from their snapshot too.
+    (No prediction-equality assert for DRF: the continuation PRNG stream
+    is decorrelated from the prior run by design, so bootstrap samples
+    differ — same contract as test_drf_checkpoint_continues.)"""
+    from h2o3_tpu.models import DRF, XGBoost
+    fr = _reg_frame()
+    for cls_ in (DRF, XGBoost):
+        monkeypatch.setenv("H2O3_TPU_FAULT_INJECT", "tree_chunk:0:3:raise")
+        failure.reset()
+        failure._handled.add("ghost")
+        with pytest.raises(failure.InjectedFault):
+            cls_(response_column="y", ntrees=12, max_depth=3, seed=7,
+                 score_tree_interval=2).train(fr)
+        monkeypatch.delenv("H2O3_TPU_FAULT_INJECT")
+        failure.reset()
+        done = recovery.resume(str(recovery_env))
+        assert len(done) == 1, cls_.__name__
+        m = dkv.get(done[0])
+        assert m.output["ntrees_trained"] == 12
+        assert m.output["resumed_from_snapshot"]["cursor"]["trees_done"] == 4
+        assert not list(recovery_env.glob("job_*.json"))
+        snapshot.reset()                 # fresh throttle for the next algo
+
+
+def test_cancelled_and_deterministic_failures_not_resurrected(
+        recovery_env, monkeypatch):
+    """journal_fail contract: cancelled jobs and deterministic failures
+    flip the entry to 'failed' — resume() must never resurrect them."""
+    from h2o3_tpu.models import GBM
+    from h2o3_tpu.runtime.job import JobCancelled
+    fr = _reg_frame()
+
+    class CancelGBM(GBM):
+        def _fit(self, *a, **k):
+            raise JobCancelled("user hit stop")
+
+    CancelGBM.__name__ = "GBM"
+    with pytest.raises(JobCancelled):
+        CancelGBM(response_column="y", ntrees=3).train(fr)
+    # a deterministic (injected, non-degraded) failure also marks failed
+    monkeypatch.setenv("H2O3_TPU_FAULT_INJECT", "tree_chunk:0:1:raise")
+    failure.reset()
+    from h2o3_tpu.runtime import heartbeat
+    heartbeat.start(interval=0.5)        # healthy self-stamp
+    try:
+        with pytest.raises(failure.InjectedFault):
+            GBM(response_column="y", ntrees=3, max_depth=2,
+                seed=1).train(fr)
+    finally:
+        heartbeat.stop()
+        monkeypatch.delenv("H2O3_TPU_FAULT_INJECT")
+    entries = [json.loads(p.read_text())
+               for p in recovery_env.glob("job_*.json")]
+    assert len(entries) == 2
+    assert all(e["status"] == "failed" for e in entries)
+    assert recovery.resume(str(recovery_env)) == []
+
+
+def test_journal_start_honors_params_override(recovery_env):
+    """Regression: journal_start used to rebind ``params = {}`` before
+    evaluating the caller's override, silently journaling builder.params
+    instead (recovery.py:42) — balance_classes runs journaled the
+    synthetic weights column and resumed into a broken builder."""
+    from h2o3_tpu.models import GBM
+    fr = _reg_frame()
+    b = GBM(response_column="y", ntrees=3)
+    override = dataclasses.replace(b.params, ntrees=7,
+                                   weights_column=None)
+    uri = recovery.journal_start(b, fr, params=override)
+    with open(uri) as f:
+        entry = json.load(f)
+    assert entry["params"]["ntrees"] == 7        # the override, not 3
+    recovery.journal_done(uri)
+
+
+def test_snapshot_write_failures_never_fail_training(
+        recovery_env, monkeypatch):
+    """Best-effort contract: every snapshot write blowing up (injected
+    ``raise`` at the snapshot_write point) must leave training untouched."""
+    from h2o3_tpu.models import GBM
+    monkeypatch.setenv("H2O3_TPU_FAULT_INJECT",
+                       "snapshot_write:0:1:raise:99")
+    failure.reset()
+    fr = _reg_frame()
+    m = GBM(response_column="y", ntrees=6, max_depth=2, seed=2,
+            score_tree_interval=2).train(fr)
+    assert m.output["ntrees_trained"] == 6
+    # job completed: journal entry removed, no snapshot left behind
+    assert not list(recovery_env.glob("job_*.json"))
+    assert not list(recovery_env.glob("snap_*.bin"))
+
+
+def test_snapshot_throttle_and_per_job_interval(recovery_env, monkeypatch):
+    """A huge snapshot_interval on the job suppresses every write except
+    the first; interval 0 writes at every chunk boundary."""
+    from h2o3_tpu.models import GBM
+    fr = _reg_frame()
+    calls = []
+    orig = snapshot._write_task
+
+    def counting(task):
+        calls.append(task[0])
+        orig(task)
+
+    monkeypatch.setattr(snapshot, "_write_task", counting)
+    GBM(response_column="y", ntrees=8, max_depth=2, seed=2,
+        score_tree_interval=2, snapshot_interval=3600.0).train(fr)
+    assert len(calls) == 1               # first write, then throttled
+    snapshot.reset()
+    GBM(response_column="y", ntrees=8, max_depth=2, seed=2,
+        score_tree_interval=2, snapshot_interval=0.0).train(fr)
+    assert len(calls) == 1 + 4           # every 2-tree chunk of 8 trees
+
+
+def test_deeplearning_resume_from_snapshot(recovery_env, monkeypatch):
+    """DL epoch snapshots: resume restores the journaled weights and
+    trains only the remaining epochs (resume_params cursor)."""
+    from h2o3_tpu.models import DeepLearning
+    fr = _reg_frame(n=400)
+    monkeypatch.setenv("H2O3_TPU_FAULT_INJECT", "dl_iter:0:3:raise")
+    failure.reset()
+    failure._handled.add("ghost")
+    kw = dict(response_column="y", hidden=[8], epochs=6, seed=4,
+              mini_batch_size=32, train_samples_per_iteration=400)
+    with pytest.raises(failure.InjectedFault):
+        DeepLearning(**kw).train(fr)
+    monkeypatch.delenv("H2O3_TPU_FAULT_INJECT")
+    failure.reset()
+    entries = [json.loads(p.read_text())
+               for p in recovery_env.glob("job_*.json")]
+    assert len(entries) == 1 and entries[0]["status"] == "running"
+    cursor = entries[0]["snapshot_cursor"]
+    assert cursor["epochs_done"] > 0
+    assert cursor["resume_params"]["epochs"] == pytest.approx(
+        6 - cursor["epochs_done"])
+    done = recovery.resume(str(recovery_env))
+    assert len(done) == 1
+    model = dkv.get(done[0])
+    assert model.output["resumed_from_snapshot"]["cursor"] == cursor
+    # only the remaining epochs were retrained
+    assert model.output["epochs_trained"] == pytest.approx(
+        6 - cursor["epochs_done"], abs=0.5)
+    assert not list(recovery_env.glob("job_*.json"))
+
+
+def test_recovery_status_route_reports_journal_and_snapshot(
+        recovery_env, monkeypatch):
+    """GET /3/Recovery: journal + snapshot state for the operator."""
+    from h2o3_tpu.api.server import Api
+    fr = _reg_frame()
+    _crash_gbm_mid_train(recovery_env, monkeypatch, fr)
+    out = Api().recovery_status(recovery_dir=str(recovery_env))
+    assert out["resumable"] == 1
+    (e,) = out["entries"]
+    assert e["algo"] == "GBM" and e["status"] == "running"
+    assert e["snapshot_uri"] and e["snapshot_cursor"]["trees_done"] == 4
+    # leave the dir clean for the fixture teardown
+    recovery.resume(str(recovery_env))
+
+
+def test_glm_lambda_path_journals_progress_cursor(recovery_env, monkeypatch):
+    """GLM's host lambda loop records a cursor-only progress update (the
+    warm-start beta is not a loadable model; the journal still shows how
+    far the path got for the /3/Recovery view)."""
+    from h2o3_tpu.models import GLM
+    fr = _reg_frame()
+    failure.reset()
+    monkeypatch.setenv("H2O3_TPU_FAULT_INJECT", "glm_lambda:0:3:raise")
+    failure._handled.add("ghost")
+    with pytest.raises(failure.InjectedFault):
+        GLM(response_column="y", family="gaussian", lambda_search=True,
+            nlambdas=8, non_negative=True, alpha=0.5).train(fr)
+    monkeypatch.delenv("H2O3_TPU_FAULT_INJECT")
+    failure.reset()
+    entries = [json.loads(p.read_text())
+               for p in recovery_env.glob("job_*.json")]
+    assert len(entries) == 1 and entries[0]["status"] == "running"
+    assert entries[0]["snapshot_cursor"]["lambda_index"] >= 0
+    assert entries[0].get("snapshot_uri") is None    # cursor-only
+    done = recovery.resume(str(recovery_env))        # from-scratch retrain
+    assert len(done) == 1
+
+
+def test_fault_injection_matrix_actions(cl, monkeypatch):
+    """The spec grammar: kill stays default, raise/delay/dkv_drop fire
+    ``repeat`` times from the nth hit, malformed specs are no-ops."""
+    failure.reset()
+    monkeypatch.setenv("H2O3_TPU_FAULT_INJECT",
+                       "pt:0:2:raise,other:0:1:dkv_drop")
+    failure.maybe_inject("pt")                       # hit 1: below nth
+    with pytest.raises(failure.InjectedFault):
+        failure.maybe_inject("pt")                   # hit 2: fires
+    failure.maybe_inject("pt")                       # hit 3: healed
+    with pytest.raises(ConnectionError):
+        failure.maybe_inject("other")
+    failure.maybe_inject("other")                    # healed
+    failure.reset()
+    monkeypatch.setenv("H2O3_TPU_FAULT_INJECT", "pt:0:1:delay:50:2")
+    import time
+    t0 = time.time()
+    failure.maybe_inject("pt")
+    failure.maybe_inject("pt")
+    assert time.time() - t0 >= 0.09                  # two 50 ms delays
+    failure.maybe_inject("pt")                       # repeat exhausted
+    failure.reset()
+    monkeypatch.setenv("H2O3_TPU_FAULT_INJECT",
+                       "pt:zero:1,pt:0,garbage,pt:0:1:frobnicate")
+    failure.maybe_inject("pt")                       # all malformed: no-op
+    failure.reset()

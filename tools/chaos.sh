@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# chaos.sh — THE chaos-suite entry point (ROADMAP lists it next to
+# tier1.sh).  One command runs the full survivable-training matrix:
+#
+#   - kill-resume-verify: a real subprocess is hard-killed (exit 137)
+#     mid-GBM via H2O3_TPU_FAULT_INJECT, a fresh process re-imports the
+#     journaled frame and recovery.resume() continues from the progress
+#     snapshot; final predictions must match an uninterrupted run
+#     (tests/test_chaos.py),
+#   - DKV retry budget: a coordinator outage shorter than the retry
+#     budget causes zero failures (tests/test_dkv_retry.py),
+#   - in-process snapshot/journal/resume contracts
+#     (tests/test_snapshot_recovery.py).
+#
+# Exits with pytest's return code.
+set -o pipefail
+cd "$(dirname "$0")/.."
+timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_chaos.py tests/test_dkv_retry.py \
+    tests/test_snapshot_recovery.py tests/test_failure.py \
+    -q -p no:cacheprovider -p no:xdist -p no:randomly
+exit $?
