@@ -253,6 +253,142 @@ def hist_piece():
          note="ratio > 1: subtraction beats the all-rows masked path")
 
 
+def splits_piece():
+    """Standalone split-search comparison: multi-pass best_splits vs the
+    fused winner-records path vs the batched-K fused path, per level of
+    a depth-6 build, without the full bench.
+
+    Per level d (leaf slots L = 2^d) three JSON lines land:
+      - ``split_separate_L*`` — best_splits, the multi-pass XLA oracle
+        (~15 [L, F, B] intermediates through HBM per level),
+      - ``split_fused_L*``    — fused_best_splits on the platform's
+        shipping impl (winner-records Pallas kernel on TPU, the
+        bit-identical XLA twin elsewhere),
+      - ``split_batched_K3_L*`` — fused_best_splits_batched over K=3
+        class histograms flattened into ONE records pass (per-tree ms is
+        the number to compare against split_fused_L*).
+    The histograms chain level to level off one leaf chain (70/30
+    splits) so each level's H carries realistic occupancy, and the timed
+    carry feeds back into the operand so XLA cannot CSE the calls.
+
+    A final ``ktree_dispatch`` line counts pallas_call equations in the
+    traced batched level program (hist + split search for all K trees):
+    the acceptance is 2 launches per level TOTAL — one histogram kernel
+    (vmap batches the grid over K) and one records kernel (K*L leaves
+    flatten into rows) — independent of K.
+
+    Usage (chip): python bench_pieces.py splits
+    CPU smoke:    JAX_PLATFORMS=cpu H2O3_PIECES_ROWS=200000 \\
+                  python bench_pieces.py splits
+    (Off-TPU the fused path ships the XLA twin; pass
+    H2O3_SPLITS_INTERPRET=1 to time the Pallas kernel in interpret mode
+    instead — a methodology check, not a projection.)
+    """
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import jax
+    import jax.numpy as jnp
+
+    import h2o3_tpu
+    from bench_util import timed_amortized
+    cl = h2o3_tpu.init()
+    platform = jax.devices()[0].platform
+    n = N_ROWS - (N_ROWS % (512 * cl.n_row_shards))
+
+    from h2o3_tpu.models.tree.hist import (
+        make_varbin_hist_fn, make_batched_level_fn, offset_codes,
+        best_splits, fused_best_splits, fused_best_splits_batched)
+
+    def emit(**rec):
+        print(json.dumps({**rec, "platform": platform, "rows": n}),
+              flush=True)
+
+    force = "" if platform == "tpu" else "pallas_interpret"
+    fsplit = "pallas_interpret" if (platform != "tpu" and
+                                    os.environ.get("H2O3_SPLITS_INTERPRET")) \
+        else ""
+    impl = "pallas" if platform == "tpu" else \
+        ("pallas_interpret" if fsplit else "xla_twin")
+    K = 3
+    key = jax.random.PRNGKey(0)
+    ks = jax.random.split(key, 16)
+    codes = jnp.stack([
+        jax.random.randint(ks[f], (n,), 0, min(bc, NBINS), dtype=jnp.int32)
+        for f, bc in enumerate(BIN_COUNTS)], axis=0)
+    gcodes = offset_codes(codes, BIN_COUNTS, NBINS)
+    gK = jax.random.normal(ks[8], (K, n), jnp.float32)
+    hK = jnp.abs(jax.random.normal(ks[9], (K, n), jnp.float32)) + 0.1
+    w = jnp.ones((n,), jnp.float32)
+
+    leaf = jnp.zeros(n, jnp.int32)
+    summary = {}
+    for d in range(6):
+        L = 2 ** d
+        if d:
+            bit = (jax.random.uniform(ks[10 + d], (n,)) < 0.3) \
+                .astype(jnp.int32)
+            leaf = 2 * leaf + bit
+        vfn = make_varbin_hist_fn(L, F, BIN_COUNTS, B, n, force_impl=force)
+        HK = jnp.stack([vfn(gcodes, leaf, gK[k], hK[k], w)
+                        for k in range(K)])
+        H = HK[0]
+
+        def run_sep(acc, Hh):
+            out = best_splits(Hh + acc * 0.0, NBINS, 1.0, 1.0, 1e-5)
+            return out[3].reshape(-1)[0].astype(jnp.float32) * 1e-30
+
+        ms_sep = timed_amortized(run_sep, H, reps=REPS)
+        emit(piece=f"split_separate_L{L}", ms=round(ms_sep, 3))
+
+        def run_fus(acc, Hh):
+            out = fused_best_splits(Hh + acc * 0.0, NBINS, 1.0, 1.0, 1e-5,
+                                    force_impl=fsplit)
+            return out[3].reshape(-1)[0].astype(jnp.float32) * 1e-30
+
+        ms_fus = timed_amortized(run_fus, H, reps=REPS)
+        emit(piece=f"split_fused_L{L}", ms=round(ms_fus, 3), impl=impl)
+
+        def run_bat(acc, Hh):
+            out = fused_best_splits_batched(Hh + acc * 0.0, NBINS, 1.0,
+                                            1.0, 1e-5, force_impl=fsplit)
+            return out[3].reshape(-1)[0].astype(jnp.float32) * 1e-30
+
+        ms_bat = timed_amortized(run_bat, HK, reps=REPS)
+        emit(piece=f"split_batched_K{K}_L{L}", ms=round(ms_bat, 3),
+             ms_per_tree=round(ms_bat / K, 3), impl=impl)
+        summary[f"L{L}"] = {
+            "fused_speedup": round(ms_sep / ms_fus, 2) if ms_fus else None,
+            "batched_per_tree_vs_fused":
+                round(ms_fus / (ms_bat / K), 2) if ms_bat else None}
+
+    emit(piece="splits_summary", per_level=summary,
+         note="fused_speedup > 1: single-pass records path beats the "
+              "multi-pass XLA search; batched_per_tree_vs_fused > 1: "
+              "flattening K trees into one launch amortizes dispatch")
+
+    # dispatch-count proof for the batched K-tree level: ONE histogram
+    # launch + ONE records launch regardless of K (count from the traced
+    # program, not a projection)
+    lev = make_batched_level_fn(1, K, F, B, n, bin_counts=BIN_COUNTS,
+                                force_impl=force or "pallas",
+                                subtract=False)
+    leafK = jnp.broadcast_to(leaf, (K, n))
+    wK = jnp.broadcast_to(w, (K, n))
+
+    def batched_level(c, lf, gg, hh, ww):
+        Hh = lev(c, lf, gg, hh, ww)
+        return fused_best_splits_batched(Hh, NBINS, 1.0, 1.0, 1e-5,
+                                         force_impl="pallas")
+
+    n_calls = str(jax.make_jaxpr(batched_level)(
+        gcodes, leafK, gK, hK, wK)).count("pallas_call")
+    emit(piece="ktree_dispatch", pallas_calls_per_level=n_calls, K=K,
+         expect=2, ok=n_calls == 2,
+         note="1 hist kernel (vmap batches the grid over K) + 1 records "
+              "kernel (K*L leaves flatten into rows)")
+
+
 def parse_piece():
     """Standalone ingest bench: bench.py's 568 MB parse line (same file,
     same warmup methodology) without the ~1091 s full suite.
@@ -289,5 +425,7 @@ if __name__ == "__main__":
         parse_piece()
     elif len(sys.argv) > 1 and sys.argv[1] == "hist":
         hist_piece()
+    elif len(sys.argv) > 1 and sys.argv[1] == "splits":
+        splits_piece()
     else:
         main()

@@ -29,8 +29,10 @@ from ..scorekeeper import stop_early, metric_direction
 from .binning import fit_bins, edges_matrix
 from .shared import (SharedTree, SharedTreeModel, SharedTreeParameters,
                      StackedTrees, TreeList, chunk_schedule,
-                     make_tree_scan_fn, resolve_hist_mode,
-                     run_hist_crosscheck, traverse_jit)
+                     make_multinomial_scan_fn, make_tree_scan_fn,
+                     resolve_hist_mode, resolve_split_mode,
+                     run_hist_crosscheck, run_split_crosscheck,
+                     traverse_jit)
 from ...metrics.core import make_metrics
 
 
@@ -173,37 +175,89 @@ class DRF(SharedTree):
                 learn_rate=1.0, reg_alpha=p.reg_alpha, gamma=p.gamma,
                 min_child_weight=p.min_child_weight)
             hist_mode = "subtract"
-        scan_fn = make_tree_scan_fn(
-            "drf", 0.0, 0.0, 0.0, p.max_depth, p.nbins, Fw, N,
-            p.effective_hist_precision, p.sample_rate, 1.0,
-            hier=use_hier_split_search(p, N),
-            bin_counts=wbin_counts, plan=plan, hist_mode=hist_mode)
+        # split_mode="check" — fused (batched-K for multiclass) vs the
+        # sequential best_splits oracle on the real mean-fit gradients
+        split_mode = resolve_split_mode(
+            p, plan=plan, hier=use_hier_split_search(p, N))
+        if split_mode == "check":
+            gK = jnp.stack([-t * w for t in targets])
+            hK = jnp.broadcast_to(w, gK.shape)
+            kchk = jnp.stack([jax.random.fold_in(rng, k)
+                              for k in range(K)]) if K > 1 else rng
+            run_split_crosscheck(
+                wcodes, gK if K > 1 else gK[0],
+                hK if K > 1 else hK[0], w, edges_mat, kchk,
+                max_depth=p.max_depth, nbins=p.nbins, F=Fw, n_padded=N,
+                bin_counts=wbin_counts, hist_mode=hist_mode,
+                reg_lambda=p.reg_lambda, min_rows=p.min_rows,
+                min_split_improvement=p.min_split_improvement,
+                learn_rate=1.0, col_sample_rate=col_rate,
+                reg_alpha=p.reg_alpha, gamma=p.gamma,
+                min_child_weight=p.min_child_weight)
+            split_mode = "fused"
+        # batched multiclass: one K-tree build per round (one hist + one
+        # split launch per level for all K class trees) instead of K
+        # sequential scans — identical keys (same fold_in structure), so
+        # the sequential path below stays its oracle
+        batched = split_mode == "fused" and K > 1
+        if batched:
+            scan_fn_k = make_multinomial_scan_fn(
+                K, p.max_depth, p.nbins, Fw, N,
+                p.effective_hist_precision, p.sample_rate, 1.0,
+                bin_counts=wbin_counts, hist_mode=hist_mode,
+                split_mode="fused", mode="drf")
+        else:
+            scan_fn = make_tree_scan_fn(
+                "drf", 0.0, 0.0, 0.0, p.max_depth, p.nbins, Fw, N,
+                p.effective_hist_precision, p.sample_rate, 1.0,
+                hier=use_hier_split_search(p, N),
+                bin_counts=wbin_counts, plan=plan, hist_mode=hist_mode,
+                split_mode=split_mode)
         scalars = (p.reg_lambda, p.min_rows, p.min_split_improvement, 1.0,
                    col_rate, p.reg_alpha, p.gamma, p.min_child_weight)
         chunks = [[] for _ in range(K)]
         if prior is not None:
             for k in range(K):
                 chunks[k].append(prior_stacked(prior, k if K > 1 else None))
+        from ...runtime import failure
         for chunk_no, (c, t_new, score_now) in enumerate(chunk_schedule(
                 p.ntrees - prior_nt, p.score_tree_interval)):
             t_done = prior_nt + t_new
-            for k in range(K):
-                Fk0 = F_sum[:, k] if K > 1 else F_sum
-                # same (rng, chunk_no) across classes -> same bootstrap per
-                # iteration (DRF.java samples once per tree); the salt
-                # decorrelates each class tree's per-split feature subsets
-                Fk, lv, vals, cov = scan_fn(wcodes, targets[k], w, Fk0,
-                                            edges_mat, rng, chunk_no, c,
-                                            *scalars, k)
-                chunks[k].append(StackedTrees(lv, vals, cov))
-                if K > 1:
-                    F_sum = F_sum.at[:, k].set(Fk)
+            if batched:
+                # chaos matrix: kill/resume mid-K-tree-round on the
+                # batched path
+                failure.maybe_inject("ktree_round")
+                F_sum, lv, vals, cov = scan_fn_k(wcodes, Y1, w, F_sum,
+                                                 edges_mat, rng, chunk_no,
+                                                 c, *scalars)
+                for k in range(K):
+                    lv_k = [tuple(lvd[i][:, k] for i in range(4))
+                            for lvd in lv]
+                    chunk = StackedTrees(lv_k, vals[:, k], cov[:, k])
+                    chunks[k].append(chunk)
                     if valid is not None:
-                        F_v = F_v.at[:, k].add(traverse_jit(lv, vals, Xv))
-                else:
-                    F_sum = Fk
-                    if valid is not None:
-                        F_v = F_v + traverse_jit(lv, vals, Xv)
+                        F_v = F_v.at[:, k].add(
+                            traverse_jit(chunk.levels, chunk.values, Xv))
+            else:
+                for k in range(K):
+                    Fk0 = F_sum[:, k] if K > 1 else F_sum
+                    # same (rng, chunk_no) across classes -> same bootstrap
+                    # per iteration (DRF.java samples once per tree); the
+                    # salt decorrelates each class tree's per-split feature
+                    # subsets
+                    Fk, lv, vals, cov = scan_fn(wcodes, targets[k], w, Fk0,
+                                                edges_mat, rng, chunk_no, c,
+                                                *scalars, k)
+                    chunks[k].append(StackedTrees(lv, vals, cov))
+                    if K > 1:
+                        F_sum = F_sum.at[:, k].set(Fk)
+                        if valid is not None:
+                            F_v = F_v.at[:, k].add(
+                                traverse_jit(lv, vals, Xv))
+                    else:
+                        F_sum = Fk
+                        if valid is not None:
+                            F_v = F_v + traverse_jit(lv, vals, Xv)
             job.update(t_done / p.ntrees, f"tree {t_done}/{p.ntrees}")
             from ...runtime import snapshot
             from .shared import (tree_snapshot_state,

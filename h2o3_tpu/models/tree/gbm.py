@@ -32,9 +32,10 @@ from ..scorekeeper import stop_early, metric_direction
 from .binning import fit_bins, edges_matrix
 from .shared import (SharedTree, SharedTreeModel, SharedTreeParameters,
                      StackedTrees, Tree, TreeList, build_tree,
-                     chunk_schedule, make_tree_scan_fn, resolve_hist_mode,
-                     run_hist_crosscheck, stack_trees, traverse_jit,
-                     use_hier_split_search)
+                     chunk_schedule, make_build_tree_fn, make_tree_scan_fn,
+                     resolve_hist_mode, resolve_split_mode,
+                     run_hist_crosscheck, run_split_crosscheck, stack_trees,
+                     traverse_jit, use_hier_split_search)
 from ...metrics.core import make_metrics
 
 
@@ -265,6 +266,32 @@ class GBM(SharedTree):
                 gamma=p.gamma, min_child_weight=p.min_child_weight)
             hist_mode = "subtract"
 
+        # split_mode="check" — fused (batched-K for multinomial) vs the
+        # sequential best_splits oracle on the REAL first-round gradients
+        # (shared.run_split_crosscheck), then training rides the fused path.
+        split_mode = resolve_split_mode(
+            p, mono=mono, plan=plan, hier=use_hier_split_search(p, N))
+        if split_mode == "check":
+            if multinomial:
+                g0, h0 = grads_multi(Y1, F)
+                gc_, hc_ = (g0 * w[:, None]).T, (h0 * w[:, None]).T
+                kchk = jnp.stack([jax.random.fold_in(rng, k)
+                                  for k in range(K)])
+            else:
+                g0, h0 = grads_single(y, F)
+                gc_, hc_ = g0 * w, h0 * w
+                kchk = rng
+            run_split_crosscheck(
+                wcodes, gc_, hc_, w, edges_mat, kchk,
+                max_depth=p.max_depth, nbins=p.nbins, F=Fw, n_padded=N,
+                bin_counts=wbin_counts, hist_mode=hist_mode,
+                reg_lambda=p.reg_lambda, min_rows=p.min_rows,
+                min_split_improvement=p.min_split_improvement,
+                learn_rate=p.learn_rate, col_sample_rate=p.col_sample_rate,
+                reg_alpha=p.reg_alpha, gamma=p.gamma,
+                min_child_weight=p.min_child_weight)
+            split_mode = "fused"
+
         if fused_multi:
             # multinomial fast path: K class trees per round, a whole
             # scoring interval of rounds per dispatch
@@ -273,15 +300,20 @@ class GBM(SharedTree):
                 K, p.max_depth, p.nbins, Fw, N,
                 p.effective_hist_precision, p.sample_rate, p.col_sample_rate_per_tree,
                 hier=use_hier_split_search(p, N),
-                bin_counts=wbin_counts, plan=plan, hist_mode=hist_mode)
+                bin_counts=wbin_counts, plan=plan, hist_mode=hist_mode,
+                split_mode=split_mode)
             scalars = (p.reg_lambda, p.min_rows, p.min_split_improvement,
                        p.learn_rate, p.col_sample_rate, p.reg_alpha, p.gamma,
                        p.min_child_weight)
             chunks_k = [[prior_stacked(prior, k)] if prior is not None
                         else [] for k in range(K)]
+            from ...runtime import failure
             for chunk_no, (c, t_new, score_now) in enumerate(chunk_schedule(
                     p.ntrees - prior_nt, p.score_tree_interval)):
                 t_done = prior_nt + t_new
+                # chaos matrix: kill/resume mid-multinomial-round — each
+                # chunk is a batch of K-tree rounds on the fused path
+                failure.maybe_inject("ktree_round")
                 F, lv, vals, cov = scan_fn(wcodes, Y1, w, F, edges_mat,
                                            rng, chunk_no, c, *scalars)
                 for k in range(K):
@@ -323,7 +355,7 @@ class GBM(SharedTree):
                 hier=use_hier_split_search(p, N) and mono is None,
                 bin_counts=wbin_counts, mono=mono, plan=plan,
                 custom_fn=getattr(p, "custom_distribution_func", None),
-                hist_mode=hist_mode)
+                hist_mode=hist_mode, split_mode=split_mode)
             scalars = (p.reg_lambda, p.min_rows, p.min_split_improvement,
                        p.learn_rate, p.col_sample_rate, p.reg_alpha, p.gamma,
                        p.min_child_weight)
@@ -401,26 +433,62 @@ class GBM(SharedTree):
 
             if multinomial:
                 g, h = grads_multi(Y1, F_eff)
-                ktrees = []
+                # preserve the sequential loop's key sequence: one split
+                # per class tree, whether or not the round is batched
+                kks = []
                 for k in range(K):
                     rng, kk = jax.random.split(rng)
-                    tree, leaf = build_tree(
-                        codes, g[:, k] * w_eff, h[:, k] * w_eff, w_eff,
-                        edges_mat, p.nbins,
-                        p.max_depth, p.reg_lambda, p.min_rows,
-                        p.min_split_improvement, lr_build, kk,
-                        p.col_sample_rate, tree_mask,
-                        p.reg_alpha, p.gamma, p.min_child_weight,
-                    hist_precision=p.effective_hist_precision,
-                        hier=use_hier_split_search(p, N),
-                        hist_mode=hist_mode)
+                    kks.append(kk)
+                from .hist import table_lookup
+                if split_mode == "fused" and not use_hier_split_search(p, N):
+                    # DART candidate round on the batched path: ONE build
+                    # grows all K class trees (one launch per level)
+                    fnK = make_build_tree_fn(
+                        p.max_depth, p.nbins, binned.nfeatures, N,
+                        p.effective_hist_precision, hist_mode=hist_mode,
+                        nk=K, split_mode="fused")
+                    tmK = jnp.broadcast_to(
+                        jnp.asarray(tree_mask, bool) if tree_mask
+                        is not None else jnp.ones(binned.nfeatures, bool),
+                        (K, binned.nfeatures))
+                    levels, valsK, coverK, leafK = fnK(
+                        codes, (g * w_eff[:, None]).T,
+                        (h * w_eff[:, None]).T, w_eff, edges_mat,
+                        jnp.stack(kks), p.reg_lambda, p.min_rows,
+                        p.min_split_improvement, lr_build,
+                        p.col_sample_rate, tmK, p.reg_alpha, p.gamma,
+                        p.min_child_weight)
                     if dart:
-                        tree.values = tree.values * b_scale
-                    ktrees.append(tree)
-                    from .hist import table_lookup
-                    dF = table_lookup(jnp.asarray(tree.values)[None, :],
-                                      leaf, len(tree.values))[0]
-                    F = F.at[:, k].add(dF)
+                        valsK = valsK * b_scale
+                    ktrees = [Tree([lv[0][k] for lv in levels],
+                                   [lv[1][k] for lv in levels],
+                                   [lv[2][k] for lv in levels],
+                                   [lv[3][k] for lv in levels], valsK[k],
+                                   cover=coverK[k]) for k in range(K)]
+                    dF = jax.vmap(
+                        lambda v, l: table_lookup(v[None, :], l,
+                                                  v.shape[0])[0])(
+                        valsK, leafK)
+                    F = F + dF.T
+                else:
+                    ktrees = []
+                    for k in range(K):
+                        tree, leaf = build_tree(
+                            codes, g[:, k] * w_eff, h[:, k] * w_eff, w_eff,
+                            edges_mat, p.nbins,
+                            p.max_depth, p.reg_lambda, p.min_rows,
+                            p.min_split_improvement, lr_build, kks[k],
+                            p.col_sample_rate, tree_mask,
+                            p.reg_alpha, p.gamma, p.min_child_weight,
+                            hist_precision=p.effective_hist_precision,
+                            hier=use_hier_split_search(p, N),
+                            hist_mode=hist_mode, split_mode=split_mode)
+                        if dart:
+                            tree.values = tree.values * b_scale
+                        ktrees.append(tree)
+                        dF = table_lookup(jnp.asarray(tree.values)[None, :],
+                                          leaf, len(tree.values))[0]
+                        F = F.at[:, k].add(dF)
                 trees.append(ktrees)
                 if dart and drop_idx:
                     for i in drop_idx:
@@ -441,7 +509,7 @@ class GBM(SharedTree):
                     p.reg_alpha, p.gamma, p.min_child_weight, mono=mono,
                     hist_precision=p.effective_hist_precision,
                     hier=use_hier_split_search(p, N) and mono is None,
-                    hist_mode=hist_mode)
+                    hist_mode=hist_mode, split_mode=split_mode)
                 tree.values = tree.values * b_scale
                 trees.append(tree)
                 from .hist import table_lookup

@@ -595,6 +595,103 @@ def make_subtract_level_fn(d: int, F: int, B: int, n_padded: int,
     return jax.jit(f)
 
 
+@functools.lru_cache(maxsize=None)
+def make_batched_level_fn(d: int, K: int, F: int, B: int, n_padded: int,
+                          bin_counts=None, force_impl: str = "",
+                          precision: str = "bf16", subtract: bool = True):
+    """Level-``d`` histograms for K trees in ONE kernel launch.
+
+    The K-class multinomial round used to issue K separate level programs
+    (K dispatches + K traced copies); here the per-tree local pass is
+    ``jax.vmap``-ed over a leading K axis, which Pallas lowers to a single
+    ``pallas_call`` with K prepended to the grid — one launch per level
+    regardless of K (the batching rule leaves the shared ``codes`` operand
+    unbatched, so the dominant streaming input is NOT duplicated K times).
+    Per-tree row compaction (``subtract=True``, mirroring
+    make_subtract_level_fn) stays plain vmapped XLA: each tree picks its
+    own smaller siblings, so codes/leaf/stat planes diverge per tree after
+    the scatter and batch cleanly into the kernel.
+
+    ``subtract=False`` is the full-rebuild contract (hist_mode="full") at
+    a K axis — the crosscheck oracle for the batched path.
+
+    Shapes: codes [F, N] shared; leaf/g/h/w [K, N]; ``d >= 1`` subtract
+    additionally takes carry [n_shards, K, 3, Lp, F, B].  Returns
+    H [K, 3, 2^d, F, B] (psum'd) and, for subtract, the next carry.
+    """
+    cl = cluster()
+    n_local = n_padded // cl.n_row_shards
+    Lc = 2 ** d
+    Lp = 2 ** max(d - 1, 0)
+    specs_k = (P(None, ROW_AXIS),) * 5
+
+    if not subtract:
+        inner = _local_hist_impl(Lc, F, B, n_local, bin_counts=bin_counts,
+                                 force_impl=force_impl, precision=precision)
+
+        def localf(codes, leafK, gK, hK, wK):
+            Hl = jax.vmap(inner, in_axes=(None, 0, 0, 0, 0))(
+                codes, leafK, gK, hK, wK)
+            return jax.lax.psum(Hl, ROW_AXIS)
+
+        f = shard_map(localf, mesh=cl.mesh, in_specs=specs_k, out_specs=P(),
+                      check_vma=False)
+        return jax.jit(f)
+
+    cap = n_local // 2 if d > 0 else n_local
+    inner = _local_hist_impl(Lp, F, B, cap, bin_counts=bin_counts,
+                             force_impl=force_impl, precision=precision)
+
+    if d == 0:
+        def local0(codes, leafK, gK, hK, wK):
+            Hl = jax.vmap(inner, in_axes=(None, 0, 0, 0, 0))(
+                codes, leafK, gK, hK, wK)
+            return jax.lax.psum(Hl, ROW_AXIS), Hl[None]
+
+        f = shard_map(local0, mesh=cl.mesh, in_specs=specs_k,
+                      out_specs=(P(), P(ROW_AXIS)), check_vma=False)
+        return jax.jit(f)
+
+    def locald(codes, leafK, gK, hK, wK, carry):
+        HpK = carry[0]                             # [K, 3, Lp, F, B]
+
+        def one(leaf, g, h, w, Hp):
+            # per-tree smaller-sibling compaction — the exact
+            # make_subtract_level_fn body, codes closed over (shared)
+            cidx = jax.lax.broadcasted_iota(jnp.int32, (Lc, 1), 0)
+            cnt = jnp.sum(cidx == leaf[None, :], axis=1, dtype=jnp.int32)
+            small_is_left = cnt[0::2] <= cnt[1::2]
+            chosen_child = jnp.stack(
+                [small_is_left, ~small_is_left], axis=1).reshape(-1)
+            chosen = table_lookup(
+                chosen_child.astype(jnp.float32)[None], leaf, Lc)[0] > 0.5
+            target = jnp.where(
+                chosen, jnp.cumsum(chosen.astype(jnp.int32)) - 1, cap)
+            ccodes = jnp.zeros((F, cap), codes.dtype) \
+                .at[:, target].set(codes, mode="drop", unique_indices=True)
+            pleaf = jnp.zeros((cap,), jnp.int32) \
+                .at[target].set((leaf >> 1).astype(jnp.int32), mode="drop",
+                                unique_indices=True)
+            st = jnp.zeros((3, cap), jnp.float32) \
+                .at[:, target].set(
+                    jnp.stack([g, h, w]).astype(jnp.float32), mode="drop",
+                    unique_indices=True)
+            Hs = inner(ccodes, pleaf, st[0], st[1], st[2])
+            Ho = Hp - Hs
+            Ho = Ho.at[1:].max(0.0)
+            sl = small_is_left[None, :, None, None]
+            Hl_ = jnp.where(sl, Hs, Ho)
+            Hr_ = jnp.where(sl, Ho, Hs)
+            return jnp.stack([Hl_, Hr_], axis=2).reshape(3, Lc, F, B)
+
+        HlocK = jax.vmap(one)(leafK, gK, hK, wK, HpK)
+        return jax.lax.psum(HlocK, ROW_AXIS), HlocK[None]
+
+    f = shard_map(locald, mesh=cl.mesh, in_specs=specs_k + (P(ROW_AXIS),),
+                  out_specs=(P(), P(ROW_AXIS)), check_vma=False)
+    return jax.jit(f)
+
+
 def _make_pallas_fine_hist(L: int, F: int, W: int, K: int, nbins: int,
                            n_local: int, interpret: bool = False,
                            precision: str = "bf16"):
@@ -890,6 +987,300 @@ def best_splits(Hist, nbins: int, reg_lambda: float, min_rows: float,
     cr = jnp.where(valid, cr, 0.0)
     children = jnp.stack([gl, hl, cl, gr, hr, cr], axis=1)   # [L, 6]
     return feat, bin_, na_left, best_gain, valid, children
+
+
+# --------------------------------------------------------- fused split search
+#
+# best_splits above materializes ~15 [L, F, B] intermediates (cumsums, both
+# NA-direction gain planes, child stats) through HBM every level — at bench
+# shape that read-back is ~5 ms/level (PROFILE.md round 6), comparable to
+# the histogram kernel itself below the root.  The fused path replaces it
+# with a single-pass Pallas kernel that reads the [3, L, F, B] block ONCE
+# into VMEM, computes cumulative G/H/C via an upper-triangular one-hot
+# matmul on the MXU, evaluates both NA-direction boundary gains, takes the
+# per-(leaf, feature) argmax on-chip, and writes only a compact
+# [L*F, 16]-float winner-record block back out.  A tiny XLA epilogue
+# (finish_splits) then reduces records over features and reproduces
+# best_splits' exact output tuple.  The split search itself cannot live
+# inside the histogram kernel's epilogue: gains need the GLOBALLY psum'd
+# histogram and the hist kernel is per-shard — the fusion here removes the
+# multi-pass XLA materialization, not the (unavoidable) single H block.
+#
+# Record planes (lane k of the [L*F, 16] block):
+#   0 gain   best boundary gain for this (leaf, feature), NA-resolved
+#   1 bin    argmax bin (first index on ties — matches best_splits' argmax)
+#   2 na_left
+#   3-5  GL/HL/CL at the best bin, EXCLUDING the NA bucket
+#   6-8  g/h/c of the NA bucket
+#   9-11 totG/totH/totC (NA included)
+# Lanes 12-15 pad the record row to the lane-tile multiple.
+#
+# The XLA twin (_split_records_xla) evaluates gains with the same formula
+# and jnp.cumsum, making it BIT-identical to best_splits — it is the
+# default off-TPU so CPU crosschecks compare exactly.  On chip the kernel's
+# matmul cumsum accumulates in a different order than jnp.cumsum (both
+# f32-exact per element, ±1 ulp on the sums), so exactly-tied gains are the
+# one legitimate divergence source — same caveat as hist_mode="check".
+
+_REC_PLANES = 12
+
+
+def _split_records_xla(Hist, reg_lambda, min_rows, reg_alpha, gamma,
+                       min_child_weight):
+    """Per-(leaf, feature) winner records [L, F, 12] — XLA path, bit-
+    identical gains to best_splits (same op sequence, jnp.cumsum)."""
+    G, Hs, C = Hist[0], Hist[1], Hist[2]
+    g_na, h_na, c_na = G[..., -1], Hs[..., -1], C[..., -1]
+    cumG = jnp.cumsum(G[..., :-1], -1)
+    cumH = jnp.cumsum(Hs[..., :-1], -1)
+    cumC = jnp.cumsum(C[..., :-1], -1)
+    totG = cumG[..., -1] + g_na
+    totH = cumH[..., -1] + h_na
+    totC = cumC[..., -1] + c_na
+    parent = _score(totG, totH, reg_lambda, reg_alpha)
+    GL, HL, CL = cumG[..., :-1], cumH[..., :-1], cumC[..., :-1]
+    GR = totG[..., None] - GL - g_na[..., None]
+    HR = totH[..., None] - HL - h_na[..., None]
+    CR = totC[..., None] - CL - c_na[..., None]
+
+    def gain_with_na(gl, hl, cl, gr, hr, cr):
+        g = 0.5 * (_score(gl, hl, reg_lambda, reg_alpha)
+                   + _score(gr, hr, reg_lambda, reg_alpha)
+                   - parent[..., None]) - gamma
+        ok = (cl >= min_rows) & (cr >= min_rows) & \
+            (hl >= min_child_weight) & (hr >= min_child_weight)
+        return jnp.where(ok, g, -jnp.inf)
+
+    gain_naL = gain_with_na(GL + g_na[..., None], HL + h_na[..., None],
+                            CL + c_na[..., None], GR, HR, CR)
+    gain_naR = gain_with_na(GL, HL, CL, GR + g_na[..., None],
+                            HR + h_na[..., None], CR + c_na[..., None])
+    na_left_better = gain_naL >= gain_naR
+    gain = jnp.maximum(gain_naL, gain_naR)         # [L, F, nbins-1]
+    bin_ = jnp.argmax(gain, axis=-1)
+
+    def pick(a):
+        return jnp.take_along_axis(a, bin_[..., None], -1)[..., 0]
+
+    return jnp.stack(
+        [pick(gain), bin_.astype(jnp.float32),
+         pick(na_left_better).astype(jnp.float32),
+         pick(GL), pick(HL), pick(CL), g_na, h_na, c_na,
+         totG, totH, totC], axis=-1)               # [L, F, 12]
+
+
+def _make_pallas_split_records(LF: int, B: int, interpret: bool = False):
+    """Split-records kernel: (G2, H2, C2 [LF, B], scal [1, 8] SMEM) ->
+    rec [LF, 16].  One (leaf, feature) pair per sublane row; bins in
+    lanes; grid over row blocks.  Rows must arrive padded to the block
+    multiple (padding rows emit garbage records the caller slices off)."""
+    nbins = B - 1
+    Bpad = (B + 127) // 128 * 128
+    # ~24 live [RS, Bpad] f32 intermediates on the scoped-VMEM stack
+    RS = int(max(8, min(1024, (6_291_456 // (96 * Bpad)) // 8 * 8)))
+    nblk = (LF + RS - 1) // RS
+
+    def kernel(g_ref, h_ref, c_ref, sc_ref, out_ref):
+        lam = sc_ref[0, 0]
+        alpha = sc_ref[0, 1]
+        gamma = sc_ref[0, 2]
+        min_rows = sc_ref[0, 3]
+        mcw = sc_ref[0, 4]
+        Gb, Hb, Cb = g_ref[:], h_ref[:], c_ref[:]
+        biota = jax.lax.broadcasted_iota(jnp.int32, (RS, B), 1)
+
+        def lane(x, k):                            # extract lane k -> [RS, 1]
+            return jnp.sum(jnp.where(biota == k, x, 0.0), axis=1,
+                           keepdims=True)
+
+        gna, hna, cna = lane(Gb, nbins), lane(Hb, nbins), lane(Cb, nbins)
+        reg = biota < nbins
+        # lane cumsum as an upper-triangular 0/1 matmul; HIGHEST because
+        # the default TPU matmul rounds f32 operands to bf16 (the 0/1 side
+        # is exact, so full passes recover exact f32 partial sums)
+        U = (jax.lax.broadcasted_iota(jnp.int32, (B, B), 0)
+             <= jax.lax.broadcasted_iota(jnp.int32, (B, B), 1)) \
+            .astype(jnp.float32)
+
+        def cum(x):
+            return jax.lax.dot_general(
+                jnp.where(reg, x, 0.0), U, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST)
+
+        cumG, cumH, cumC = cum(Gb), cum(Hb), cum(Cb)
+        totG = lane(cumG, nbins - 1) + gna         # [RS, 1]
+        totH = lane(cumH, nbins - 1) + hna
+        totC = lane(cumC, nbins - 1) + cna
+
+        def score(Gv, Hv):
+            Gt = jnp.sign(Gv) * jnp.maximum(jnp.abs(Gv) - alpha, 0.0)
+            return Gt * Gt / (Hv + lam)
+
+        parent = score(totG, totH)
+        cand = biota <= nbins - 2                  # split after bin b
+        GL, HL, CL = cumG, cumH, cumC
+        GR = totG - GL - gna
+        HR = totH - HL - hna
+        CR = totC - CL - cna
+
+        def gain_dir(gl, hl, cl, gr, hr, cr):
+            gn = 0.5 * (score(gl, hl) + score(gr, hr) - parent) - gamma
+            ok = (cl >= min_rows) & (cr >= min_rows) & \
+                (hl >= mcw) & (hr >= mcw)
+            return jnp.where(ok & cand, gn, -jnp.inf)
+
+        gL = gain_dir(GL + gna, HL + hna, CL + cna, GR, HR, CR)
+        gR = gain_dir(GL, HL, CL, GR + gna, HR + hna, CR + cna)
+        nab = (gL >= gR).astype(jnp.float32)
+        gain = jnp.maximum(gL, gR)
+        # first-index lane argmax (ties -> lowest bin, like jnp.argmax)
+        m = jnp.max(gain, axis=1, keepdims=True)
+        idx = jnp.min(jnp.where(gain == m, biota, B), axis=1, keepdims=True)
+        sel = biota == idx
+
+        def pick(x):
+            return jnp.sum(jnp.where(sel, x, 0.0), axis=1, keepdims=True)
+
+        recs = (pick(gain), idx.astype(jnp.float32), pick(nab),
+                pick(GL), pick(HL), pick(CL), gna, hna, cna,
+                totG, totH, totC)
+        oiota = jax.lax.broadcasted_iota(jnp.int32, (RS, 16), 1)
+        out = jnp.zeros((RS, 16), jnp.float32)
+        for k, v in enumerate(recs):
+            out = jnp.where(oiota == k, v, out)
+        out_ref[:] = out
+
+    return pl.pallas_call(
+        kernel,
+        grid=(nblk,),
+        in_specs=[
+            pl.BlockSpec((RS, B), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((RS, B), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((RS, B), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((RS, 16), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((nblk * RS, 16), jnp.float32),
+        interpret=interpret,
+    ), RS
+
+
+def split_records(Hist, nbins: int, reg_lambda, min_rows, reg_alpha=0.0,
+                  gamma=0.0, min_child_weight=0.0, force_impl: str = ""):
+    """Per-(leaf, feature) winner records [L, F, 12] from H[3, L, F, B].
+
+    On TPU the Pallas kernel; elsewhere the bit-identical XLA twin.
+    ``force_impl``: "xla" | "pallas" | "pallas_interpret" pin the path."""
+    cl = cluster()
+    platform = cl.mesh.devices.flat[0].platform
+    use_kernel = force_impl in ("pallas", "pallas_interpret") or \
+        (force_impl == "" and platform == "tpu")
+    if not use_kernel:
+        return _split_records_xla(Hist, reg_lambda, min_rows, reg_alpha,
+                                  gamma, min_child_weight)
+    interpret = force_impl == "pallas_interpret" or platform != "tpu"
+    _, L, F, B = Hist.shape
+    call, RS = _make_pallas_split_records(L * F, B, interpret=interpret)
+    pad = (L * F + RS - 1) // RS * RS - L * F
+    planes = Hist.reshape(3, L * F, B)
+    if pad:
+        planes = jnp.pad(planes, [(0, 0), (0, pad), (0, 0)])
+    sc = jnp.zeros((1, 8), jnp.float32).at[0, :5].set(
+        jnp.stack([reg_lambda, reg_alpha, gamma, min_rows,
+                   min_child_weight]).astype(jnp.float32))
+    # the H block is replicated post-psum; run the kernel replicated too
+    # (pallas_call must not meet the GSPMD partitioner un-shard_mapped)
+    rec = shard_map(call, mesh=cl.mesh, in_specs=(P(), P(), P(), P()),
+                    out_specs=P(), check_vma=False)(
+        planes[0], planes[1], planes[2], sc)
+    return rec[:L * F, :_REC_PLANES].reshape(L, F, _REC_PLANES)
+
+
+def finish_splits(rec, min_rows, min_split_improvement, feat_mask=None):
+    """Reduce winner records over features into best_splits' exact output
+    tuple (feat, bin, na_left, gain, valid, children[L, 6]).  The child
+    statistics reproduce best_splits' arithmetic ORDER (GR formed before
+    the NA resolution), keeping the XLA fused path bitwise-identical."""
+    L, F, _ = rec.shape
+    gain = rec[..., 0]
+    if feat_mask is not None:
+        m = feat_mask if feat_mask.ndim == 2 else feat_mask[None, :]
+        gain = jnp.where(m, gain, -jnp.inf)
+    feat = jnp.argmax(gain, axis=1).astype(jnp.int32)
+
+    def pick(i):
+        return jnp.take_along_axis(rec[..., i], feat[:, None], 1)[:, 0]
+
+    best_gain = jnp.take_along_axis(gain, feat[:, None], 1)[:, 0]
+    bin_ = pick(1).astype(jnp.int32)
+    na_left = pick(2) > 0.5
+    glx, hlx, clx = pick(3), pick(4), pick(5)
+    gna, hna, cna = pick(6), pick(7), pick(8)
+    ftot, htot, ctot = pick(9), pick(10), pick(11)
+    valid = jnp.isfinite(best_gain) & \
+        (best_gain > min_split_improvement) & \
+        (rec[..., 11] >= 2 * min_rows).any(-1)
+    gr0 = ftot - glx - gna
+    hr0 = htot - hlx - hna
+    cr0 = ctot - clx - cna
+    gl = jnp.where(na_left, glx + gna, glx)
+    hl = jnp.where(na_left, hlx + hna, hlx)
+    cl = jnp.where(na_left, clx + cna, clx)
+    gr = jnp.where(na_left, gr0, gr0 + gna)
+    hr = jnp.where(na_left, hr0, hr0 + hna)
+    cr = jnp.where(na_left, cr0, cr0 + cna)
+    gl = jnp.where(valid, gl, ftot)
+    hl = jnp.where(valid, hl, htot)
+    cl = jnp.where(valid, cl, ctot)
+    gr = jnp.where(valid, gr, 0.0)
+    hr = jnp.where(valid, hr, 0.0)
+    cr = jnp.where(valid, cr, 0.0)
+    children = jnp.stack([gl, hl, cl, gr, hr, cr], axis=1)
+    return feat, bin_, na_left, best_gain, valid, children
+
+
+def fused_best_splits(Hist, nbins: int, reg_lambda, min_rows,
+                      min_split_improvement, feat_mask=None,
+                      reg_alpha=0.0, gamma=0.0, min_child_weight=0.0,
+                      force_impl: str = ""):
+    """Drop-in best_splits replacement via the single-pass records path.
+
+    Same output tuple; no ``mono`` support (callers gate monotone builds
+    to the separate path).  Selection equivalence with best_splits' flat
+    f-major argmax: per-(l, f) first-max over bins then first-max over
+    features picks the same (f, b) — both resolve ties toward the lowest
+    flat index.  Call inside jit (traces inline; the records kernel is the
+    only launch)."""
+    rec = split_records(Hist, nbins, reg_lambda, min_rows, reg_alpha,
+                        gamma, min_child_weight, force_impl=force_impl)
+    return finish_splits(rec, min_rows, min_split_improvement, feat_mask)
+
+
+def fused_best_splits_batched(HistK, nbins: int, reg_lambda, min_rows,
+                              min_split_improvement, feat_mask=None,
+                              reg_alpha=0.0, gamma=0.0,
+                              min_child_weight=0.0, force_impl: str = ""):
+    """Batched-K fused split search: H [K, 3, L, F, B] -> per-tree tuples
+    with leading K axes.  The K*L leaves flatten into one records-kernel
+    launch (one dispatch for all K trees); ``feat_mask`` is [K, L, F] or
+    [K, F].  Per-leaf reductions (argmax, valid's any(-1)) are row-local,
+    so flattening K into L is exact."""
+    K, _, L, F, B = HistK.shape
+    Hflat = jnp.moveaxis(HistK, 1, 0).reshape(3, K * L, F, B)
+    fm = None
+    if feat_mask is not None:
+        fm = feat_mask if feat_mask.ndim == 3 else \
+            jnp.broadcast_to(feat_mask[:, None, :], (K, L, F))
+        fm = fm.reshape(K * L, F)
+    feat, bin_, na_left, gain, valid, children = fused_best_splits(
+        Hflat, nbins, reg_lambda, min_rows, min_split_improvement,
+        feat_mask=fm, reg_alpha=reg_alpha, gamma=gamma,
+        min_child_weight=min_child_weight, force_impl=force_impl)
+    return (feat.reshape(K, L), bin_.reshape(K, L),
+            na_left.reshape(K, L), gain.reshape(K, L),
+            valid.reshape(K, L), children.reshape(K, L, 6))
 
 
 def _coarse_totals(Hc, reg_lambda, reg_alpha):

@@ -33,8 +33,10 @@ from ..scorekeeper import stop_early, metric_direction
 from ..distributions import make_distribution
 from .binning import BinnedFrame, fit_bins, encode_bins
 from .hist import (make_hist_fn, make_fine_hist_fn, make_varbin_hist_fn,
-                   make_subtract_level_fn, offset_codes, best_splits,
-                   best_splits_hier, select_superbins, partition)
+                   make_subtract_level_fn, make_batched_level_fn,
+                   offset_codes, best_splits, best_splits_hier,
+                   fused_best_splits, fused_best_splits_batched,
+                   select_superbins, partition)
 
 
 @dataclasses.dataclass
@@ -74,6 +76,19 @@ class SharedTreeParameters(Parameters):
     #   "check"    — driver assert mode: grow one tree both ways on the
     #     real data and raise on divergence, then train with "subtract".
     hist_mode: str = "subtract"
+    # split-search strategy per level (mirrors hist_mode):
+    #   "fused"    (default) — single-pass winner-record kernel between the
+    #     histogram and the tiny feature-argmax epilogue (hist.py
+    #     fused_best_splits; off-TPU the bit-identical XLA twin), and
+    #     multinomial/DRF-multiclass/uplift rounds grow their K trees as
+    #     ONE batched level program (one kernel launch per level);
+    #   "separate" — the multi-pass best_splits oracle + sequential
+    #     K-iteration class loops (the pre-batching pipeline, kept whole);
+    #   "check"    — driver assert mode: grow the first round both ways on
+    #     the real data and raise on divergence, then train with "fused".
+    # Monotone constraints, EFB bundling and the hierarchical search stay
+    # on the separate path (drivers downgrade automatically).
+    split_mode: str = "fused"
     # probability calibration (hex/tree CalibrationHelper)
     calibrate_model: bool = False
     calibration_frame: Optional[object] = None
@@ -382,7 +397,8 @@ def validate_checkpoint_depth(prior, k, params, F: int, n_padded: int):
 def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
                        hist_precision: str = "bf16", hier: bool = False,
                        fine_k: int = 2, bin_counts=None, mono=None,
-                       plan=None, hist_mode: str = "subtract"):
+                       plan=None, hist_mode: str = "subtract",
+                       nk: int = 1, split_mode: str = "separate"):
     """One compiled program that grows a whole tree on device.
 
     The level loop (SharedTree.buildLayer) is unrolled inside a single jit:
@@ -412,8 +428,32 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
     Drivers therefore enable it only at benchmark scale
     (split_search="auto" gate) or on request.  ``hier`` keeps its own
     coarse-level subtraction; ``hist_mode`` does not apply to it.
+
+    ``split_mode="fused"`` swaps best_splits for the single-pass
+    winner-record path (hist.fused_best_splits — on TPU a Pallas kernel
+    that never materializes the [3, L, F, B] gain intermediates, off-TPU
+    a bit-identical XLA twin).  ``nk > 1`` grows K trees at once: g/h,
+    rng_key and tree_mask gain a leading [K] axis, every level issues ONE
+    batched hist launch + ONE records launch for all K trees
+    (hist.make_batched_level_fn), and levels/vals/cover/leaf come back
+    with leading [K].  The batched build reproduces the sequential
+    per-tree key chains exactly (vmapped threefry draws are bitwise the
+    per-key calls), so a K-loop of single-tree builds is its oracle.
     """
     B = nbins + 1
+    if split_mode not in ("separate", "fused"):
+        raise ValueError(
+            f"split_mode={split_mode!r}: use 'separate' or 'fused' here "
+            "('check' is a driver mode — see run_split_crosscheck)")
+    if split_mode == "fused" and (mono is not None or plan is not None
+                                  or hier):
+        raise ValueError(
+            "split_mode='fused' does not compose with monotone "
+            "constraints, EFB bundling or the hierarchical search; the "
+            "drivers downgrade to 'separate' automatically")
+    if nk > 1 and split_mode != "fused":
+        raise ValueError("the batched K-tree build (nk > 1) requires "
+                         "split_mode='fused'")
     if mono is not None and hier:
         raise ValueError("monotone constraints are not supported with "
                          "the hierarchical split search")
@@ -454,6 +494,100 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
         and F * B * 3 * kern_L[d] * 4 <= 12 * 1024 * 1024
         for d in range(max_depth)]
     force = "" if on_tpu else "pallas_interpret"
+    if nk > 1:
+        lev_fns = [
+            make_batched_level_fn(
+                d, nk, F, B, n_padded,
+                bin_counts=tuple(bin_counts) if varbin_level[d] else None,
+                force_impl=force if varbin_level[d] else "",
+                precision=hist_precision,
+                subtract=(hist_mode == "subtract"))
+            for d in range(max_depth)]
+
+        def buildK(codes, g, h, w, edges_mat, rng_keys, reg_lambda,
+                   min_rows, min_split_improvement, learn_rate,
+                   col_sample_rate, tree_mask, reg_alpha, gamma,
+                   min_child_weight):
+            # the K-tree analog of build() below: one level loop, every
+            # array carrying a leading [K].  w may be [N] (row sample
+            # shared across class trees — reference semantics) or [K, N]
+            # (uplift arms); either broadcasts to g's shape.
+            N = codes.shape[1]
+            wK = jnp.broadcast_to(w, g.shape)
+            leaf = jnp.zeros((nk, N), jnp.int32)
+            levels = []
+            alive = jnp.ones((nk, 1), bool)
+            # per-tree key chains: vmapped threefry emits bitwise the
+            # per-key split/uniform results, so each tree's column draws
+            # match the sequential oracle exactly
+            keysK = jax.vmap(
+                lambda kk: jax.random.split(kk, max_depth))(rng_keys)
+            H_carry = None
+            hcodes = offset_codes(codes, bin_counts, nbins) \
+                if any(varbin_level) else codes
+            for d in range(max_depth):
+                L = 2 ** d
+                per_split = jax.vmap(
+                    lambda kd: jax.random.uniform(kd, (L, F)))(
+                        keysK[:, d]) < col_sample_rate
+                per_split = per_split.at[:, :, 0].set(
+                    (per_split.any(axis=2) & per_split[:, :, 0])
+                    | ~per_split.any(axis=2))
+                mask = per_split & tree_mask[:, None, :]
+                lcodes = hcodes if varbin_level[d] else codes
+                if hist_mode == "subtract":
+                    if d == 0:
+                        H, H_carry = lev_fns[0](lcodes, leaf, g, h, wK)
+                    else:
+                        H, H_carry = lev_fns[d](lcodes, leaf, g, h, wK,
+                                                H_carry)
+                else:
+                    H = lev_fns[d](lcodes, leaf, g, h, wK)
+                feat, bin_, na_left, gain, valid, children = \
+                    fused_best_splits_batched(
+                        H, nbins, reg_lambda, min_rows,
+                        min_split_improvement, mask, reg_alpha, gamma,
+                        min_child_weight)
+                if d > 0:
+                    valid = valid & alive
+                    gl, hl, cl2 = (children[..., 0], children[..., 1],
+                                   children[..., 2])
+                    gr, hr, cr2 = (children[..., 3], children[..., 4],
+                                   children[..., 5])
+                    children = jnp.stack(
+                        [jnp.where(valid, gl, gl + gr),
+                         jnp.where(valid, hl, hl + hr),
+                         jnp.where(valid, cl2, cl2 + cr2),
+                         jnp.where(valid, gr, 0.0),
+                         jnp.where(valid, hr, 0.0),
+                         jnp.where(valid, cr2, 0.0)], axis=-1)
+                alive = jnp.stack([valid, valid], axis=2).reshape(nk, -1)
+                thr = edges_mat[feat, jnp.clip(bin_, 0, nbins - 1)]
+                leaf = jax.vmap(partition,
+                                in_axes=(None, 0, 0, 0, 0, 0, None))(
+                    codes, leaf, feat, bin_, na_left, valid,
+                    jnp.int32(nbins))
+                levels.append((feat, thr, na_left, valid))
+            gl, hl, cl = (children[..., 0], children[..., 1],
+                          children[..., 2])
+            gr, hr, cr = (children[..., 3], children[..., 4],
+                          children[..., 5])
+
+            from .hist import newton_value
+
+            def newton(gc, hc, cc):
+                return jnp.where(cc > 0,
+                                 newton_value(gc, hc, reg_lambda,
+                                              reg_alpha),
+                                 0.0)
+            vals = jnp.stack([newton(gl, hl, cl), newton(gr, hr, cr)],
+                             axis=2).reshape(nk, -1)
+            vals = (vals * learn_rate).astype(jnp.float32)
+            cover = jnp.stack([cl, cr], axis=2).reshape(nk, -1) \
+                .astype(jnp.float32)
+            return levels, vals, cover, leaf
+
+        return jax.jit(buildK)
     if not hier and hist_mode == "subtract":
         level_fns = [
             make_subtract_level_fn(
@@ -562,6 +696,14 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
                         H, nbins, plan, reg_lambda, min_rows,
                         min_split_improvement, mask, reg_alpha, gamma,
                         min_child_weight)
+                elif split_mode == "fused":
+                    # single-pass winner records between hist and the tiny
+                    # feature argmax — no [3, L, F, B] gain intermediates
+                    feat, bin_, na_left, gain, valid, children = \
+                        fused_best_splits(
+                            H, nbins, reg_lambda, min_rows,
+                            min_split_improvement, mask, reg_alpha, gamma,
+                            min_child_weight)
                 else:
                     feat, bin_, na_left, gain, valid, children = best_splits(
                         H, nbins, reg_lambda, min_rows,
@@ -706,13 +848,31 @@ def resolve_hist_mode(params) -> str:
     return mode
 
 
+def resolve_split_mode(params, *, mono=None, plan=None,
+                       hier: bool = False) -> str:
+    """Validate + normalize the ``split_mode`` knob (mirrors
+    resolve_hist_mode; drivers call this once and ``"check"`` is resolved
+    to ``"fused"`` AFTER run_split_crosscheck).  Monotone constraints, EFB
+    bundling and the hierarchical search have no fused implementation, so
+    those builds downgrade to ``"separate"`` here — silently, matching
+    the drivers' existing auto-gating of those features."""
+    mode = str(getattr(params, "split_mode", "fused")).lower()
+    if mode not in ("fused", "separate", "check"):
+        raise ValueError(
+            f"split_mode={mode!r}: use fused | separate | check")
+    if mode != "separate" and (mono is not None or plan is not None
+                               or hier):
+        return "separate"
+    return mode
+
+
 def run_hist_crosscheck(codes, g, h, w, edges_mat, rng_key, *, max_depth,
                         nbins, F, n_padded, hist_precision="f32",
                         bin_counts=None, mono=None, plan=None,
                         reg_lambda=0.0, min_rows=1.0,
                         min_split_improvement=1e-5, learn_rate=0.1,
                         reg_alpha=0.0, gamma=0.0, min_child_weight=0.0,
-                        atol=1e-4):
+                        nk: int = 1, atol=1e-4):
     """The hist_mode="check" driver assert: grow ONE tree with the
     subtraction path and one with the full oracle on identical inputs and
     raise AssertionError on any divergence in split structure, row routing
@@ -724,13 +884,21 @@ def run_hist_crosscheck(codes, g, h, w, edges_mat, rng_key, *, max_depth,
     gains are the one legitimate divergence source (f32 subtraction
     rounding can reorder equal gains) — that trips the assert by design:
     "byte-exact or provably within tolerance" is the contract checked.
+
+    ``nk > 1`` covers the batched K-tree path: g/h are [K, N], rng_key is
+    [K, 2], and both hist modes run through the batched level programs
+    (which require the fused split search) — so a multinomial/DRF round's
+    exact batched kernel geometry is what gets checked.
     """
     outs = {}
-    tm = jnp.ones((F,), bool)
+    tm = jnp.ones((nk, F), bool) if nk > 1 else jnp.ones((F,), bool)
     for mode in ("subtract", "full"):
         fn = make_build_tree_fn(max_depth, nbins, F, n_padded,
                                 hist_precision, bin_counts=bin_counts,
-                                mono=mono, plan=plan, hist_mode=mode)
+                                mono=mono, plan=plan, hist_mode=mode,
+                                nk=nk,
+                                split_mode="fused" if nk > 1
+                                else "separate")
         levels, vals, cover, leaf = fn(
             codes, g, h, w, edges_mat, rng_key, reg_lambda, min_rows,
             min_split_improvement, learn_rate, 1.0, tm, reg_alpha, gamma,
@@ -760,13 +928,116 @@ def run_hist_crosscheck(codes, g, h, w, edges_mat, rng_key, *, max_depth,
             f"{np.max(np.abs(np.asarray(v_s) - np.asarray(v_f)))})")
 
 
+def run_split_crosscheck(codes, g, h, w, edges_mat, rng_keys, *, max_depth,
+                         nbins, F, n_padded, hist_precision="f32",
+                         bin_counts=None, hist_mode="subtract",
+                         tree_masks=None, reg_lambda=0.0, min_rows=1.0,
+                         min_split_improvement=1e-5, learn_rate=0.1,
+                         col_sample_rate=1.0, reg_alpha=0.0, gamma=0.0,
+                         min_child_weight=0.0, atol=1e-4):
+    """The split_mode="check" driver assert: grow ONE round of K trees
+    with the fused path (batched-K when K > 1) and with a K-loop of
+    sequential separate-oracle builds on identical inputs; raise
+    AssertionError on any divergence in split structure, row routing or
+    leaf values.
+
+    ``g``/``h``/``rng_keys``/``tree_masks`` carry a leading [K] (K=1
+    collapses to the single-tree fused-vs-best_splits check); ``w`` is
+    [N] shared or [K, N].  Runs at the caller's real padded shape so the
+    exact batched kernel geometry of the training run is validated.
+    Comparisons at invalid slots are masked: a dead node's stored
+    (feat, thr) is arbitrary — the paths may legitimately disagree there
+    when a leaf's feature draw is empty — and nothing reads it
+    (partition routes by valid).  On chip, exactly tied gains can reorder
+    under the records kernel's different cumsum association — same
+    legitimate-divergence caveat as hist_mode="check".
+    """
+    g, h = jnp.asarray(g), jnp.asarray(h)
+    if g.ndim == 1:
+        g, h = g[None], h[None]
+    K = g.shape[0]
+    rng_keys = jnp.asarray(rng_keys)
+    if rng_keys.ndim == 1:
+        rng_keys = rng_keys[None]
+    tm = jnp.asarray(tree_masks, bool) if tree_masks is not None \
+        else jnp.ones((K, F), bool)
+    if tm.ndim == 1:
+        tm = tm[None]
+    wK = jnp.broadcast_to(jnp.asarray(w), g.shape)
+    hm = hist_mode if hist_mode in ("subtract", "full") else "subtract"
+    sep = make_build_tree_fn(max_depth, nbins, F, n_padded, hist_precision,
+                             bin_counts=bin_counts, hist_mode=hm)
+    sep_out = []
+    for k in range(K):
+        levels, vals, cover, leaf = sep(
+            codes, g[k], h[k], wK[k], edges_mat, rng_keys[k], reg_lambda,
+            min_rows, min_split_improvement, learn_rate, col_sample_rate,
+            tm[k], reg_alpha, gamma, min_child_weight)
+        sep_out.append(jax.device_get([[list(lv) for lv in levels], vals,
+                                       leaf]))
+    if K > 1:
+        fus = make_build_tree_fn(max_depth, nbins, F, n_padded,
+                                 hist_precision, bin_counts=bin_counts,
+                                 hist_mode=hm, nk=K, split_mode="fused")
+        levels, vals, cover, leaf = fus(
+            codes, g, h, wK, edges_mat, rng_keys, reg_lambda, min_rows,
+            min_split_improvement, learn_rate, col_sample_rate, tm,
+            reg_alpha, gamma, min_child_weight)
+    else:
+        fus = make_build_tree_fn(max_depth, nbins, F, n_padded,
+                                 hist_precision, bin_counts=bin_counts,
+                                 hist_mode=hm, split_mode="fused")
+        levels, vals, cover, leaf = fus(
+            codes, g[0], h[0], wK[0], edges_mat, rng_keys[0], reg_lambda,
+            min_rows, min_split_improvement, learn_rate, col_sample_rate,
+            tm[0], reg_alpha, gamma, min_child_weight)
+        levels = [tuple(x[None] for x in lv) for lv in levels]
+        vals, leaf = vals[None], leaf[None]
+    lv_fus, v_fus, leaf_fus = jax.device_get(
+        [[list(lv) for lv in levels], vals, leaf])
+    for k in range(K):
+        lv_s, v_s, leaf_s = sep_out[k]
+        for d in range(len(lv_s)):
+            valid_s = np.asarray(lv_s[d][3], bool)
+            if not np.array_equal(valid_s,
+                                  np.asarray(lv_fus[d][3][k], bool)):
+                raise AssertionError(
+                    f"split_mode='check': fused and separate builds "
+                    f"disagree on valid at tree {k} level {d}")
+            for name, i in (("feat", 0), ("na_left", 2)):
+                a = np.asarray(lv_s[d][i])
+                b = np.asarray(lv_fus[d][i][k])
+                if not np.array_equal(a[valid_s], b[valid_s]):
+                    raise AssertionError(
+                        f"split_mode='check': {name} diverges at tree "
+                        f"{k} level {d}: {a} vs {b}")
+            a = np.asarray(lv_s[d][1])
+            b = np.asarray(lv_fus[d][1][k])
+            if not np.allclose(a[valid_s], b[valid_s], atol=atol,
+                               rtol=1e-5):
+                raise AssertionError(
+                    f"split_mode='check': split thresholds diverge at "
+                    f"tree {k} level {d}")
+        if not np.array_equal(leaf_s, leaf_fus[k]):
+            raise AssertionError(
+                "split_mode='check': final leaf routing differs between "
+                f"the fused and separate builds for tree {k}")
+        if not np.allclose(v_s, v_fus[k], atol=atol, rtol=1e-4):
+            raise AssertionError(
+                f"split_mode='check': leaf values diverge for tree {k} "
+                f"(max abs diff "
+                f"{np.max(np.abs(np.asarray(v_s) - np.asarray(v_fus[k])))}"
+                ")")
+
+
 @functools.lru_cache(maxsize=None)
 def make_tree_scan_fn(mode: str, tweedie_power: float, quantile_alpha: float,
                       huber_alpha: float, max_depth: int, nbins: int, F: int,
                       n_padded: int, hist_precision: str, sample_rate: float,
                       col_sample_rate_per_tree: float, hier: bool = False,
                       bin_counts=None, mono=None, custom_fn=None, plan=None,
-                      hist_mode: str = "subtract"):
+                      hist_mode: str = "subtract",
+                      split_mode: str = "fused"):
     """Scan a CHUNK of boosting/bagging rounds in ONE device dispatch.
 
     The per-tree driver loop (gradients -> row/column sample -> grow ->
@@ -785,9 +1056,12 @@ def make_tree_scan_fn(mode: str, tweedie_power: float, quantile_alpha: float,
             mode, nclasses=2 if mode == "bernoulli" else 1,
             tweedie_power=tweedie_power, quantile_alpha=quantile_alpha,
             huber_alpha=huber_alpha, custom_distribution_func=custom_fn)
+    if mono is not None or plan is not None or hier:
+        split_mode = "separate"          # no fused path for these builds
     bt_fn = make_build_tree_fn(max_depth, nbins, F, n_padded, hist_precision,
                                hier=hier, bin_counts=bin_counts, mono=mono,
-                               plan=plan, hist_mode=hist_mode)
+                               plan=plan, hist_mode=hist_mode,
+                               split_mode=split_mode)
 
     def scan_fn(codes, y, w, F0, edges_mat, rng0, chunk_no, nchunk,
                 reg_lambda, min_rows, min_split_improvement, learn_rate,
@@ -835,23 +1109,43 @@ def make_multinomial_scan_fn(K: int, max_depth: int, nbins: int, F: int,
                              sample_rate: float,
                              col_sample_rate_per_tree: float,
                              hier: bool = False, bin_counts=None, plan=None,
-                             hist_mode: str = "subtract"):
-    """Scan a chunk of multinomial boosting rounds in ONE dispatch.
+                             hist_mode: str = "subtract",
+                             split_mode: str = "fused",
+                             mode: str = "multinomial"):
+    """Scan a chunk of K-tree rounds in ONE dispatch.
 
-    Each round grows K one-vs-rest trees on softmax gradients
-    (GBM.java buildNextKTrees' K-tree loop), all inside the scan body —
-    the multinomial analog of make_tree_scan_fn.  Rows are sampled once
-    per round and shared across the K class trees (reference semantics).
+    Each round grows K one-vs-rest trees — on softmax gradients for
+    ``mode="multinomial"`` (GBM.java buildNextKTrees' K-tree loop) or on
+    the constant forest fit (grad=-y, hess=1) for ``mode="drf"`` — all
+    inside the scan body.  Rows are sampled once per round and shared
+    across the K class trees (reference semantics).
+
+    ``split_mode="fused"`` (default) grows the K trees as ONE batched
+    build (make_build_tree_fn nk=K): one hist launch + one split-records
+    launch per level regardless of K, and the traced scan body holds one
+    level program instead of K copies.  ``"separate"`` keeps the
+    K-iteration Python loop of single-tree builds — the oracle the
+    batched path reproduces key-for-key (same fold_in structure), which
+    run_split_crosscheck asserts on real data.
+
     Returns (F_final [N, K], levels with leading [T, K, ...] dims, values
-    [T, K, 2^depth], covers [T, K, 2^depth]).
+    [T, K, 2^depth], covers [T, K, 2^depth]) — identical layout on both
+    paths.
     """
     # the builder clamps internally; the level-stacking loop below must
     # iterate the SAME effective count
     max_depth = effective_max_depth(max_depth, nbins, F, n_padded)
+    if mode not in ("multinomial", "drf"):
+        raise ValueError(f"mode={mode!r}: use 'multinomial' or 'drf'")
+    if hier or plan is not None:
+        split_mode = "separate"          # no fused path for these builds
+    batched = split_mode == "fused" and K > 1
     bt_fn = make_build_tree_fn(max_depth, nbins, F, n_padded,
                                hist_precision, hier=hier,
                                bin_counts=bin_counts, plan=plan,
-                               hist_mode=hist_mode)
+                               hist_mode=hist_mode,
+                               nk=K if batched else 1,
+                               split_mode=split_mode)
 
     def scan_fn(codes, Y1, w, F0, edges_mat, rng0, chunk_no, nchunk,
                 reg_lambda, min_rows, min_split_improvement, learn_rate,
@@ -862,25 +1156,47 @@ def make_multinomial_scan_fn(K: int, max_depth: int, nbins: int, F: int,
 
         def body(Fc, key_t):
             ks, km, kb = jax.random.split(key_t, 3)
-            Pr = jax.nn.softmax(Fc, axis=1)
-            g = Pr - Y1
-            h = jnp.maximum(Pr * (1 - Pr), 1e-10)
+            if mode == "drf":
+                # forest mean-fit: constant pseudo-gradients, no feedback
+                g = -Y1
+                h = jnp.ones_like(Y1)
+            else:
+                Pr = jax.nn.softmax(Fc, axis=1)
+                g = Pr - Y1
+                h = jnp.maximum(Pr * (1 - Pr), 1e-10)
             wv = w
             if sample_rate < 1.0:
                 wv = w * jax.random.bernoulli(ks, sample_rate, w.shape)
-            per_levels, per_vals, per_covers, dFs = [], [], [], []
+            # per-class key/mask derivation is IDENTICAL on both paths
+            # (fold_in(kb, k) / fold_in(km, k)) so batched and separate
+            # rounds draw the same columns and per-split subsets
+            tms, kks = [], []
             for k in range(K):
-                kk = jax.random.fold_in(kb, k)
+                kks.append(jax.random.fold_in(kb, k))
                 tm = jnp.ones((F,), bool)
                 if col_sample_rate_per_tree < 1.0:
                     m = jax.random.uniform(
                         jax.random.fold_in(km, k),
                         (F,)) < col_sample_rate_per_tree
                     tm = m.at[0].set(m[0] | ~m.any())
+                tms.append(tm)
+            if batched:
+                levels, vals, covers, leafK = bt_fn(
+                    codes, (g * wv[:, None]).T, (h * wv[:, None]).T, wv,
+                    edges_mat, jnp.stack(kks), reg_lambda, min_rows,
+                    min_split_improvement, learn_rate, col_sample_rate,
+                    jnp.stack(tms), reg_alpha, gamma, min_child_weight)
+                dF = jax.vmap(
+                    lambda v, l: table_lookup(v[None, :], l,
+                                              v.shape[0])[0])(vals, leafK)
+                return Fc + dF.T, (tuple(tuple(lvl) for lvl in levels),
+                                   vals, covers)
+            per_levels, per_vals, per_covers, dFs = [], [], [], []
+            for k in range(K):
                 levels, vals, cover, leaf = bt_fn(
-                    codes, g[:, k] * wv, h[:, k] * wv, wv, edges_mat, kk,
-                    reg_lambda, min_rows, min_split_improvement,
-                    learn_rate, col_sample_rate, tm, reg_alpha, gamma,
+                    codes, g[:, k] * wv, h[:, k] * wv, wv, edges_mat,
+                    kks[k], reg_lambda, min_rows, min_split_improvement,
+                    learn_rate, col_sample_rate, tms[k], reg_alpha, gamma,
                     min_child_weight)
                 per_levels.append(levels)
                 per_vals.append(vals)
@@ -953,7 +1269,8 @@ def build_tree(codes, g, h, w, edges, nbins: int, max_depth: int,
                tree_col_mask: Optional[np.ndarray] = None,
                reg_alpha: float = 0.0, gamma: float = 0.0,
                min_child_weight: float = 0.0, hist_precision: str = "bf16",
-               hier: bool = False, mono=None, hist_mode: str = "subtract"):
+               hier: bool = False, mono=None, hist_mode: str = "subtract",
+               split_mode: str = "fused"):
     """Grow one tree — convenience wrapper around make_build_tree_fn.
 
     ``edges`` may be the per-feature edge list (converted to the dense
@@ -968,8 +1285,11 @@ def build_tree(codes, g, h, w, edges, nbins: int, max_depth: int,
     edges_mat = jnp.asarray(edges, jnp.float32)
     tm = jnp.asarray(tree_col_mask, bool) if tree_col_mask is not None \
         else jnp.ones(F, bool)
+    if mono is not None or hier:
+        split_mode = "separate"          # no fused path for these builds
     fn = make_build_tree_fn(max_depth, nbins, F, N, hist_precision,
-                            hier=hier, mono=mono, hist_mode=hist_mode)
+                            hier=hier, mono=mono, hist_mode=hist_mode,
+                            split_mode=split_mode)
     levels, vals, cover, leaf = fn(codes, g, h, w, edges_mat, rng_key,
                                    reg_lambda, min_rows,
                                    min_split_improvement, learn_rate,

@@ -28,11 +28,11 @@ from ...runtime.job import Job
 from ..base import Model, ModelBuilder
 from ..datainfo import DataInfo
 from .binning import fit_bins, edges_matrix
-from .hist import (make_hist_fn, make_subtract_level_fn, partition,
-                   table_lookup)
+from .hist import (make_batched_level_fn, make_hist_fn,
+                   make_subtract_level_fn, partition, table_lookup)
 from .shared import (SharedTreeModel, SharedTree, SharedTreeParameters,
                      StackedTrees, Tree, TreeList, resolve_hist_mode,
-                     traverse_jit)
+                     resolve_split_mode, traverse_jit)
 
 _EPS = 1e-6
 
@@ -201,6 +201,16 @@ class UpliftDRF(SharedTree):
         full_fns = [make_hist_fn(2 ** d, F, B, N)
                     for d in range(p.max_depth)] \
             if hist_mode in ("full", "check") else None
+        # split_mode="fused": the two arms ride the batched level program
+        # as the K axis (K=2, shared leaf routing, per-arm stat planes) —
+        # one hist launch per level instead of two; the divergence split
+        # search itself stays _uplift_best_splits.  "check" grows the
+        # first tree both ways and asserts, then trains batched.
+        split_mode = resolve_split_mode(p)
+        bfns = [make_batched_level_fn(
+                    d, 2, F, B, N, subtract=(hist_mode != "full"))
+                for d in range(p.max_depth)] \
+            if split_mode != "separate" else None
 
         col_rate = 1.0 if p.mtries == -2 else \
             max(min(p.mtries if p.mtries > 0 else int(np.sqrt(F)), F), 1) / F
@@ -219,16 +229,33 @@ class UpliftDRF(SharedTree):
             pc = jnp.where(nc > 0, y1c / jnp.maximum(nc, _EPS), 0.0)
             return pt.astype(jnp.float32), pc.astype(jnp.float32)
 
-        def grow_tree(wv, keys, mode):
+        def grow_tree(wv, keys, mode, batched=False):
             """One uplift tree's level loop under the given hist_mode."""
             leaf = jnp.zeros(N, jnp.int32)
             levels = []
             gt, nt = wv * y * treat, wv * treat
             gc, nc = wv * y * (1 - treat), wv * (1 - treat)
-            Ht_carry = Hc_carry = None
+            if batched:
+                gA, nA = jnp.stack([gt, gc]), jnp.stack([nt, nc])
+            Ht_carry = Hc_carry = HA_carry = None
             for d in range(p.max_depth):
                 L = 2 ** d
-                if mode == "subtract":
+                if batched:
+                    # both arms in ONE launch per level: arm = batched-K
+                    # axis; the shared leaf broadcasts, so both arms pick
+                    # identical smaller-sibling compactions
+                    leafA = jnp.broadcast_to(leaf, (2, N))
+                    if mode == "subtract":
+                        if d == 0:
+                            HA, HA_carry = bfns[0](codes, leafA, gA, nA,
+                                                   nA)
+                        else:
+                            HA, HA_carry = bfns[d](codes, leafA, gA, nA,
+                                                   nA, HA_carry)
+                    else:
+                        HA = bfns[d](codes, leafA, gA, nA, nA)
+                    Ht, Hc = HA[0], HA[1]
+                elif mode == "subtract":
                     if d == 0:
                         Ht, Ht_carry = level_fns[0](codes, leaf, gt, nt, nt)
                         Hc, Hc_carry = level_fns[0](codes, leaf, gc, nc, nc)
@@ -259,6 +286,7 @@ class UpliftDRF(SharedTree):
             if p.sample_rate < 1.0:
                 wv = w * jax.random.bernoulli(ks, p.sample_rate, w.shape)
             keys = jax.random.split(km, p.max_depth)
+            hm = "full" if hist_mode == "full" else "subtract"
             if hist_mode == "check" and t_i == 0:
                 # driver assert: first tree grown both ways must agree
                 lv_s, leaf_s = grow_tree(wv, keys, "subtract")
@@ -276,10 +304,28 @@ class UpliftDRF(SharedTree):
                         "hist_mode='check': uplift final leaf routing "
                         "differs between histogram builds")
                 levels, leaf = lv_s, leaf_s
+            elif split_mode == "check" and t_i == 0:
+                # driver assert: the batched two-arm level program must
+                # grow the same first tree as the two-call-per-level path
+                lv_b, leaf_b = grow_tree(wv, keys, hm, batched=True)
+                lv_s, leaf_s = grow_tree(wv, keys, hm)
+                host = jax.device_get([lv_b, leaf_b, lv_s, leaf_s])
+                for d, (a, b) in enumerate(zip(host[0], host[2])):
+                    for i, nm in ((0, "feat"), (1, "thr"), (3, "valid")):
+                        if not np.allclose(a[i], b[i]):
+                            raise AssertionError(
+                                f"split_mode='check': uplift batched and "
+                                f"separate level builds disagree on {nm} "
+                                f"at level {d}")
+                if not np.array_equal(host[1], host[3]):
+                    raise AssertionError(
+                        "split_mode='check': uplift final leaf routing "
+                        "differs between the batched and separate builds")
+                split_mode = "fused"
+                levels, leaf = lv_b, leaf_b
             else:
                 levels, leaf = grow_tree(
-                    wv, keys,
-                    "full" if hist_mode == "full" else "subtract")
+                    wv, keys, hm, batched=(split_mode == "fused"))
             pt_vals, pc_vals = leaf_stats(leaf, wv)
             lv = [tuple(x) if not isinstance(x, tuple) else x
                   for x in levels]

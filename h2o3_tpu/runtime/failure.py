@@ -31,9 +31,13 @@ list of ``point:proc:nth[:action[:arg][:repeat]]`` specs.  ``action``:
 
 Non-kill actions fire ``repeat`` times (default 1) starting at the nth
 hit, so a transient fault heals and retry paths can be proven to
-converge.  Injection points: ``tree_chunk``, ``dl_iter``, ``dkv_rpc``,
-``parse_range``, ``cv_fold``, ``grid_member``, ``automl_member``,
-``glm_lambda``, ``snapshot_write``.
+converge.  Injection points: ``tree_chunk``, ``ktree_round``,
+``dl_iter``, ``dkv_rpc``, ``parse_range``, ``cv_fold``,
+``grid_member``, ``automl_member``, ``glm_lambda``,
+``snapshot_write``.  ``ktree_round`` fires at the top of every batched
+K-tree boosting round (the fused multinomial/multiclass level
+program), so kill/resume mid-round exercises snapshot recovery of the
+one-launch-per-level path.
 """
 
 from __future__ import annotations

@@ -85,6 +85,7 @@ def _release_compiled_programs():
         from h2o3_tpu.models.tree import hist as _h, shared as _s
         for fn in (_h.make_hist_fn, _h.make_fine_hist_fn,
                    _h.make_varbin_hist_fn, _h.make_subtract_level_fn,
+                   _h.make_batched_level_fn,
                    _s.make_build_tree_fn, _s.make_tree_scan_fn,
                    _s.make_multinomial_scan_fn):
             fn.cache_clear()

@@ -155,6 +155,102 @@ def test_kill_resume_verify_gbm(cl, tmp_path):
     np.testing.assert_allclose(resumed, base, rtol=1e-4, atol=1e-4)
 
 
+_MULTI_CSV_ROWS = 600
+
+_TRAIN_MULTI = textwrap.dedent("""
+    import sys
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import h2o3_tpu
+    h2o3_tpu.init()
+    from h2o3_tpu.frame.parse import import_file
+    from h2o3_tpu.models import GBM
+    fr = import_file(sys.argv[1], destination_frame="chaos_multi_fr")
+    m = GBM(response_column="y", ntrees={nt}, max_depth=3, learn_rate=0.2,
+            seed=7, score_tree_interval=2).train(fr)
+    probs = np.stack([m.predict(fr).vec(c).to_numpy() for c in "abc"],
+                     axis=1)
+    np.save(sys.argv[2], probs)
+    print("TRAINED", m.output["ntrees_trained"])
+""").format(nt=NTREES)
+
+_RESUME_MULTI = textwrap.dedent("""
+    import json
+    import sys
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import h2o3_tpu
+    h2o3_tpu.init()
+    from h2o3_tpu.frame.parse import import_file
+    from h2o3_tpu.runtime import dkv, recovery
+    fr = import_file(sys.argv[1], destination_frame="chaos_multi_fr")
+    done = recovery.resume()
+    assert len(done) == 1, f"expected 1 resumed model, got {{done}}"
+    m = dkv.get(done[0])
+    print("RESUME_INFO", json.dumps({{
+        "ntrees": m.output["ntrees_trained"],
+        "cursor": m.output["resumed_from_snapshot"]["cursor"]}}))
+    probs = np.stack([m.predict(fr).vec(c).to_numpy() for c in "abc"],
+                     axis=1)
+    np.save(sys.argv[2], probs)
+""").format()
+
+
+def _write_multi_csv(path, seed=13, n=_MULTI_CSV_ROWS):
+    rng = np.random.default_rng(seed)
+    centers = np.array([[2.0, 0.0], [-2.0, 1.0], [0.0, -2.0]])
+    labels = rng.integers(0, 3, n)
+    X = centers[labels] + rng.normal(size=(n, 2))
+    names = np.array(["a", "b", "c"])[labels]
+    path.write_text("x0,x1,y\n" + "\n".join(
+        f"{r[0]:.9g},{r[1]:.9g},{s}" for r, s in zip(X, names)))
+    return str(path)
+
+
+def test_kill_resume_mid_multinomial_round(cl, tmp_path):
+    """Chaos row for the batched K-tree path: ``ktree_round`` fires at the
+    top of every fused multinomial chunk (one launch per level for all K
+    class trees), so the kill lands mid-boosting-round on the batched
+    pipeline.  Resume must restart from the last chunk-boundary snapshot
+    and reproduce the uninterrupted run's class probabilities."""
+    csv = _write_multi_csv(tmp_path / "chaos_multi.csv")
+    base_dir = tmp_path / "base_multi"
+    base_dir.mkdir()
+
+    base_npy = str(tmp_path / "base_multi.npy")
+    out = _run(_TRAIN_MULTI, _chaos_env(base_dir), csv, base_npy)
+    assert f"TRAINED {NTREES}" in out.stdout
+
+    kill_dir = tmp_path / "kill_multi"
+    kill_dir.mkdir()
+    kill_npy = str(tmp_path / "kill_multi.npy")
+    _run(_TRAIN_MULTI,
+         _chaos_env(kill_dir,
+                    {"H2O3_TPU_FAULT_INJECT":
+                     f"ktree_round:0:{KILL_AT_CHUNK}"}),
+         csv, kill_npy, expect_rc=137)
+    assert not os.path.exists(kill_npy)
+    (entry_path,) = kill_dir.glob("job_*.json")
+    entry = json.loads(entry_path.read_text())
+    assert entry["status"] == "running"
+    assert entry["snapshot_uri"]
+    assert entry["snapshot_cursor"]["trees_done"] == 2 * (KILL_AT_CHUNK - 1)
+
+    res_npy = str(tmp_path / "resumed_multi.npy")
+    out = _run(_RESUME_MULTI, _chaos_env(kill_dir), csv, res_npy)
+    info = json.loads(
+        next(line for line in out.stdout.splitlines()
+             if line.startswith("RESUME_INFO ")).split(" ", 1)[1])
+    assert info["ntrees"] == NTREES
+    assert info["cursor"]["trees_done"] == 2 * (KILL_AT_CHUNK - 1)
+    assert not list(kill_dir.glob("job_*.json"))
+
+    np.testing.assert_allclose(np.load(res_npy), np.load(base_npy),
+                               rtol=1e-4, atol=1e-4)
+
+
 def test_kill_without_snapshot_still_resumes_from_zero(cl, tmp_path):
     """Matrix row 2: killed before the first snapshot could land
     (snapshot_write is the kill point) — the journal has no snapshot_uri

@@ -107,6 +107,22 @@ def test_subtract_level_lowers_for_tpu():
         _lower_tpu(fn, codes, leaf, g, h, w, carry)
 
 
+def test_split_records_kernel_lowers_for_tpu():
+    """The fused coarse split search's winner-records kernel (triangular
+    one-hot matmul cumsum + on-chip per-(leaf, feature) argmax) at every
+    level width of a depth-6 build, plus the batched-K multinomial shape
+    (K trees flatten into the leaf-row axis, so K*L*F rows is just a
+    bigger grid of the same geometry)."""
+    from functools import partial
+    from h2o3_tpu.models.tree.hist import split_records
+
+    for L in BENCH_LEVELS + (3 * 32,):             # K=3 classes at depth 5
+        fn = jax.jit(partial(split_records, nbins=NBINS, reg_lambda=0.5,
+                             min_rows=10.0, reg_alpha=0.1, gamma=0.1,
+                             min_child_weight=1.0, force_impl="pallas"))
+        _lower_tpu(fn, ((3, L, F, B), jnp.float32))
+
+
 @pytest.mark.xfail(
     reason="jax 0.4.37 (the PR-1 compat downgrade) does not run the "
            "Mosaic MLIR verifier inside jax.export — the f32 "
