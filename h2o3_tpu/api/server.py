@@ -18,6 +18,7 @@ import json
 import os
 import re
 import threading
+import time
 import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Tuple
@@ -85,10 +86,17 @@ def _model_schema(key: str, m) -> dict:
 
 
 class _Server(ThreadingHTTPServer):
-    """HTTP server with optional per-connection TLS (deferred handshake)."""
+    """HTTP server with optional per-connection TLS (deferred handshake)
+    and in-flight handler tracking so shutdown can drain gracefully."""
 
     ssl_context = None
     daemon_threads = True
+    block_on_close = False        # drain() bounds the wait instead
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._inflight: set = set()
+        self._inflight_lock = threading.Lock()
 
     def get_request(self):
         sock, addr = super().get_request()
@@ -96,6 +104,28 @@ class _Server(ThreadingHTTPServer):
             sock = self.ssl_context.wrap_socket(
                 sock, server_side=True, do_handshake_on_connect=False)
         return sock, addr
+
+    def process_request_thread(self, request, client_address):
+        t = threading.current_thread()
+        with self._inflight_lock:
+            self._inflight.add(t)
+        try:
+            super().process_request_thread(request, client_address)
+        finally:
+            with self._inflight_lock:
+                self._inflight.discard(t)
+
+    def drain(self, timeout: float) -> int:
+        """Wait (bounded) for in-flight request handlers; returns how many
+        were still running when the deadline hit."""
+        deadline = time.time() + timeout
+        while True:
+            with self._inflight_lock:
+                live = [t for t in self._inflight
+                        if t.is_alive() and t is not threading.current_thread()]
+            if not live or time.time() >= deadline:
+                return len(live)
+            live[0].join(timeout=min(0.1, max(deadline - time.time(), 0.01)))
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -940,13 +970,17 @@ class Api:
         """GET /3/Recovery — journal + progress-snapshot state: which jobs
         are resumable, from which snapshot/cursor (operator view of the
         survivable-training pipeline; defaults to H2O3_TPU_RECOVERY_DIR)."""
+        from ..runtime import dkv
         from ..runtime.recovery import journal_status
         entries = journal_status(recovery_dir or None)
         return {"recovery_dir": recovery_dir or
                 os.environ.get("H2O3_TPU_RECOVERY_DIR", ""),
                 "entries": entries,
                 "resumable": sum(1 for e in entries
-                                 if e.get("status") == "running")}
+                                 if e.get("status") == "running"),
+                # coordinator durability/fencing: epoch, WAL generation/
+                # records, dedup window — the restart-runbook facts
+                "coordinator": dkv.wal_stats()}
 
     _nps: dict = {}
 
@@ -1006,9 +1040,10 @@ class Api:
         return {"status": "shutting down"}
 
     def timeline(self) -> dict:
-        """GET /3/Timeline — recent runtime events (TimelineHandler:12)."""
-        from ..runtime.observability import timeline_events
-        return {"events": timeline_events()}
+        """GET /3/Timeline — recent runtime events (TimelineHandler:12)
+        plus the monotonic counters (WAL records/bytes, dedup hits)."""
+        from ..runtime.observability import counters, timeline_events
+        return {"events": timeline_events(), "counters": counters()}
 
     def logs(self, **kw) -> dict:
         from ..runtime.observability import recent_logs
@@ -1215,7 +1250,16 @@ class H2OServer:
         return self
 
     def stop(self):
-        self.httpd.shutdown()
+        from ..runtime.config import config
+        self.httpd.shutdown()               # stop accepting new requests
+        # bounded drain: in-flight handlers get to finish their reply
+        # (the /3/Shutdown response itself rides this grace window)
+        left = self.httpd.drain(config().rest_drain_timeout_s)
+        if left:
+            from ..runtime.observability import log
+            log.warning("REST shutdown: %d request handler(s) still "
+                        "running after %.1fs drain", left,
+                        config().rest_drain_timeout_s)
         self.httpd.server_close()
 
     @property

@@ -82,12 +82,15 @@ class Cluster:
 
     def describe(self) -> dict:
         """Cluster status — the `/3/Cloud` analog (water/api/CloudHandler)."""
+        from . import dkv
         return {
             "devices": [str(d) for d in self.mesh.devices.flat],
             "platform": self.mesh.devices.flat[0].platform,
             "mesh_shape": dict(self.mesh.shape),
             "process_index": jax.process_index(),
             "process_count": jax.process_count(),
+            # control-plane durability/fencing facts (epoch, WAL, role)
+            "control_plane": dkv.wal_stats(),
         }
 
 

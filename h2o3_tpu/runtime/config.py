@@ -35,6 +35,22 @@ class Config:
     dkv_backoff_base_s: float = 0.05
     dkv_backoff_max_s: float = 2.0
     dkv_retry_budget_s: float = 30.0
+    # heartbeat stamps get a much shorter budget: one missed stamp beats
+    # a 30 s-blocked beat thread (heartbeat._beat)
+    hb_dkv_budget_s: float = 2.0
+    # coordinator durability (dkv WAL + compacted snapshots): directory
+    # (default <H2O3_TPU_RECOVERY_DIR>/dkv; local paths only) and how many
+    # WAL records accumulate before a compacted snapshot replaces them
+    dkv_wal_dir: Optional[str] = None
+    dkv_wal_compact_every: int = 512
+    # exactly-once RPC: how many request-ids the coordinator remembers
+    dkv_dedup_window: int = 4096
+    # coordinator handler hardening: declared-frame cap and the
+    # per-connection recv timeout that frees half-open handler threads
+    dkv_max_frame_mb: float = 256.0
+    dkv_recv_timeout_s: float = 30.0
+    # REST shutdown: bounded wait for in-flight request handlers
+    rest_drain_timeout_s: float = 5.0
     # in-training progress snapshots (runtime/snapshot.py): min seconds
     # between writes per job (0 = every opportunity), async writer thread
     snapshot_interval_s: float = 30.0
@@ -56,6 +72,14 @@ class Config:
             dkv_backoff_base_s=float(e("H2O3_TPU_DKV_BACKOFF_BASE", 0.05)),
             dkv_backoff_max_s=float(e("H2O3_TPU_DKV_BACKOFF_MAX", 2.0)),
             dkv_retry_budget_s=float(e("H2O3_TPU_DKV_RETRY_BUDGET", 30.0)),
+            hb_dkv_budget_s=float(e("H2O3_TPU_HB_BUDGET", 2.0)),
+            dkv_wal_dir=e("H2O3_TPU_DKV_WAL_DIR") or None,
+            dkv_wal_compact_every=int(e("H2O3_TPU_DKV_WAL_COMPACT", 512)),
+            dkv_dedup_window=int(e("H2O3_TPU_DKV_DEDUP_WINDOW", 4096)),
+            dkv_max_frame_mb=float(e("H2O3_TPU_DKV_MAX_FRAME_MB", 256.0)),
+            dkv_recv_timeout_s=float(e("H2O3_TPU_DKV_RECV_TIMEOUT", 30.0)),
+            rest_drain_timeout_s=float(
+                e("H2O3_TPU_REST_DRAIN_TIMEOUT", 5.0)),
             snapshot_interval_s=float(e("H2O3_TPU_SNAPSHOT_INTERVAL", 30.0)),
             snapshot_async=e("H2O3_TPU_SNAPSHOT_ASYNC", "1")
             not in ("0", "false", "no"),

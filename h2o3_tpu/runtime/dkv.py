@@ -16,10 +16,36 @@ device-backed objects (frames, models) exist everywhere by construction;
 the coordinator service carries the *metadata* plane — key listings, job
 status, small host objects — and gives non-zero processes and external
 clients (REST) a consistent view.  The API mirrors DKV.get/put/remove.
+
+Crash-recoverable coordinator (the reference survives coordinator loss via
+Paxos membership + UDP retransmit; the TCP control plane needs all three
+explicitly):
+
+* **Durability** — when a local recovery dir is configured, every
+  plain-host-data mutation is appended to a write-ahead log
+  (``<dir>/dkv/wal_<gen>.log``, crc32-framed, flushed per record) and
+  periodically compacted into a snapshot (``snap_<gen>.pkl``);
+  ``serve()`` rehydrates snapshot+WAL, so a restarted coordinator comes
+  back knowing its keys, job records, and ``make_key`` counter.
+* **Epoch fencing** — each ``serve()`` incarnation takes a monotonic
+  epoch (persisted in ``EPOCH`` when durable, wall-clock-seeded when
+  not) stamped into every RPC response.  Clients track the highest seen
+  epoch: a *bump* means the coordinator restarted — they re-push their
+  locally-originated plain keys (the SPMD store is the source of truth);
+  a *lower* epoch means a stale incarnation is still answering and the
+  response is refused (retried until the live one answers).
+* **Exactly-once RPC** — the retry loop is at-least-once over transport,
+  so mutating ops carry a client-generated request id; the coordinator
+  keeps a dedup window (rebuilt from the WAL across restarts) and
+  answers a retried op from it instead of re-applying — a dropped
+  *response* can no longer double-apply ``incr`` or burn ``make_key``
+  counters.
 """
 
 from __future__ import annotations
 
+import collections
+import contextlib
 import os
 import pickle
 import socket
@@ -28,6 +54,7 @@ import ssl
 import struct
 import threading
 import time
+import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 _store: Dict[str, Any] = {}
@@ -38,6 +65,39 @@ _counter = 0
 _remote: Optional[Tuple[str, int]] = None     # set on non-coordinator procs
 _server: Optional["_DKVServer"] = None
 _client_ssl: Optional[ssl.SSLContext] = None
+
+# epoch fencing: this incarnation's epoch (coordinator) / highest seen (client)
+_epoch = 0
+_seen_epoch = 0
+_epoch_lock = threading.Lock()
+_repushing = False
+_local_plain: set = set()       # plain keys this process originated
+
+# durability: write-ahead log + compacted snapshots (coordinator only)
+_wal_f = None
+_wal_gen = 0
+_wal_records = 0
+_wal_bytes = 0
+_restored = 0
+
+# exactly-once: request-id -> response value (bounded, WAL-rebuilt)
+_MUTATING = frozenset({"put", "remove", "cas", "incr", "make_key"})
+_dedup: "collections.OrderedDict[str, Any]" = collections.OrderedDict()
+_nonce = f"{os.getpid():x}.{os.urandom(3).hex()}"
+_req_seq = 0
+
+_budget_tls = threading.local()
+
+
+class StaleCoordinatorError(ConnectionError):
+    """A response arrived from an older coordinator incarnation than this
+    client has already talked to — split-brain protection: the response
+    is refused and the op retried until the live incarnation answers."""
+
+
+def is_coordinator() -> bool:
+    """Is this process currently serving the DKV control plane?"""
+    return _server is not None
 
 
 def _tls_contexts():
@@ -90,17 +150,23 @@ def make_key(prefix: str) -> str:
     global _counter
     with _lock:
         _counter += 1
-        return f"{prefix}_{_counter}"
+        key = f"{prefix}_{_counter}"
+        _wal_append({"op": "counter", "n": _counter})
+        return key
 
 
 def put(key: str, value: Any) -> str:
+    plain = _is_plain(value)
     with _lock:
         is_new = key not in _store
         _store[key] = value
+        if plain:
+            _local_plain.add(key)
+            _wal_append({"op": "put", "key": key, "value": value})
     if is_new:                           # upserts of pre-existing keys are
         from . import scope              # NOT scope-owned temporaries
         scope.track(key)
-    if _remote is not None and _is_plain(value):
+    if _remote is not None and plain:
         _rpc("put", key=key, value=value)
     return key
 
@@ -116,6 +182,8 @@ def get(key: str) -> Optional[Any]:
 def remove(key: str) -> None:
     with _lock:
         _store.pop(key, None)
+        _local_plain.discard(key)
+        _wal_append({"op": "remove", "key": key})
     if _remote is not None:
         _rpc("remove", key=key)
 
@@ -131,6 +199,7 @@ def keys(prefix: str = "") -> List[str]:
 def clear() -> None:
     with _lock:
         _store.clear()
+        _local_plain.clear()
 
 
 def local_size() -> int:
@@ -149,6 +218,8 @@ def cas(key: str, expected: Any, new: Any) -> bool:
     with _lock:
         if _store.get(key) == expected:
             _store[key] = new
+            if _is_plain(new):
+                _wal_append({"op": "put", "key": key, "value": new})
             return True
         return False
 
@@ -160,6 +231,7 @@ def incr(key: str, delta: float = 1.0) -> float:
     with _lock:
         v = float(_store.get(key, 0.0)) + delta
         _store[key] = v
+        _wal_append({"op": "put", "key": key, "value": v})
         return v
 
 
@@ -201,6 +273,74 @@ def _rpc_once(payload: bytes) -> dict:
     return resp
 
 
+def _next_req_id() -> str:
+    global _req_seq
+    with _lock:
+        _req_seq += 1
+        return f"{_nonce}:{_req_seq}"
+
+
+@contextlib.contextmanager
+def retry_budget(seconds: float):
+    """Cap this thread's DKV retry budget for the enclosed ops.
+
+    Heartbeat stamps use this: one missed stamp is better than a beat
+    thread blocked for the full 30 s default budget."""
+    prev = getattr(_budget_tls, "seconds", None)
+    _budget_tls.seconds = seconds
+    try:
+        yield
+    finally:
+        _budget_tls.seconds = prev
+
+
+def _note_epoch(ep: int) -> None:
+    """Fence a response's coordinator epoch.
+
+    Lower than already seen ⇒ stale incarnation still answering: refuse
+    (StaleCoordinatorError is transport-class, so the op retries).
+    Higher than already seen ⇒ the coordinator restarted: re-push this
+    process's locally-originated plain keys — the SPMD store is the
+    source of truth and the new incarnation may have lost writes that
+    landed after its last WAL record (or have no WAL at all)."""
+    global _seen_epoch, _repushing
+    if not ep:
+        return
+    do_repush = False
+    with _epoch_lock:
+        if _seen_epoch and ep < _seen_epoch:
+            raise StaleCoordinatorError(
+                f"DKV response from stale coordinator epoch {ep} "
+                f"(already saw {_seen_epoch})")
+        if _seen_epoch and ep > _seen_epoch and not _repushing:
+            _repushing = True
+            do_repush = True
+        old, _seen_epoch = _seen_epoch, max(_seen_epoch, ep)
+    if do_repush:
+        try:
+            _repush(old, ep)
+        finally:
+            with _epoch_lock:
+                _repushing = False
+
+
+def _repush(old: int, new: int) -> None:
+    with _lock:
+        items = [(k, _store[k]) for k in sorted(_local_plain)
+                 if k in _store and _is_plain(_store[k])]
+    from .observability import log, record
+    record("dkv_epoch_bump", old_epoch=old, new_epoch=new,
+           repushed=len(items))
+    log.warning("DKV coordinator epoch bump %d -> %d (restart detected); "
+                "re-pushing %d locally-originated keys", old, new,
+                len(items))
+    for k, v in items:
+        try:
+            _rpc("put", key=k, value=v)
+        except Exception as e:           # noqa: BLE001 — best-effort heal
+            log.warning("DKV re-push of %r failed: %r", k, e)
+
+
 def _rpc(op: str, **kw) -> Any:
     """Coordinator RPC with per-op retry: exponential backoff + jitter
     under a retry budget.
@@ -214,21 +354,35 @@ def _rpc(op: str, **kw) -> Any:
     (extra attempts, default 5), ``H2O3_TPU_DKV_BACKOFF_BASE`` /
     ``H2O3_TPU_DKV_BACKOFF_MAX`` (seconds, default 0.05/2.0), and
     ``H2O3_TPU_DKV_RETRY_BUDGET`` (total seconds across one op's
-    retries, default 30).
+    retries, default 30; ``retry_budget()`` caps it per thread).
+
+    Retry makes transport at-least-once, so mutating ops carry a request
+    id generated ONCE per logical op — every retry resends the same id
+    and the coordinator's dedup window makes the retry idempotent
+    (exactly-once).  Every response is epoch-fenced via ``_note_epoch``.
     """
     import random
 
     from .config import config
+    if op in _MUTATING:
+        kw.setdefault("req_id", _next_req_id())
     payload = pickle.dumps({"op": op, **kw},
                            protocol=pickle.HIGHEST_PROTOCOL)
     cfg = config()
-    deadline = time.time() + cfg.dkv_retry_budget_s
+    budget = getattr(_budget_tls, "seconds", None)
+    if budget is None:
+        budget = cfg.dkv_retry_budget_s
+    deadline = time.time() + budget
     attempt = 0
     while True:
         try:
             from . import failure
             failure.maybe_inject("dkv_rpc")
             resp = _rpc_once(payload)
+            # a drop HERE models a lost response: the server has already
+            # applied the op, so the retry must hit the dedup window
+            failure.maybe_inject("dkv_rpc_resp")
+            _note_epoch(resp.get("epoch", 0))
             break
         except (ConnectionError, TimeoutError, ssl.SSLError, OSError) as e:
             attempt += 1
@@ -249,51 +403,346 @@ def _rpc(op: str, **kw) -> Any:
     return resp.get("value")
 
 
+# ------------------------------------------------------ durability (WAL)
+#
+# File layout under <durable dir> (default <H2O3_TPU_RECOVERY_DIR>/dkv):
+#   wal_<gen>.log   crc32+length-framed pickled mutation records, flushed
+#                   per record (survives process SIGKILL; machine loss is
+#                   out of scope — the reference's Paxos doesn't survive
+#                   that either)
+#   snap_<gen>.pkl  compacted snapshot of the plain store + counter +
+#                   dedup window, written every dkv_wal_compact_every
+#                   records via tmp+rename (never torn)
+#   EPOCH           this coordinator's incarnation counter
+#
+# Record ops are normalized to replayable primitives: put / remove /
+# counter (cas success and incr become the resulting put; the make_key
+# counter becomes its high-water mark), each carrying the request id +
+# response so the exactly-once dedup window survives a restart too.
+
+def _durable_dir() -> Optional[str]:
+    from .config import config
+    d = config().dkv_wal_dir
+    if not d:
+        from . import recovery
+        base = recovery.recovery_dir()
+        if base:
+            d = os.path.join(base, "dkv")
+    if not d or "://" in d:              # WAL needs a local appendable path
+        return None
+    return d
+
+
+def _wal_open(d: str) -> None:
+    global _wal_f
+    _wal_f = open(os.path.join(d, f"wal_{_wal_gen}.log"), "ab")
+
+
+def _close_wal() -> None:
+    global _wal_f, _wal_records, _wal_bytes, _wal_gen, _restored
+    if _wal_f is not None:
+        try:
+            _wal_f.close()
+        except OSError:
+            pass
+    _wal_f = None
+    _wal_records = _wal_bytes = _wal_gen = _restored = 0
+
+
+def _wal_append(rec: dict) -> None:
+    """Append one normalized mutation record (caller holds ``_lock``).
+
+    No-op off-coordinator / non-durable.  Best-effort by design: a full
+    disk degrades durability, it must not fail the control plane."""
+    global _wal_records, _wal_bytes
+    if _wal_f is None:
+        return
+    try:
+        blob = pickle.dumps(rec, protocol=pickle.HIGHEST_PROTOCOL)
+        _wal_f.write(struct.pack("<II", zlib.crc32(blob), len(blob)) + blob)
+        _wal_f.flush()
+        _wal_records += 1
+        _wal_bytes += len(blob) + 8
+        from .observability import count
+        count("dkv_wal_records")
+        count("dkv_wal_bytes", len(blob) + 8)
+        from .config import config
+        if _wal_records >= config().dkv_wal_compact_every:
+            _compact()
+    except Exception as e:               # noqa: BLE001
+        from .observability import log
+        log.warning("DKV WAL append failed: %r", e)
+
+
+def _mutation_record(op: str, req: dict, value: Any) -> Optional[dict]:
+    """Normalize an APPLIED mutation to a replayable WAL record (or None
+    when nothing durable changed).  Caller holds ``_lock``."""
+    rid = req.get("req_id")
+    if op == "put" and _is_plain(req["value"]):
+        return {"op": "put", "key": req["key"], "value": req["value"],
+                "rid": rid, "resp": value}
+    if op == "remove":
+        return {"op": "remove", "key": req["key"], "rid": rid, "resp": None}
+    if op == "cas" and value and _is_plain(req["new"]):
+        return {"op": "put", "key": req["key"], "value": req["new"],
+                "rid": rid, "resp": True}
+    if op == "incr":
+        return {"op": "put", "key": req["key"], "value": value,
+                "rid": rid, "resp": value}
+    if op == "make_key":
+        return {"op": "counter", "n": _counter, "rid": rid, "resp": value}
+    return None
+
+
+def _trim_dedup() -> None:
+    from .config import config
+    cap = config().dkv_dedup_window
+    while len(_dedup) > cap:
+        _dedup.popitem(last=False)
+
+
+def _compact() -> None:
+    """Fold the WAL into a fresh snapshot generation (caller holds
+    ``_lock``); old generation files are reaped only after the new
+    snapshot is durably in place."""
+    global _wal_gen, _wal_records, _wal_bytes, _wal_f
+    d = os.path.dirname(_wal_f.name)
+    old_gen, new_gen = _wal_gen, _wal_gen + 1
+    snap = {"store": {k: v for k, v in _store.items() if _is_plain(v)},
+            "counter": _counter, "epoch": _epoch, "dedup": dict(_dedup)}
+    tmp = os.path.join(d, f"snap_{new_gen}.tmp")
+    with open(tmp, "wb") as f:
+        pickle.dump(snap, f, protocol=pickle.HIGHEST_PROTOCOL)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(d, f"snap_{new_gen}.pkl"))
+    old_wal = _wal_f.name
+    _wal_f.close()
+    _wal_gen, _wal_records, _wal_bytes = new_gen, 0, 0
+    _wal_open(d)
+    for stale in (old_wal, os.path.join(d, f"snap_{old_gen}.pkl")):
+        try:
+            os.remove(stale)
+        except OSError:
+            pass
+    from .observability import count, log, record
+    count("dkv_wal_compactions")
+    record("dkv_wal", event="compact", gen=new_gen,
+           keys=len(snap["store"]))
+    log.info("DKV WAL compacted into snapshot gen %d (%d plain keys)",
+             new_gen, len(snap["store"]))
+
+
+def _rehydrate(d: str) -> Tuple[int, int]:
+    """Rebuild durable control-plane state: latest snapshot + WAL replay.
+
+    In-memory state wins per key — an in-process re-serve is not a
+    crash, its live values are newer than the disk's.  A torn WAL tail
+    (crash mid-write) is truncated so later appends stay replayable.
+    Returns (restored_key_count, epoch_hint).  Caller holds ``_lock``."""
+    global _counter, _wal_gen, _wal_records, _wal_bytes, _restored
+    import re as _re
+
+    from .observability import log
+    gens = set()
+    try:
+        names = os.listdir(d)
+    except OSError:
+        names = []
+    for n in names:
+        m = _re.fullmatch(r"(?:snap|wal)_(\d+)\.(?:pkl|log)", n)
+        if m:
+            gens.add(int(m.group(1)))
+    if not gens:
+        _wal_gen = 0
+        return 0, 0
+    gen = max(gens)
+    state: Dict[str, Any] = {}
+    dedup: Dict[str, Any] = {}
+    counter = 0
+    epoch_hint = 0
+    snap_path = os.path.join(d, f"snap_{gen}.pkl")
+    if os.path.exists(snap_path):
+        try:
+            with open(snap_path, "rb") as f:
+                snap = pickle.load(f)
+            state.update(snap.get("store", {}))
+            dedup.update(snap.get("dedup", {}))
+            counter = int(snap.get("counter", 0))
+            epoch_hint = int(snap.get("epoch", 0))
+        except Exception as e:           # noqa: BLE001
+            log.warning("DKV snapshot %s unreadable: %r", snap_path, e)
+    wal_path = os.path.join(d, f"wal_{gen}.log")
+    nrec = nbytes = 0
+    if os.path.exists(wal_path):
+        try:
+            with open(wal_path, "rb") as f:
+                blob = f.read()
+        except OSError:
+            blob = b""
+        off = 0
+        while off + 8 <= len(blob):
+            crc, ln = struct.unpack_from("<II", blob, off)
+            body = blob[off + 8: off + 8 + ln]
+            if len(body) < ln or zlib.crc32(body) != crc:
+                break                    # torn/corrupt tail
+            try:
+                rec = pickle.loads(body)
+            except Exception:            # noqa: BLE001
+                break
+            op = rec.get("op")
+            if op == "put":
+                state[rec["key"]] = rec["value"]
+            elif op == "remove":
+                state.pop(rec["key"], None)
+            elif op == "counter":
+                counter = max(counter, int(rec["n"]))
+            if rec.get("rid"):
+                dedup[rec["rid"]] = rec.get("resp")
+            off += 8 + ln
+            nrec += 1
+            nbytes += 8 + ln
+        if off < len(blob):
+            log.warning("DKV WAL %s: torn tail at byte %d truncated "
+                        "(%d records replayed)", wal_path, off, nrec)
+            try:
+                with open(wal_path, "r+b") as f:
+                    f.truncate(off)
+            except OSError:
+                pass
+    restored = 0
+    for k, v in state.items():
+        if k not in _store:
+            _store[k] = v
+            restored += 1
+    _counter = max(_counter, counter)
+    for rid, resp in dedup.items():
+        _dedup.setdefault(rid, resp)
+    _trim_dedup()
+    _wal_gen, _wal_records, _wal_bytes = gen, nrec, nbytes
+    _restored = restored
+    return restored, epoch_hint
+
+
+def _bump_epoch(d: Optional[str], hint: int = 0) -> int:
+    """Take the next coordinator incarnation epoch.
+
+    Durable dirs persist it in EPOCH (monotonic across restarts);
+    without one the wall clock seeds it, so a restarted coordinator
+    *process* still presents a higher epoch than its predecessor."""
+    global _epoch
+    prev = max(_epoch, hint)
+    if d:
+        try:
+            with open(os.path.join(d, "EPOCH")) as f:
+                prev = max(prev, int(f.read().strip() or 0))
+        except (OSError, ValueError):
+            pass
+    else:
+        prev = max(prev, int(time.time()))
+    _epoch = prev + 1
+    if d:
+        try:
+            tmp = os.path.join(d, "EPOCH.tmp")
+            with open(tmp, "w") as f:
+                f.write(str(_epoch))
+            os.replace(tmp, os.path.join(d, "EPOCH"))
+        except OSError as e:
+            from .observability import log
+            log.warning("DKV epoch persist failed: %r", e)
+    return _epoch
+
+
+def wal_stats() -> dict:
+    """Control-plane durability/fencing facts — the /3/Recovery and
+    /3/Cloud operator view."""
+    with _lock:
+        return {
+            "role": ("coordinator" if _server is not None
+                     else "worker" if _remote is not None else "local"),
+            "epoch": _epoch,
+            "seen_epoch": _seen_epoch,
+            "durable": _wal_f is not None,
+            "durable_dir": (os.path.dirname(_wal_f.name)
+                            if _wal_f is not None else None),
+            "wal_gen": _wal_gen,
+            "wal_records": _wal_records,
+            "wal_bytes": _wal_bytes,
+            "restored_keys": _restored,
+            "dedup_entries": len(_dedup),
+        }
+
+
+# ----------------------------------------------------------- the service
+
+def _apply_op(op: str, req: dict) -> Any:
+    """Apply one op against the local store (caller holds ``_lock``).
+    Shared by the coordinator handler and nothing else — the local API
+    keeps its inline fast paths — so handler semantics live in one
+    place."""
+    global _counter
+    if op == "put":
+        _store[req["key"]] = req["value"]
+        return req["key"]
+    if op == "get":
+        return _store.get(req["key"])
+    if op == "remove":
+        _store.pop(req["key"], None)
+        return None
+    if op == "keys":
+        return sorted(k for k in _store if k.startswith(req["prefix"]))
+    if op == "cas":
+        if _store.get(req["key"]) == req["expected"]:
+            _store[req["key"]] = req["new"]
+            return True
+        return False
+    if op == "incr":
+        v = float(_store.get(req["key"], 0.0)) + req["delta"]
+        _store[req["key"]] = v
+        return v
+    if op == "make_key":
+        _counter += 1
+        return f"{req['prefix']}_{_counter}"
+    if op == "ping":
+        return "pong"
+    raise ValueError(f"unknown DKV op {op!r}")
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
-        global _counter
+        from .config import config
+        cfg = config()
         try:
+            from . import failure
+            failure.maybe_inject("dkv_handle")
+            # a half-open client must not pin this thread forever
+            self.request.settimeout(cfg.dkv_recv_timeout_s)
             n = struct.unpack("<Q", _recvall(self.request, 8))[0]
+            if n > cfg.dkv_max_frame_mb * (1 << 20):
+                raise ValueError(
+                    f"DKV frame of {n} bytes exceeds the "
+                    f"{cfg.dkv_max_frame_mb:g} MB cap "
+                    f"(H2O3_TPU_DKV_MAX_FRAME_MB)")
             req = pickle.loads(_recvall(self.request, n))
             op = req["op"]
-            if op == "put":
-                with _lock:
-                    _store[req["key"]] = req["value"]
-                value = req["key"]
-            elif op == "get":
-                with _lock:
-                    value = _store.get(req["key"])
-            elif op == "remove":
-                with _lock:
-                    _store.pop(req["key"], None)
-                value = None
-            elif op == "keys":
-                with _lock:
-                    value = sorted(k for k in _store
-                                   if k.startswith(req["prefix"]))
-            elif op == "cas":
-                with _lock:
-                    if _store.get(req["key"]) == req["expected"]:
-                        _store[req["key"]] = req["new"]
-                        value = True
-                    else:
-                        value = False
-            elif op == "incr":
-                with _lock:
-                    value = float(_store.get(req["key"], 0.0)) \
-                        + req["delta"]
-                    _store[req["key"]] = value
-            elif op == "make_key":
-                with _lock:
-                    _counter += 1
-                    value = f"{req['prefix']}_{_counter}"
-            elif op == "ping":
-                value = "pong"
-            else:
-                raise ValueError(f"unknown DKV op {op!r}")
-            resp = {"value": value}
+            rid = req.get("req_id")
+            with _lock:
+                if rid is not None and rid in _dedup:
+                    value = _dedup[rid]          # retried op: already applied
+                    from .observability import count
+                    count("dkv_dedup_hits")
+                else:
+                    value = _apply_op(op, req)
+                    if op in _MUTATING:
+                        rec = _mutation_record(op, req, value)
+                        if rec is not None:
+                            _wal_append(rec)
+                        if rid is not None:
+                            _dedup[rid] = value
+                            _trim_dedup()
+            resp = {"value": value, "epoch": _epoch}
         except Exception as e:          # noqa: BLE001 — reported to client
-            resp = {"err": repr(e)}
+            resp = {"err": repr(e), "epoch": _epoch}
         payload = pickle.dumps(resp, protocol=pickle.HIGHEST_PROTOCOL)
         try:
             self.request.sendall(struct.pack("<Q", len(payload)) + payload)
@@ -314,7 +763,12 @@ class _DKVServer(socketserver.ThreadingTCPServer):
 
 
 def serve(host: str = "127.0.0.1", port: int = 0) -> int:
-    """Start the coordinator DKV service; returns the bound port."""
+    """Start the coordinator DKV service; returns the bound port.
+
+    Each call that actually (re)starts the service is a new incarnation:
+    it rehydrates the durable snapshot+WAL (when a local recovery dir is
+    configured), takes the next epoch, and stamps it into every
+    response."""
     global _server
     if _server is not None:
         if port in (0, _server.server_address[1]):
@@ -323,19 +777,40 @@ def serve(host: str = "127.0.0.1", port: int = 0) -> int:
         _server.shutdown()
         _server.server_close()            # release the listen socket too
         _server = None
+    d = _durable_dir()
+    restored, hint = 0, 0
+    with _lock:
+        _close_wal()
+        if d:
+            try:
+                os.makedirs(d, exist_ok=True)
+                restored, hint = _rehydrate(d)
+                _wal_open(d)
+            except Exception as e:       # noqa: BLE001 — serve regardless
+                from .observability import log
+                log.warning("DKV durability disabled (%r)", e)
+                d = None
+        epoch = _bump_epoch(d, hint)
     _server = _DKVServer((host, port), _Handler)
     srv_ctx, _ = _tls_contexts()
     _server.ssl_context = srv_ctx
     t = threading.Thread(target=_server.serve_forever, daemon=True,
                          name="dkv-coordinator")
     t.start()
+    from .observability import log, record
+    record("coordinator_restart", epoch=epoch, restored_keys=restored,
+           durable=bool(d), port=_server.server_address[1])
+    log.info("DKV coordinator serving on port %d (epoch %d, durable=%s, "
+             "%d keys restored)", _server.server_address[1], epoch,
+             bool(d), restored)
     return _server.server_address[1]
 
 
 def attach(host: str, port: int, timeout: float = 60.0) -> None:
     """Point this process's DKV at the coordinator service (with retry)."""
-    global _remote, _client_ssl
+    global _remote, _client_ssl, _seen_epoch
     _, _client_ssl = _tls_contexts()
+    _seen_epoch = 0                      # fencing restarts per attachment
     _remote = (host, port)
     deadline = time.time() + timeout
     while True:
@@ -350,9 +825,13 @@ def attach(host: str, port: int, timeout: float = 60.0) -> None:
 
 
 def detach() -> None:
-    global _remote, _server
+    global _remote, _server, _client_ssl, _seen_epoch
     _remote = None
+    _client_ssl = None    # a later plaintext attach must not reuse stale TLS
+    _seen_epoch = 0
     if _server is not None:
         _server.shutdown()
         _server.server_close()            # release the listen socket too
         _server = None
+    with _lock:
+        _close_wal()

@@ -20,7 +20,10 @@ thread polls the heartbeat view; when a member decays to ``dead`` it
 
 Fault injection (SURVEY.md §5 explicitly asks the rebuild to add hooks
 the reference lacks): ``H2O3_TPU_FAULT_INJECT`` holds a comma-separated
-list of ``point:proc:nth[:action[:arg][:repeat]]`` specs.  ``action``:
+list of ``point:proc:nth[:action[:arg][:repeat]]`` specs.  ``proc`` is a
+jax process index, or the literal ``coordinator`` to select whichever
+process is serving the DKV control plane (usable before device init —
+no jax import on that path).  ``action``:
 
 - ``kill`` (default) — ``os._exit(137)`` at every hit from the nth on,
 - ``raise`` — raise :class:`InjectedFault` (a deterministic failure the
@@ -32,9 +35,12 @@ list of ``point:proc:nth[:action[:arg][:repeat]]`` specs.  ``action``:
 Non-kill actions fire ``repeat`` times (default 1) starting at the nth
 hit, so a transient fault heals and retry paths can be proven to
 converge.  Injection points: ``tree_chunk``, ``ktree_round``,
-``dl_iter``, ``dkv_rpc``, ``parse_range``, ``cv_fold``,
-``grid_member``, ``automl_member``, ``glm_lambda``,
-``snapshot_write``.  ``ktree_round`` fires at the top of every batched
+``dl_iter``, ``dkv_rpc``, ``dkv_rpc_resp`` (after the server applied —
+models a LOST RESPONSE, the exactly-once dedup case), ``dkv_handle``
+(top of the coordinator's connection handler — with
+``:coordinator:<nth>:kill`` it hard-kills the coordinator at the nth
+handled connection), ``parse_range``, ``cv_fold``, ``grid_member``,
+``automl_member``, ``glm_lambda``, ``snapshot_write``.  ``ktree_round`` fires at the top of every batched
 K-tree boosting round (the fused multinomial/multiclass level
 program), so kill/resume mid-round exercises snapshot recovery of the
 one-launch-per-level path.
@@ -171,7 +177,7 @@ def _inject_one(point: str, spec: str, slot: int) -> None:
     if len(parts) < 3:
         return
     try:
-        pt, pidx, nth = parts[0], int(parts[1]), int(parts[2])
+        pt, proc, nth = parts[0], parts[1], int(parts[2])
     except ValueError:
         return
     if pt != point:
@@ -187,9 +193,20 @@ def _inject_one(point: str, spec: str, slot: int) -> None:
         return
     if action not in ("kill", "raise", "delay", "dkv_drop"):
         return
-    import jax
-    if jax.process_index() != pidx:
-        return
+    if proc == "coordinator":
+        # role selector: fires only on the process serving the DKV
+        # control plane (no jax import — usable before device init)
+        if not dkv.is_coordinator():
+            return
+        pidx = None
+    else:
+        try:
+            pidx = int(proc)
+        except ValueError:
+            return
+        import jax
+        if jax.process_index() != pidx:
+            return
     key = (point, slot)
     _inject_counts[key] = count = _inject_counts.get(key, 0) + 1
     if count < nth or (repeat is not None and count >= nth + repeat):
@@ -197,8 +214,8 @@ def _inject_one(point: str, spec: str, slot: int) -> None:
     from .observability import log, record
     record("fault_injected", point=point, action=action, hit=count)
     if action == "kill":
-        log.error("FAULT INJECTION: killing process %d at %s #%d",
-                  pidx, point, count)
+        log.error("FAULT INJECTION: killing process %s at %s #%d",
+                  "coordinator" if pidx is None else pidx, point, count)
         os._exit(137)
     log.warning("FAULT INJECTION: %s at %s #%d", action, point, count)
     if action == "raise":

@@ -39,12 +39,16 @@ def node_name() -> str:
 
 
 def _beat(name: str, interval: float) -> None:
-    dkv.put(PREFIX + name, {
-        "ts": time.time(),
-        "interval": interval,
-        "pid": os.getpid(),
-        "keys": dkv.local_size(),
-    })
+    from .config import config
+    # a short retry budget, NOT the full 30 s default: one missed stamp
+    # is better than a beat thread blocked past several intervals
+    with dkv.retry_budget(config().hb_dkv_budget_s):
+        dkv.put(PREFIX + name, {
+            "ts": time.time(),
+            "interval": interval,
+            "pid": os.getpid(),
+            "keys": dkv.local_size(),
+        })
 
 
 def start(interval: float = 5.0, name: Optional[str] = None) -> str:

@@ -49,6 +49,24 @@ def record(kind: str, **fields) -> None:
         _EVENTS.append({"ts": time.time(), "kind": kind, **fields})
 
 
+_COUNTERS: collections.Counter = collections.Counter()
+
+
+def count(name: str, delta: int = 1) -> None:
+    """Bump a monotonic named counter.
+
+    For high-rate stats (DKV WAL records/bytes, dedup hits) that would
+    churn the timeline ring if each were an event; surfaced alongside
+    the ring on /3/Timeline."""
+    with _lock:
+        _COUNTERS[name] += delta
+
+
+def counters() -> Dict[str, int]:
+    with _lock:
+        return dict(_COUNTERS)
+
+
 def timeline_events(limit: int = 500) -> List[Dict]:
     with _lock:
         return list(_EVENTS)[-limit:]

@@ -30,6 +30,12 @@ def _dir() -> Optional[str]:
     return os.environ.get("H2O3_TPU_RECOVERY_DIR") or None
 
 
+def recovery_dir() -> Optional[str]:
+    """The configured recovery base URI (journal, snapshots, and — for
+    local paths — the coordinator's DKV write-ahead log under dkv/)."""
+    return _dir()
+
+
 def _entry_uri(base: str, job_key: str) -> str:
     return f"{base.rstrip('/')}/job_{job_key}.json"
 

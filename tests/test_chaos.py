@@ -13,6 +13,7 @@ point for this suite.
 
 import json
 import os
+import socket
 import subprocess
 import sys
 import textwrap
@@ -24,6 +25,7 @@ import h2o3_tpu
 
 NTREES = 12
 KILL_AT_CHUNK = 3          # chunks are 2 trees: snapshot covers 4 trees
+COORD_KILL_AT_CONN = 12    # coordinator self-kills at the nth connection
 
 
 def _chaos_env(tmp_path, extra=None):
@@ -249,6 +251,147 @@ def test_kill_resume_mid_multinomial_round(cl, tmp_path):
 
     np.testing.assert_allclose(np.load(res_npy), np.load(base_npy),
                                rtol=1e-4, atol=1e-4)
+
+
+_COORD = textwrap.dedent("""
+    import sys
+    import time
+    from h2o3_tpu.runtime import dkv
+    port = dkv.serve(host="127.0.0.1", port=int(sys.argv[1]))
+    print("SERVING", port, dkv._epoch, flush=True)
+    while True:
+        time.sleep(0.1)
+""")
+
+_TRAIN_COORD_KILL = textwrap.dedent("""
+    import json
+    import sys
+    import time
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import h2o3_tpu
+    h2o3_tpu.init()
+    from h2o3_tpu.frame.parse import import_file
+    from h2o3_tpu.models import GBM
+    from h2o3_tpu.runtime import dkv, heartbeat
+    dkv.attach("127.0.0.1", int(sys.argv[3]))
+    heartbeat.start(interval=0.3)        # steady control-plane traffic
+    dkv.put("!coordchaos/fact", {{"who": "worker", "n": 42}})
+    fr = import_file(sys.argv[1], destination_frame="chaos_fr")
+    m = GBM(response_column="y", ntrees={nt}, max_depth=3, learn_rate=0.2,
+            seed=7, score_tree_interval=2).train(fr)
+    np.save(sys.argv[2], m.predict(fr).to_numpy()[:, 0])
+    # poll until the RESTARTED coordinator serves our fact again (either
+    # rehydrated from its WAL or re-pushed on the epoch bump)
+    fact = None
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        try:
+            fact = dkv._rpc("get", key="!coordchaos/fact")
+            if fact is not None:
+                break
+        except Exception:
+            pass
+        time.sleep(0.2)
+    from h2o3_tpu.runtime.observability import timeline_events
+    evs = timeline_events(2000)
+    print("WORKER_INFO", json.dumps({{
+        "ntrees": m.output["ntrees_trained"],
+        "seen_epoch": dkv._seen_epoch,
+        "fact": fact,
+        "retries": sum(1 for e in evs if e["kind"] == "dkv_retry"),
+        "bumps": sum(1 for e in evs if e["kind"] == "dkv_epoch_bump")}}))
+""").format(nt=NTREES)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _start_coord(port, env):
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _COORD, str(port)], env=env,
+        cwd=os.path.dirname(os.path.dirname(__file__)),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    line = proc.stdout.readline().strip()
+    if not line.startswith("SERVING"):
+        try:
+            _, err = proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            err = "<coordinator hung>"
+        raise AssertionError(f"coordinator failed: {line!r}\n{err}")
+    return proc, int(line.split()[2])
+
+
+def test_coordinator_hard_kill_midtrain_rehydrate_reattach(cl, tmp_path):
+    """The coordinator-chaos acceptance scenario: a worker trains a GBM
+    against an external DKV coordinator; the coordinator hard-kills
+    itself (exit 137) mid-run via ``dkv_handle:coordinator:N``, is
+    restarted on the same port + recovery dir, the worker's retry budget
+    rides out the outage (zero job failures), the restarted incarnation
+    presents a higher epoch, the worker re-attaches/fences it, the
+    durable store comes back, and the predictions equal an uninterrupted
+    run's."""
+    csv = _write_csv(tmp_path / "coordchaos.csv")
+    base_dir = tmp_path / "base_coord"
+    base_dir.mkdir()
+    base_npy = str(tmp_path / "base_coord.npy")
+    out = _run(_TRAIN, _chaos_env(base_dir), csv, base_npy)
+    assert f"TRAINED {NTREES}" in out.stdout
+
+    coord_dir = tmp_path / "coord_state"
+    coord_dir.mkdir()
+    port = _free_port()
+    proc1, ep1 = _start_coord(
+        port, _chaos_env(coord_dir, {
+            "H2O3_TPU_FAULT_INJECT":
+            f"dkv_handle:coordinator:{COORD_KILL_AT_CONN}"}))
+
+    worker_dir = tmp_path / "worker_recovery"
+    worker_dir.mkdir()
+    worker_npy = str(tmp_path / "coord_worker.npy")
+    worker = subprocess.Popen(
+        [sys.executable, "-c", _TRAIN_COORD_KILL, csv, worker_npy,
+         str(port)],
+        env=_chaos_env(worker_dir, {
+            # the outage spans a subprocess restart: widen the client
+            # retry envelope so no in-flight op exhausts its budget
+            "H2O3_TPU_DKV_RETRIES": "60",
+            "H2O3_TPU_DKV_BACKOFF_MAX": "0.5",
+            "H2O3_TPU_DKV_RETRY_BUDGET": "120"}),
+        cwd=os.path.dirname(os.path.dirname(__file__)),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    proc2 = None
+    try:
+        # the injected kill is a real os._exit(137) inside the handler
+        assert proc1.wait(timeout=240) == 137
+
+        proc2, ep2 = _start_coord(port, _chaos_env(coord_dir))
+        wout, werr = worker.communicate(timeout=300)
+        assert worker.returncode == 0, (
+            f"worker rc={worker.returncode}\nstdout:\n{wout[-3000:]}\n"
+            f"stderr:\n{werr[-3000:]}")
+        info = json.loads(
+            next(line for line in wout.splitlines()
+                 if line.startswith("WORKER_INFO ")).split(" ", 1)[1])
+        assert info["ntrees"] == NTREES              # zero job failures
+        assert ep2 > ep1                             # new incarnation
+        assert info["seen_epoch"] == ep2             # worker re-fenced
+        assert info["fact"] == {"who": "worker", "n": 42}
+        assert info["retries"] >= 1                  # outage was real
+        np.testing.assert_allclose(np.load(worker_npy), np.load(base_npy),
+                                   rtol=1e-4, atol=1e-4)
+    finally:
+        for p in (proc1, proc2, worker):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait(timeout=15)
 
 
 def test_kill_without_snapshot_still_resumes_from_zero(cl, tmp_path):

@@ -2,18 +2,24 @@
 the retry budget must be invisible to callers (zero job failures)."""
 
 import socket
+import subprocess
 import threading
 import time
 
 import pytest
 
 import h2o3_tpu
-from h2o3_tpu.runtime import dkv, failure
+from h2o3_tpu.runtime import dkv, failure, heartbeat
 from h2o3_tpu.runtime.config import reload as config_reload
 
 
 @pytest.fixture()
 def fast_retry(monkeypatch):
+    # stop the background DKV traffic (heartbeat stamps, watchdog key
+    # scans): it would otherwise consume fault-injection hits and make
+    # the exactly-once assertions nondeterministic
+    heartbeat.stop()
+    failure.stop()
     monkeypatch.setenv("H2O3_TPU_DKV_RETRIES", "6")
     monkeypatch.setenv("H2O3_TPU_DKV_BACKOFF_BASE", "0.05")
     monkeypatch.setenv("H2O3_TPU_DKV_BACKOFF_MAX", "0.3")
@@ -28,6 +34,8 @@ def fast_retry(monkeypatch):
               "H2O3_TPU_FAULT_INJECT"):
         monkeypatch.delenv(k, raising=False)
     config_reload()
+    heartbeat.start()
+    failure.start()
 
 
 def _free_port() -> int:
@@ -108,3 +116,75 @@ def test_injected_dkv_drops_are_absorbed(cl, fast_retry, monkeypatch):
         monkeypatch.delenv("H2O3_TPU_FAULT_INJECT")
         failure.reset()
         dkv.detach()
+
+
+def test_incr_and_make_key_exactly_once_under_dropped_response(
+        cl, fast_retry, monkeypatch):
+    """The exactly-once acceptance proof: ``dkv_rpc_resp`` drops the
+    RESPONSE after the server applied the op.  The retry resends the same
+    request id and must answer from the dedup window — no double-applied
+    ``incr``, no gap in the ``make_key`` counter."""
+    from h2o3_tpu.runtime.observability import counters
+    port = dkv.serve(port=0)
+    dkv.attach("127.0.0.1", port)
+    try:
+        failure.reset()
+        monkeypatch.setenv("H2O3_TPU_FAULT_INJECT",
+                           "dkv_rpc_resp:0:1:dkv_drop")
+        before = counters().get("dkv_dedup_hits", 0)
+        assert dkv._rpc("incr", key="!eo_ctr", delta=1.0) == 1.0
+        assert dkv._store["!eo_ctr"] == 1.0          # applied exactly once
+        assert counters().get("dkv_dedup_hits", 0) > before
+        monkeypatch.delenv("H2O3_TPU_FAULT_INJECT")
+        assert dkv._rpc("incr", key="!eo_ctr", delta=1.0) == 2.0
+
+        failure.reset()
+        monkeypatch.setenv("H2O3_TPU_FAULT_INJECT",
+                           "dkv_rpc_resp:0:1:dkv_drop")
+        k1 = dkv._rpc("make_key", prefix="!eo")
+        monkeypatch.delenv("H2O3_TPU_FAULT_INJECT")
+        k2 = dkv._rpc("make_key", prefix="!eo")
+        n1, n2 = (int(k.rsplit("_", 1)[1]) for k in (k1, k2))
+        assert n2 == n1 + 1                          # no counter gap
+    finally:
+        monkeypatch.delenv("H2O3_TPU_FAULT_INJECT", raising=False)
+        failure.reset()
+        dkv.remove("!eo_ctr")
+        dkv.detach()
+
+
+def test_tls_retry_and_exactly_once(cl, fast_retry, monkeypatch, tmp_path):
+    """The retry + dedup machinery must hold over a TLS control plane,
+    and detach() must drop the client TLS context with the remote."""
+    cert, key = str(tmp_path / "dkv.pem"), str(tmp_path / "dkv.key")
+    subprocess.run(["openssl", "req", "-x509", "-newkey", "rsa:2048",
+                    "-keyout", key, "-out", cert, "-days", "1", "-nodes",
+                    "-subj", "/CN=localhost"],
+                   capture_output=True, check=True)
+    monkeypatch.setenv("H2O3_TPU_TLS_CERT", cert)
+    monkeypatch.setenv("H2O3_TPU_TLS_KEY", key)
+    config_reload()
+    port = dkv.serve(port=0)
+    dkv.attach("127.0.0.1", port)
+    try:
+        assert dkv._client_ssl is not None           # handshake is real
+        failure.reset()
+        monkeypatch.setenv("H2O3_TPU_FAULT_INJECT",
+                           "dkv_rpc:0:1:dkv_drop:2")
+        assert dkv._rpc("ping") == "pong"            # drops retried over TLS
+        failure.reset()
+        monkeypatch.setenv("H2O3_TPU_FAULT_INJECT",
+                           "dkv_rpc_resp:0:1:dkv_drop")
+        assert dkv._rpc("incr", key="!tls_ctr", delta=1.0) == 1.0
+        assert dkv._store["!tls_ctr"] == 1.0
+    finally:
+        monkeypatch.delenv("H2O3_TPU_FAULT_INJECT", raising=False)
+        failure.reset()
+        dkv.remove("!tls_ctr")
+        dkv.detach()
+        monkeypatch.delenv("H2O3_TPU_TLS_CERT")
+        monkeypatch.delenv("H2O3_TPU_TLS_KEY")
+        config_reload()
+    # the satellite contract: a later plaintext attach must not reuse a
+    # stale TLS context
+    assert dkv._client_ssl is None and dkv._remote is None

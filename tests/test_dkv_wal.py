@@ -1,0 +1,259 @@
+"""Coordinator crash recovery: WAL+snapshot rehydration across a real
+SIGKILL, compaction, epoch fencing with client re-push, dedup-window
+survival, and the hardened connection handler.
+
+The round-trip contract (ISSUE acceptance): populate a coordinator,
+``kill -9`` it, restart ``serve()`` on the same port and recovery dir,
+and ``keys()``/``get()``/the ``make_key`` counter all match the pre-kill
+state — with the next incarnation presenting a strictly higher epoch.
+"""
+
+import os
+import pickle
+import socket
+import struct
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from h2o3_tpu.runtime import dkv, failure, heartbeat
+from h2o3_tpu.runtime.config import reload as config_reload
+
+_REPO = os.path.dirname(os.path.dirname(__file__))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _raw_rpc(port: int, op: str, **kw):
+    """One protocol-level round trip, independent of this process's DKV
+    client state (so background threads can't consume injection hits or
+    repush behind the assertions)."""
+    payload = pickle.dumps({"op": op, **kw},
+                           protocol=pickle.HIGHEST_PROTOCOL)
+    with socket.create_connection(("127.0.0.1", port), timeout=15) as s:
+        s.sendall(struct.pack("<Q", len(payload)) + payload)
+        n = struct.unpack("<Q", dkv._recvall(s, 8))[0]
+        resp = pickle.loads(dkv._recvall(s, n))
+    return resp
+
+
+_COORD = textwrap.dedent("""
+    import sys
+    import time
+    from h2o3_tpu.runtime import dkv
+    port = dkv.serve(host="127.0.0.1", port=int(sys.argv[1]))
+    print("SERVING", port, dkv._epoch, flush=True)
+    while True:
+        time.sleep(0.1)
+""")
+
+
+def _coord_env(recovery_dir=None):
+    env = dict(os.environ)
+    env.pop("H2O3_TPU_FAULT_INJECT", None)
+    env.pop("H2O3_TPU_RECOVERY_DIR", None)
+    env.pop("H2O3_TPU_DKV_WAL_DIR", None)
+    env.update({"JAX_PLATFORMS": "cpu", "H2O3_TPU_LOG_STDERR": "1"})
+    if recovery_dir is not None:
+        env["H2O3_TPU_RECOVERY_DIR"] = str(recovery_dir)
+    return env
+
+
+def _start_coord(port: int, env: dict):
+    """Launch a coordinator subprocess; returns (proc, epoch)."""
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _COORD, str(port)], env=env, cwd=_REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    line = proc.stdout.readline().strip()
+    if not line.startswith("SERVING"):
+        try:
+            _, err = proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            err = "<no stderr: coordinator hung>"
+        raise AssertionError(f"coordinator failed to serve: {line!r}\n{err}")
+    _, _, epoch = line.split()
+    return proc, int(epoch)
+
+
+def test_wal_rehydration_survives_kill9(tmp_path):
+    """The acceptance round trip, with a REAL process kill: no atexit, no
+    flush-on-close — only the per-record WAL flush stands between the
+    store and oblivion."""
+    port = _free_port()
+    env = _coord_env(tmp_path)
+    proc, ep1 = _start_coord(port, env)
+    try:
+        assert _raw_rpc(port, "put", key="alpha", value=1,
+                        req_id="t:1")["value"] == "alpha"
+        _raw_rpc(port, "put", key="beta", value={"rows": [1, 2, 3]},
+                 req_id="t:2")
+        k1 = _raw_rpc(port, "make_key", prefix="job", req_id="t:3")["value"]
+        assert _raw_rpc(port, "incr", key="ctr", delta=2.5,
+                        req_id="t:4")["value"] == 2.5
+        _raw_rpc(port, "put", key="gone", value="x", req_id="t:5")
+        _raw_rpc(port, "remove", key="gone", req_id="t:6")
+        assert _raw_rpc(port, "cas", key="alpha", expected=1, new=2,
+                        req_id="t:7")["value"] is True
+        pre_keys = _raw_rpc(port, "keys", prefix="")["value"]
+        assert "gone" not in pre_keys
+    finally:
+        proc.kill()                                  # SIGKILL, not shutdown
+        proc.wait(timeout=15)
+
+    proc2, ep2 = _start_coord(port, env)
+    try:
+        assert ep2 > ep1                             # monotonic incarnations
+        assert _raw_rpc(port, "keys", prefix="")["value"] == pre_keys
+        assert _raw_rpc(port, "get", key="alpha")["value"] == 2
+        assert _raw_rpc(port, "get",
+                        key="beta")["value"] == {"rows": [1, 2, 3]}
+        assert _raw_rpc(port, "get", key="ctr")["value"] == 2.5
+        assert _raw_rpc(port, "get", key="gone")["value"] is None
+        # the make_key counter continues past its pre-kill high-water mark
+        k2 = _raw_rpc(port, "make_key", prefix="job", req_id="t:8")["value"]
+        assert int(k2.rsplit("_", 1)[1]) == int(k1.rsplit("_", 1)[1]) + 1
+        # a RETRIED pre-kill request id answers from the WAL-rebuilt dedup
+        # window instead of re-applying (exactly-once across restart)
+        assert _raw_rpc(port, "make_key", prefix="job",
+                        req_id="t:3")["value"] == k1
+        assert _raw_rpc(port, "incr", key="ctr", delta=2.5,
+                        req_id="t:4")["value"] == 2.5
+    finally:
+        proc2.kill()
+        proc2.wait(timeout=15)
+
+
+@pytest.fixture()
+def local_coord(monkeypatch, tmp_path):
+    """In-process coordinator sandbox: background DKV traffic stopped so
+    injection counters and WAL records are deterministic."""
+    heartbeat.stop()
+    failure.stop()
+    failure.reset()
+    wal_dir = str(tmp_path / "waldir")
+    monkeypatch.setenv("H2O3_TPU_DKV_WAL_DIR", wal_dir)
+    monkeypatch.setenv("H2O3_TPU_DKV_WAL_COMPACT", "8")
+    monkeypatch.setenv("H2O3_TPU_DKV_RECV_TIMEOUT", "0.6")
+    config_reload()
+    yield wal_dir
+    dkv.detach()
+    failure.reset()
+    for k in ("H2O3_TPU_DKV_WAL_DIR", "H2O3_TPU_DKV_WAL_COMPACT",
+              "H2O3_TPU_DKV_RECV_TIMEOUT", "H2O3_TPU_FAULT_INJECT"):
+        monkeypatch.delenv(k, raising=False)
+    config_reload()
+    heartbeat.start()
+    failure.start()
+
+
+def test_wal_compaction_rotates_generations(cl, local_coord):
+    """Every dkv_wal_compact_every records the WAL folds into a snapshot
+    generation; exactly one (snap, wal) pair survives, and a restart
+    rehydrates from the pair — not the deleted history."""
+    dkv.serve(port=0)
+    my_keys = [f"!walc/k{i}" for i in range(20)]
+    for i, k in enumerate(my_keys):
+        dkv.put(k, i)
+    names = sorted(os.listdir(local_coord))
+    snaps = [n for n in names if n.startswith("snap_")]
+    wals = [n for n in names if n.startswith("wal_")]
+    assert len(snaps) == 1 and len(wals) == 1, names
+    gen = int(snaps[0].split("_")[1].split(".")[0])
+    assert gen >= 1 and wals[0] == f"wal_{gen}.log"
+    from h2o3_tpu.runtime.observability import counters
+    assert counters().get("dkv_wal_compactions", 0) >= 1
+
+    # crash simulation: drop the served state without a clean close
+    dkv._server.shutdown()
+    dkv._server.server_close()
+    dkv._server = None
+    dkv._wal_f = None
+    with dkv._lock:
+        for k in my_keys:
+            dkv._store.pop(k, None)
+            dkv._local_plain.discard(k)
+    dkv.serve(port=0)
+    for i, k in enumerate(my_keys):
+        assert dkv.get(k) == i
+    assert dkv.wal_stats()["restored_keys"] >= len(my_keys)
+
+
+def test_handler_frame_cap_and_recv_timeout(cl, local_coord):
+    """Satellite hardening: an absurd declared frame length is rejected
+    before allocation, and a half-open client is cut loose by the recv
+    timeout instead of pinning a handler thread forever."""
+    port = dkv.serve(port=0)
+    with socket.create_connection(("127.0.0.1", port), timeout=10) as s:
+        s.sendall(struct.pack("<Q", 1 << 40))        # claims a 1 TiB frame
+        n = struct.unpack("<Q", dkv._recvall(s, 8))[0]
+        resp = pickle.loads(dkv._recvall(s, n))
+    assert "exceeds" in resp["err"] and "MB cap" in resp["err"]
+
+    t0 = time.time()
+    with socket.create_connection(("127.0.0.1", port), timeout=10) as s:
+        # half-open: never send the frame; H2O3_TPU_DKV_RECV_TIMEOUT=0.6
+        n = struct.unpack("<Q", dkv._recvall(s, 8))[0]
+        resp = pickle.loads(dkv._recvall(s, n))
+    assert "err" in resp and time.time() - t0 < 3.0
+
+
+def test_epoch_bump_repush_and_stale_fence(cl, local_coord, monkeypatch,
+                                           tmp_path):
+    """A coordinator restart bumps the epoch; the attached client detects
+    it on its next op, re-pushes its locally-originated plain keys, and
+    refuses responses stamped with an older epoch."""
+    monkeypatch.setenv("H2O3_TPU_DKV_BACKOFF_BASE", "0.02")
+    monkeypatch.setenv("H2O3_TPU_DKV_RETRIES", "40")
+    monkeypatch.setenv("H2O3_TPU_DKV_RETRY_BUDGET", "60")
+    config_reload()
+    port = _free_port()
+    env = _coord_env()                 # NON-durable: epoch is time-seeded
+    proc, ep1 = _start_coord(port, env)
+    proc2 = None
+    try:
+        dkv.attach("127.0.0.1", port)
+        assert dkv._seen_epoch == ep1
+        dkv.put("!repush/fact", {"v": 7})
+        assert _raw_rpc(port, "get", key="!repush/fact")["value"] == {"v": 7}
+
+        proc.kill()
+        proc.wait(timeout=15)
+        time.sleep(1.1)                # time-seeded epochs tick at 1 s
+        proc2, ep2 = _start_coord(port, env)
+        assert ep2 > ep1
+
+        # fresh coordinator lost the key; the client's next op fences the
+        # bump and re-pushes it
+        assert _raw_rpc(port, "get", key="!repush/fact")["value"] is None
+        dkv.get("!no_such_key_anywhere")             # any op sees the bump
+        assert dkv._seen_epoch == ep2
+        assert _raw_rpc(port, "get", key="!repush/fact")["value"] == {"v": 7}
+        from h2o3_tpu.runtime.observability import timeline_events
+        bumps = [e for e in timeline_events(2000)
+                 if e["kind"] == "dkv_epoch_bump"]
+        assert bumps and bumps[-1]["new_epoch"] == ep2
+        assert bumps[-1]["repushed"] >= 1
+
+        # split-brain protection: a stale incarnation's epoch is refused
+        with pytest.raises(dkv.StaleCoordinatorError):
+            dkv._note_epoch(ep2 - 1)
+    finally:
+        dkv.detach()
+        dkv.remove("!repush/fact")
+        for k in ("H2O3_TPU_DKV_BACKOFF_BASE", "H2O3_TPU_DKV_RETRIES",
+                  "H2O3_TPU_DKV_RETRY_BUDGET"):
+            monkeypatch.delenv(k, raising=False)
+        for p in (proc, proc2):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait(timeout=15)

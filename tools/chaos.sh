@@ -7,16 +7,24 @@
 #     journaled frame and recovery.resume() continues from the progress
 #     snapshot; final predictions must match an uninterrupted run
 #     (tests/test_chaos.py),
-#   - DKV retry budget: a coordinator outage shorter than the retry
-#     budget causes zero failures (tests/test_dkv_retry.py),
+#   - coordinator hard-kill: the DKV coordinator os._exit(137)s mid-GBM
+#     (dkv_handle:coordinator:N), is restarted on the same port +
+#     recovery dir, the worker rides out the outage on its retry budget,
+#     fences the new epoch, and the model matches the uninterrupted run
+#     (tests/test_chaos.py),
+#   - WAL+snapshot rehydration, epoch fencing/re-push, exactly-once
+#     dedup across a real SIGKILL, handler hardening
+#     (tests/test_dkv_wal.py),
+#   - DKV retry budget + exactly-once under dropped responses, plain and
+#     TLS (tests/test_dkv_retry.py),
 #   - in-process snapshot/journal/resume contracts
 #     (tests/test_snapshot_recovery.py).
 #
 # Exits with pytest's return code.
 set -o pipefail
 cd "$(dirname "$0")/.."
-timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest \
-    tests/test_chaos.py tests/test_dkv_retry.py \
+timeout -k 10 1500 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_chaos.py tests/test_dkv_wal.py tests/test_dkv_retry.py \
     tests/test_snapshot_recovery.py tests/test_failure.py \
     -q -p no:cacheprovider -p no:xdist -p no:randomly
 exit $?
