@@ -389,6 +389,179 @@ def splits_piece():
               "kernel (K*L leaves flatten into rows)")
 
 
+def deep_piece():
+    """Deep-level layout comparison: the dense [2^d, F, B] grid vs the
+    node-sparse [A, F, B] slot layout, depth 6 -> 12 at 64 and 256 bins.
+
+    Per (nbins, depth) two JSON lines land, each timing ONE level program
+    (histogram + fused split search, the per-level unit of work):
+
+      - ``deep_dense_b*_d*``  — make_subtract_level_fn at the full level
+        width 2^d; where the dense grid exceeds the 64 MB histogram
+        budget the line carries ``over_budget: true`` and is NOT timed
+        (that is the wall the sparse layout removes),
+      - ``deep_sparse_b*_d*`` — make_sparse_level_fn at the slot width
+        A = min(2^d, sparse_slot_budget(F, B)): histogram bytes follow
+        the ALIVE-bounded slot axis, plateauing at the budget instead of
+        doubling per level.
+
+    A ``deep_summary_b*`` line tabulates the per-depth byte ratio and a
+    final ``deep_dispatch`` line counts pallas_call equations in the
+    traced sparse level program — the acceptance is 2 launches per level
+    (one sparse histogram kernel + one winner-records kernel) no matter
+    how many leaves are alive.
+
+    Usage (chip): python bench_pieces.py deep
+    CPU smoke:    JAX_PLATFORMS=cpu H2O3_PIECES_ROWS=50000 \\
+                  H2O3_PIECES_REPS=2 python bench_pieces.py deep
+    (Off-TPU the inner histogram ships the einsum impl — same level
+    program structure, smoke-scale numbers only; chip numbers are the
+    deliverable.)
+    """
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import jax
+    import jax.numpy as jnp
+
+    import h2o3_tpu
+    from bench_util import timed_amortized
+    cl = h2o3_tpu.init()
+    platform = jax.devices()[0].platform
+    n = N_ROWS - (N_ROWS % (512 * cl.n_row_shards))
+    shards = cl.n_row_shards
+
+    from h2o3_tpu.models.tree.hist import (
+        fused_best_splits, make_sparse_level_fn, make_subtract_level_fn,
+        offset_codes, sparse_slot_budget)
+
+    def emit(**rec):
+        print(json.dumps({**rec, "platform": platform, "rows": n}),
+              flush=True)
+
+    CAP = 64 * 1024 * 1024
+    on_tpu = platform == "tpu"
+    key = jax.random.PRNGKey(0)
+    ks = jax.random.split(key, 16)
+    g = jax.random.normal(ks[8], (n,), jnp.float32)
+    h = jnp.abs(jax.random.normal(ks[9], (n,), jnp.float32)) + 0.1
+    w = jnp.ones((n,), jnp.float32)
+
+    for nbins in (64, 256):
+        B_ = nbins + 1
+        # varbin packed kernel on chip; einsum inner for CPU smoke
+        bc = tuple(min(c, nbins) for c in BIN_COUNTS) if on_tpu else None
+        codes = jnp.stack([
+            jax.random.randint(ks[f], (n,), 0, min(c, nbins),
+                               dtype=jnp.int32)
+            for f, c in enumerate(BIN_COUNTS)], axis=0)
+        hc = offset_codes(codes, bc, nbins) if bc else codes
+        A_cap = sparse_slot_budget(F, B_)
+        mem = {}
+        for d in range(6, 13):
+            Ld = 2 ** d
+            dense_bytes = F * B_ * 3 * Ld * 4
+            sp_A = min(Ld, A_cap)
+            sp_Ap = min(Ld // 2, A_cap)
+            sparse_bytes = F * B_ * 3 * sp_A * 4
+            mem[f"d{d}"] = {"dense_mb": round(dense_bytes / 2 ** 20, 1),
+                            "sparse_mb": round(sparse_bytes / 2 ** 20, 1)}
+
+            if dense_bytes <= CAP:
+                dfn = make_subtract_level_fn(d, F, B_, n, bin_counts=bc)
+                leaf = jax.random.randint(ks[10], (n,), 0, Ld,
+                                          dtype=jnp.int32)
+                dcarry = jnp.zeros((shards, 3, Ld // 2, F, B_),
+                                   jnp.float32)
+
+                def run_d(acc, lf, cr, _fn=dfn, _b=nbins):
+                    H, _ = _fn(hc, lf, g + acc * 0.0, h, w, cr)
+                    out = fused_best_splits(H, _b, 1.0, 1.0, 1e-5)
+                    return out[3].reshape(-1)[0].astype(jnp.float32) \
+                        * 1e-30
+
+                ms = timed_amortized(run_d, leaf, dcarry, reps=REPS)
+                emit(piece=f"deep_dense_b{nbins}_d{d}", ms=round(ms, 3),
+                     slots=Ld, hist_bytes=dense_bytes)
+            else:
+                emit(piece=f"deep_dense_b{nbins}_d{d}", ms=None,
+                     slots=Ld, hist_bytes=dense_bytes, over_budget=True,
+                     note="dense grid exceeds the 64 MB histogram budget")
+
+            sfn = make_sparse_level_fn(sp_Ap, sp_A, F, B_, n,
+                                       bin_counts=bc)
+            sleaf = jax.random.randint(ks[11], (n,), 0, sp_A,
+                                       dtype=jnp.int32)
+            ps = jnp.minimum(jnp.arange(sp_A, dtype=jnp.int32) // 2,
+                             sp_Ap - 1)
+            scarry = jnp.zeros((shards, 3, sp_Ap, F, B_), jnp.float32)
+
+            def run_s(acc, lf, cr, _fn=sfn, _ps=ps, _b=nbins):
+                H, _ = _fn(hc, lf, g + acc * 0.0, h, w, cr, _ps)
+                out = fused_best_splits(H, _b, 1.0, 1.0, 1e-5)
+                return out[3].reshape(-1)[0].astype(jnp.float32) * 1e-30
+
+            ms = timed_amortized(run_s, sleaf, scarry, reps=REPS)
+            emit(piece=f"deep_sparse_b{nbins}_d{d}", ms=round(ms, 3),
+                 slots=sp_A, hist_bytes=sparse_bytes,
+                 mem_ratio=round(dense_bytes / sparse_bytes, 2))
+
+        # the alive-bounded case the layout exists for: a skewed deep
+        # tree with ~256 alive leaves runs the SAME level program at
+        # EVERY depth — time and bytes stop depending on d entirely,
+        # while the dense grid doubles per level above
+        A_alive = 256
+        afn = make_sparse_level_fn(A_alive, A_alive, F, B_, n,
+                                   bin_counts=bc)
+        sleaf = jax.random.randint(ks[12], (n,), 0, A_alive,
+                                   dtype=jnp.int32)
+        ps = jnp.minimum(jnp.arange(A_alive, dtype=jnp.int32) // 2,
+                         A_alive - 1)
+        acarry = jnp.zeros((shards, 3, A_alive, F, B_), jnp.float32)
+
+        def run_a(acc, lf, cr, _fn=afn, _ps=ps, _b=nbins):
+            H, _ = _fn(hc, lf, g + acc * 0.0, h, w, cr, _ps)
+            out = fused_best_splits(H, _b, 1.0, 1.0, 1e-5)
+            return out[3].reshape(-1)[0].astype(jnp.float32) * 1e-30
+
+        ms = timed_amortized(run_a, sleaf, acarry, reps=REPS)
+        emit(piece=f"deep_sparse_alive{A_alive}_b{nbins}", ms=round(ms, 3),
+             slots=A_alive, hist_bytes=F * B_ * 3 * A_alive * 4,
+             note="256 alive leaves: identical level cost at EVERY "
+                  "depth 8..12+ — hist bytes follow alive leaves, "
+                  "not 2^d")
+
+        emit(piece=f"deep_summary_b{nbins}", slot_budget=A_cap,
+             per_depth_mb=mem,
+             alive256_mb=round(F * B_ * 3 * A_alive * 4 / 2 ** 20, 1),
+             note="sparse bytes are alive-bounded (plateau at the slot "
+                  "budget in the worst case); dense doubles per level "
+                  "and blows the 64 MB cap at depth 12 x 256 bins")
+
+    # dispatch-count proof: 2 pallas launches per sparse level (hist +
+    # records), independent of the alive-slot count — from the traced
+    # program, not a projection
+    Ap_, A_ = 8, 16
+    lev = make_sparse_level_fn(
+        Ap_, A_, F, B, n, bin_counts=BIN_COUNTS,
+        force_impl="pallas" if on_tpu else "pallas_interpret")
+    sleaf = jnp.zeros((n,), jnp.int32)
+    carry = jnp.zeros((shards, 3, Ap_, F, B), jnp.float32)
+    ps = jnp.arange(A_, dtype=jnp.int32) // 2
+
+    def sparse_level(c, lf, gg, hh, ww, cr, pp):
+        H, _ = lev(c, lf, gg, hh, ww, cr, pp)
+        return fused_best_splits(H, NBINS, 1.0, 1.0, 1e-5,
+                                 force_impl="pallas")
+
+    gcodes = offset_codes(jnp.zeros((F, n), jnp.int32), BIN_COUNTS, NBINS)
+    n_calls = str(jax.make_jaxpr(sparse_level)(
+        gcodes, sleaf, g, h, w, carry, ps)).count("pallas_call")
+    emit(piece="deep_dispatch", pallas_calls_per_level=n_calls, expect=2,
+         ok=n_calls == 2,
+         note="1 sparse hist kernel + 1 records kernel per deep level")
+
+
 def parse_piece():
     """Standalone ingest bench: bench.py's 568 MB parse line (same file,
     same warmup methodology) without the ~1091 s full suite.
@@ -427,5 +600,7 @@ if __name__ == "__main__":
         hist_piece()
     elif len(sys.argv) > 1 and sys.argv[1] == "splits":
         splits_piece()
+    elif len(sys.argv) > 1 and sys.argv[1] == "deep":
+        deep_piece()
     else:
         main()

@@ -28,11 +28,12 @@ from ..datainfo import DataInfo
 from ..scorekeeper import stop_early, metric_direction
 from .binning import fit_bins, edges_matrix
 from .shared import (SharedTree, SharedTreeModel, SharedTreeParameters,
-                     StackedTrees, TreeList, chunk_schedule,
+                     StackedTrees, TreeList, chunk_schedule, dense_mem_cap,
                      make_multinomial_scan_fn, make_tree_scan_fn,
-                     resolve_hist_mode, resolve_split_mode,
-                     run_hist_crosscheck, run_split_crosscheck,
-                     traverse_jit)
+                     resolve_hist_layout, resolve_hist_mode,
+                     resolve_split_mode, run_hist_crosscheck,
+                     run_layout_crosscheck, run_split_crosscheck,
+                     traverse_jit, use_hier_split_search)
 from ...metrics.core import make_metrics
 
 
@@ -95,10 +96,19 @@ class DRF(SharedTree):
         from .shared import maybe_bundle
         plan, wcodes, Fw, wbin_counts = maybe_bundle(binned, p, None,
                                                      frame.nrows)
+        # resolve the kernel-strategy knobs ONCE, up front — the layout
+        # changes the effective-depth cap, so checkpoint validation and
+        # the recorded depth must see the resolved layout (see gbm.py)
+        hist_mode = resolve_hist_mode(p)
+        split_mode = resolve_split_mode(
+            p, plan=plan, hier=use_hier_split_search(p, N))
+        hist_layout = resolve_hist_layout(
+            p, hist_mode=hist_mode, plan=plan,
+            hier=use_hier_split_search(p, N))
         if prior is not None:
             from .shared import validate_checkpoint_depth
             validate_checkpoint_depth(prior, 0 if K > 1 else None,
-                                      p, Fw, N)
+                                      p, Fw, N, hist_layout=hist_layout)
         rng = jax.random.PRNGKey(p.effective_seed())
 
         # mtries resolves against the WORKING feature count: the per-split
@@ -115,7 +125,12 @@ class DRF(SharedTree):
         model = DRFModel(job.dest_key or dkv.make_key(self.algo), p, di)
         model.output["nclass_trees"] = K
         from .shared import record_effective_depth
-        record_effective_depth(model, p, Fw, N)
+        eff_depth = record_effective_depth(model, p, Fw, N,
+                                           hist_layout=hist_layout)
+        # deep_level chaos hook fires only when sparse levels actually run
+        sparse_deep = (hist_layout in ("sparse", "check") and eff_depth
+                       > max(1, min(p.sparse_depth_threshold,
+                                    dense_mem_cap(p.nbins, Fw))))
 
         if K > 1:
             yi = jnp.clip(y.astype(jnp.int32), 0, K - 1)
@@ -161,8 +176,6 @@ class DRF(SharedTree):
         # a whole scoring interval of trees is one device dispatch.  The same
         # per-tree keys are reused across classes so every class sees the
         # same bootstrap sample per iteration (DRF.java samples once/tree).
-        from .shared import use_hier_split_search
-        hist_mode = resolve_hist_mode(p)
         if hist_mode == "check":
             # driver assert: the forest's mean-fit gradients (g=-y, h=1)
             # through both histogram paths must grow the same tree
@@ -177,8 +190,6 @@ class DRF(SharedTree):
             hist_mode = "subtract"
         # split_mode="check" — fused (batched-K for multiclass) vs the
         # sequential best_splits oracle on the real mean-fit gradients
-        split_mode = resolve_split_mode(
-            p, plan=plan, hier=use_hier_split_search(p, N))
         if split_mode == "check":
             gK = jnp.stack([-t * w for t in targets])
             hK = jnp.broadcast_to(w, gK.shape)
@@ -195,6 +206,26 @@ class DRF(SharedTree):
                 reg_alpha=p.reg_alpha, gamma=p.gamma,
                 min_child_weight=p.min_child_weight)
             split_mode = "fused"
+        # hist_layout="check" — dense vs node-sparse deep levels on the
+        # real mean-fit gradients, then training rides the sparse path
+        if hist_layout == "check":
+            gK = jnp.stack([-t * w for t in targets])
+            hK = jnp.broadcast_to(w, gK.shape)
+            kchk = jnp.stack([jax.random.fold_in(rng, k)
+                              for k in range(K)]) if K > 1 else rng
+            run_layout_crosscheck(
+                wcodes, gK if K > 1 else gK[0],
+                hK if K > 1 else hK[0], w, edges_mat, kchk,
+                max_depth=p.max_depth, nbins=p.nbins, F=Fw, n_padded=N,
+                bin_counts=wbin_counts,
+                sparse_depth_threshold=p.sparse_depth_threshold,
+                reg_lambda=p.reg_lambda, min_rows=p.min_rows,
+                min_split_improvement=p.min_split_improvement,
+                learn_rate=1.0, col_sample_rate=col_rate,
+                reg_alpha=p.reg_alpha, gamma=p.gamma,
+                min_child_weight=p.min_child_weight)
+            hist_layout = "sparse"
+            model.output["hist_layout"] = hist_layout
         # batched multiclass: one K-tree build per round (one hist + one
         # split launch per level for all K class trees) instead of K
         # sequential scans — identical keys (same fold_in structure), so
@@ -205,14 +236,16 @@ class DRF(SharedTree):
                 K, p.max_depth, p.nbins, Fw, N,
                 p.effective_hist_precision, p.sample_rate, 1.0,
                 bin_counts=wbin_counts, hist_mode=hist_mode,
-                split_mode="fused", mode="drf")
+                split_mode="fused", mode="drf", hist_layout=hist_layout,
+                sparse_depth_threshold=p.sparse_depth_threshold)
         else:
             scan_fn = make_tree_scan_fn(
                 "drf", 0.0, 0.0, 0.0, p.max_depth, p.nbins, Fw, N,
                 p.effective_hist_precision, p.sample_rate, 1.0,
                 hier=use_hier_split_search(p, N),
                 bin_counts=wbin_counts, plan=plan, hist_mode=hist_mode,
-                split_mode=split_mode)
+                split_mode=split_mode, hist_layout=hist_layout,
+                sparse_depth_threshold=p.sparse_depth_threshold)
         scalars = (p.reg_lambda, p.min_rows, p.min_split_improvement, 1.0,
                    col_rate, p.reg_alpha, p.gamma, p.min_child_weight)
         chunks = [[] for _ in range(K)]
@@ -223,6 +256,9 @@ class DRF(SharedTree):
         for chunk_no, (c, t_new, score_now) in enumerate(chunk_schedule(
                 p.ntrees - prior_nt, p.score_tree_interval)):
             t_done = prior_nt + t_new
+            if sparse_deep:
+                # kill/resume while node-sparse deep levels are live
+                failure.maybe_inject("deep_level")
             if batched:
                 # chaos matrix: kill/resume mid-K-tree-round on the
                 # batched path

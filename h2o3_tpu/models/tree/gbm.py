@@ -32,9 +32,11 @@ from ..scorekeeper import stop_early, metric_direction
 from .binning import fit_bins, edges_matrix
 from .shared import (SharedTree, SharedTreeModel, SharedTreeParameters,
                      StackedTrees, Tree, TreeList, build_tree,
-                     chunk_schedule, make_build_tree_fn, make_tree_scan_fn,
+                     chunk_schedule, dense_mem_cap, make_build_tree_fn,
+                     make_tree_scan_fn, resolve_hist_layout,
                      resolve_hist_mode, resolve_split_mode,
-                     run_hist_crosscheck, run_split_crosscheck, stack_trees,
+                     run_hist_crosscheck, run_layout_crosscheck,
+                     run_split_crosscheck, stack_trees,
                      traverse_jit, use_hier_split_search)
 from ...metrics.core import make_metrics
 
@@ -140,10 +142,20 @@ class GBM(SharedTree):
         from .shared import maybe_bundle
         plan, wcodes, Fw, wbin_counts = maybe_bundle(binned, p, mono,
                                                      frame.nrows)
+        # resolve the kernel-strategy knobs ONCE, up front: the layout
+        # changes the effective-depth cap (node-sparse levels drop the
+        # dense 64 MB histogram bound), so checkpoint validation and the
+        # recorded depth must see the resolved layout, not the raw knob
+        hist_mode = resolve_hist_mode(p)
+        split_mode = resolve_split_mode(
+            p, mono=mono, plan=plan, hier=use_hier_split_search(p, N))
+        hist_layout = resolve_hist_layout(
+            p, hist_mode=hist_mode, mono=mono, plan=plan,
+            hier=use_hier_split_search(p, N))
         if prior is not None:
             from .shared import validate_checkpoint_depth
             validate_checkpoint_depth(prior, 0 if multinomial else None,
-                                      p, Fw, N)
+                                      p, Fw, N, hist_layout=hist_layout)
         seed = p.effective_seed()
         rng = jax.random.PRNGKey(seed)
         nprng = np.random.default_rng(seed)
@@ -155,7 +167,12 @@ class GBM(SharedTree):
         model.output["binning"] = {"nbins": p.nbins}
         model.output["nclass_trees"] = K
         from .shared import record_effective_depth
-        record_effective_depth(model, p, Fw, N)
+        eff_depth = record_effective_depth(model, p, Fw, N,
+                                           hist_layout=hist_layout)
+        # deep_level chaos hook fires only when sparse levels actually run
+        sparse_deep = (hist_layout in ("sparse", "check") and eff_depth
+                       > max(1, min(p.sparse_depth_threshold,
+                                    dense_mem_cap(p.nbins, Fw))))
         if plan is not None:
             model.output["efb_bundles"] = sum(
                 1 for w in plan.working if w[0] == "bundle")
@@ -249,7 +266,6 @@ class GBM(SharedTree):
         # the subtraction path and the full oracle on the REAL first-tree
         # gradients must agree (shared.run_hist_crosscheck), then training
         # proceeds on the subtraction path.
-        hist_mode = resolve_hist_mode(p)
         if hist_mode == "check":
             if multinomial:
                 g0, h0 = grads_multi(Y1, F)
@@ -269,8 +285,6 @@ class GBM(SharedTree):
         # split_mode="check" — fused (batched-K for multinomial) vs the
         # sequential best_splits oracle on the REAL first-round gradients
         # (shared.run_split_crosscheck), then training rides the fused path.
-        split_mode = resolve_split_mode(
-            p, mono=mono, plan=plan, hier=use_hier_split_search(p, N))
         if split_mode == "check":
             if multinomial:
                 g0, h0 = grads_multi(Y1, F)
@@ -292,6 +306,33 @@ class GBM(SharedTree):
                 min_child_weight=p.min_child_weight)
             split_mode = "fused"
 
+        # hist_layout="check" — dense vs node-sparse deep levels on the
+        # REAL first-round gradients (shared.run_layout_crosscheck: depth
+        # clamped to the DENSE cap so both layouts can grow it), then
+        # training rides the sparse path at the full layout-aware depth.
+        if hist_layout == "check":
+            if multinomial:
+                g0, h0 = grads_multi(Y1, F)
+                gc_, hc_ = (g0 * w[:, None]).T, (h0 * w[:, None]).T
+                kchk = jnp.stack([jax.random.fold_in(rng, k)
+                                  for k in range(K)])
+            else:
+                g0, h0 = grads_single(y, F)
+                gc_, hc_ = g0 * w, h0 * w
+                kchk = rng
+            run_layout_crosscheck(
+                wcodes, gc_, hc_, w, edges_mat, kchk,
+                max_depth=p.max_depth, nbins=p.nbins, F=Fw, n_padded=N,
+                bin_counts=wbin_counts,
+                sparse_depth_threshold=p.sparse_depth_threshold,
+                reg_lambda=p.reg_lambda, min_rows=p.min_rows,
+                min_split_improvement=p.min_split_improvement,
+                learn_rate=p.learn_rate, col_sample_rate=p.col_sample_rate,
+                reg_alpha=p.reg_alpha, gamma=p.gamma,
+                min_child_weight=p.min_child_weight)
+            hist_layout = "sparse"
+            model.output["hist_layout"] = hist_layout
+
         if fused_multi:
             # multinomial fast path: K class trees per round, a whole
             # scoring interval of rounds per dispatch
@@ -301,7 +342,8 @@ class GBM(SharedTree):
                 p.effective_hist_precision, p.sample_rate, p.col_sample_rate_per_tree,
                 hier=use_hier_split_search(p, N),
                 bin_counts=wbin_counts, plan=plan, hist_mode=hist_mode,
-                split_mode=split_mode)
+                split_mode=split_mode, hist_layout=hist_layout,
+                sparse_depth_threshold=p.sparse_depth_threshold)
             scalars = (p.reg_lambda, p.min_rows, p.min_split_improvement,
                        p.learn_rate, p.col_sample_rate, p.reg_alpha, p.gamma,
                        p.min_child_weight)
@@ -314,6 +356,9 @@ class GBM(SharedTree):
                 # chaos matrix: kill/resume mid-multinomial-round — each
                 # chunk is a batch of K-tree rounds on the fused path
                 failure.maybe_inject("ktree_round")
+                if sparse_deep:
+                    # kill/resume while node-sparse deep levels are live
+                    failure.maybe_inject("deep_level")
                 F, lv, vals, cov = scan_fn(wcodes, Y1, w, F, edges_mat,
                                            rng, chunk_no, c, *scalars)
                 for k in range(K):
@@ -355,14 +400,20 @@ class GBM(SharedTree):
                 hier=use_hier_split_search(p, N) and mono is None,
                 bin_counts=wbin_counts, mono=mono, plan=plan,
                 custom_fn=getattr(p, "custom_distribution_func", None),
-                hist_mode=hist_mode, split_mode=split_mode)
+                hist_mode=hist_mode, split_mode=split_mode,
+                hist_layout=hist_layout,
+                sparse_depth_threshold=p.sparse_depth_threshold)
             scalars = (p.reg_lambda, p.min_rows, p.min_split_improvement,
                        p.learn_rate, p.col_sample_rate, p.reg_alpha, p.gamma,
                        p.min_child_weight)
             chunks = [prior_stacked(prior)] if prior is not None else []
+            from ...runtime import failure
             for chunk_no, (c, t_new, score_now) in enumerate(chunk_schedule(
                     p.ntrees - prior_nt, p.score_tree_interval)):
                 t_done = prior_nt + t_new
+                if sparse_deep:
+                    # kill/resume while node-sparse deep levels are live
+                    failure.maybe_inject("deep_level")
                 F, lv, vals, cov = scan_fn(wcodes, y, w, F, edges_mat,
                                            rng, chunk_no, c, *scalars, 0)
                 chunk = StackedTrees(lv, vals, cov)
@@ -446,7 +497,8 @@ class GBM(SharedTree):
                     fnK = make_build_tree_fn(
                         p.max_depth, p.nbins, binned.nfeatures, N,
                         p.effective_hist_precision, hist_mode=hist_mode,
-                        nk=K, split_mode="fused")
+                        nk=K, split_mode="fused", hist_layout=hist_layout,
+                        sparse_depth_threshold=p.sparse_depth_threshold)
                     tmK = jnp.broadcast_to(
                         jnp.asarray(tree_mask, bool) if tree_mask
                         is not None else jnp.ones(binned.nfeatures, bool),
@@ -482,7 +534,9 @@ class GBM(SharedTree):
                             p.reg_alpha, p.gamma, p.min_child_weight,
                             hist_precision=p.effective_hist_precision,
                             hier=use_hier_split_search(p, N),
-                            hist_mode=hist_mode, split_mode=split_mode)
+                            hist_mode=hist_mode, split_mode=split_mode,
+                            hist_layout=hist_layout,
+                            sparse_depth_threshold=p.sparse_depth_threshold)
                         if dart:
                             tree.values = tree.values * b_scale
                         ktrees.append(tree)
@@ -509,7 +563,9 @@ class GBM(SharedTree):
                     p.reg_alpha, p.gamma, p.min_child_weight, mono=mono,
                     hist_precision=p.effective_hist_precision,
                     hier=use_hier_split_search(p, N) and mono is None,
-                    hist_mode=hist_mode, split_mode=split_mode)
+                    hist_mode=hist_mode, split_mode=split_mode,
+                    hist_layout=hist_layout,
+                    sparse_depth_threshold=p.sparse_depth_threshold)
                 tree.values = tree.values * b_scale
                 trees.append(tree)
                 from .hist import table_lookup

@@ -692,6 +692,186 @@ def make_batched_level_fn(d: int, K: int, F: int, B: int, n_padded: int,
     return jax.jit(f)
 
 
+def sparse_slot_budget(F: int, B: int,
+                       cap_bytes: int = 64 * 1024 * 1024) -> int:
+    """Static slot capacity for node-sparse deep levels.
+
+    The dense grid hits its memory wall where ``F*B*3*2^d*4`` exceeds the
+    64 MB histogram budget (shared.effective_max_depth).  The sparse layout
+    sizes its slot axis so the SAME budget holds at every depth: the
+    largest multiple of 8 (the f32 sublane tile) slots whose [A, F, B]
+    triple-plane grid fits ``cap_bytes``, clamped to [16, 4096].  Levels
+    whose full child width 2^d is smaller than this use 2^d directly."""
+    a = cap_bytes // (F * B * 3 * 4)
+    return int(max(16, min(4096, (a // 8) * 8)))
+
+
+def sparse_slot_maps(valid_prev, A_next: int):
+    """Child-slot assignment for the next node-sparse level.
+
+    ``valid_prev`` [Ap] holds the previous level's split decisions in that
+    level's own slot (or dense-leaf) space.  Both children of every valid
+    slot get a contiguous slot pair (even = left), in slot order.  Returns
+
+    - ``child_base`` [Ap+1]: first child slot of each previous slot
+      (``A_next`` when the slot gets no pair — invalid, past the slot
+      budget, or the appended sentinel row),
+    - ``ps_of_slot`` [A_next]: each slot's parent slot (pairs share it;
+      phantom slots past the live range point at 0 and are masked off),
+    - ``real`` [A_next]: live-slot mask (phantom slots are never written
+      by any row and their split records are discarded).
+
+    When a level has more alive children than ``A_next`` slots, later
+    pairs are dropped ATOMICALLY in slot order and those children become
+    terminal leaves — the deterministic num_leaves-style degradation the
+    operations guide documents; ``hist_layout="check"`` surfaces it."""
+    Ap = valid_prev.shape[0]
+    idx = jnp.cumsum(valid_prev.astype(jnp.int32)) - 1          # [Ap]
+    kept = valid_prev & (2 * idx + 1 < A_next)
+    base = jnp.where(kept, 2 * idx, A_next).astype(jnp.int32)
+    child_base = jnp.concatenate(
+        [base, jnp.full((1,), A_next, jnp.int32)])              # [Ap+1]
+    half = jnp.zeros((A_next // 2,), jnp.int32) \
+        .at[jnp.where(kept, idx, A_next // 2)] \
+        .set(jnp.arange(Ap, dtype=jnp.int32), mode="drop")
+    ps_of_slot = jnp.repeat(half, 2)
+    real = jnp.arange(A_next) < 2 * jnp.sum(kept.astype(jnp.int32))
+    return child_base, ps_of_slot, real
+
+
+def _sparse_local_body(A_prev: int, A: int, F: int, cap: int, inner):
+    """Per-shard node-sparse level body shared by the single-tree and
+    batched-K wrappers: smaller-sibling compaction labeled by PARENT SLOT
+    (not dense parent id), subtraction against the slot-space carry, then
+    a slot-axis gather into this level's [A] slot space."""
+
+    def body(codes, sleaf, g, h, w, Hp, ps_of_slot):
+        side = jnp.arange(A, dtype=jnp.int32) & 1               # [A]
+        # local physical row count per slot — orientation only, exactly as
+        # the dense subtract kernel counts per dense child
+        sidx = jax.lax.broadcasted_iota(jnp.int32, (A, 1), 0)
+        cnt = jnp.sum(sidx == sleaf[None, :], axis=1, dtype=jnp.int32)
+        # fold to per-parent-slot left/right counts (tiny [A] scatter-add;
+        # phantom slots contribute 0 rows so pointing them at parent 0 is
+        # harmless)
+        cl_ = jnp.zeros((A_prev,), jnp.int32).at[ps_of_slot].add(
+            jnp.where(side == 0, cnt, 0), mode="drop")
+        cr_ = jnp.zeros((A_prev,), jnp.int32).at[ps_of_slot].add(
+            jnp.where(side == 1, cnt, 0), mode="drop")
+        small_is_left = cl_ <= cr_                              # [A_prev]
+        chosen_slot = jnp.where(side == 0, small_is_left[ps_of_slot],
+                                ~small_is_left[ps_of_slot])     # [A]
+        # per-row (smaller-sibling?, parent slot) in ONE one-hot product
+        # over the A+1-wide slot table; the sentinel row (slot A — nodes
+        # whose chain died or overflowed) is never chosen, so dead rows
+        # stay out of the histogram entirely
+        tbl = jnp.stack([
+            jnp.concatenate([chosen_slot.astype(jnp.float32),
+                             jnp.zeros((1,), jnp.float32)]),
+            jnp.concatenate([ps_of_slot.astype(jnp.float32),
+                             jnp.zeros((1,), jnp.float32)])])
+        t = table_lookup(tbl, sleaf, A + 1)                     # [2, N]
+        chosen = t[0] > 0.5
+        prow = t[1].astype(jnp.int32)
+        target = jnp.where(chosen,
+                           jnp.cumsum(chosen.astype(jnp.int32)) - 1, cap)
+        ccodes = jnp.zeros((F, cap), codes.dtype) \
+            .at[:, target].set(codes, mode="drop", unique_indices=True)
+        pleaf = jnp.zeros((cap,), jnp.int32) \
+            .at[target].set(prow, mode="drop", unique_indices=True)
+        st = jnp.zeros((3, cap), jnp.float32) \
+            .at[:, target].set(
+                jnp.stack([g, h, w]).astype(jnp.float32), mode="drop",
+                unique_indices=True)
+        Hs = inner(ccodes, pleaf, st[0], st[1], st[2])     # [3, A_prev,F,B]
+        Ho = Hp - Hs
+        Ho = Ho.at[1:].max(0.0)
+        # gather each slot's histogram from its parent row: the smaller
+        # child reads Hs, the larger its reconstruction — a slot-axis
+        # gather over A blocks, NOT a per-row op
+        Hs_g = jnp.take(Hs, ps_of_slot, axis=1)
+        Ho_g = jnp.take(Ho, ps_of_slot, axis=1)
+        return jnp.where(chosen_slot[None, :, None, None], Hs_g, Ho_g)
+
+    return body
+
+
+@functools.lru_cache(maxsize=None)
+def make_sparse_level_fn(A_prev: int, A: int, F: int, B: int,
+                         n_padded: int, bin_counts=None,
+                         force_impl: str = "", precision: str = "bf16"):
+    """Node-sparse deep-level histogram: [A, F, B] slots for ALIVE leaves
+    instead of the dense [2^d, F, B] grid (ROADMAP item 1 — the CSR move
+    the GPU tree-boosting literature sizes deep levels by).
+
+    Below the depth threshold the smaller-sibling compaction already
+    streams <= N/2 rows, but the dense slot grid kept histogram bytes at
+    F*B*3*2^d*4 — the 64 MB wall that capped depth.  Here the level is
+    keyed by slot ids: rows carry ``sleaf`` [N] in [0, A] (A = "no slot":
+    terminal chains and budget overflow), the carry is the PREVIOUS
+    level's per-shard slot-space histograms [n_shards, 3, A_prev, F, B],
+    and ``ps_of_slot`` [A] (replicated) maps each slot to its parent's
+    slot — at the dense->sparse boundary the "previous slot space" is just
+    the dense parent id space, so the first sparse level consumes the
+    dense subtract carry unchanged.  When every parent is valid and
+    A = 2^d the slot map is the identity and the output is bit-identical
+    to make_subtract_level_fn; with dead chains the compaction prefix
+    differs (dead rows are dropped rather than histogrammed), so parity
+    is structural + f32-tolerance, which hist_layout="check" asserts.
+
+    Returns ``(H_global [3, A, F, B], carry [n_shards, 3, A, F, B])``.
+    """
+    cl = cluster()
+    n_local = n_padded // cl.n_row_shards
+    cap = n_local // 2
+    inner = _local_hist_impl(A_prev, F, B, cap, bin_counts=bin_counts,
+                             force_impl=force_impl, precision=precision)
+    body = _sparse_local_body(A_prev, A, F, cap, inner)
+
+    def locald(codes, sleaf, g, h, w, carry, ps_of_slot):
+        Hloc = body(codes, sleaf, g, h, w, carry[0], ps_of_slot)
+        return jax.lax.psum(Hloc, ROW_AXIS), Hloc[None]
+
+    specs_in = (P(None, ROW_AXIS), P(ROW_AXIS), P(ROW_AXIS), P(ROW_AXIS),
+                P(ROW_AXIS), P(ROW_AXIS), P())
+    f = shard_map(locald, mesh=cl.mesh, in_specs=specs_in,
+                  out_specs=(P(), P(ROW_AXIS)), check_vma=False)
+    return jax.jit(f)
+
+
+@functools.lru_cache(maxsize=None)
+def make_batched_sparse_level_fn(A_prev: int, A: int, K: int, F: int,
+                                 B: int, n_padded: int, bin_counts=None,
+                                 force_impl: str = "",
+                                 precision: str = "bf16"):
+    """K-tree node-sparse level in ONE kernel launch — the
+    make_batched_level_fn contract at the sparse slot geometry.
+
+    Each tree has its own slot assignment (per-tree valid flags), so
+    ``sleaf``/``ps_of_slot`` carry a leading [K]; the per-tree body is
+    vmapped and Pallas prepends K to the grid exactly as the dense
+    batched path does, keeping the launch count at one hist + one records
+    kernel per level regardless of K.  Shapes: codes [F, N] shared;
+    sleaf/g/h/w [K, N]; carry [n_shards, K, 3, A_prev, F, B];
+    ps_of_slot [K, A] replicated.  Returns (H [K, 3, A, F, B], carry)."""
+    cl = cluster()
+    n_local = n_padded // cl.n_row_shards
+    cap = n_local // 2
+    inner = _local_hist_impl(A_prev, F, B, cap, bin_counts=bin_counts,
+                             force_impl=force_impl, precision=precision)
+    body = _sparse_local_body(A_prev, A, F, cap, inner)
+
+    def locald(codes, sleafK, gK, hK, wK, carry, psK):
+        HlocK = jax.vmap(body, in_axes=(None, 0, 0, 0, 0, 0, 0))(
+            codes, sleafK, gK, hK, wK, carry[0], psK)
+        return jax.lax.psum(HlocK, ROW_AXIS), HlocK[None]
+
+    specs_in = (P(None, ROW_AXIS),) * 5 + (P(ROW_AXIS), P())
+    f = shard_map(locald, mesh=cl.mesh, in_specs=specs_in,
+                  out_specs=(P(), P(ROW_AXIS)), check_vma=False)
+    return jax.jit(f)
+
+
 def _make_pallas_fine_hist(L: int, F: int, W: int, K: int, nbins: int,
                            n_local: int, interpret: bool = False,
                            precision: str = "bf16"):
@@ -1527,5 +1707,32 @@ def partition(codes, leaf, feat, bin_, na_left, valid, na_bin: jnp.int32):
     right = jnp.where(is_na, ~nl, c > b)
     right = right & v
     return (2 * leaf + right.astype(jnp.int32)).astype(jnp.int32)
+
+
+@jax.jit
+def partition_right(codes, leaf, feat, bin_, na_left, valid,
+                    na_bin: jnp.int32):
+    """The ``partition`` routing decision alone — the went-right bit per
+    row, without the dense ``2*leaf + right`` relabeling.  The node-sparse
+    deep levels route rows through A+1-entry SLOT tables (instead of the
+    2^d dense tables, whose one-hot product would reintroduce the dense
+    per-row cost), then apply the bit to both the dense leaf id and the
+    slot id; the sentinel slot's table row is valid=False so dead rows
+    keep flowing left, matching dense terminality."""
+    L = feat.shape[0]
+    tables = jnp.stack([feat.astype(jnp.float32), bin_.astype(jnp.float32),
+                        na_left.astype(jnp.float32),
+                        valid.astype(jnp.float32)], axis=0)      # [4, L]
+    t = table_lookup(tables, leaf, L)                            # [4, N]
+    f = t[0].astype(jnp.int32)
+    b = t[1].astype(jnp.int32)
+    nl = t[2] > 0.5
+    v = t[3] > 0.5
+    Fdim = codes.shape[0]
+    fiota = jax.lax.broadcasted_iota(jnp.int32, (Fdim, 1), 0)
+    c = jnp.sum(jnp.where(f[None, :] == fiota, codes, 0), axis=0)
+    is_na = c == na_bin
+    right = jnp.where(is_na, ~nl, c > b)
+    return (right & v).astype(jnp.int32)
 
 

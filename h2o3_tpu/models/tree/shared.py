@@ -34,9 +34,12 @@ from ..distributions import make_distribution
 from .binning import BinnedFrame, fit_bins, encode_bins
 from .hist import (make_hist_fn, make_fine_hist_fn, make_varbin_hist_fn,
                    make_subtract_level_fn, make_batched_level_fn,
+                   make_sparse_level_fn, make_batched_sparse_level_fn,
+                   sparse_slot_budget, sparse_slot_maps,
                    offset_codes, best_splits, best_splits_hier,
                    fused_best_splits, fused_best_splits_batched,
-                   select_superbins, partition)
+                   select_superbins, partition, partition_right,
+                   table_lookup)
 
 
 @dataclasses.dataclass
@@ -89,6 +92,26 @@ class SharedTreeParameters(Parameters):
     # Monotone constraints, EFB bundling and the hierarchical search stay
     # on the separate path (drivers downgrade automatically).
     split_mode: str = "fused"
+    # per-level histogram LAYOUT (mirrors hist_mode/split_mode):
+    #   "auto"   (default) — dense [2^d, F, B] slot grids above
+    #     sparse_depth_threshold, node-sparse [A, F, B] slots keyed by the
+    #     compacted row prefix below it (hist.make_sparse_level_fn):
+    #     histogram bytes scale with ALIVE leaves instead of 2^d, so the
+    #     64 MB histogram budget no longer caps tree depth;
+    #   "dense"  — the dense grid at every level (the oracle);
+    #   "sparse" — force the sparse layout below the threshold even when
+    #     "auto" would (identically) pick it; fails fast when it cannot
+    #     engage (hist_mode="full" has no carry to subtract from);
+    #   "check"  — driver assert mode: grow one tree both ways on the real
+    #     data, compare structure exactly and values to f32 tolerance
+    #     (run_layout_crosscheck), then train with "auto".
+    # Monotone constraints, EFB bundling and the hierarchical search stay
+    # dense (drivers downgrade automatically, as with split_mode).
+    hist_layout: str = "auto"
+    # first sparse level under hist_layout auto/sparse (expert knob): level
+    # d >= threshold histograms in slot space.  Clamped per frame to the
+    # dense memory cap so the dense levels above it always fit the budget.
+    sparse_depth_threshold: int = 8
     # probability calibration (hex/tree CalibrationHelper)
     calibrate_model: bool = False
     calibration_frame: Optional[object] = None
@@ -335,62 +358,90 @@ def traverse(levels, values, X):
 traverse_jit = jax.jit(traverse)
 
 
-def effective_max_depth(max_depth: int, nbins: int, F: int,
-                        n_padded: int) -> int:
-    """Dense-level depth cap, shared by EVERY consumer of the build
-    factories (the scan drivers and checkpoint validation must agree with
-    the tree builder on the level count).
-
-    Levels are FULL-WIDTH [2^d] arrays (that is what makes every per-level
-    op a dense matmul), so histogram memory doubles per level; the
-    reference's node-sparse trees have no such coupling and default to
-    depth 20 (DRF).  Cap where (a) a balanced tree would run out of rows
-    (2^d > n admits only chain-shaped deeper trees, which terminal-leaf
-    masking reproduces as no-op levels), and (b) the per-level histogram
-    would exceed a 64 MB device budget.  Growth virtually always stops
-    earlier via min_rows/purity (valid masking); configs asking for more
-    depth get the capped tree — a documented dense-design bound
-    (PROFILE.md round-4)."""
+def dense_mem_cap(nbins: int, F: int) -> int:
+    """Deepest level whose dense [2^d, F, B] histogram fits the 64 MB
+    device budget — the memory wall the node-sparse layout removes."""
     B = nbins + 1
-    row_cap = max(1, int(np.ceil(np.log2(max(n_padded, 2)))) + 1)
     mem_cap = 1
     while (mem_cap < 24
            and F * B * 3 * 2 ** mem_cap * 4 <= 64 * 1024 * 1024):
         mem_cap += 1
-    return max(1, min(max_depth, row_cap, mem_cap))
+    return mem_cap
 
 
-def record_effective_depth(model, params, F: int, n_padded: int) -> int:
+def effective_max_depth(max_depth: int, nbins: int, F: int,
+                        n_padded: int, hist_layout: str = "dense",
+                        sparse_depth_threshold: int = 8) -> int:
+    """Depth cap, shared by EVERY consumer of the build factories (the
+    scan drivers and checkpoint validation must agree with the tree
+    builder on the level count).
+
+    Dense levels are FULL-WIDTH [2^d] arrays (that is what makes every
+    per-level op a dense matmul), so histogram memory doubles per level;
+    the reference's node-sparse trees have no such coupling and default to
+    depth 20 (DRF).  Cap where (a) a balanced tree would run out of rows
+    (2^d > n admits only chain-shaped deeper trees, which terminal-leaf
+    masking reproduces as no-op levels), and (b) — dense layout only —
+    the per-level histogram would exceed a 64 MB device budget.  With the
+    node-sparse layout engaged (``hist_layout`` "sparse"/"auto", passed
+    here ALREADY RESOLVED for downgrades — see sparse_layout_active) the
+    memory bound applies only to the dense levels above the threshold:
+    the builder clamps the threshold itself to dense_mem_cap and the
+    sparse levels' slot axis is budget-sized (hist.sparse_slot_budget),
+    so depth becomes row/compute-bound.  Growth virtually always stops
+    earlier via min_rows/purity (valid masking); configs asking for more
+    depth get the capped tree — a documented design bound (PROFILE.md
+    round-4, revised round-8)."""
+    row_cap = max(1, int(np.ceil(np.log2(max(n_padded, 2)))) + 1)
+    if hist_layout in ("sparse", "auto", "check"):
+        return max(1, min(max_depth, row_cap))
+    return max(1, min(max_depth, row_cap, dense_mem_cap(nbins, F)))
+
+
+def record_effective_depth(model, params, F: int, n_padded: int,
+                           hist_layout: str = "dense") -> int:
     """Record requested vs effective depth in model.output and WARN when the
     dense-level bound caps the user's max_depth — the divergence from the
     reference's node-sparse trees (which honor depth 20+) must be visible,
-    not silent (ADVICE round-4 medium finding)."""
+    not silent (ADVICE round-4 medium finding).  ``hist_layout`` is the
+    driver-RESOLVED layout (resolve_hist_layout), so a sparse-capable run
+    records — and gets — the uncapped depth."""
     import warnings
-    eff = effective_max_depth(params.max_depth, params.nbins, F, n_padded)
+    eff = effective_max_depth(
+        params.max_depth, params.nbins, F, n_padded, hist_layout,
+        getattr(params, "sparse_depth_threshold", 8))
     model.output["requested_max_depth"] = params.max_depth
     model.output["effective_max_depth"] = eff
+    model.output["hist_layout"] = hist_layout
     if eff < params.max_depth:
+        hint = ("rows bound the tree" if hist_layout != "dense" else
+                "full-width [2^d] levels double histogram memory per "
+                "level; hist_layout='auto' lifts the memory bound")
         warnings.warn(
             f"max_depth={params.max_depth} is capped to {eff} on this frame "
-            f"by the dense-level depth bound (full-width [2^d] levels double "
-            f"histogram memory per level; {F} features x {params.nbins} bins "
+            f"({hint}; {F} features x {params.nbins} bins "
             f"x {n_padded} rows). Trees train at depth {eff}; lower "
             f"max_depth to silence this.", stacklevel=3)
     return eff
 
 
-def validate_checkpoint_depth(prior, k, params, F: int, n_padded: int):
-    """Continuation chunks must stack at ONE depth: the dense-level cap
-    depends on the frame size, so a continuation on a differently-sized
-    frame could disagree with the checkpoint's level count — fail clearly
-    instead of mis-stacking."""
-    eff = effective_max_depth(params.max_depth, params.nbins, F, n_padded)
+def validate_checkpoint_depth(prior, k, params, F: int, n_padded: int,
+                              hist_layout: str = "dense"):
+    """Continuation chunks must stack at ONE depth: the depth cap depends
+    on the frame size AND the resolved histogram layout, so a continuation
+    on a differently-sized frame (or with the other layout) could disagree
+    with the checkpoint's level count — fail clearly instead of
+    mis-stacking."""
+    eff = effective_max_depth(
+        params.max_depth, params.nbins, F, n_padded, hist_layout,
+        getattr(params, "sparse_depth_threshold", 8))
     pd = prior_stacked(prior, k).depth
     if pd != eff:
         raise ValueError(
             f"checkpoint tree depth {pd} != effective depth {eff} on this "
-            f"frame (dense-level depth cap); continue on a similarly sized "
-            f"frame or lower max_depth to {pd}")
+            f"frame (depth cap under hist_layout={hist_layout!r}); continue "
+            f"on a similarly sized frame with the same layout or lower "
+            f"max_depth to {pd}")
 
 
 @functools.lru_cache(maxsize=None)
@@ -398,7 +449,9 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
                        hist_precision: str = "bf16", hier: bool = False,
                        fine_k: int = 2, bin_counts=None, mono=None,
                        plan=None, hist_mode: str = "subtract",
-                       nk: int = 1, split_mode: str = "separate"):
+                       nk: int = 1, split_mode: str = "separate",
+                       hist_layout: str = "dense",
+                       sparse_depth_threshold: int = 8):
     """One compiled program that grows a whole tree on device.
 
     The level loop (SharedTree.buildLayer) is unrolled inside a single jit:
@@ -439,8 +492,38 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
     with leading [K].  The batched build reproduces the sequential
     per-tree key chains exactly (vmapped threefry draws are bitwise the
     per-key calls), so a K-loop of single-tree builds is its oracle.
+
+    ``hist_layout="sparse"`` switches levels at/below
+    ``sparse_depth_threshold`` (clamped per frame to the dense memory cap)
+    to the node-sparse slot layout: histograms, split search and routing
+    run in an [A] slot space sized by ALIVE leaves (hist.sparse_slot_budget
+    caps A so the 64 MB histogram budget holds at EVERY depth), rows carry
+    a slot id updated through A+1-entry tables, and each level's records
+    are expanded back to the dense [2^d] contract so traversal, exporters
+    and checkpoints are layout-blind.  Requires hist_mode="subtract" (the
+    slot carry IS the subtraction carry); dense candidate records on dead
+    chains are not reproduced (sparse never histograms dead rows), so
+    parity with "dense" is: valid/leaf routing exact, feat/thr/na_left
+    exact WHERE VALID, leaf values to f32 tolerance
+    (run_layout_crosscheck).
     """
     B = nbins + 1
+    if hist_layout not in ("dense", "sparse"):
+        raise ValueError(
+            f"hist_layout={hist_layout!r}: use 'dense' or 'sparse' here "
+            "('auto'/'check' are driver modes — see resolve_hist_layout)")
+    if hist_layout == "sparse":
+        if hist_mode != "subtract":
+            raise ValueError(
+                "hist_layout='sparse' requires hist_mode='subtract': the "
+                "slot-space level carry is the subtraction carry "
+                "(hist_mode='full' has no carry to subtract from)")
+        if hier or mono is not None or plan is not None:
+            raise ValueError(
+                "hist_layout='sparse' does not compose with monotone "
+                "constraints, EFB bundling or the hierarchical search; "
+                "the drivers downgrade to 'dense' automatically under "
+                "hist_layout='auto'")
     if split_mode not in ("separate", "fused"):
         raise ValueError(
             f"split_mode={split_mode!r}: use 'separate' or 'fused' here "
@@ -465,7 +548,22 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
         raise ValueError(
             f"hist_mode={hist_mode!r}: use 'subtract' or 'full' here "
             "('check' is a driver mode — see run_hist_crosscheck)")
-    max_depth = effective_max_depth(max_depth, nbins, F, n_padded)
+    max_depth = effective_max_depth(max_depth, nbins, F, n_padded,
+                                    hist_layout, sparse_depth_threshold)
+    # first node-sparse level: the threshold clamps to the dense memory
+    # cap so every dense level above it fits the budget, and to >= 1 so
+    # the root level (whose carry seeds the chain) is always dense
+    t0 = max(1, min(sparse_depth_threshold, dense_mem_cap(nbins, F)))
+    sparse_from = t0 if (hist_layout == "sparse" and max_depth > t0) \
+        else max_depth
+    A_cap = sparse_slot_budget(F, B)
+    # slot capacity per sparse level, and the PREVIOUS level's slot space
+    # (the carry/compaction geometry) — at the boundary that is the dense
+    # parent id space, so the first sparse level consumes the dense
+    # subtract carry unchanged
+    A_lv = {d: min(2 ** d, A_cap) for d in range(sparse_from, max_depth)}
+    Ap_lv = {d: (2 ** (d - 1) if d == sparse_from else A_lv[d - 1])
+             for d in range(sparse_from, max_depth)}
     from ...runtime.cluster import cluster
     # per-feature packed bins (DHistogram-style): only the TPU Pallas path
     # has the ragged kernel; dense einsum covers CPU tests.  The packed
@@ -487,13 +585,96 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
     # own bound — the gate is per level so a deep tree keeps the fast
     # kernel on its shallow levels.  The subtract path histograms at the
     # PARENT slot count (2^(d-1)); the full oracle at the child count.
-    kern_L = [2 ** d if hist_mode == "full" else 2 ** max(d - 1, 0)
+    kern_L = [Ap_lv[d] if d >= sparse_from
+              else (2 ** d if hist_mode == "full" else 2 ** max(d - 1, 0))
               for d in range(max_depth)]
     varbin_level = [
         use_varbin and 3 * kern_L[d] <= 1024
         and F * B * 3 * kern_L[d] * 4 <= 12 * 1024 * 1024
         for d in range(max_depth)]
     force = "" if on_tpu else "pallas_interpret"
+
+    # ---- node-sparse deep levels (hist_layout="sparse", d >= sparse_from)
+    # Per-tree helpers shared by build()/buildK() (buildK vmaps them).
+    # All slot bookkeeping is O(A) or O(2^d) index math — the only per-row
+    # work is the A+1-entry table routing (partition_right) and the one
+    # boundary slot lookup.
+    sparse_fns = {}
+    for d in range(sparse_from, max_depth):
+        _kw = dict(bin_counts=(tuple(bin_counts) if varbin_level[d]
+                               else None),
+                   force_impl=force if varbin_level[d] else "",
+                   precision=hist_precision)
+        sparse_fns[d] = (
+            make_batched_sparse_level_fn(Ap_lv[d], A_lv[d], nk, F, B,
+                                         n_padded, **_kw)
+            if nk > 1 else
+            make_sparse_level_fn(Ap_lv[d], A_lv[d], F, B, n_padded, **_kw))
+
+    def _slot_maps(d, prev_valid, slot_of_leaf, leaf_of_slot):
+        """Slot assignment + dense<->slot index maps for sparse level d.
+        ``prev_valid`` is the previous level's valid flags in its OWN
+        space: dense [2^(d-1)] at the boundary, [Ap] slots after it."""
+        A = A_lv[d]
+        sidx = jnp.arange(A, dtype=jnp.int32)
+        child_base, ps_of_slot, real = sparse_slot_maps(prev_valid, A)
+        l2 = jnp.arange(2 ** d, dtype=jnp.int32)
+        if d == sparse_from:
+            sol = jnp.minimum(child_base[l2 >> 1] + (l2 & 1), A)
+            los = 2 * ps_of_slot + (sidx & 1)
+        else:
+            sol = jnp.minimum(child_base[slot_of_leaf[l2 >> 1]]
+                              + (l2 & 1), A)
+            los = 2 * leaf_of_slot[ps_of_slot] + (sidx & 1)
+        return child_base, ps_of_slot, real, sol, los
+
+    def _sleaf_of_leaf(slot_of_leaf, leaf, L):
+        # boundary only: dense leaf id -> slot id, one [1, 2^t] MXU lookup
+        return table_lookup(slot_of_leaf[None].astype(jnp.float32),
+                            leaf, L)[0].astype(jnp.int32)
+
+    def _slot_collapse(valid_s, children_s):
+        # the dense dead-slot stat collapse, in slot space: non-split
+        # slots keep full totals on the left so their rows' leaf values
+        # cover everything draining through them
+        gl, hl, cl2 = children_s[:, 0], children_s[:, 1], children_s[:, 2]
+        gr, hr, cr2 = children_s[:, 3], children_s[:, 4], children_s[:, 5]
+        return jnp.stack(
+            [jnp.where(valid_s, gl, gl + gr),
+             jnp.where(valid_s, hl, hl + hr),
+             jnp.where(valid_s, cl2, cl2 + cr2),
+             jnp.where(valid_s, gr, 0.0),
+             jnp.where(valid_s, hr, 0.0),
+             jnp.where(valid_s, cr2, 0.0)], axis=1)
+
+    def _expand_sparse(d, feat_s, bin_s, na_s, valid_s, children_s,
+                       slot_of_leaf, prev_children):
+        """Slot records -> the dense [2^d] level contract.  Unslotted
+        nodes (dead chains / slot-budget overflow) are terminal: invalid
+        records, child stats inherited from their side of the parent's
+        record so every row draining through them keeps a leaf value
+        (the dense collapse semantics, to f32 tolerance)."""
+        A = A_lv[d]
+        l2 = jnp.arange(2 ** d, dtype=jnp.int32)
+        mapped = slot_of_leaf < A
+        slc = jnp.minimum(slot_of_leaf, A - 1)
+        feat_d = jnp.where(mapped, feat_s[slc], 0)
+        bin_d = jnp.where(mapped, bin_s[slc], 0)
+        na_d = jnp.where(mapped, na_s[slc], False)
+        valid_d = mapped & valid_s[slc]
+        pc = prev_children[l2 >> 1]
+        tot = jnp.where((l2 & 1)[:, None] == 0, pc[:, 0:3], pc[:, 3:6])
+        inherit = jnp.concatenate([tot, jnp.zeros_like(tot)], axis=1)
+        children_d = jnp.where(mapped[:, None], children_s[slc], inherit)
+        return feat_d, bin_d, na_d, valid_d, children_d
+
+    def _pad_slot_tables(feat_s, bin_s, na_s, valid_s):
+        # sentinel row (slot A): valid=False, so dead/overflowed rows
+        # keep flowing left — dense terminality through slot tables
+        def z(a):
+            return jnp.concatenate([a, jnp.zeros((1,), a.dtype)])
+        return z(feat_s), z(bin_s), z(na_s), z(valid_s)
+
     if nk > 1:
         lev_fns = [
             make_batched_level_fn(
@@ -502,7 +683,7 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
                 force_impl=force if varbin_level[d] else "",
                 precision=hist_precision,
                 subtract=(hist_mode == "subtract"))
-            for d in range(max_depth)]
+            for d in range(sparse_from)]
 
         def buildK(codes, g, h, w, edges_mat, rng_keys, reg_lambda,
                    min_rows, min_split_improvement, learn_rate,
@@ -535,6 +716,53 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
                     | ~per_split.any(axis=2))
                 mask = per_split & tree_mask[:, None, :]
                 lcodes = hcodes if varbin_level[d] else codes
+                if d >= sparse_from:
+                    A = A_lv[d]
+                    if d == sparse_from:
+                        (child_base, ps_of_slot, real, slot_of_leaf,
+                         leaf_of_slot) = jax.vmap(
+                            lambda v: _slot_maps(d, v, None, None))(valid)
+                        sleaf = jax.vmap(_sleaf_of_leaf,
+                                         in_axes=(0, 0, None))(
+                            slot_of_leaf, leaf, L)
+                    else:
+                        (child_base, ps_of_slot, real, slot_of_leaf,
+                         leaf_of_slot) = jax.vmap(
+                            functools.partial(_slot_maps, d))(
+                            valid_s, slot_of_leaf, leaf_of_slot)
+                        sleaf = jnp.minimum(
+                            jnp.take_along_axis(child_base, sleaf, axis=1)
+                            + right, A)
+                    H, H_carry = sparse_fns[d](lcodes, sleaf, g, h, wK,
+                                               H_carry, ps_of_slot)
+                    # the col mask is DRAWN dense (same keys as the dense
+                    # layout, bit-identical RNG), then gathered to slots
+                    mask_s = jax.vmap(lambda m, i: m[i])(mask,
+                                                         leaf_of_slot)
+                    feat_s, bin_s, na_s, gain, valid_s, children_s = \
+                        fused_best_splits_batched(
+                            H, nbins, reg_lambda, min_rows,
+                            min_split_improvement, mask_s, reg_alpha,
+                            gamma, min_child_weight)
+                    # phantom slots past the live range gathered parent
+                    # slot 0's histogram — no rows, records discarded
+                    valid_s = valid_s & real
+                    children_s = jax.vmap(_slot_collapse)(valid_s,
+                                                          children_s)
+                    feat, bin_, na_left, valid, children = jax.vmap(
+                        functools.partial(_expand_sparse, d))(
+                        feat_s, bin_s, na_s, valid_s, children_s,
+                        slot_of_leaf, children)
+                    thr = edges_mat[feat, jnp.clip(bin_, 0, nbins - 1)]
+                    fp, bp, nap, vp = jax.vmap(_pad_slot_tables)(
+                        feat_s, bin_s, na_s, valid_s)
+                    right = jax.vmap(
+                        partition_right,
+                        in_axes=(None, 0, 0, 0, 0, 0, None))(
+                        codes, sleaf, fp, bp, nap, vp, jnp.int32(nbins))
+                    leaf = 2 * leaf + right
+                    levels.append((feat, thr, na_left, valid))
+                    continue
                 if hist_mode == "subtract":
                     if d == 0:
                         H, H_carry = lev_fns[0](lcodes, leaf, g, h, wK)
@@ -595,7 +823,7 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
                 bin_counts=tuple(bin_counts) if varbin_level[d] else None,
                 force_impl=force if varbin_level[d] else "",
                 precision=hist_precision)
-            for d in range(max_depth)]
+            for d in range(sparse_from)]
     else:
         hist_fns = [
             make_varbin_hist_fn(kern_L[d], F, tuple(bin_counts), B,
@@ -649,6 +877,56 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
                 (per_split.any(axis=1) & per_split[:, 0])
                 | ~per_split.any(axis=1))
             mask = per_split & tree_mask[None, :]
+            if d >= sparse_from:
+                A = A_lv[d]
+                if d == sparse_from:
+                    # boundary: slots assigned from the last DENSE level's
+                    # valid flags; the dense subtract carry is consumed
+                    # unchanged (its slot space is the dense parent space)
+                    (child_base, ps_of_slot, real, slot_of_leaf,
+                     leaf_of_slot) = _slot_maps(d, valid, None, None)
+                    sleaf = _sleaf_of_leaf(slot_of_leaf, leaf, L)
+                else:
+                    (child_base, ps_of_slot, real, slot_of_leaf,
+                     leaf_of_slot) = _slot_maps(d, valid_s, slot_of_leaf,
+                                                leaf_of_slot)
+                    sleaf = jnp.minimum(jnp.take(child_base, sleaf)
+                                        + right, A)
+                lcodes = hcodes if varbin_level[d] else codes
+                H, H_carry = sparse_fns[d](lcodes, sleaf, g, h, w,
+                                           H_carry, ps_of_slot)
+                # col mask DRAWN dense (bit-identical RNG to the dense
+                # layout), gathered to slots
+                mask_s = mask[leaf_of_slot]
+                if split_mode == "fused":
+                    feat_s, bin_s, na_s, gain, valid_s, children_s = \
+                        fused_best_splits(
+                            H, nbins, reg_lambda, min_rows,
+                            min_split_improvement, mask_s, reg_alpha,
+                            gamma, min_child_weight)
+                else:
+                    feat_s, bin_s, na_s, gain, valid_s, children_s = \
+                        best_splits(
+                            H, nbins, reg_lambda, min_rows,
+                            min_split_improvement, mask_s, reg_alpha,
+                            gamma, min_child_weight)
+                # phantom slots past the live range gathered parent slot
+                # 0's histogram — no rows, records discarded here
+                valid_s = valid_s & real
+                children_s = _slot_collapse(valid_s, children_s)
+                feat, bin_, na_left, valid, children = _expand_sparse(
+                    d, feat_s, bin_s, na_s, valid_s, children_s,
+                    slot_of_leaf, children)
+                thr = edges_mat[feat, jnp.clip(bin_, 0, nbins - 1)]
+                fp, bp, nap, vp = _pad_slot_tables(feat_s, bin_s, na_s,
+                                                   valid_s)
+                right = partition_right(codes, sleaf, fp, bp, nap, vp,
+                                        jnp.int32(nbins))
+                # same went-right bit updates BOTH ids: dense leaf (final
+                # values/traversal) and slot (next level's routing)
+                leaf = 2 * leaf + right
+                levels.append((feat, thr, na_left, valid))
+                continue
             if hier:
                 if d == 0:
                     Hc = coarse_fns[0](ccodes, leaf, g, h, w)
@@ -866,6 +1144,55 @@ def resolve_split_mode(params, *, mono=None, plan=None,
     return mode
 
 
+def sparse_layout_active(hist_layout: str, hist_mode: str = "subtract", *,
+                         mono=None, plan=None, hier: bool = False) -> bool:
+    """Whether the node-sparse deep-level layout ENGAGES for a build with
+    these features — the single predicate every consumer (the build
+    factories, the scan factories' own depth computation,
+    record_effective_depth / validate_checkpoint_depth, and the drivers'
+    deep_level fault hook) shares, so level counts agree everywhere.
+    ``hist_mode="check"`` counts as subtract (that is what it trains with
+    after the crosscheck); depth-threshold gating is the builder's job."""
+    return (hist_layout in ("sparse", "auto", "check")
+            and hist_mode in ("subtract", "check")
+            and mono is None and plan is None and not hier)
+
+
+def resolve_hist_layout(params, *, hist_mode=None, mono=None, plan=None,
+                        hier: bool = False) -> str:
+    """Validate + normalize the ``hist_layout`` knob (mirrors
+    resolve_split_mode; drivers call this once, and ``"check"`` is
+    resolved to ``"sparse"`` AFTER run_layout_crosscheck).  Returns the
+    BUILDER value — "dense" or "sparse" ("sparse" means "below the
+    clamped sparse_depth_threshold"; the builder applies the threshold,
+    so "auto" and "sparse" build identically) — or "check" for the driver
+    to act on first.  "auto" downgrades silently to "dense" for monotone
+    constraints, EFB bundling, the hierarchical search, or
+    hist_mode="full" (no carry to subtract from); an EXPLICIT "sparse"
+    with any of those raises — failing fast beats silently training a
+    different layout than asked."""
+    layout = str(getattr(params, "hist_layout", "auto")).lower()
+    if layout not in ("dense", "sparse", "auto", "check"):
+        raise ValueError(
+            f"hist_layout={layout!r}: use dense | sparse | auto | check")
+    if int(getattr(params, "sparse_depth_threshold", 8)) < 1:
+        raise ValueError("sparse_depth_threshold must be >= 1 (the root "
+                         "level seeds the carry and is always dense)")
+    if layout == "dense":
+        return "dense"
+    hm = hist_mode if hist_mode is not None else resolve_hist_mode(params)
+    if not sparse_layout_active(layout, hm, mono=mono, plan=plan,
+                                hier=hier):
+        if layout == "sparse":
+            raise ValueError(
+                "hist_layout='sparse' does not compose with "
+                "hist_mode='full', monotone constraints, EFB bundling or "
+                "the hierarchical split search; use hist_layout='auto' "
+                "to downgrade automatically")
+        return "dense"
+    return "check" if layout == "check" else "sparse"
+
+
 def run_hist_crosscheck(codes, g, h, w, edges_mat, rng_key, *, max_depth,
                         nbins, F, n_padded, hist_precision="f32",
                         bin_counts=None, mono=None, plan=None,
@@ -1030,6 +1357,106 @@ def run_split_crosscheck(codes, g, h, w, edges_mat, rng_keys, *, max_depth,
                 ")")
 
 
+def run_layout_crosscheck(codes, g, h, w, edges_mat, rng_keys, *,
+                          max_depth, nbins, F, n_padded,
+                          hist_precision="f32", bin_counts=None,
+                          sparse_depth_threshold=8, tree_masks=None,
+                          reg_lambda=0.0, min_rows=1.0,
+                          min_split_improvement=1e-5, learn_rate=0.1,
+                          col_sample_rate=1.0, reg_alpha=0.0, gamma=0.0,
+                          min_child_weight=0.0, atol=1e-4):
+    """The hist_layout="check" driver assert: grow ONE tree (or one
+    batched-K round — g/h/rng_keys with leading [K]) with the dense
+    layout and one with the node-sparse layout on identical real inputs,
+    and raise AssertionError on divergence.
+
+    Depth is clamped to the DENSE effective depth for the comparison (the
+    whole point of "sparse" is to grow past the dense memory cap, where
+    no oracle exists).  The sparse path never histograms rows on dead
+    chains, so dense candidate records on invalid slots are not
+    reproduced: valid flags and row routing are compared EXACTLY,
+    feat/na_left exactly and thresholds to tolerance WHERE VALID, and
+    leaf values to f32 tolerance everywhere (dead-chain values come from
+    the parent-side inheritance rather than a re-histogram).  A slot
+    budget overflow (alive leaves past hist.sparse_slot_budget) forces
+    children terminal on the sparse side and trips the valid compare —
+    surfacing the num_leaves-style degradation is this mode's job."""
+    md = effective_max_depth(max_depth, nbins, F, n_padded)
+    g, h = jnp.asarray(g), jnp.asarray(h)
+    squeeze = g.ndim == 1
+    if squeeze:
+        g, h = g[None], h[None]
+    K = g.shape[0]
+    rng_keys = jnp.asarray(rng_keys)
+    if rng_keys.ndim == 1:
+        rng_keys = rng_keys[None]
+    tm = jnp.asarray(tree_masks, bool) if tree_masks is not None \
+        else jnp.ones((K, F), bool)
+    if tm.ndim == 1:
+        tm = tm[None]
+    wK = jnp.broadcast_to(jnp.asarray(w), g.shape)
+    outs = {}
+    for layout in ("dense", "sparse"):
+        fn = make_build_tree_fn(
+            md, nbins, F, n_padded, hist_precision,
+            bin_counts=bin_counts, hist_mode="subtract",
+            nk=K if K > 1 else 1,
+            split_mode="fused" if K > 1 else "separate",
+            hist_layout=layout,
+            sparse_depth_threshold=sparse_depth_threshold)
+        if K > 1:
+            levels, vals, cover, leaf = fn(
+                codes, g, h, wK, edges_mat, rng_keys, reg_lambda,
+                min_rows, min_split_improvement, learn_rate,
+                col_sample_rate, tm, reg_alpha, gamma, min_child_weight)
+        else:
+            levels, vals, cover, leaf = fn(
+                codes, g[0], h[0], wK[0], edges_mat, rng_keys[0],
+                reg_lambda, min_rows, min_split_improvement, learn_rate,
+                col_sample_rate, tm[0], reg_alpha, gamma,
+                min_child_weight)
+            levels = [tuple(x[None] for x in lv) for lv in levels]
+            vals, leaf = vals[None], leaf[None]
+        outs[layout] = jax.device_get(
+            [[list(lv) for lv in levels], vals, leaf])
+    lv_d, v_d, leaf_d = outs["dense"]
+    lv_s, v_s, leaf_s = outs["sparse"]
+    for k in range(K):
+        for d in range(len(lv_d)):
+            valid_d = np.asarray(lv_d[d][3][k], bool)
+            if not np.array_equal(valid_d,
+                                  np.asarray(lv_s[d][3][k], bool)):
+                raise AssertionError(
+                    f"hist_layout='check': dense and sparse builds "
+                    f"disagree on valid at tree {k} level {d} (an alive-"
+                    f"leaf count past the slot budget forces terminal "
+                    f"leaves on the sparse side — see sparse_slot_budget)")
+            for name, i in (("feat", 0), ("na_left", 2)):
+                a = np.asarray(lv_d[d][i][k])
+                b = np.asarray(lv_s[d][i][k])
+                if not np.array_equal(a[valid_d], b[valid_d]):
+                    raise AssertionError(
+                        f"hist_layout='check': {name} diverges at tree "
+                        f"{k} level {d}")
+            a = np.asarray(lv_d[d][1][k])
+            b = np.asarray(lv_s[d][1][k])
+            if not np.allclose(a[valid_d], b[valid_d], atol=atol,
+                               rtol=1e-5):
+                raise AssertionError(
+                    f"hist_layout='check': split thresholds diverge at "
+                    f"tree {k} level {d}")
+        if not np.array_equal(leaf_d[k], leaf_s[k]):
+            raise AssertionError(
+                "hist_layout='check': final leaf routing differs "
+                f"between the dense and sparse builds for tree {k}")
+        if not np.allclose(v_d[k], v_s[k], atol=atol, rtol=1e-4):
+            raise AssertionError(
+                f"hist_layout='check': leaf values diverge for tree {k} "
+                f"(max abs diff "
+                f"{np.max(np.abs(np.asarray(v_d[k]) - np.asarray(v_s[k])))}"
+                ")")
+
+
 @functools.lru_cache(maxsize=None)
 def make_tree_scan_fn(mode: str, tweedie_power: float, quantile_alpha: float,
                       huber_alpha: float, max_depth: int, nbins: int, F: int,
@@ -1037,7 +1464,9 @@ def make_tree_scan_fn(mode: str, tweedie_power: float, quantile_alpha: float,
                       col_sample_rate_per_tree: float, hier: bool = False,
                       bin_counts=None, mono=None, custom_fn=None, plan=None,
                       hist_mode: str = "subtract",
-                      split_mode: str = "fused"):
+                      split_mode: str = "fused",
+                      hist_layout: str = "dense",
+                      sparse_depth_threshold: int = 8):
     """Scan a CHUNK of boosting/bagging rounds in ONE device dispatch.
 
     The per-tree driver loop (gradients -> row/column sample -> grow ->
@@ -1058,10 +1487,13 @@ def make_tree_scan_fn(mode: str, tweedie_power: float, quantile_alpha: float,
             huber_alpha=huber_alpha, custom_distribution_func=custom_fn)
     if mono is not None or plan is not None or hier:
         split_mode = "separate"          # no fused path for these builds
+        hist_layout = "dense"            # nor a sparse one (resolve_*)
     bt_fn = make_build_tree_fn(max_depth, nbins, F, n_padded, hist_precision,
                                hier=hier, bin_counts=bin_counts, mono=mono,
                                plan=plan, hist_mode=hist_mode,
-                               split_mode=split_mode)
+                               split_mode=split_mode,
+                               hist_layout=hist_layout,
+                               sparse_depth_threshold=sparse_depth_threshold)
 
     def scan_fn(codes, y, w, F0, edges_mat, rng0, chunk_no, nchunk,
                 reg_lambda, min_rows, min_split_improvement, learn_rate,
@@ -1111,7 +1543,9 @@ def make_multinomial_scan_fn(K: int, max_depth: int, nbins: int, F: int,
                              hier: bool = False, bin_counts=None, plan=None,
                              hist_mode: str = "subtract",
                              split_mode: str = "fused",
-                             mode: str = "multinomial"):
+                             mode: str = "multinomial",
+                             hist_layout: str = "dense",
+                             sparse_depth_threshold: int = 8):
     """Scan a chunk of K-tree rounds in ONE dispatch.
 
     Each round grows K one-vs-rest trees — on softmax gradients for
@@ -1132,20 +1566,24 @@ def make_multinomial_scan_fn(K: int, max_depth: int, nbins: int, F: int,
     [T, K, 2^depth], covers [T, K, 2^depth]) — identical layout on both
     paths.
     """
-    # the builder clamps internally; the level-stacking loop below must
-    # iterate the SAME effective count
-    max_depth = effective_max_depth(max_depth, nbins, F, n_padded)
     if mode not in ("multinomial", "drf"):
         raise ValueError(f"mode={mode!r}: use 'multinomial' or 'drf'")
     if hier or plan is not None:
         split_mode = "separate"          # no fused path for these builds
+        hist_layout = "dense"            # nor a sparse one (resolve_*)
+    # the builder clamps internally; the level-stacking loop below must
+    # iterate the SAME effective count — layout-aware, like the builder
+    max_depth = effective_max_depth(max_depth, nbins, F, n_padded,
+                                    hist_layout, sparse_depth_threshold)
     batched = split_mode == "fused" and K > 1
     bt_fn = make_build_tree_fn(max_depth, nbins, F, n_padded,
                                hist_precision, hier=hier,
                                bin_counts=bin_counts, plan=plan,
                                hist_mode=hist_mode,
                                nk=K if batched else 1,
-                               split_mode=split_mode)
+                               split_mode=split_mode,
+                               hist_layout=hist_layout,
+                               sparse_depth_threshold=sparse_depth_threshold)
 
     def scan_fn(codes, Y1, w, F0, edges_mat, rng0, chunk_no, nchunk,
                 reg_lambda, min_rows, min_split_improvement, learn_rate,
@@ -1270,7 +1708,8 @@ def build_tree(codes, g, h, w, edges, nbins: int, max_depth: int,
                reg_alpha: float = 0.0, gamma: float = 0.0,
                min_child_weight: float = 0.0, hist_precision: str = "bf16",
                hier: bool = False, mono=None, hist_mode: str = "subtract",
-               split_mode: str = "fused"):
+               split_mode: str = "fused", hist_layout: str = "dense",
+               sparse_depth_threshold: int = 8):
     """Grow one tree — convenience wrapper around make_build_tree_fn.
 
     ``edges`` may be the per-feature edge list (converted to the dense
@@ -1287,9 +1726,11 @@ def build_tree(codes, g, h, w, edges, nbins: int, max_depth: int,
         else jnp.ones(F, bool)
     if mono is not None or hier:
         split_mode = "separate"          # no fused path for these builds
+        hist_layout = "dense"            # nor a sparse one (resolve_*)
     fn = make_build_tree_fn(max_depth, nbins, F, N, hist_precision,
                             hier=hier, mono=mono, hist_mode=hist_mode,
-                            split_mode=split_mode)
+                            split_mode=split_mode, hist_layout=hist_layout,
+                            sparse_depth_threshold=sparse_depth_threshold)
     levels, vals, cover, leaf = fn(codes, g, h, w, edges_mat, rng_key,
                                    reg_lambda, min_rows,
                                    min_split_improvement, learn_rate,

@@ -28,10 +28,13 @@ from ...runtime.job import Job
 from ..base import Model, ModelBuilder
 from ..datainfo import DataInfo
 from .binning import fit_bins, edges_matrix
-from .hist import (make_batched_level_fn, make_hist_fn,
-                   make_subtract_level_fn, partition, table_lookup)
+from .hist import (make_batched_level_fn, make_batched_sparse_level_fn,
+                   make_hist_fn, make_sparse_level_fn,
+                   make_subtract_level_fn, partition, partition_right,
+                   sparse_slot_budget, sparse_slot_maps, table_lookup)
 from .shared import (SharedTreeModel, SharedTree, SharedTreeParameters,
-                     StackedTrees, Tree, TreeList, resolve_hist_mode,
+                     StackedTrees, Tree, TreeList, dense_mem_cap,
+                     resolve_hist_layout, resolve_hist_mode,
                      resolve_split_mode, traverse_jit)
 
 _EPS = 1e-6
@@ -211,6 +214,57 @@ class UpliftDRF(SharedTree):
                     d, 2, F, B, N, subtract=(hist_mode != "full"))
                 for d in range(p.max_depth)] \
             if split_mode != "separate" else None
+        # hist_layout="sparse": levels at/below the clamped threshold key
+        # histograms by ALIVE-leaf slots [A, F, B] instead of the dense
+        # [2^d, F, B] grid (both arms share one slot map — the leaf
+        # assignment is shared).  "check" grows the first tree both ways.
+        hist_layout = resolve_hist_layout(p, hist_mode=hist_mode)
+        if hist_layout == "check" and (hist_mode == "check"
+                                       or split_mode == "check"):
+            raise ValueError(
+                "hist_layout='check' needs a resolved hist_mode/split_mode "
+                "(run one crosscheck at a time)")
+        t0 = max(1, min(p.sparse_depth_threshold, dense_mem_cap(p.nbins, F)))
+        sparse_from0 = t0 if (hist_layout in ("sparse", "check")
+                              and p.max_depth > t0) else p.max_depth
+        A_cap = sparse_slot_budget(F, B)
+        A_lv = {d: min(2 ** d, A_cap)
+                for d in range(sparse_from0, p.max_depth)}
+        Ap_lv = {d: (2 ** (d - 1) if d == sparse_from0 else A_lv[d - 1])
+                 for d in range(sparse_from0, p.max_depth)}
+        sparse_fns = {d: make_sparse_level_fn(Ap_lv[d], A_lv[d], F, B, N)
+                      for d in range(sparse_from0, p.max_depth)}
+        sparse_bfns = {d: make_batched_sparse_level_fn(
+                           Ap_lv[d], A_lv[d], 2, F, B, N)
+                       for d in range(sparse_from0, p.max_depth)} \
+            if split_mode != "separate" else None
+
+        def _slot_maps(d, prev_valid, slot_of_leaf, leaf_of_slot):
+            # slot assignment + dense<->slot index maps for sparse level d
+            # (shared.make_build_tree_fn's helper, at uplift's geometry)
+            A = A_lv[d]
+            sidx = jnp.arange(A, dtype=jnp.int32)
+            child_base, ps_of_slot, real = sparse_slot_maps(prev_valid, A)
+            l2 = jnp.arange(2 ** d, dtype=jnp.int32)
+            if d == sparse_from0:
+                sol = jnp.minimum(child_base[l2 >> 1] + (l2 & 1), A)
+                los = 2 * ps_of_slot + (sidx & 1)
+            else:
+                sol = jnp.minimum(child_base[slot_of_leaf[l2 >> 1]]
+                                  + (l2 & 1), A)
+                los = 2 * leaf_of_slot[ps_of_slot] + (sidx & 1)
+            return child_base, ps_of_slot, real, sol, los
+
+        def _sleaf_of_leaf(slot_of_leaf, leaf, L):
+            # boundary only: dense leaf id -> slot id, one MXU lookup
+            return table_lookup(slot_of_leaf[None].astype(jnp.float32),
+                                leaf, L)[0].astype(jnp.int32)
+
+        def _pad_slot_tables(feat_s, bin_s, na_s, valid_s):
+            # sentinel row (slot A): valid=False -> dead rows flow left
+            def z(a):
+                return jnp.concatenate([a, jnp.zeros((1,), a.dtype)])
+            return z(feat_s), z(bin_s), z(na_s), z(valid_s)
 
         col_rate = 1.0 if p.mtries == -2 else \
             max(min(p.mtries if p.mtries > 0 else int(np.sqrt(F)), F), 1) / F
@@ -229,17 +283,84 @@ class UpliftDRF(SharedTree):
             pc = jnp.where(nc > 0, y1c / jnp.maximum(nc, _EPS), 0.0)
             return pt.astype(jnp.float32), pc.astype(jnp.float32)
 
-        def grow_tree(wv, keys, mode, batched=False):
+        def grow_tree(wv, keys, mode, batched=False, layout="dense"):
             """One uplift tree's level loop under the given hist_mode."""
             leaf = jnp.zeros(N, jnp.int32)
             levels = []
+            # terminality invariant (see shared.make_build_tree_fn): a dead
+            # node's descendants stay dead — required by the node-sparse
+            # exporters AND by the sparse layout (dead chains get no slots)
+            alive = jnp.ones((1,), bool)
             gt, nt = wv * y * treat, wv * treat
             gc, nc = wv * y * (1 - treat), wv * (1 - treat)
             if batched:
                 gA, nA = jnp.stack([gt, gc]), jnp.stack([nt, nc])
+            sparse_from = sparse_from0 if (layout == "sparse"
+                                           and mode == "subtract") \
+                else p.max_depth
             Ht_carry = Hc_carry = HA_carry = None
+            valid = valid_s = slot_of_leaf = leaf_of_slot = None
+            sleaf = right = None
             for d in range(p.max_depth):
                 L = 2 ** d
+                mask = jax.random.uniform(keys[d], (L, F)) < col_rate
+                mask = mask.at[:, 0].set(mask[:, 0] | ~mask.any(axis=1))
+                if d >= sparse_from:
+                    A = A_lv[d]
+                    if d == sparse_from:
+                        # boundary: slots from the last DENSE level's valid
+                        # flags; the dense subtract carry is consumed
+                        # unchanged (its slot space = dense parent space)
+                        (child_base, ps_of_slot, real, slot_of_leaf,
+                         leaf_of_slot) = _slot_maps(d, valid, None, None)
+                        sleaf = _sleaf_of_leaf(slot_of_leaf, leaf, L)
+                    else:
+                        (child_base, ps_of_slot, real, slot_of_leaf,
+                         leaf_of_slot) = _slot_maps(d, valid_s,
+                                                    slot_of_leaf,
+                                                    leaf_of_slot)
+                        sleaf = jnp.minimum(jnp.take(child_base, sleaf)
+                                            + right, A)
+                    if batched:
+                        # both arms share the slot map (shared leaf
+                        # assignment) — one launch covers both
+                        sleafA = jnp.broadcast_to(sleaf, (2, N))
+                        psA = jnp.broadcast_to(ps_of_slot, (2, A))
+                        HA, HA_carry = sparse_bfns[d](codes, sleafA, gA,
+                                                      nA, nA, HA_carry,
+                                                      psA)
+                        Ht, Hc = HA[0], HA[1]
+                    else:
+                        Ht, Ht_carry = sparse_fns[d](codes, sleaf, gt, nt,
+                                                     nt, Ht_carry,
+                                                     ps_of_slot)
+                        Hc, Hc_carry = sparse_fns[d](codes, sleaf, gc, nc,
+                                                     nc, Hc_carry,
+                                                     ps_of_slot)
+                    # col mask DRAWN dense (bit-identical RNG to the dense
+                    # layout), gathered to slots
+                    mask_s = mask[leaf_of_slot]
+                    feat_s, bin_s, valid_s, gain = _uplift_best_splits(
+                        Ht, Hc, p.nbins, p.uplift_metric, p.min_rows,
+                        mask_s)
+                    # phantom slots past the live range carry no rows
+                    valid_s = valid_s & real
+                    na_s = jnp.ones_like(valid_s)
+                    # expand slot records to the dense [2^d] level contract
+                    mapped = slot_of_leaf < A
+                    slc = jnp.minimum(slot_of_leaf, A - 1)
+                    feat = jnp.where(mapped, feat_s[slc], 0)
+                    bin_ = jnp.where(mapped, bin_s[slc], 0)
+                    valid = mapped & valid_s[slc]
+                    na_left = jnp.ones_like(valid)
+                    thr = edges_mat[feat, jnp.clip(bin_, 0, p.nbins - 1)]
+                    fp, bp, nap, vp = _pad_slot_tables(feat_s, bin_s,
+                                                       na_s, valid_s)
+                    right = partition_right(codes, sleaf, fp, bp, nap, vp,
+                                            jnp.int32(p.nbins))
+                    leaf = 2 * leaf + right
+                    levels.append((feat, thr, na_left, valid))
+                    continue
                 if batched:
                     # both arms in ONE launch per level: arm = batched-K
                     # axis; the shared leaf broadcasts, so both arms pick
@@ -267,10 +388,10 @@ class UpliftDRF(SharedTree):
                 else:
                     Ht = full_fns[d](codes, leaf, gt, nt, nt)
                     Hc = full_fns[d](codes, leaf, gc, nc, nc)
-                mask = jax.random.uniform(keys[d], (L, F)) < col_rate
-                mask = mask.at[:, 0].set(mask[:, 0] | ~mask.any(axis=1))
                 feat, bin_, valid, gain = _uplift_best_splits(
                     Ht, Hc, p.nbins, p.uplift_metric, p.min_rows, mask)
+                valid = valid & alive
+                alive = jnp.stack([valid, valid], axis=1).reshape(-1)
                 na_left = jnp.ones_like(valid)
                 thr = edges_mat[feat, jnp.clip(bin_, 0, p.nbins - 1)]
                 leaf = partition(codes, leaf, feat, bin_, na_left, valid,
@@ -280,6 +401,7 @@ class UpliftDRF(SharedTree):
 
         trees_t: List[Tree] = []
         trees_c: List[Tree] = []
+        from ...runtime import failure
         for t_i in range(p.ntrees):
             rng, ks, km = jax.random.split(rng, 3)
             wv = w
@@ -287,7 +409,40 @@ class UpliftDRF(SharedTree):
                 wv = w * jax.random.bernoulli(ks, p.sample_rate, w.shape)
             keys = jax.random.split(km, p.max_depth)
             hm = "full" if hist_mode == "full" else "subtract"
-            if hist_mode == "check" and t_i == 0:
+            if sparse_from0 < p.max_depth:
+                # kill/resume while node-sparse deep levels are live
+                failure.maybe_inject("deep_level")
+            if hist_layout == "check" and t_i == 0:
+                # driver assert: dense and node-sparse layouts must grow
+                # the same first tree (valid + routing exact; feat/thr
+                # compared where valid — dense keeps candidate records on
+                # dead slots, sparse drops the rows)
+                lv_sp, leaf_sp = grow_tree(
+                    wv, keys, hm, batched=(split_mode == "fused"),
+                    layout="sparse")
+                lv_d, leaf_d = grow_tree(
+                    wv, keys, hm, batched=(split_mode == "fused"))
+                host = jax.device_get([lv_sp, leaf_sp, lv_d, leaf_d])
+                for d, (a, b) in enumerate(zip(host[0], host[2])):
+                    va, vb = np.asarray(a[3]), np.asarray(b[3])
+                    if not np.array_equal(va, vb):
+                        raise AssertionError(
+                            f"hist_layout='check': uplift dense and sparse "
+                            f"layouts disagree on valid at level {d}")
+                    for i, nm in ((0, "feat"), (1, "thr")):
+                        if not np.allclose(np.where(va, a[i], 0),
+                                           np.where(vb, b[i], 0)):
+                            raise AssertionError(
+                                f"hist_layout='check': uplift dense and "
+                                f"sparse layouts disagree on {nm} at "
+                                f"level {d}")
+                if not np.array_equal(host[1], host[3]):
+                    raise AssertionError(
+                        "hist_layout='check': uplift final leaf routing "
+                        "differs between the dense and sparse layouts")
+                hist_layout = "sparse"
+                levels, leaf = lv_sp, leaf_sp
+            elif hist_mode == "check" and t_i == 0:
                 # driver assert: first tree grown both ways must agree
                 lv_s, leaf_s = grow_tree(wv, keys, "subtract")
                 lv_f, leaf_f = grow_tree(wv, keys, "full")
@@ -325,7 +480,9 @@ class UpliftDRF(SharedTree):
                 levels, leaf = lv_b, leaf_b
             else:
                 levels, leaf = grow_tree(
-                    wv, keys, hm, batched=(split_mode == "fused"))
+                    wv, keys, hm, batched=(split_mode == "fused"),
+                    layout=("sparse" if hist_layout == "sparse"
+                            else "dense"))
             pt_vals, pc_vals = leaf_stats(leaf, wv)
             lv = [tuple(x) if not isinstance(x, tuple) else x
                   for x in levels]
@@ -346,6 +503,7 @@ class UpliftDRF(SharedTree):
         model.output["edges"] = binned.edges
         model.output["init_score"] = 0.0
         model.output["nclass_trees"] = 1
+        model.output["hist_layout"] = hist_layout
 
         from ...metrics.uplift import uplift_metrics
         X = model._design(frame)

@@ -40,10 +40,14 @@ models a LOST RESPONSE, the exactly-once dedup case), ``dkv_handle``
 (top of the coordinator's connection handler — with
 ``:coordinator:<nth>:kill`` it hard-kills the coordinator at the nth
 handled connection), ``parse_range``, ``cv_fold``, ``grid_member``,
-``automl_member``, ``glm_lambda``, ``snapshot_write``.  ``ktree_round`` fires at the top of every batched
+``automl_member``, ``glm_lambda``, ``snapshot_write``,
+``deep_level``.  ``ktree_round`` fires at the top of every batched
 K-tree boosting round (the fused multinomial/multiclass level
 program), so kill/resume mid-round exercises snapshot recovery of the
-one-launch-per-level path.
+one-launch-per-level path.  ``deep_level`` fires at the top of a tree
+chunk/round only when the node-sparse deep-level layout
+(``hist_layout="sparse"``) is engaged past its depth threshold, so
+kill/resume mid-deep-tree exercises recovery of the sparse path.
 """
 
 from __future__ import annotations

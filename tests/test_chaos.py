@@ -157,6 +157,69 @@ def test_kill_resume_verify_gbm(cl, tmp_path):
     np.testing.assert_allclose(resumed, base, rtol=1e-4, atol=1e-4)
 
 
+_TRAIN_DEEP = textwrap.dedent("""
+    import sys
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import h2o3_tpu
+    h2o3_tpu.init()
+    from h2o3_tpu.frame.parse import import_file
+    from h2o3_tpu.models import GBM
+    fr = import_file(sys.argv[1], destination_frame="chaos_fr")
+    m = GBM(response_column="y", ntrees={nt}, max_depth=5, learn_rate=0.2,
+            seed=7, score_tree_interval=2, hist_layout="sparse",
+            sparse_depth_threshold=2).train(fr)
+    assert m.output["hist_layout"] == "sparse"
+    np.save(sys.argv[2], m.predict(fr).to_numpy()[:, 0])
+    print("TRAINED", m.output["ntrees_trained"])
+""").format(nt=NTREES)
+
+
+def test_kill_resume_mid_deep_tree(cl, tmp_path):
+    """Chaos row for the node-sparse deep-level path: ``deep_level``
+    fires at the top of each tree chunk only when ``hist_layout="sparse"``
+    is engaged past its depth threshold, so the kill lands while the
+    sparse slot layout is live.  Resume must restart from the last
+    chunk-boundary snapshot, rebuild the sparse level program in a fresh
+    process, and reproduce the uninterrupted run's predictions."""
+    csv = _write_csv(tmp_path / "chaos_deep.csv")
+    base_dir = tmp_path / "base_deep"
+    base_dir.mkdir()
+
+    base_npy = str(tmp_path / "base_deep.npy")
+    out = _run(_TRAIN_DEEP, _chaos_env(base_dir), csv, base_npy)
+    assert f"TRAINED {NTREES}" in out.stdout
+
+    kill_dir = tmp_path / "kill_deep"
+    kill_dir.mkdir()
+    kill_npy = str(tmp_path / "kill_deep.npy")
+    _run(_TRAIN_DEEP,
+         _chaos_env(kill_dir,
+                    {"H2O3_TPU_FAULT_INJECT":
+                     f"deep_level:0:{KILL_AT_CHUNK}"}),
+         csv, kill_npy, expect_rc=137)
+    assert not os.path.exists(kill_npy)          # it really died mid-train
+    (entry_path,) = kill_dir.glob("job_*.json")
+    entry = json.loads(entry_path.read_text())
+    assert entry["status"] == "running"
+    assert entry["snapshot_uri"]
+    assert entry["snapshot_cursor"]["trees_done"] == 2 * (KILL_AT_CHUNK - 1)
+
+    res_npy = str(tmp_path / "resumed_deep.npy")
+    out = _run(_RESUME, _chaos_env(kill_dir), csv, res_npy)
+    info = json.loads(
+        next(line for line in out.stdout.splitlines()
+             if line.startswith("RESUME_INFO ")).split(" ", 1)[1])
+    assert info["ntrees"] == NTREES
+    assert info["cursor"]["trees_done"] == 2 * (KILL_AT_CHUNK - 1)
+    assert info["log_proof"] >= 1
+    assert not list(kill_dir.glob("job_*.json"))
+
+    np.testing.assert_allclose(np.load(res_npy), np.load(base_npy),
+                               rtol=1e-4, atol=1e-4)
+
+
 _MULTI_CSV_ROWS = 600
 
 _TRAIN_MULTI = textwrap.dedent("""

@@ -107,6 +107,45 @@ def test_subtract_level_lowers_for_tpu():
         _lower_tpu(fn, codes, leaf, g, h, w, carry)
 
 
+def test_sparse_level_lowers_for_tpu():
+    """The node-sparse deep-level program — slot-table lookup (MXU
+    one-hot product), parent-slot compaction, varbin kernel over the
+    N/2 prefix, subtraction + slot-axis gather — as ONE exported TPU
+    program at bench deep-level geometry (slot widths past the dense
+    threshold, where hist_layout='auto' engages)."""
+    from h2o3_tpu.models.tree.hist import make_sparse_level_fn
+    from h2o3_tpu.runtime.cluster import cluster
+    shards = cluster().n_row_shards
+    for Ap, A in ((128, 256), (256, 512)):
+        fn = make_sparse_level_fn(Ap, A, F, B, N_PADDED,
+                                  bin_counts=BENCH_BIN_COUNTS,
+                                  force_impl="pallas")
+        codes = ((F, N_PADDED), jnp.int16)
+        sleaf, g, h, w = _stat_shapes(N_PADDED)[1:]
+        carry = ((shards, 3, Ap, F, B), jnp.float32)
+        ps = ((A,), jnp.int32)
+        _lower_tpu(fn, codes, sleaf, g, h, w, carry, ps)
+
+
+def test_batched_sparse_level_lowers_for_tpu():
+    """The batched-K sparse level (one launch for all K class trees at
+    deep-level slot geometry) lowers for TPU — K prepends to the Pallas
+    grid exactly as the dense batched kernel does."""
+    from h2o3_tpu.models.tree.hist import make_batched_sparse_level_fn
+    from h2o3_tpu.runtime.cluster import cluster
+    shards = cluster().n_row_shards
+    K, Ap, A = 3, 128, 256
+    fn = make_batched_sparse_level_fn(Ap, A, K, F, B, N_PADDED,
+                                      bin_counts=BENCH_BIN_COUNTS,
+                                      force_impl="pallas")
+    codes = ((F, N_PADDED), jnp.int16)
+    rowK = ((K, N_PADDED), jnp.float32)
+    sleafK = ((K, N_PADDED), jnp.int32)
+    carry = ((shards, K, 3, Ap, F, B), jnp.float32)
+    psK = ((K, A), jnp.int32)
+    _lower_tpu(fn, codes, sleafK, rowK, rowK, rowK, carry, psK)
+
+
 def test_split_records_kernel_lowers_for_tpu():
     """The fused coarse split search's winner-records kernel (triangular
     one-hot matmul cumsum + on-chip per-(leaf, feature) argmax) at every

@@ -7,6 +7,10 @@
 #     journaled frame and recovery.resume() continues from the progress
 #     snapshot; final predictions must match an uninterrupted run
 #     (tests/test_chaos.py),
+#   - deep-level kill: the same kill-resume-verify scenario with the
+#     node-sparse deep-level layout engaged (hist_layout="sparse" past
+#     its depth threshold; deep_level injection point)
+#     (tests/test_chaos.py),
 #   - coordinator hard-kill: the DKV coordinator os._exit(137)s mid-GBM
 #     (dkv_handle:coordinator:N), is restarted on the same port +
 #     recovery dir, the worker rides out the outage on its retry budget,
