@@ -593,6 +593,105 @@ def parse_piece():
         "stages": dict(last_parse_stats)}), flush=True)
 
 
+def obs_piece():
+    """Telemetry-overhead bench: the hist level loop (the subtract-path
+    chain hist_piece times) run three ways — bare, wrapped in the
+    ``level_phase`` span hooks with telemetry ON, and wrapped with
+    telemetry OFF (``H2O3_TPU_METRICS=0`` fast path).
+
+    The hooks are host-side (span event + latency histogram per phase),
+    so their cost must disappear against a real kernel dispatch: the
+    acceptance bar is < 2% overhead with telemetry enabled.
+
+    Usage (chip): python bench_pieces.py obs
+    CPU smoke:    JAX_PLATFORMS=cpu H2O3_PIECES_ROWS=200000 \\
+                  python bench_pieces.py obs
+    """
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    import h2o3_tpu
+    from h2o3_tpu.models.tree.hist import (make_subtract_level_fn,
+                                           offset_codes)
+    from h2o3_tpu.models.tree.shared import level_phase
+    from h2o3_tpu.runtime import observability as obs
+
+    cl = h2o3_tpu.init()
+    platform = jax.devices()[0].platform
+    n = N_ROWS - (N_ROWS % (512 * cl.n_row_shards))
+    force = "" if platform == "tpu" else "pallas_interpret"
+    reps = max(REPS // 4, 3)
+
+    key = jax.random.PRNGKey(0)
+    ks = jax.random.split(key, 16)
+    codes = jnp.stack([
+        jax.random.randint(ks[f], (n,), 0, min(bc, NBINS), dtype=jnp.int32)
+        for f, bc in enumerate(BIN_COUNTS)], axis=0)
+    gcodes = offset_codes(codes, BIN_COUNTS, NBINS)
+    g = jax.random.normal(ks[8], (n,), jnp.float32)
+    h = jnp.abs(jax.random.normal(ks[9], (n,), jnp.float32)) + 0.1
+    w = jnp.ones((n,), jnp.float32)
+
+    # the same leaf/carry chain hist_piece uses (70/30 splits), built and
+    # warmed up outside the timed loops so only steady-state dispatch is
+    # measured
+    chain = []
+    leaf = jnp.zeros(n, jnp.int32)
+    fn0 = make_subtract_level_fn(0, F, B, n, bin_counts=BIN_COUNTS,
+                                 force_impl=force)
+    _, carry = fn0(gcodes, leaf, g, h, w)
+    for d in range(1, 6):
+        bit = (jax.random.uniform(ks[10 + (d % 6)], (n,)) < 0.3) \
+            .astype(jnp.int32)
+        leaf = 2 * leaf + bit
+        fn_d = make_subtract_level_fn(d, F, B, n, bin_counts=BIN_COUNTS,
+                                      force_impl=force)
+        H, next_carry = fn_d(gcodes, leaf, g, h, w, carry)   # warmup
+        jax.block_until_ready(H)
+        chain.append((fn_d, leaf, carry))
+        carry = next_carry
+
+    def run_loop(instrument: bool) -> float:
+        t0 = _time.perf_counter()
+        for _ in range(reps):
+            for d, (fn_d, lf, cr) in enumerate(chain, start=1):
+                if instrument:
+                    with level_phase("hist", d):
+                        H, _ = fn_d(gcodes, lf, g, h, w, cr)
+                else:
+                    H, _ = fn_d(gcodes, lf, g, h, w, cr)
+                jax.block_until_ready(H)
+        return (_time.perf_counter() - t0) * 1e3 / (reps * len(chain))
+
+    def emit(**rec):
+        print(json.dumps({**rec, "platform": platform, "rows": n,
+                          "reps": reps}), flush=True)
+
+    run_loop(False)                                   # loop warmup
+    ms_plain = run_loop(False)
+    prev = obs.set_enabled(True)
+    ms_on = run_loop(True)
+    obs.set_enabled(False)
+    ms_off = run_loop(True)
+    obs.set_enabled(prev)
+
+    emit(piece="obs_plain", ms=round(ms_plain, 4))
+    emit(piece="obs_enabled", ms=round(ms_on, 4))
+    emit(piece="obs_disabled", ms=round(ms_off, 4))
+    pct_on = 100.0 * (ms_on - ms_plain) / ms_plain
+    pct_off = 100.0 * (ms_off - ms_plain) / ms_plain
+    emit(piece="obs_summary",
+         overhead_pct_enabled=round(pct_on, 3),
+         overhead_pct_disabled=round(pct_off, 3),
+         ok=bool(pct_on < 2.0),
+         note="span+histogram hooks on the hist level loop; bar is < 2%")
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "parse":
         parse_piece()
@@ -602,5 +701,7 @@ if __name__ == "__main__":
         splits_piece()
     elif len(sys.argv) > 1 and sys.argv[1] == "deep":
         deep_piece()
+    elif len(sys.argv) > 1 and sys.argv[1] == "obs":
+        obs_piece()
     else:
         main()

@@ -201,6 +201,16 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _reply_text(self, text: str):
+        # Prometheus text exposition (the only str-returning route)
+        body = text.encode()
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def _deny(self):
         self.send_response(401)
         self.send_header("WWW-Authenticate", 'Basic realm="h2o3_tpu"')
@@ -226,6 +236,8 @@ class _Handler(BaseHTTPRequestHandler):
                     out = fn(self.server.api, *m.groups(), **params)
                     if isinstance(out, bytes):       # artifact downloads
                         return self._reply_bytes(out)
+                    if isinstance(out, str):         # /metrics exposition
+                        return self._reply_text(out)
                     return self._reply(200, out)
                 except KeyError as e:
                     return self._reply(404, {"error": str(e)})
@@ -606,8 +618,16 @@ class Api:
 
     # ------------------------------------------------------------------ jobs
     def jobs_list(self) -> dict:
-        from ..runtime.job import list_jobs
-        return {"jobs": [j.describe() for j in list_jobs()]}
+        from ..runtime import dkv
+        from ..runtime.job import MIRROR_PREFIX, list_jobs
+        out = [j.describe() for j in list_jobs()]
+        seen = {d["key"] for d in out}
+        # plain status mirrors replicated from other members' jobs
+        for k in dkv.keys(MIRROR_PREFIX):
+            d = dkv.get(k)
+            if isinstance(d, dict) and d.get("key") not in seen:
+                out.append(d)
+        return {"jobs": out}
 
     # -------------------------------------------- small utility handlers
     # (the reference's RequestServer breadth: Typeahead, CreateFrame,
@@ -1039,15 +1059,45 @@ class Api:
             _t.Thread(target=srv.stop, daemon=True).start()
         return {"status": "shutting down"}
 
-    def timeline(self) -> dict:
-        """GET /3/Timeline — recent runtime events (TimelineHandler:12)
-        plus the monotonic counters (WAL records/bytes, dedup hits)."""
-        from ..runtime.observability import counters, timeline_events
-        return {"events": timeline_events(), "counters": counters()}
+    def timeline(self, limit=500, **kw) -> dict:
+        """GET /3/Timeline[?limit=N] — recent runtime events
+        (TimelineHandler:12) plus the monotonic counters (WAL records/
+        bytes, dedup hits), per-node sections built from the telemetry
+        shipped on heartbeat stamps, and span events stitched into trace
+        trees (local + shipped, matched by trace_id)."""
+        from ..runtime import observability as obs
+        limit = int(limit)
+        events = obs.timeline_events(limit)
+        nodes = {}
+        all_events = list(events)
+        try:
+            me = obs.node_name()
+            for node, stamp in obs.cluster_stamps().items():
+                if not isinstance(stamp, dict):
+                    continue
+                shipped = stamp.get("events") or []
+                nodes[node] = {
+                    "ts": stamp.get("ts"),
+                    "pid": stamp.get("pid"),
+                    "metric_series": len(stamp.get("metrics") or []),
+                    "events": shipped[-limit:] if node != me else [],
+                }
+                if node != me:
+                    all_events.extend(shipped)
+        except Exception:                # noqa: BLE001 — local-only view
+            pass
+        return {"events": events, "counters": obs.counters(),
+                "nodes": nodes, "traces": obs.trace_forest(all_events)}
 
-    def logs(self, **kw) -> dict:
+    def prometheus(self) -> str:
+        """GET /metrics — Prometheus text exposition: this process's
+        registry plus every heartbeating node's shipped snapshot."""
+        from ..runtime.observability import render_prometheus
+        return render_prometheus(cluster=True)
+
+    def logs(self, limit=500, **kw) -> dict:
         from ..runtime.observability import recent_logs
-        return {"log": recent_logs()}
+        return {"log": recent_logs(int(limit))}
 
     def job(self, key: str) -> dict:
         from ..runtime.job import list_jobs
@@ -1145,8 +1195,9 @@ class H2OServer:
             r"/3/ImportFiles": lambda a, **kw: a.import_files(**kw),
             r"/3/Metadata/schemas": lambda a: a.schemas(),
             r"/3/About": lambda a: a.about(),
-            r"/3/Timeline": lambda a: a.timeline(),
+            r"/3/Timeline": lambda a, **kw: a.timeline(**kw),
             r"/3/Logs": lambda a, **kw: a.logs(**kw),
+            r"/metrics": lambda a: a.prometheus(),
             r"/3/Typeahead/files": lambda a, **kw: a.typeahead(**kw),
             r"/3/JStack": lambda a: a.jstack(),
             r"/3/NetworkTest": lambda a: a.network_test(),

@@ -359,8 +359,11 @@ class GBM(SharedTree):
                 if sparse_deep:
                     # kill/resume while node-sparse deep levels are live
                     failure.maybe_inject("deep_level")
-                F, lv, vals, cov = scan_fn(wcodes, Y1, w, F, edges_mat,
-                                           rng, chunk_no, c, *scalars)
+                from ...runtime import observability as obs
+                with obs.span("tree_chunk", job=job.key, chunk=chunk_no,
+                              trees=c, classes=K):
+                    F, lv, vals, cov = scan_fn(wcodes, Y1, w, F, edges_mat,
+                                               rng, chunk_no, c, *scalars)
                 for k in range(K):
                     lv_k = [tuple(lvd[i][:, k] for i in range(4))
                             for lvd in lv]
@@ -414,8 +417,11 @@ class GBM(SharedTree):
                 if sparse_deep:
                     # kill/resume while node-sparse deep levels are live
                     failure.maybe_inject("deep_level")
-                F, lv, vals, cov = scan_fn(wcodes, y, w, F, edges_mat,
-                                           rng, chunk_no, c, *scalars, 0)
+                from ...runtime import observability as obs
+                with obs.span("tree_chunk", job=job.key, chunk=chunk_no,
+                              trees=c):
+                    F, lv, vals, cov = scan_fn(wcodes, y, w, F, edges_mat,
+                                               rng, chunk_no, c, *scalars, 0)
                 chunk = StackedTrees(lv, vals, cov)
                 chunks.append(chunk)
                 job.update(t_done / p.ntrees, f"tree {t_done}/{p.ntrees}")

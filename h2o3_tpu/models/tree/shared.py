@@ -14,9 +14,11 @@ tree, all batched over rows on the VPU.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 import os
+import time
 from typing import List, Optional, Sequence
 
 import jax
@@ -40,6 +42,24 @@ from .hist import (make_hist_fn, make_fine_hist_fn, make_varbin_hist_fn,
                    fused_best_splits, fused_best_splits_batched,
                    select_superbins, partition, partition_right,
                    table_lookup)
+
+
+@contextlib.contextmanager
+def level_phase(phase: str, level: int):
+    """Host-side span around one per-level phase (hist/split/partition).
+
+    The level loop runs at TRACE time inside ``jax.jit``, so inside a
+    jitted build this measures per-phase tracing/dispatch cost on the
+    host (events fire once per compilation; the device-side timeline
+    stays ``jax.profiler``'s job).  Around EAGER phase calls (crosscheck
+    drivers, bench pieces) it times real execution.  Durations land in
+    ``tree_phase_seconds{phase,level}`` and on the event ring."""
+    from ...runtime import observability as obs
+    t0 = time.perf_counter()
+    with obs.span("tree_phase", phase=phase, level=level):
+        yield
+    obs.observe("tree_phase_seconds", time.perf_counter() - t0,
+                phase=phase, level=str(level))
 
 
 @dataclasses.dataclass
@@ -893,23 +913,25 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
                     sleaf = jnp.minimum(jnp.take(child_base, sleaf)
                                         + right, A)
                 lcodes = hcodes if varbin_level[d] else codes
-                H, H_carry = sparse_fns[d](lcodes, sleaf, g, h, w,
-                                           H_carry, ps_of_slot)
+                with level_phase("hist", d):
+                    H, H_carry = sparse_fns[d](lcodes, sleaf, g, h, w,
+                                               H_carry, ps_of_slot)
                 # col mask DRAWN dense (bit-identical RNG to the dense
                 # layout), gathered to slots
                 mask_s = mask[leaf_of_slot]
-                if split_mode == "fused":
-                    feat_s, bin_s, na_s, gain, valid_s, children_s = \
-                        fused_best_splits(
-                            H, nbins, reg_lambda, min_rows,
-                            min_split_improvement, mask_s, reg_alpha,
-                            gamma, min_child_weight)
-                else:
-                    feat_s, bin_s, na_s, gain, valid_s, children_s = \
-                        best_splits(
-                            H, nbins, reg_lambda, min_rows,
-                            min_split_improvement, mask_s, reg_alpha,
-                            gamma, min_child_weight)
+                with level_phase("split", d):
+                    if split_mode == "fused":
+                        feat_s, bin_s, na_s, gain, valid_s, children_s = \
+                            fused_best_splits(
+                                H, nbins, reg_lambda, min_rows,
+                                min_split_improvement, mask_s, reg_alpha,
+                                gamma, min_child_weight)
+                    else:
+                        feat_s, bin_s, na_s, gain, valid_s, children_s = \
+                            best_splits(
+                                H, nbins, reg_lambda, min_rows,
+                                min_split_improvement, mask_s, reg_alpha,
+                                gamma, min_child_weight)
                 # phantom slots past the live range gathered parent slot
                 # 0's histogram — no rows, records discarded here
                 valid_s = valid_s & real
@@ -920,74 +942,84 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
                 thr = edges_mat[feat, jnp.clip(bin_, 0, nbins - 1)]
                 fp, bp, nap, vp = _pad_slot_tables(feat_s, bin_s, na_s,
                                                    valid_s)
-                right = partition_right(codes, sleaf, fp, bp, nap, vp,
-                                        jnp.int32(nbins))
+                with level_phase("partition", d):
+                    right = partition_right(codes, sleaf, fp, bp, nap, vp,
+                                            jnp.int32(nbins))
                 # same went-right bit updates BOTH ids: dense leaf (final
                 # values/traversal) and slot (next level's routing)
                 leaf = 2 * leaf + right
                 levels.append((feat, thr, na_left, valid))
                 continue
             if hier:
-                if d == 0:
-                    Hc = coarse_fns[0](ccodes, leaf, g, h, w)
-                else:
-                    em = ((leaf & 1) == 0).astype(jnp.float32)
-                    Hcl = coarse_fns[d](ccodes, leaf >> 1,
-                                        g * em, h * em, w * em)
-                    # clamp the h/w planes at 0: per-level kernel routing can
-                    # pair differently-rounded kernels across the subtraction
-                    # (bf16 vs f32), and negative hessian/weight sums would
-                    # corrupt best_splits at the boundary level
-                    Hcr = H_prev - Hcl
-                    Hcr = Hcr.at[1:].max(0.0)
-                    Hc = jnp.stack([Hcl, Hcr], axis=2) \
-                        .reshape(3, L, F, S + 1)
-                H_prev = Hc
-                sel, ub = select_superbins(
-                    Hc, nbins, W, fine_k, reg_lambda, reg_alpha, gamma,
-                    min_rows, min_child_weight, mask)
-                Hf = fine_fns[d](codes, leaf, g, h, w, sel)
-                feat, bin_, na_left, gain, valid, children, _ = \
-                    best_splits_hier(
-                        Hc, Hf, sel, ub, nbins, W, reg_lambda, min_rows,
-                        min_split_improvement, mask, reg_alpha, gamma,
-                        min_child_weight)
-            else:
-                lcodes = hcodes if varbin_level[d] else codes
-                if hist_mode == "subtract":
-                    # smaller-sibling compaction + parent subtraction: the
-                    # kernel streams only the <= N/2 rows of each parent's
-                    # smaller child; the larger sibling is reconstructed
-                    # from the per-shard parent carry (hist.py)
+                with level_phase("hist", d):
                     if d == 0:
-                        H, H_carry = level_fns[0](lcodes, leaf, g, h, w)
+                        Hc = coarse_fns[0](ccodes, leaf, g, h, w)
                     else:
-                        H, H_carry = level_fns[d](lcodes, leaf, g, h, w,
-                                                  H_carry)
-                else:
-                    # "full" oracle: every child histogrammed from all rows
-                    H = hist_fns[d](lcodes, leaf, g, h, w)
-                if plan is not None:
-                    from .efb import best_splits_mixed
-                    (feat, bin_, na_left, gain, valid, children, wfeat,
-                     lo_w, hi_w, inv_w) = best_splits_mixed(
-                        H, nbins, plan, reg_lambda, min_rows,
-                        min_split_improvement, mask, reg_alpha, gamma,
-                        min_child_weight)
-                elif split_mode == "fused":
-                    # single-pass winner records between hist and the tiny
-                    # feature argmax — no [3, L, F, B] gain intermediates
-                    feat, bin_, na_left, gain, valid, children = \
-                        fused_best_splits(
-                            H, nbins, reg_lambda, min_rows,
+                        em = ((leaf & 1) == 0).astype(jnp.float32)
+                        Hcl = coarse_fns[d](ccodes, leaf >> 1,
+                                            g * em, h * em, w * em)
+                        # clamp the h/w planes at 0: per-level kernel
+                        # routing can pair differently-rounded kernels
+                        # across the subtraction (bf16 vs f32), and
+                        # negative hessian/weight sums would corrupt
+                        # best_splits at the boundary level
+                        Hcr = H_prev - Hcl
+                        Hcr = Hcr.at[1:].max(0.0)
+                        Hc = jnp.stack([Hcl, Hcr], axis=2) \
+                            .reshape(3, L, F, S + 1)
+                    H_prev = Hc
+                    sel, ub = select_superbins(
+                        Hc, nbins, W, fine_k, reg_lambda, reg_alpha, gamma,
+                        min_rows, min_child_weight, mask)
+                    Hf = fine_fns[d](codes, leaf, g, h, w, sel)
+                with level_phase("split", d):
+                    feat, bin_, na_left, gain, valid, children, _ = \
+                        best_splits_hier(
+                            Hc, Hf, sel, ub, nbins, W, reg_lambda, min_rows,
                             min_split_improvement, mask, reg_alpha, gamma,
                             min_child_weight)
-                else:
-                    feat, bin_, na_left, gain, valid, children = best_splits(
-                        H, nbins, reg_lambda, min_rows,
-                        min_split_improvement, mask, reg_alpha, gamma,
-                        min_child_weight,
-                        mono=mono_arr if mono is not None else None)
+            else:
+                lcodes = hcodes if varbin_level[d] else codes
+                with level_phase("hist", d):
+                    if hist_mode == "subtract":
+                        # smaller-sibling compaction + parent subtraction:
+                        # the kernel streams only the <= N/2 rows of each
+                        # parent's smaller child; the larger sibling is
+                        # reconstructed from the per-shard parent carry
+                        # (hist.py)
+                        if d == 0:
+                            H, H_carry = level_fns[0](lcodes, leaf, g, h, w)
+                        else:
+                            H, H_carry = level_fns[d](lcodes, leaf, g, h, w,
+                                                      H_carry)
+                    else:
+                        # "full" oracle: every child histogrammed from
+                        # all rows
+                        H = hist_fns[d](lcodes, leaf, g, h, w)
+                with level_phase("split", d):
+                    if plan is not None:
+                        from .efb import best_splits_mixed
+                        (feat, bin_, na_left, gain, valid, children, wfeat,
+                         lo_w, hi_w, inv_w) = best_splits_mixed(
+                            H, nbins, plan, reg_lambda, min_rows,
+                            min_split_improvement, mask, reg_alpha, gamma,
+                            min_child_weight)
+                    elif split_mode == "fused":
+                        # single-pass winner records between hist and the
+                        # tiny feature argmax — no [3, L, F, B] gain
+                        # intermediates
+                        feat, bin_, na_left, gain, valid, children = \
+                            fused_best_splits(
+                                H, nbins, reg_lambda, min_rows,
+                                min_split_improvement, mask, reg_alpha,
+                                gamma, min_child_weight)
+                    else:
+                        feat, bin_, na_left, gain, valid, children = \
+                            best_splits(
+                                H, nbins, reg_lambda, min_rows,
+                                min_split_improvement, mask, reg_alpha,
+                                gamma, min_child_weight,
+                                mono=mono_arr if mono is not None else None)
             if d > 0:
                 valid = valid & alive
                 # collapse the child stats of dead slots back to "all rows
@@ -1022,14 +1054,15 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
                 lo = jnp.stack([lo_l, lo_r], axis=1).reshape(-1)
                 hi = jnp.stack([hi_l, hi_r], axis=1).reshape(-1)
             thr = edges_mat[feat, jnp.clip(bin_, 0, nbins - 1)]
-            if plan is not None:
-                from .hist import partition_ranged
-                leaf = partition_ranged(codes, leaf, wfeat, lo_w, hi_w,
-                                        inv_w, na_left, valid,
-                                        jnp.int32(nbins))
-            else:
-                leaf = partition(codes, leaf, feat, bin_, na_left, valid,
-                                 jnp.int32(nbins))
+            with level_phase("partition", d):
+                if plan is not None:
+                    from .hist import partition_ranged
+                    leaf = partition_ranged(codes, leaf, wfeat, lo_w, hi_w,
+                                            inv_w, na_left, valid,
+                                            jnp.int32(nbins))
+                else:
+                    leaf = partition(codes, leaf, feat, bin_, na_left,
+                                     valid, jnp.int32(nbins))
             levels.append((feat, thr, na_left, valid))
         # Newton leaf values from the last level's child sums — no extra
         # data pass (fitBestConstants from the histograms themselves)
@@ -1731,11 +1764,13 @@ def build_tree(codes, g, h, w, edges, nbins: int, max_depth: int,
                             hier=hier, mono=mono, hist_mode=hist_mode,
                             split_mode=split_mode, hist_layout=hist_layout,
                             sparse_depth_threshold=sparse_depth_threshold)
-    levels, vals, cover, leaf = fn(codes, g, h, w, edges_mat, rng_key,
-                                   reg_lambda, min_rows,
-                                   min_split_improvement, learn_rate,
-                                   col_sample_rate, tm, reg_alpha, gamma,
-                                   min_child_weight)
+    from ...runtime import observability as obs
+    with obs.span("tree_build", depth=max_depth, rows=int(N)):
+        levels, vals, cover, leaf = fn(codes, g, h, w, edges_mat, rng_key,
+                                       reg_lambda, min_rows,
+                                       min_split_improvement, learn_rate,
+                                       col_sample_rate, tm, reg_alpha,
+                                       gamma, min_child_weight)
     tree = Tree([lv[0] for lv in levels], [lv[1] for lv in levels],
                 [lv[2] for lv in levels], [lv[3] for lv in levels], vals,
                 cover=cover)

@@ -163,6 +163,41 @@ def _guardrail_fraction() -> float:
     return config().hbm_guardrail_fraction
 
 
+def sample_memory_gauges() -> int:
+    """Sample per-device allocator stats into telemetry gauges.
+
+    Rides the same ``memory_stats()`` probe as ``_check_hbm_budget``;
+    called from the heartbeat so every stamp ships fresh numbers.
+    ``device_memory_bytes{device,kind}`` carries ``in_use``/``limit``
+    plus an ``in_use_peak`` high-watermark (the WaterMeter analog).
+    Returns how many devices reported stats (CPU backends report none).
+    """
+    from . import observability as obs
+    sampled = 0
+    for dev in jax.local_devices():
+        try:
+            stats = dev.memory_stats() or {}
+        except Exception:               # noqa: BLE001 — backend-optional
+            continue
+        in_use = stats.get("bytes_in_use")
+        if in_use is None:
+            continue
+        d = str(dev.id)
+        obs.set_gauge("device_memory_bytes", in_use, device=d, kind="in_use")
+        obs.gauge("device_memory_bytes", device=d,
+                  kind="in_use_peak").set_max(in_use)
+        limit = stats.get("bytes_limit")
+        if limit:
+            obs.set_gauge("device_memory_bytes", limit, device=d,
+                          kind="limit")
+        peak = stats.get("peak_bytes_in_use")
+        if peak:
+            obs.gauge("device_memory_bytes", device=d,
+                      kind="in_use_peak").set_max(peak)
+        sampled += 1
+    return sampled
+
+
 def _check_hbm_budget(nbytes: int, sharding=None, shape=None) -> None:
     """Fail fast with a clear message instead of an opaque XLA OOM.
 

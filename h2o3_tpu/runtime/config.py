@@ -55,6 +55,12 @@ class Config:
     # between writes per job (0 = every opportunity), async writer thread
     snapshot_interval_s: float = 30.0
     snapshot_async: bool = True
+    # telemetry (runtime/observability.py): master switch for metric/span
+    # instrumentation, per-node log file (%h/%p expand to hostname/pid),
+    # and how many timeline events each heartbeat stamp ships (0 = none)
+    metrics_enabled: bool = True
+    log_file: Optional[str] = None
+    hb_ship_events: int = 200
 
     @staticmethod
     def from_env() -> "Config":
@@ -83,6 +89,10 @@ class Config:
             snapshot_interval_s=float(e("H2O3_TPU_SNAPSHOT_INTERVAL", 30.0)),
             snapshot_async=e("H2O3_TPU_SNAPSHOT_ASYNC", "1")
             not in ("0", "false", "no"),
+            metrics_enabled=e("H2O3_TPU_METRICS", "1")
+            not in ("0", "false", "no"),
+            log_file=e("H2O3_TPU_LOG_FILE") or None,
+            hb_ship_events=int(e("H2O3_TPU_HB_SHIP_EVENTS", 200)),
         )
 
     def describe(self) -> dict:
@@ -106,9 +116,10 @@ def config() -> Config:
 
 def reload() -> Config:
     """Re-read the environment (tests / dynamic reconfiguration)."""
-    import logging
     global _config
     with _lock:
         _config = Config.from_env()
-        logging.getLogger("h2o3_tpu").setLevel(_config.log_level)
-        return _config
+        cfg = _config
+    from . import observability
+    observability.apply_config(cfg)
+    return cfg

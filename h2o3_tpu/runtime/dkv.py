@@ -339,6 +339,15 @@ def _repush(old: int, new: int) -> None:
             _rpc("put", key=k, value=v)
         except Exception as e:           # noqa: BLE001 — best-effort heal
             log.warning("DKV re-push of %r failed: %r", k, e)
+    # the re-pushed heartbeat stamp carries the metrics snapshot that was
+    # current at the LAST beat; stamp again now so the new coordinator
+    # incarnation sees fresh telemetry immediately (no gap while the beat
+    # thread sleeps out its interval)
+    try:
+        from . import heartbeat
+        heartbeat.reship()
+    except Exception as e:               # noqa: BLE001 — telemetry only
+        log.warning("post-bump telemetry re-ship failed: %r", e)
 
 
 def _rpc(op: str, **kw) -> Any:
@@ -360,12 +369,20 @@ def _rpc(op: str, **kw) -> Any:
     id generated ONCE per logical op — every retry resends the same id
     and the coordinator's dedup window makes the retry idempotent
     (exactly-once).  Every response is epoch-fenced via ``_note_epoch``.
+
+    Telemetry: the active trace context rides the envelope (``trace``
+    key), so the coordinator's handler span joins the caller's trace;
+    client latency lands in ``dkv_rpc_seconds{op,side,retried}``.
     """
     import random
 
     from .config import config
+    from . import observability as obs
     if op in _MUTATING:
         kw.setdefault("req_id", _next_req_id())
+    trace_ctx = obs.current_trace()
+    if trace_ctx:
+        kw["trace"] = trace_ctx
     payload = pickle.dumps({"op": op, **kw},
                            protocol=pickle.HIGHEST_PROTOCOL)
     cfg = config()
@@ -374,32 +391,40 @@ def _rpc(op: str, **kw) -> Any:
         budget = cfg.dkv_retry_budget_s
     deadline = time.time() + budget
     attempt = 0
-    while True:
-        try:
-            from . import failure
-            failure.maybe_inject("dkv_rpc")
-            resp = _rpc_once(payload)
-            # a drop HERE models a lost response: the server has already
-            # applied the op, so the retry must hit the dedup window
-            failure.maybe_inject("dkv_rpc_resp")
-            _note_epoch(resp.get("epoch", 0))
-            break
-        except (ConnectionError, TimeoutError, ssl.SSLError, OSError) as e:
-            attempt += 1
-            now = time.time()
-            if attempt > cfg.dkv_retries or now >= deadline:
-                raise
-            from .observability import log, record
-            sleep = min(cfg.dkv_backoff_base_s * (2 ** (attempt - 1)),
-                        cfg.dkv_backoff_max_s)
-            sleep *= 0.5 + random.random()          # jitter in [0.5x, 1.5x)
-            sleep = min(sleep, max(deadline - now, 0.01))
-            record("dkv_retry", op=op, attempt=attempt, error=repr(e))
-            log.warning("DKV %s RPC failed (%r); retry %d/%d in %.2fs",
-                        op, e, attempt, cfg.dkv_retries, sleep)
-            time.sleep(sleep)
-    if resp.get("err"):
-        raise RuntimeError(f"DKV coordinator error: {resp['err']}")
+    t0 = time.perf_counter()
+    with obs.span("dkv_rpc", op=op):
+        while True:
+            try:
+                from . import failure
+                failure.maybe_inject("dkv_rpc")
+                resp = _rpc_once(payload)
+                # a drop HERE models a lost response: the server has
+                # already applied the op, so the retry must hit the
+                # dedup window
+                failure.maybe_inject("dkv_rpc_resp")
+                _note_epoch(resp.get("epoch", 0))
+                break
+            except (ConnectionError, TimeoutError,
+                    ssl.SSLError, OSError) as e:
+                attempt += 1
+                now = time.time()
+                if attempt > cfg.dkv_retries or now >= deadline:
+                    obs.inc("dkv_rpc_failures", op=op)
+                    raise
+                from .observability import log, record
+                sleep = min(cfg.dkv_backoff_base_s * (2 ** (attempt - 1)),
+                            cfg.dkv_backoff_max_s)
+                sleep *= 0.5 + random.random()      # jitter in [0.5x, 1.5x)
+                sleep = min(sleep, max(deadline - now, 0.01))
+                record("dkv_retry", op=op, attempt=attempt, error=repr(e))
+                log.warning("DKV %s RPC failed (%r); retry %d/%d in %.2fs",
+                            op, e, attempt, cfg.dkv_retries, sleep)
+                time.sleep(sleep)
+        obs.observe("dkv_rpc_seconds", time.perf_counter() - t0,
+                    op=op, side="client",
+                    retried="true" if attempt else "false")
+        if resp.get("err"):
+            raise RuntimeError(f"DKV coordinator error: {resp['err']}")
     return resp.get("value")
 
 
@@ -726,20 +751,30 @@ class _Handler(socketserver.BaseRequestHandler):
             req = pickle.loads(_recvall(self.request, n))
             op = req["op"]
             rid = req.get("req_id")
-            with _lock:
-                if rid is not None and rid in _dedup:
-                    value = _dedup[rid]          # retried op: already applied
-                    from .observability import count
-                    count("dkv_dedup_hits")
-                else:
-                    value = _apply_op(op, req)
-                    if op in _MUTATING:
-                        rec = _mutation_record(op, req, value)
-                        if rec is not None:
-                            _wal_append(rec)
-                        if rid is not None:
-                            _dedup[rid] = value
-                            _trim_dedup()
+            # adopt the caller's trace context (if any) so the handler
+            # span lands in the same tree as the client's dkv_rpc span
+            from . import observability as obs
+            t0 = time.perf_counter()
+            dedup_hit = False
+            with obs.trace_context(req.get("trace")), \
+                    obs.span("dkv_handle", op=op):
+                with _lock:
+                    if rid is not None and rid in _dedup:
+                        value = _dedup[rid]      # retried op: already applied
+                        dedup_hit = True
+                        obs.count("dkv_dedup_hits")
+                    else:
+                        value = _apply_op(op, req)
+                        if op in _MUTATING:
+                            rec = _mutation_record(op, req, value)
+                            if rec is not None:
+                                _wal_append(rec)
+                            if rid is not None:
+                                _dedup[rid] = value
+                                _trim_dedup()
+            obs.observe("dkv_handle_seconds", time.perf_counter() - t0,
+                        op=op, side="server",
+                        dedup_hit="true" if dedup_hit else "false")
             resp = {"value": value, "epoch": _epoch}
         except Exception as e:          # noqa: BLE001 — reported to client
             resp = {"err": repr(e), "epoch": _epoch}
@@ -835,3 +870,5 @@ def detach() -> None:
         _server = None
     with _lock:
         _close_wal()
+    from .observability import close_log_file
+    close_log_file()                      # release the per-node log file

@@ -31,6 +31,8 @@ PREFIX = "!hb/"
 _thread: Optional[threading.Thread] = None
 _stop = threading.Event()
 _node: Optional[str] = None
+_interval: float = 5.0
+_atexit_hooked = False
 
 
 def node_name() -> str:
@@ -39,23 +41,64 @@ def node_name() -> str:
 
 
 def _beat(name: str, interval: float) -> None:
+    from . import observability as obs
     from .config import config
+    cfg = config()
+    stamp = {
+        "ts": time.time(),
+        "interval": interval,
+        "pid": os.getpid(),
+        "keys": dkv.local_size(),
+    }
+    # telemetry rides the stamp: the full (cumulative) metric registry
+    # plus a bounded event tail.  Cumulative — not a delta — so a lost
+    # or duplicated stamp cannot skew the coordinator's merged view, and
+    # the plain-dict stamp is in dkv._local_plain, so an epoch bump
+    # re-pushes it to the new coordinator incarnation automatically.
+    if cfg.metrics_enabled:
+        try:
+            import sys
+            if "jax" in sys.modules:    # never boot jax from the beat
+                from . import cluster
+                cluster.sample_memory_gauges()
+        except Exception:               # noqa: BLE001 — gauges optional
+            pass
+        stamp["metrics"] = obs.metrics_wire()
+        if cfg.hb_ship_events:
+            stamp["events"] = obs.events_wire(cfg.hb_ship_events)
     # a short retry budget, NOT the full 30 s default: one missed stamp
     # is better than a beat thread blocked past several intervals
-    with dkv.retry_budget(config().hb_dkv_budget_s):
-        dkv.put(PREFIX + name, {
-            "ts": time.time(),
-            "interval": interval,
-            "pid": os.getpid(),
-            "keys": dkv.local_size(),
-        })
+    with dkv.retry_budget(cfg.hb_dkv_budget_s):
+        dkv.put(PREFIX + name, stamp)
+
+
+def reship() -> bool:
+    """Stamp immediately with a fresh telemetry snapshot.
+
+    Called after a DKV epoch bump (``dkv._repush``): the new coordinator
+    incarnation gets this worker's metrics without waiting out the beat
+    interval, closing the telemetry gap across a coordinator restart."""
+    if _node is None or _stop.is_set():
+        return False
+    from . import observability as obs
+    _beat(_node, _interval)
+    obs.record("metrics_reship", node=_node)
+    return True
 
 
 def start(interval: float = 5.0, name: Optional[str] = None) -> str:
     """Start (or restart) this process's heartbeat thread."""
-    global _thread, _node
+    global _thread, _node, _interval, _atexit_hooked
+    if not _atexit_hooked:
+        # registered after jax's own atexit hooks, so it runs BEFORE
+        # them: the beat thread is joined while the backend still exists
+        # (the stamp is left behind; members() GC handles stale ones)
+        import atexit
+        atexit.register(stop, remove=False)
+        _atexit_hooked = True
     stop()
     _node = name or node_name()
+    _interval = interval
     _stop.clear()
     try:
         _beat(_node, interval)          # immediate first stamp, best-effort
@@ -74,13 +117,18 @@ def start(interval: float = 5.0, name: Optional[str] = None) -> str:
     return _node
 
 
-def stop() -> None:
+def stop(remove: bool = True) -> None:
+    """Halt the beat thread; ``remove=False`` leaves the stamp behind.
+
+    Always join the thread before process exit: the beat samples device
+    gauges through jax, and a beat racing interpreter/XLA teardown can
+    abort the process from a C++ destructor."""
     global _thread
     _stop.set()
     if _thread is not None:
         _thread.join(timeout=2.0)
         _thread = None
-    if _node is not None:
+    if remove and _node is not None:
         try:
             dkv.remove(PREFIX + _node)  # clean departure ≠ failure
         except Exception:               # noqa: BLE001

@@ -20,6 +20,8 @@ from typing import Any, Callable, Optional
 
 from . import dkv
 
+MIRROR_PREFIX = "!job/"    # plain status stamps, replicated coordinator-side
+
 CREATED = "CREATED"
 RUNNING = "RUNNING"
 DONE = "DONE"
@@ -56,13 +58,19 @@ class Job:
 
     # ------------------------------------------------------------- lifecycle
     def run(self, fn: Callable[["Job"], Any]) -> Any:
-        """Run ``fn(self)`` inline, tracking status/exceptions (blocking)."""
-        from .observability import record
+        """Run ``fn(self)`` inline, tracking status/exceptions (blocking).
+
+        Opens a root trace span: every span/DKV RPC under ``fn`` (across
+        processes — the context rides the RPC envelope) shares one
+        trace_id, so /3/Timeline renders the job as a single tree."""
+        from .observability import record, trace
         self.status = RUNNING
         self.start_time = time.time()
         record("job_start", job=self.key, description=self.description)
         try:
-            self.result = fn(self)
+            with trace("job", job=self.key, description=self.description):
+                self._mirror()
+                self.result = fn(self)
             if self.status == RUNNING:   # an external fail() wins the race
                 self.status = DONE
                 self.progress = 1.0
@@ -82,6 +90,21 @@ class Job:
             self._done.set()
             record("job_end", job=self.key, status=self.status,
                    duration_s=round(self.run_time, 4))
+            self._mirror()
+
+    def _mirror(self) -> None:
+        """Replicate a plain status stamp under ``!job/<key>``.
+
+        The Job object itself holds host state (threads, events) and
+        never leaves this process; the mirror is plain data, so when the
+        process is attached to a DKV coordinator the put crosses the RPC
+        boundary — the coordinator sees every member's jobs, and the
+        start-of-run mirror (inside the job's root trace) is what stitches
+        the coordinator's handler spans into the job's trace tree."""
+        try:
+            dkv.put(MIRROR_PREFIX + self.key, self.describe())
+        except Exception:               # noqa: BLE001 — status is best-effort
+            pass
 
     def start(self, fn: Callable[["Job"], Any]) -> "Job":
         """Run ``fn(self)`` on a background thread (async job)."""
@@ -132,6 +155,7 @@ class Job:
         self.traceback = "".join(traceback.format_exception(exc))
         self.end_time = time.time()
         self._done.set()
+        self._mirror()
 
     @property
     def is_running(self) -> bool:

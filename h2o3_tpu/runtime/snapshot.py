@@ -121,7 +121,7 @@ def maybe_snapshot(job, model, cursor: dict,
         log.warning("snapshot build for %s failed: %r", journal_uri, e)
         return None
     uri = _snapshot_uri(journal_uri)
-    task = (uri, payload, journal_uri, dict(cursor))
+    task = (uri, payload, journal_uri, dict(cursor), time.time())
     from .config import config
     if config().snapshot_async:
         _ensure_worker()
@@ -174,18 +174,24 @@ def _drain() -> None:
 
 
 def _write_task(task) -> None:
-    uri, payload, journal_uri, cursor = task
+    uri, payload, journal_uri, cursor, queued_ts = task
     from . import failure, recovery
-    from .observability import log, record
+    from .observability import log, observe, record
     t0 = time.time()
+    # lag = queue dwell before the writer picked the task up; a growing
+    # lag means the async writer is falling behind the snapshot cadence
+    lag = max(t0 - queued_ts, 0.0)
     try:
         failure.maybe_inject("snapshot_write")
         from .. import persist
         with persist.open_write(uri) as f:
             f.write(payload)
         prev = recovery.journal_update_snapshot(journal_uri, uri, cursor)
+        observe("snapshot_lag_seconds", lag)
+        observe("snapshot_write_seconds", time.time() - t0)
         record("snapshot_write", uri=uri, bytes=len(payload),
-               cursor=cursor, duration_s=round(time.time() - t0, 4))
+               cursor=cursor, lag_s=round(lag, 4),
+               duration_s=round(time.time() - t0, 4))
         if prev and prev != uri:
             try:
                 persist.delete(prev)

@@ -357,6 +357,21 @@ _TRAIN_COORD_KILL = textwrap.dedent("""
         except Exception:
             pass
         time.sleep(0.2)
+    # telemetry must survive the restart: the re-shipped heartbeat stamp
+    # (dkv._repush -> heartbeat.reship) must already carry this worker's
+    # metrics snapshot on the NEW coordinator incarnation — no gap until
+    # the next beat interval
+    hb_metrics = 0
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        try:
+            stamp = dkv._rpc("get", key="!hb/" + heartbeat.node_name())
+            if isinstance(stamp, dict) and stamp.get("metrics"):
+                hb_metrics = len(stamp["metrics"])
+                break
+        except Exception:
+            pass
+        time.sleep(0.2)
     from h2o3_tpu.runtime.observability import timeline_events
     evs = timeline_events(2000)
     print("WORKER_INFO", json.dumps({{
@@ -364,7 +379,12 @@ _TRAIN_COORD_KILL = textwrap.dedent("""
         "seen_epoch": dkv._seen_epoch,
         "fact": fact,
         "retries": sum(1 for e in evs if e["kind"] == "dkv_retry"),
-        "bumps": sum(1 for e in evs if e["kind"] == "dkv_epoch_bump")}}))
+        "bumps": sum(1 for e in evs if e["kind"] == "dkv_epoch_bump"),
+        "reships": sum(1 for e in evs if e["kind"] == "metrics_reship"),
+        "hb_metrics_after_bump": hb_metrics}}))
+    # join the beat thread before exit: a beat sampling device gauges
+    # mid-teardown can abort the interpreter from XLA's C++ side
+    heartbeat.stop(remove=False)
 """).format(nt=NTREES)
 
 
@@ -448,6 +468,10 @@ def test_coordinator_hard_kill_midtrain_rehydrate_reattach(cl, tmp_path):
         assert info["seen_epoch"] == ep2             # worker re-fenced
         assert info["fact"] == {"who": "worker", "n": 42}
         assert info["retries"] >= 1                  # outage was real
+        # telemetry re-shipped after the epoch bump: the new incarnation
+        # holds the worker's metrics without waiting out a beat interval
+        assert info["reships"] >= 1
+        assert info["hb_metrics_after_bump"] > 0
         np.testing.assert_allclose(np.load(worker_npy), np.load(base_npy),
                                    rtol=1e-4, atol=1e-4)
     finally:

@@ -158,5 +158,25 @@ def test_rest_routes_round_trip(tmp_path):
         assert js["traces"]
         nt = get("/3/NetworkTest")
         assert nt["results"]
+
+        # Timeline honors ?limit= and carries the cluster sections
+        from h2o3_tpu.runtime import observability as obs
+        for i in range(5):
+            obs.record("route_marker", i=i)
+        tl = get("/3/Timeline?limit=3")
+        assert len(tl["events"]) == 3
+        assert "counters" in tl and "nodes" in tl and "traces" in tl
+        lg = get("/3/Logs?limit=2")
+        assert len(lg["log"]) <= 2
+
+        # /metrics is Prometheus text exposition, not JSON; the in-process
+        # server scrapes the same registry this test writes to
+        obs.observe("route_scrape_seconds", 0.01, where="test")
+        with urllib.request.urlopen(f"{srv.url}/metrics") as r:
+            ctype = r.headers.get("Content-Type", "")
+            body = r.read().decode()
+        assert ctype.startswith("text/plain")
+        assert "# TYPE route_scrape_seconds histogram" in body
+        assert 'le=' in body
     finally:
         srv.stop()

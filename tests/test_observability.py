@@ -1,0 +1,247 @@
+"""Telemetry plane unit tests: metric registry (counters / gauges /
+mergeable histograms), wire + Prometheus rendering, span ok/error
+recording, trace propagation + forest stitching, and the per-node
+log-file handler lifecycle."""
+
+import logging
+import math
+import os
+
+import pytest
+
+from h2o3_tpu.runtime import observability as obs
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    prev = obs.set_enabled(True)
+    obs.reset_metrics()
+    yield
+    obs.reset_metrics()
+    obs.set_enabled(prev)
+
+
+# ------------------------------------------------------------------ metrics
+
+def test_registry_identity_by_name_and_labels():
+    a = obs.counter("reqs", op="put")
+    assert obs.counter("reqs", op="put") is a          # same series
+    assert obs.counter("reqs", op="get") is not a      # label split
+    assert obs.counter("other", op="put") is not a     # name split
+    # label values are stringified, so 1 and "1" are the same series
+    assert obs.gauge("g", shard=1) is obs.gauge("g", shard="1")
+
+
+def test_counter_gauge_semantics():
+    c = obs.counter("n_ops")
+    c.inc()
+    c.inc(2.5)
+    assert c.wire() == {"n": "n_ops", "l": {}, "t": "c", "v": 3.5}
+    g = obs.gauge("mem", kind="in_use")
+    g.set(100.0)
+    g.set(40.0)
+    assert g.value == 40.0                             # last-writer
+    w = obs.gauge("mem", kind="peak")
+    w.set_max(100.0)
+    w.set_max(40.0)
+    assert w.value == 100.0                            # watermark
+    assert g.wire()["l"] == {"kind": "in_use"}
+
+
+def test_histogram_bucketization_and_overflow():
+    h = obs.histogram("lat")
+    assert h.buckets == obs.LATENCY_BUCKETS
+    h.observe(0.0003)       # lands in the <= 5e-4 slot
+    h.observe(1e9)          # beyond the last edge -> +Inf overflow slot
+    i = obs.LATENCY_BUCKETS.index(0.0005)
+    assert h.counts[i] == 1
+    assert h.counts[-1] == 1
+    assert h.count == 2
+    assert h.sum == pytest.approx(0.0003 + 1e9)
+    w = h.wire()
+    assert w["t"] == "h" and len(w["c"]) == len(w["b"]) + 1
+
+
+def test_latency_buckets_are_log_spaced_and_monotone():
+    b = obs.LATENCY_BUCKETS
+    assert all(x < y for x, y in zip(b, b[1:]))
+    assert b[0] == pytest.approx(1e-4)
+    assert b[-1] == pytest.approx(500.0)
+
+
+def test_histogram_merge_by_summation():
+    h1 = obs.histogram("rpc")
+    for v in (0.001, 0.002, 10.0):
+        h1.observe(v)
+    a, b = h1.wire(), h1.wire()
+    merged = obs.merge_histograms([a, {"t": "c", "v": 1}, b])
+    assert merged["n_obs"] == 6
+    assert merged["s"] == pytest.approx(2 * h1.sum)
+    assert merged["c"] == [x * 2 for x in h1.counts]
+    bad = dict(b, b=[1.0, 2.0])
+    with pytest.raises(ValueError, match="bucket edges differ"):
+        obs.merge_histograms([a, bad])
+
+
+def test_merge_wire_adds_node_label():
+    obs.counter("x", op="put").inc()
+    snap = obs.metrics_wire()
+    merged = obs.merge_wire({"nodeA": snap, "nodeB": snap})
+    assert len(merged) == 2
+    assert {s["l"]["node"] for s in merged} == {"nodeA", "nodeB"}
+    assert all(s["l"]["op"] == "put" for s in merged)
+
+
+def test_enabled_switch_gates_instrumentation():
+    obs.set_enabled(False)
+    obs.inc("gated")
+    obs.observe("gated_h", 0.1)
+    obs.set_gauge("gated_g", 1.0)
+    assert obs.metrics_wire() == []
+    obs.set_enabled(True)
+    obs.inc("gated")
+    assert len(obs.metrics_wire()) == 1
+
+
+# --------------------------------------------------------------- prometheus
+
+def test_render_prometheus_text():
+    obs.counter("dkv_rpc_failures", op="put").inc()
+    obs.gauge("device_memory_bytes", device="0", kind="in_use").set(123.0)
+    h = obs.histogram("dkv_rpc_seconds", op="get", side="client")
+    h.observe(0.0002)
+    h.observe(0.0002)
+    h.observe(2.0)
+    text = obs.render_prometheus(cluster=False)
+    assert "# TYPE dkv_rpc_failures counter" in text
+    assert "# TYPE device_memory_bytes gauge" in text
+    assert "# TYPE dkv_rpc_seconds histogram" in text
+    me = obs.node_name()
+    assert f'dkv_rpc_failures{{node="{me}",op="put"}} 1.0' in text
+    # histogram buckets are CUMULATIVE and end with +Inf == _count
+    lines = [ln for ln in text.splitlines()
+             if ln.startswith("dkv_rpc_seconds_bucket")]
+    counts = [float(ln.rsplit(" ", 1)[1]) for ln in lines]
+    assert counts == sorted(counts)
+    assert 'le="+Inf"' in lines[-1] and counts[-1] == 3
+    assert f'dkv_rpc_seconds_count{{node="{me}",op="get",side="client"}} 3' \
+        in text
+    # flat count() counters surface as h2o3_events_total{kind=...}
+    obs.count("wal_records", 7)
+    text = obs.render_prometheus(cluster=False)
+    assert 'h2o3_events_total{kind="wal_records"' in text
+
+
+def test_prom_label_escaping():
+    assert obs._prom_labels({"msg": 'say "hi"'}) == r'{msg="say \"hi\""}'
+    assert obs._prom_name("tree.phase-seconds") == "tree_phase_seconds"
+
+
+# ------------------------------------------------------------------- traces
+
+def test_span_records_ok_and_error():
+    with obs.span("unit_ok", tag="a"):
+        pass
+    with pytest.raises(ValueError):
+        with obs.span("unit_err", tag="b"):
+            raise ValueError("boom")
+    evs = {e["kind"]: e for e in obs.timeline_events(2000)}
+    assert evs["unit_ok"]["ok"] is True
+    assert "error" not in evs["unit_ok"]
+    assert evs["unit_err"]["ok"] is False
+    assert evs["unit_err"]["error"] == "ValueError"
+    assert evs["unit_err"]["duration_s"] >= 0
+
+
+def test_span_outside_trace_allocates_no_ids():
+    with obs.span("unit_untraced"):
+        assert obs.current_trace() is None
+    ev = [e for e in obs.timeline_events(2000)
+          if e["kind"] == "unit_untraced"][-1]
+    assert "trace_id" not in ev and "span_id" not in ev
+
+
+def test_trace_nesting_and_rpc_adoption():
+    with obs.trace("unit_root"):
+        ctx = obs.current_trace()
+        assert ctx and ctx["trace_id"] and ctx["span_id"]
+        with obs.span("unit_child"):
+            inner = obs.current_trace()
+            assert inner["trace_id"] == ctx["trace_id"]
+            assert inner["span_id"] != ctx["span_id"]
+        # the handler side adopts the wire context verbatim
+        with obs.trace_context({"trace_id": "T", "span_id": "S"}):
+            with obs.span("unit_remote"):
+                pass
+    assert obs.current_trace() is None
+    evs = {e["kind"]: e for e in obs.timeline_events(2000)
+           if e["kind"].startswith("unit_")}
+    root, child = evs["unit_root"], evs["unit_child"]
+    assert child["trace_id"] == root["trace_id"]
+    assert child["parent_span"] == root["span_id"]
+    remote = evs["unit_remote"]
+    assert remote["trace_id"] == "T" and remote["parent_span"] == "S"
+
+
+def test_trace_forest_stitching():
+    events = [
+        {"ts": 1.0, "kind": "job", "trace_id": "t1", "span_id": "a"},
+        {"ts": 2.0, "kind": "tree_phase", "trace_id": "t1", "span_id": "b",
+         "parent_span": "a"},
+        {"ts": 3.0, "kind": "dkv_handle", "trace_id": "t1", "span_id": "c",
+         "parent_span": "missing"},       # shipped span, parent un-shipped
+        {"ts": 0.5, "kind": "job", "trace_id": "t0", "span_id": "z"},
+        {"ts": 4.0, "kind": "noise"},     # no ids -> excluded
+    ]
+    forest = obs.trace_forest(events)
+    assert [t["trace_id"] for t in forest] == ["t0", "t1"]  # by first ts
+    t1 = forest[1]
+    assert {s["span_id"] for s in t1["spans"]} == {"a", "c"}  # orphan=root
+    a = next(s for s in t1["spans"] if s["span_id"] == "a")
+    assert [s["span_id"] for s in a["children"]] == ["b"]
+
+
+def test_span_disabled_is_transparent():
+    obs.set_enabled(False)
+    n0 = len(obs.timeline_events(2000))
+    with obs.span("unit_gone"):
+        pass
+    assert len(obs.timeline_events(2000)) == n0
+
+
+# ----------------------------------------------------------------- log file
+
+def test_log_file_handler_lifecycle(tmp_path, monkeypatch):
+    from h2o3_tpu.runtime import config
+    template = str(tmp_path / "node_%h_%p.log")
+    monkeypatch.setenv("H2O3_TPU_LOG_FILE", template)
+    try:
+        config.reload()
+        path = template.replace("%h", __import__("socket").gethostname()) \
+                       .replace("%p", str(os.getpid()))
+        obs.log.warning("telemetry log-file smoke line")
+        assert os.path.exists(path)
+        assert "telemetry log-file smoke line" in open(path).read()
+        # the ring handler keeps working alongside the file
+        assert any("telemetry log-file smoke line" in ln
+                   for ln in obs.recent_logs())
+        obs.close_log_file()
+        assert not any(isinstance(h, logging.FileHandler)
+                       for h in obs.log.handlers)
+        obs.close_log_file()               # idempotent
+    finally:
+        monkeypatch.delenv("H2O3_TPU_LOG_FILE", raising=False)
+        config.reload()
+
+
+# ---------------------------------------------------------------------- api
+
+def test_api_timeline_limit_and_shape():
+    from h2o3_tpu.api.server import Api
+    for i in range(6):
+        obs.record("unit_api_marker", i=i)
+    out = Api().timeline(limit=4)
+    assert len(out["events"]) == 4
+    assert isinstance(out["counters"], dict)
+    assert isinstance(out["nodes"], dict)
+    assert isinstance(out["traces"], list)

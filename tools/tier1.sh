@@ -15,13 +15,23 @@
 # lines) and exits with pytest's return code — the rc is captured from
 # PIPESTATUS before the DOTS line so the tee/grep epilogue can never
 # mask a pytest failure (or a timeout's 124) from CI.
+#
+# Timing artifact: --durations=25 makes pytest print the slowest 25
+# tests; the block is extracted to tier1_durations.txt (override with
+# H2O3_TIER1_DURATIONS) so per-PR budget creep is attributable instead
+# of discovered at the timeout cliff.
 set -o pipefail
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log
 timeout -k 10 1700 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow and not heavy' --continue-on-collection-errors \
+    --durations=25 --durations-min=1.0 \
     -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
+durations_file=${H2O3_TIER1_DURATIONS:-/tmp/tier1_durations.txt}
+sed -n '/slowest.*durations/,/^[=]/p' /tmp/_t1.log | sed '$d' \
+    > "$durations_file" || true
+[ -s "$durations_file" ] && echo "DURATIONS_FILE=$durations_file"
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
     | tr -cd . | wc -c)
 exit $rc
