@@ -516,8 +516,73 @@ def orchestrate():
     print(json.dumps(rec), flush=True)
 
 
+def multichip_main():
+    """``--multichip``: the {8,16,32}-virtual-device scaling curve.
+
+    Runs the airlines-shape tree bench once per device count on the CPU
+    mesh (``--xla_force_host_platform_device_count``, hierarchical
+    ("hosts","chips") geometry via H2O3_TPU_HOSTS) and writes
+    MULTICHIP_r06.json with one ``{n_devices, trees_per_sec}`` entry per
+    point plus the 8→32 scaling ratio.  On real multi-host hardware the
+    same entry point produces the TPU curve — only the env differs.
+    Shape is the CPU-fallback shape (rows/trees overridable) so the
+    whole curve lands in minutes.
+    """
+    out_path = os.environ.get("H2O3_MULTICHIP_OUT", "MULTICHIP_r06.json")
+    rows = int(os.environ.get("H2O3_MULTICHIP_ROWS", 100_000))
+    trees = int(os.environ.get("H2O3_MULTICHIP_TREES", 10))
+    per_point_budget = int(os.environ.get("H2O3_MULTICHIP_BUDGET", 600))
+    points = ((8, 2), (16, 2), (32, 4))
+    entries = []
+    for n_dev, hosts in points:
+        t0 = time.time()
+        rec, err = _attempt({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS":
+                f"--xla_force_host_platform_device_count={n_dev}",
+            "H2O3_TPU_HOSTS": str(hosts),
+            "H2O3_TPU_REDUCE_MODE": "hier",
+            "H2O3_BENCH_SKIP_PROBE": "1",
+            "H2O3_BENCH_SKIP_SECONDARY": "1",
+            "H2O3_BENCH_ROWS": str(rows),
+            "H2O3_BENCH_TREES": str(trees),
+        }, per_point_budget)
+        entry = {"n_devices": n_dev, "hosts": hosts,
+                 "chips_per_host": n_dev // hosts,
+                 "trees_per_sec": rec["value"] if rec else 0.0,
+                 "wall_s": round(time.time() - t0, 1)}
+        if err:
+            entry["error"] = err
+        entries.append(entry)
+        print(json.dumps(entry), flush=True)
+    t8 = next((e["trees_per_sec"] for e in entries
+               if e["n_devices"] == 8), 0.0)
+    t32 = next((e["trees_per_sec"] for e in entries
+                if e["n_devices"] == 32), 0.0)
+    out = {
+        "bench": "xgboost_trees_per_sec_airlines_shape",
+        "rows": rows, "trees": trees,
+        "reduce_mode": "hier",
+        "mesh": "hierarchical (hosts, chips) virtual CPU mesh",
+        "entries": entries,
+        "scaling_8_to_32": round(t32 / t8, 3) if t8 else 0.0,
+        "note": ("virtual devices share one physical CPU: the curve "
+                 "validates the collective schedule and SPMD program at "
+                 "each geometry; real speedup requires the TPU pod "
+                 "(ROADMAP item 1 acceptance)"),
+    }
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    print(json.dumps({"multichip": out_path,
+                      "scaling_8_to_32": out["scaling_8_to_32"]}),
+          flush=True)
+
+
 if __name__ == "__main__":
     if "--worker" in sys.argv:
         worker_main()
+    elif "--multichip" in sys.argv:
+        multichip_main()
     else:
         orchestrate()

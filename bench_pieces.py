@@ -692,6 +692,109 @@ def obs_piece():
          note="span+histogram hooks on the hist level loop; bar is < 2%")
 
 
+def mesh_piece():
+    """Hierarchical-mesh data-plane proofs: the staged ICI+DCN schedule
+    vs the flat oracle, on whatever mesh the process booted with.
+
+    Three kinds of JSON lines:
+      - ``mesh_collective_proof`` (one per reduce_mode) — compiled-HLO
+        evidence: the flat schedule lowers to ONE all-reduce whose
+        replica group spans every device; the hier schedule lowers to
+        TWO all-reduces whose groups are (a) each host's chips and
+        (b) one rank per host — the dispatch-count pin that the staged
+        collective is really two stages,
+      - ``mesh_dcn_bytes`` — the cost-model arithmetic for a level-
+        histogram payload: an all-reduce over p ranks moves
+        2*bytes*(p-1)/p per rank, so the hier DCN stage has n_hosts
+        participants moving one ALREADY-REDUCED tensor per host, where
+        the flat ring has all n_devices ranks eligible to cross DCN,
+      - ``mesh_psum_flat`` / ``mesh_psum_hier`` — measured ms per
+        reduction of that payload (amortized fori-style, REPS deps).
+
+    The {8,16,32}-device trees/sec curve lives in ``bench.py
+    --multichip`` (fresh subprocess per device count); this piece proves
+    the schedule, not the scaling.
+
+    Usage (chip): python bench_pieces.py mesh
+    CPU smoke:    JAX_PLATFORMS=cpu H2O3_TPU_HOSTS=2 \\
+                  XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+                  python bench_pieces.py mesh
+    """
+    import re
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    import h2o3_tpu
+    from bench_util import timed_amortized
+    from h2o3_tpu.runtime.cluster import ROW_AXIS, cluster
+    from h2o3_tpu.runtime.compat import shard_map
+    from h2o3_tpu.runtime.mapreduce import psum_shards
+
+    cl = h2o3_tpu.init()
+    platform = jax.devices()[0].platform
+    hosts, chips = cl.n_hosts, cl.n_chips_per_host
+    n_dev = cl.n_row_shards
+    n = max(512 * n_dev, N_ROWS // 100 - (N_ROWS // 100) % (512 * n_dev))
+
+    def emit(**rec):
+        print(json.dumps({**rec, "platform": platform,
+                          "mesh": dict(cl.mesh.shape)}), flush=True)
+
+    # level-histogram payload: [3 planes, L leaves, F feats, B bins] f32
+    L = 32
+    payload_bytes = 3 * L * F * B * 4
+
+    def make_program(mode):
+        def body(x):
+            partial = jnp.sum(x) * jnp.ones((3, L, F, B), jnp.float32)
+            return psum_shards(partial, mode)
+        return jax.jit(shard_map(
+            body, mesh=cl.mesh, in_specs=P(ROW_AXIS), out_specs=P(),
+            check_vma=False))
+
+    x = jnp.ones((n,), jnp.float32)
+    for mode in ("flat", "hier"):
+        f = make_program(mode)
+        txt = f.lower(x).compile().as_text()
+        ars = [ln for ln in txt.splitlines() if "all-reduce" in ln
+               and "replica_groups" in ln]
+        groups = []
+        for ln in ars:
+            m = re.search(r"replica_groups=(\{\{.*?\}\})", ln)
+            if m:
+                groups.append(m.group(1)[:120])
+        emit(piece="mesh_collective_proof", reduce_mode=mode,
+             all_reduces=len(ars), replica_groups=groups,
+             expect=("1 group spanning all devices" if mode == "flat"
+                     else "stage 1: per-host chip rings; "
+                          "stage 2: one rank per host"))
+
+        def run(acc, xx, _f=f):
+            return _f(xx + acc * 0.0)[0, 0, 0, 0] * 1e-30
+
+        ms = timed_amortized(run, x, reps=REPS)
+        emit(piece=f"mesh_psum_{mode}", ms=round(ms, 3),
+             payload_bytes=payload_bytes)
+
+    # all-reduce over p ranks moves 2*bytes*(p-1)/p per rank; in the flat
+    # schedule every one of the n_dev ranks' transfers may cross DCN, in
+    # the staged schedule only the n_hosts-rank second stage touches DCN
+    # and its operand was already reduced chips-fold on ICI.
+    flat_dcn = 2 * payload_bytes * (n_dev - 1) / n_dev * hosts
+    hier_dcn = 2 * payload_bytes * (hosts - 1) / hosts * hosts \
+        if hosts > 1 else 0.0
+    emit(piece="mesh_dcn_bytes", payload_bytes=payload_bytes,
+         n_devices=n_dev, hosts=hosts, chips_per_host=chips,
+         flat_dcn_bytes=int(flat_dcn), hier_dcn_bytes=int(hier_dcn),
+         dcn_reduction=round(flat_dcn / hier_dcn, 2) if hier_dcn else None,
+         model="ring all-reduce: 2*B*(p-1)/p per rank; DCN ranks: "
+               "flat=all chips on every host, hier=one per host")
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "parse":
         parse_piece()
@@ -703,5 +806,7 @@ if __name__ == "__main__":
         deep_piece()
     elif len(sys.argv) > 1 and sys.argv[1] == "obs":
         obs_piece()
+    elif len(sys.argv) > 1 and sys.argv[1] == "mesh":
+        mesh_piece()
     else:
         main()

@@ -11,8 +11,10 @@ The XGBoost extension's CUDA ``gpu_hist`` is the performance target
 TPU-native redesign: scatter-adds are serialized on a vector machine, so the
 histogram becomes DENSE MATMULS on the MXU: one-hot(leaf) x (g,h,w) planes
 contracted with one-hot(bin codes) via einsum, blocked over rows to bound
-memory, shard_mapped over the mesh "rows" axis with a single ``psum`` as the
-cross-device reduce (replacing both the LocalMR pass and the MRTask tree).
+memory, shard_mapped over the mesh's ("hosts", "chips") row axes with the
+cross-device reduce staged ICI-then-DCN by runtime/mapreduce.psum_shards
+(replacing both the LocalMR pass and the MRTask tree; ``reduce_mode``
+picks flat/hier/check — see runtime/mapreduce.py).
 Split search and row partition are fused elementwise/gather passes.  All
 shapes static per tree level; one compile per (depth, F, B) geometry.
 """
@@ -25,19 +27,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
-try:
-    from jax import shard_map
-except ImportError:                       # jax<0.5: experimental namespace,
-    from jax.experimental.shard_map import shard_map as _shard_map_exp
-
-    def shard_map(*args, check_vma=None, **kw):   # check_vma spelled check_rep
-        if check_vma is not None:
-            kw["check_rep"] = check_vma
-        return _shard_map_exp(*args, **kw)
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ...runtime.cluster import cluster, ROW_AXIS
+from ...runtime.cluster import cluster, ROW_AXES, ROW_AXIS
+from ...runtime.compat import shard_map
+from ...runtime.mapreduce import checked_pair, psum_shards, \
+    resolve_reduce_mode
 
 
 def _row_sds(shape, dtype):
@@ -45,9 +41,31 @@ def _row_sds(shape, dtype):
     no VMA typing, where the plain struct is equivalent."""
     try:
         return jax.ShapeDtypeStruct(shape, dtype,
-                                    vma=frozenset({ROW_AXIS}))
+                                    vma=frozenset(ROW_AXES))
     except TypeError:
         return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _reduce_mode_dispatch(builder):
+    """Resolve ``reduce_mode`` in front of a cached builder.
+
+    ``""`` resolves to the configured mode so the LRU only ever caches
+    concretely-scheduled programs; ``"check"`` returns a flat/hier
+    checked pair (mapreduce.checked_pair) built from two cache entries.
+    ``cache_clear`` is preserved — conftest's compiled-program release
+    hook and cluster re-init both call it through the public name.
+    """
+    @functools.wraps(builder)
+    def wrapper(*args, reduce_mode: str = "", **kw):
+        mode = resolve_reduce_mode(reduce_mode or None)
+        if mode == "check":
+            return checked_pair(
+                builder(*args, reduce_mode="flat", **kw),
+                builder(*args, reduce_mode="hier", **kw),
+                what=builder.__name__)
+        return builder(*args, reduce_mode=mode, **kw)
+    wrapper.cache_clear = builder.cache_clear
+    return wrapper
 
 def _make_pallas_hist(L: int, F: int, B: int, n_local: int,
                       interpret: bool = False, precision: str = "bf16",
@@ -340,9 +358,9 @@ def offset_codes(codes, bin_counts, nbins: int):
 
 
 @functools.lru_cache(maxsize=None)
-def make_varbin_hist_fn(L: int, F: int, bin_counts: tuple, B: int,
-                        n_padded: int, force_impl: str = "",
-                        precision: str = "bf16"):
+def _make_varbin_hist_fn(L: int, F: int, bin_counts: tuple, B: int,
+                         n_padded: int, force_impl: str = "",
+                         precision: str = "bf16", reduce_mode: str = "hier"):
     """Variable-bin histogram with the DENSE output contract of
     make_hist_fn: (gcodes, leaf, g, h, w) -> H[3, L, F, B].
 
@@ -365,13 +383,16 @@ def make_varbin_hist_fn(L: int, F: int, bin_counts: tuple, B: int,
         out = inner(gcodes, leaf, g, h, w)             # [Q8, 3L]
         H = out[qmap_dense.reshape(-1)]                # [F*B, 3L]
         H = H.reshape(F, B, L, 3).transpose(3, 2, 0, 1)
-        return jax.lax.psum(H, ROW_AXIS)
+        return psum_shards(H, reduce_mode)
 
     specs_in = (P(None, ROW_AXIS), P(ROW_AXIS), P(ROW_AXIS), P(ROW_AXIS),
                 P(ROW_AXIS))
     f = shard_map(local_hist, mesh=cl.mesh, in_specs=specs_in, out_specs=P(),
                   check_vma=False)
     return jax.jit(f)
+
+
+make_varbin_hist_fn = _reduce_mode_dispatch(_make_varbin_hist_fn)
 
 
 def _make_einsum_hist(L: int, F: int, B: int, n_local: int, planes: int = 3):
@@ -405,7 +426,7 @@ def _make_einsum_hist(L: int, F: int, B: int, n_local: int, planes: int = 3):
             return acc, None
         H0 = jnp.zeros((planes, L, F, B), jnp.float32)
         if hasattr(jax.lax, "pcast"):     # jax<0.5 has no VMA typing
-            H0 = jax.lax.pcast(H0, (ROW_AXIS,), to='varying')
+            H0 = jax.lax.pcast(H0, ROW_AXES, to='varying')
         H, _ = jax.lax.scan(body, H0, (codes, leaf, S))
         return H
 
@@ -413,9 +434,9 @@ def _make_einsum_hist(L: int, F: int, B: int, n_local: int, planes: int = 3):
 
 
 @functools.lru_cache(maxsize=None)
-def make_hist_fn(L: int, F: int, B: int, n_padded: int,
-                 force_impl: str = "", precision: str = "bf16",
-                 planes: int = 3):
+def _make_hist_fn(L: int, F: int, B: int, n_padded: int,
+                  force_impl: str = "", precision: str = "bf16",
+                  planes: int = 3, reduce_mode: str = "hier"):
     """Compiled histogram: (codes[N,F], leaf[N], g[N], h[N], w[N]) ->
     H[planes, L, F, B] with planes (sum g, sum h, sum w[, sum |g|]),
     psum'd over the mesh.
@@ -445,7 +466,7 @@ def make_hist_fn(L: int, F: int, B: int, n_padded: int,
                                   planes=planes)
 
     def local_hist(codes, leaf, g, h, w):
-        return jax.lax.psum(inner(codes, leaf, g, h, w), ROW_AXIS)
+        return psum_shards(inner(codes, leaf, g, h, w), reduce_mode)
 
     specs_in = (P(None, ROW_AXIS), P(ROW_AXIS), P(ROW_AXIS), P(ROW_AXIS),
                 P(ROW_AXIS))
@@ -454,6 +475,9 @@ def make_hist_fn(L: int, F: int, B: int, n_padded: int,
     f = shard_map(local_hist, mesh=cl.mesh, in_specs=specs_in, out_specs=P(),
                   check_vma=False)
     return jax.jit(f)
+
+
+make_hist_fn = _reduce_mode_dispatch(_make_hist_fn)
 
 
 def _local_hist_impl(L: int, F: int, B: int, n_local: int, bin_counts=None,
@@ -500,9 +524,10 @@ def _local_hist_impl(L: int, F: int, B: int, n_local: int, bin_counts=None,
 
 
 @functools.lru_cache(maxsize=None)
-def make_subtract_level_fn(d: int, F: int, B: int, n_padded: int,
-                           bin_counts=None, force_impl: str = "",
-                           precision: str = "bf16"):
+def _make_subtract_level_fn(d: int, F: int, B: int, n_padded: int,
+                            bin_counts=None, force_impl: str = "",
+                            precision: str = "bf16",
+                            reduce_mode: str = "hier"):
     """Level-``d`` histogram via smaller-sibling row COMPACTION + parent
     subtraction — DHistogram / LightGBM / gpu_hist's classic halving,
     TPU-shaped (arXiv:1706.08359 §3.2).
@@ -544,7 +569,7 @@ def make_subtract_level_fn(d: int, F: int, B: int, n_padded: int,
     if d == 0:
         def local0(codes, leaf, g, h, w):
             Hl = inner(codes, leaf, g, h, w)
-            return jax.lax.psum(Hl, ROW_AXIS), Hl[None]
+            return psum_shards(Hl, reduce_mode), Hl[None]
 
         f = shard_map(local0, mesh=cl.mesh, in_specs=specs_row,
                       out_specs=(P(), P(ROW_AXIS)), check_vma=False)
@@ -587,7 +612,7 @@ def make_subtract_level_fn(d: int, F: int, B: int, n_padded: int,
         Hl_ = jnp.where(sl, Hs, Ho)
         Hr_ = jnp.where(sl, Ho, Hs)
         Hloc = jnp.stack([Hl_, Hr_], axis=2).reshape(3, Lc, F, B)
-        return jax.lax.psum(Hloc, ROW_AXIS), Hloc[None]
+        return psum_shards(Hloc, reduce_mode), Hloc[None]
 
     f = shard_map(locald, mesh=cl.mesh,
                   in_specs=specs_row + (P(ROW_AXIS),),
@@ -595,10 +620,14 @@ def make_subtract_level_fn(d: int, F: int, B: int, n_padded: int,
     return jax.jit(f)
 
 
+make_subtract_level_fn = _reduce_mode_dispatch(_make_subtract_level_fn)
+
+
 @functools.lru_cache(maxsize=None)
-def make_batched_level_fn(d: int, K: int, F: int, B: int, n_padded: int,
-                          bin_counts=None, force_impl: str = "",
-                          precision: str = "bf16", subtract: bool = True):
+def _make_batched_level_fn(d: int, K: int, F: int, B: int, n_padded: int,
+                           bin_counts=None, force_impl: str = "",
+                           precision: str = "bf16", subtract: bool = True,
+                           reduce_mode: str = "hier"):
     """Level-``d`` histograms for K trees in ONE kernel launch.
 
     The K-class multinomial round used to issue K separate level programs
@@ -632,7 +661,7 @@ def make_batched_level_fn(d: int, K: int, F: int, B: int, n_padded: int,
         def localf(codes, leafK, gK, hK, wK):
             Hl = jax.vmap(inner, in_axes=(None, 0, 0, 0, 0))(
                 codes, leafK, gK, hK, wK)
-            return jax.lax.psum(Hl, ROW_AXIS)
+            return psum_shards(Hl, reduce_mode)
 
         f = shard_map(localf, mesh=cl.mesh, in_specs=specs_k, out_specs=P(),
                       check_vma=False)
@@ -646,7 +675,7 @@ def make_batched_level_fn(d: int, K: int, F: int, B: int, n_padded: int,
         def local0(codes, leafK, gK, hK, wK):
             Hl = jax.vmap(inner, in_axes=(None, 0, 0, 0, 0))(
                 codes, leafK, gK, hK, wK)
-            return jax.lax.psum(Hl, ROW_AXIS), Hl[None]
+            return psum_shards(Hl, reduce_mode), Hl[None]
 
         f = shard_map(local0, mesh=cl.mesh, in_specs=specs_k,
                       out_specs=(P(), P(ROW_AXIS)), check_vma=False)
@@ -685,11 +714,14 @@ def make_batched_level_fn(d: int, K: int, F: int, B: int, n_padded: int,
             return jnp.stack([Hl_, Hr_], axis=2).reshape(3, Lc, F, B)
 
         HlocK = jax.vmap(one)(leafK, gK, hK, wK, HpK)
-        return jax.lax.psum(HlocK, ROW_AXIS), HlocK[None]
+        return psum_shards(HlocK, reduce_mode), HlocK[None]
 
     f = shard_map(locald, mesh=cl.mesh, in_specs=specs_k + (P(ROW_AXIS),),
                   out_specs=(P(), P(ROW_AXIS)), check_vma=False)
     return jax.jit(f)
+
+
+make_batched_level_fn = _reduce_mode_dispatch(_make_batched_level_fn)
 
 
 def sparse_slot_budget(F: int, B: int,
@@ -797,9 +829,10 @@ def _sparse_local_body(A_prev: int, A: int, F: int, cap: int, inner):
 
 
 @functools.lru_cache(maxsize=None)
-def make_sparse_level_fn(A_prev: int, A: int, F: int, B: int,
-                         n_padded: int, bin_counts=None,
-                         force_impl: str = "", precision: str = "bf16"):
+def _make_sparse_level_fn(A_prev: int, A: int, F: int, B: int,
+                          n_padded: int, bin_counts=None,
+                          force_impl: str = "", precision: str = "bf16",
+                          reduce_mode: str = "hier"):
     """Node-sparse deep-level histogram: [A, F, B] slots for ALIVE leaves
     instead of the dense [2^d, F, B] grid (ROADMAP item 1 — the CSR move
     the GPU tree-boosting literature sizes deep levels by).
@@ -830,7 +863,7 @@ def make_sparse_level_fn(A_prev: int, A: int, F: int, B: int,
 
     def locald(codes, sleaf, g, h, w, carry, ps_of_slot):
         Hloc = body(codes, sleaf, g, h, w, carry[0], ps_of_slot)
-        return jax.lax.psum(Hloc, ROW_AXIS), Hloc[None]
+        return psum_shards(Hloc, reduce_mode), Hloc[None]
 
     specs_in = (P(None, ROW_AXIS), P(ROW_AXIS), P(ROW_AXIS), P(ROW_AXIS),
                 P(ROW_AXIS), P(ROW_AXIS), P())
@@ -839,11 +872,15 @@ def make_sparse_level_fn(A_prev: int, A: int, F: int, B: int,
     return jax.jit(f)
 
 
+make_sparse_level_fn = _reduce_mode_dispatch(_make_sparse_level_fn)
+
+
 @functools.lru_cache(maxsize=None)
-def make_batched_sparse_level_fn(A_prev: int, A: int, K: int, F: int,
-                                 B: int, n_padded: int, bin_counts=None,
-                                 force_impl: str = "",
-                                 precision: str = "bf16"):
+def _make_batched_sparse_level_fn(A_prev: int, A: int, K: int, F: int,
+                                  B: int, n_padded: int, bin_counts=None,
+                                  force_impl: str = "",
+                                  precision: str = "bf16",
+                                  reduce_mode: str = "hier"):
     """K-tree node-sparse level in ONE kernel launch — the
     make_batched_level_fn contract at the sparse slot geometry.
 
@@ -864,12 +901,16 @@ def make_batched_sparse_level_fn(A_prev: int, A: int, K: int, F: int,
     def locald(codes, sleafK, gK, hK, wK, carry, psK):
         HlocK = jax.vmap(body, in_axes=(None, 0, 0, 0, 0, 0, 0))(
             codes, sleafK, gK, hK, wK, carry[0], psK)
-        return jax.lax.psum(HlocK, ROW_AXIS), HlocK[None]
+        return psum_shards(HlocK, reduce_mode), HlocK[None]
 
     specs_in = (P(None, ROW_AXIS),) * 5 + (P(ROW_AXIS), P())
     f = shard_map(locald, mesh=cl.mesh, in_specs=specs_in,
                   out_specs=(P(), P(ROW_AXIS)), check_vma=False)
     return jax.jit(f)
+
+
+make_batched_sparse_level_fn = \
+    _reduce_mode_dispatch(_make_batched_sparse_level_fn)
 
 
 def _make_pallas_fine_hist(L: int, F: int, W: int, K: int, nbins: int,
@@ -1010,7 +1051,7 @@ def _make_einsum_fine_hist(L: int, F: int, W: int, K: int, nbins: int,
             return acc, None
         H0 = jnp.zeros((3, L, F, K, W), jnp.float32)
         if hasattr(jax.lax, "pcast"):     # jax<0.5 has no VMA typing
-            H0 = jax.lax.pcast(H0, (ROW_AXIS,), to='varying')
+            H0 = jax.lax.pcast(H0, ROW_AXES, to='varying')
         H, _ = jax.lax.scan(body, H0, (codes, leaf, S))
         return H
 
@@ -1018,9 +1059,9 @@ def _make_einsum_fine_hist(L: int, F: int, W: int, K: int, nbins: int,
 
 
 @functools.lru_cache(maxsize=None)
-def make_fine_hist_fn(L: int, F: int, W: int, K: int, nbins: int,
-                      n_padded: int, force_impl: str = "",
-                      precision: str = "bf16"):
+def _make_fine_hist_fn(L: int, F: int, W: int, K: int, nbins: int,
+                       n_padded: int, force_impl: str = "",
+                       precision: str = "bf16", reduce_mode: str = "hier"):
     """Compiled fine-refinement histogram:
     (codes[F,N], leaf, g, h, w, sel[L,F,K]) -> H[3, L, F, K, W] where slot
     (l,f,k,t) sums rows with leaf l whose code == sel[l,f,k]*W + t
@@ -1043,13 +1084,16 @@ def make_fine_hist_fn(L: int, F: int, W: int, K: int, nbins: int,
                                        precision=precision)
 
     def local_hist(codes, leaf, g, h, w, sel):
-        return jax.lax.psum(inner(codes, leaf, g, h, w, sel), ROW_AXIS)
+        return psum_shards(inner(codes, leaf, g, h, w, sel), reduce_mode)
 
     specs_in = (P(None, ROW_AXIS), P(ROW_AXIS), P(ROW_AXIS), P(ROW_AXIS),
                 P(ROW_AXIS), P())
     f = shard_map(local_hist, mesh=cl.mesh, in_specs=specs_in, out_specs=P(),
                   check_vma=False)
     return jax.jit(f)
+
+
+make_fine_hist_fn = _reduce_mode_dispatch(_make_fine_hist_fn)
 
 
 def _soft_threshold(G, alpha):

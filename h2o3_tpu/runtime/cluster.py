@@ -13,11 +13,19 @@ the data plane.  Multi-process operation uses ``jax.distributed.initialize``
 over the mesh and all reductions are XLA collectives over ICI instead of the
 reference's MRTask RPC tree (water/MRTask.java:739-760).
 
-Axis names:
-  * ``"rows"``  — the data axis; Frames are row-sharded over it (the analog of
-    H2O chunk distribution, water/fvec/Vec.java:152 ESPC).
-  * ``"model"`` — optional second axis for feature/model sharding (the TP
-    analog for very wide Gram matrices, SURVEY.md §2.10).
+Axis names — the mesh is an explicit ``("hosts", "chips", "model")``
+hierarchy so collectives can be staged over the physical topology:
+  * ``"hosts"`` — the DCN axis: one slot per host (real hosts under
+    multi-process SPMD; VIRTUAL hosts carved out of the local devices via
+    ``H2O3_TPU_HOSTS`` / ``init(hosts=...)`` for CI and laptops).
+  * ``"chips"`` — the ICI axis: a host's chips, where psums ride the ring.
+  * ``"model"`` — optional axis for feature/model sharding (the TP analog
+    for very wide Gram matrices, SURVEY.md §2.10).
+  * ``ROW_AXIS`` — the data "rows" axis every Frame is sharded over — is
+    now the FLATTENED PRODUCT ``("hosts", "chips")``: PartitionSpecs,
+    shard_map specs and ``psum`` all accept the tuple, so existing call
+    sites keep working unchanged while ``runtime/mapreduce.py`` can stage
+    the reduce per physical axis (ICI first, then DCN).
 """
 
 from __future__ import annotations
@@ -30,8 +38,12 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-ROW_AXIS = "rows"
+HOST_AXIS = "hosts"
+CHIP_AXIS = "chips"
 MODEL_AXIS = "model"
+# the flattened data axis: hosts-major product, one name for call sites
+ROW_AXES = (HOST_AXIS, CHIP_AXIS)
+ROW_AXIS = ROW_AXES
 
 _lock = threading.Lock()
 _cluster: "Cluster | None" = None
@@ -66,7 +78,15 @@ class Cluster:
     # -- geometry ------------------------------------------------------------
     @property
     def n_row_shards(self) -> int:
-        return self.mesh.shape[ROW_AXIS]
+        return int(np.prod([self.mesh.shape[a] for a in ROW_AXES]))
+
+    @property
+    def n_hosts(self) -> int:
+        return self.mesh.shape[HOST_AXIS]
+
+    @property
+    def n_chips_per_host(self) -> int:
+        return self.mesh.shape[CHIP_AXIS]
 
     @property
     def n_devices(self) -> int:
@@ -94,26 +114,164 @@ class Cluster:
         }
 
 
-def init(devices=None, model_axis: int = 1, coordinator: str | None = None,
-         num_processes: int | None = None, process_id: int | None = None) -> Cluster:
+def _resolve_hosts(hosts: int | None, n_row: int) -> int:
+    """Host-axis size: explicit param > H2O3_TPU_HOSTS > process count > 1.
+
+    Auto-resolved sizes that don't divide the row-shard count degrade to a
+    single (flat) host with a telemetry event; an explicit ``hosts=``
+    argument that doesn't divide is a caller error.
+    """
+    explicit = hosts is not None
+    if hosts is None:
+        from .config import config
+        hosts = config().mesh_hosts or jax.process_count() or 1
+    if hosts < 1:
+        hosts = 1
+    if n_row % hosts:
+        if explicit:
+            raise ValueError(
+                f"hosts={hosts} must divide the row-shard count {n_row}")
+        from .observability import log, record
+        log.warning("mesh: hosts=%d does not divide %d row shards; "
+                    "falling back to a single flat host", hosts, n_row)
+        record("mesh_hosts_fallback", requested=hosts, n_row_shards=n_row)
+        hosts = 1
+    return hosts
+
+
+def _build_mesh(devices: list, hosts: int, model_axis: int) -> Mesh:
+    """(hosts, chips, model) grid over ``devices``.
+
+    Real multi-host topologies go through ``create_hybrid_device_mesh`` so
+    the chips axis maps onto each host's ICI ring and the hosts axis onto
+    DCN.  CPU/virtual devices lack the ``slice_index``/coords attributes it
+    needs, so single-host (and any failure) falls back to a process-sorted
+    reshape — hosts-major, which still keeps each virtual host's chips
+    contiguous.
+    """
+    n = len(devices)
+    chips = n // model_axis // hosts
+    if jax.process_count() > 1 and hosts == jax.process_count():
+        try:
+            from jax.experimental import mesh_utils
+            grid = mesh_utils.create_hybrid_device_mesh(
+                (1, chips * model_axis), (hosts, 1), devices=devices)
+            grid = np.asarray(grid).reshape(hosts, chips, model_axis)
+            return Mesh(grid, (HOST_AXIS, CHIP_AXIS, MODEL_AXIS))
+        except Exception as e:            # noqa: BLE001 — CPU/virtual mesh
+            from .observability import log
+            log.warning("mesh: create_hybrid_device_mesh unavailable (%r); "
+                        "using process-sorted reshape", e)
+    devs = sorted(devices, key=lambda d: (d.process_index, d.id))
+    grid = np.array(devs).reshape(hosts, chips, model_axis)
+    return Mesh(grid, (HOST_AXIS, CHIP_AXIS, MODEL_AXIS))
+
+
+def _invalidate_compiled_caches() -> None:
+    """Drop compiled programs that closed over a previous mesh.
+
+    The cached tree builders bind the live mesh at trace time via
+    ``shard_map``; after a rebuild those executables reference dead
+    devices.  Clearing the builder LRUs plus jax's global jit cache forces
+    a retrace against the new mesh.
+    """
+    for mod_name, names in (
+        ("..models.tree.hist", ("make_hist_fn", "make_fine_hist_fn",
+                                "make_varbin_hist_fn",
+                                "make_subtract_level_fn",
+                                "make_batched_level_fn",
+                                "make_sparse_level_fn",
+                                "make_batched_sparse_level_fn")),
+        ("..models.tree.shared", ("make_build_tree_fn", "make_tree_scan_fn",
+                                  "make_multinomial_scan_fn")),
+    ):
+        try:
+            import importlib
+            mod = importlib.import_module(mod_name, package=__package__)
+        except Exception:   # noqa: BLE001 — model layer optional at boot
+            continue
+        for name in names:
+            clear = getattr(getattr(mod, name, None), "cache_clear", None)
+            if clear is not None:
+                try:
+                    clear()
+                except Exception:         # noqa: BLE001
+                    pass
+    try:
+        jax.clear_caches()
+    except Exception:                     # noqa: BLE001
+        pass
+
+
+def publish_mesh_gauges(cl: "Cluster | None" = None) -> None:
+    """(Re-)emit the ``mesh_shape`` gauge, one series per mesh axis.
+
+    Separate helper (rather than inline in ``init``) so tests that reset
+    the metric registry can re-emit without re-booting the cluster.
+    """
+    from . import observability as obs
+    cl = cl if cl is not None else _cluster
+    if cl is None:
+        return
+    for axis, size in cl.mesh.shape.items():
+        obs.set_gauge("mesh_shape", size, axis=axis)
+    obs.set_gauge("mesh_shape", cl.n_devices, axis="total")
+
+
+def init(devices=None, model_axis: int | None = None,
+         coordinator: str | None = None,
+         num_processes: int | None = None, process_id: int | None = None,
+         hosts: int | None = None) -> Cluster:
     """Boot (or return) the cluster — analog of ``h2o.init()``.
 
     Single-host: builds a mesh over the local devices.  Multi-host: pass
     ``coordinator`` (+ ``num_processes``/``process_id`` or rely on the TPU
     environment) to run ``jax.distributed.initialize`` first; the mesh then
     spans all hosts' devices and collectives ride ICI/DCN.
+
+    ``hosts`` sizes the DCN axis of the mesh (default: ``H2O3_TPU_HOSTS``,
+    else the process count).  Re-calling with a geometry that differs from
+    the booted mesh REBUILDS it (with a ``cluster_reinit`` warning event and
+    a compiled-cache flush) instead of silently returning the stale mesh.
     """
     global _cluster
     with _lock:
         if _cluster is not None:
-            if (devices is None and model_axis == _cluster.mesh.shape[MODEL_AXIS]
-                    and coordinator is None):
-                return _cluster
-            if model_axis == 1 and devices is None and coordinator is None:
-                return _cluster
-            raise RuntimeError(
-                "cluster already booted with a different configuration; "
-                "call h2o3_tpu.shutdown() first to re-init")
+            if coordinator is not None:
+                raise RuntimeError(
+                    "cluster already booted; the distributed control plane "
+                    "cannot be re-initialized in-process — call "
+                    "h2o3_tpu.shutdown() first")
+            cur = _cluster.mesh
+            if devices is None and hosts is None and model_axis is None:
+                return _cluster           # default call: hand back the boot
+            req_devices = list(devices) if devices is not None \
+                else list(cur.devices.flat)
+            # unspecified axes keep their live size: a partial re-init
+            # (say init(hosts=4)) must not implicitly reset the others
+            req_model = model_axis if model_axis is not None \
+                else cur.shape[MODEL_AXIS]
+            n = len(req_devices)
+            if req_model < 1 or n % req_model:
+                raise ValueError(
+                    f"model_axis={req_model} must divide device count {n}")
+            req_hosts = _resolve_hosts(hosts, n // req_model)
+            if (req_devices == list(cur.devices.flat)
+                    and req_model == cur.shape[MODEL_AXIS]
+                    and req_hosts == cur.shape[HOST_AXIS]):
+                return _cluster           # same geometry re-stated
+            # geometry changed: the old behaviour either silently returned
+            # the cached mesh or refused — rebuild instead, loudly
+            from .observability import log, record
+            log.warning("cluster re-init: mesh %s -> devices=%d hosts=%d "
+                        "model_axis=%d; rebuilding and flushing compiled "
+                        "caches", dict(cur.shape), n, req_hosts, req_model)
+            record("cluster_reinit", old_shape=dict(cur.shape),
+                   new_devices=n, new_hosts=req_hosts,
+                   new_model_axis=req_model)
+            _invalidate_compiled_caches()
+            _cluster = None
+            devices, hosts, model_axis = req_devices, req_hosts, req_model
         if coordinator is not None:
             # `jax.process_count()` would itself initialize the XLA
             # backend, after which jax.distributed.initialize refuses to
@@ -144,17 +302,20 @@ def init(devices=None, model_axis: int = 1, coordinator: str | None = None,
                 dkv.attach(host, dkv_port)
         if devices is None:
             devices = jax.devices()
+        if model_axis is None:
+            model_axis = 1
         devices = list(devices)
         n = len(devices)
         if model_axis < 1 or n % model_axis:
             raise ValueError(f"model_axis={model_axis} must divide device count {n}")
-        dev_grid = np.array(devices).reshape(n // model_axis, model_axis)
-        mesh = Mesh(dev_grid, (ROW_AXIS, MODEL_AXIS))
+        n_hosts = _resolve_hosts(hosts, n // model_axis)
+        mesh = _build_mesh(devices, n_hosts, model_axis)
         _cluster = Cluster(mesh=mesh)
     from . import extensions, failure, heartbeat
     extensions.load_all()
     heartbeat.start()
     failure.start()                 # dead-member watchdog: detection ACTS
+    publish_mesh_gauges(_cluster)
     return _cluster
 
 

@@ -1,0 +1,26 @@
+"""jax version-compat shims, shared by every shard_map call site.
+
+One shim, three former copies (models/tree/hist.py, runtime/mapreduce.py,
+runtime/observability.network_test): jax >= 0.5 exposes ``jax.shard_map``
+with the replication checker spelled ``check_vma``; earlier versions ship
+``jax.experimental.shard_map.shard_map`` with the same knob spelled
+``check_rep``.  Callers here always use the modern ``check_vma`` spelling.
+"""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map as _shard_map
+
+    _CHECK_KW = "check_vma"
+except ImportError:                       # jax<0.5: experimental namespace
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, *args, check_vma=None, **kw):
+    """``jax.shard_map`` under either spelling of the replication checker."""
+    if check_vma is not None:
+        kw[_CHECK_KW] = check_vma
+    return _shard_map(f, *args, **kw)

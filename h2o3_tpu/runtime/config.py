@@ -61,6 +61,14 @@ class Config:
     metrics_enabled: bool = True
     log_file: Optional[str] = None
     hb_ship_events: int = 200
+    # mesh data plane (runtime/cluster.py + runtime/mapreduce.py):
+    # "hosts" axis size (0 = auto: jax.process_count(); single-host values
+    # > 1 carve VIRTUAL hosts out of the local devices for CI/laptops) and
+    # the cross-shard reduction strategy — "hier" psums within a host's
+    # ICI ring then across DCN, "flat" is the one-collective oracle,
+    # "check" runs both and raises on divergence
+    mesh_hosts: int = 0
+    reduce_mode: str = "hier"
 
     @staticmethod
     def from_env() -> "Config":
@@ -93,6 +101,8 @@ class Config:
             not in ("0", "false", "no"),
             log_file=e("H2O3_TPU_LOG_FILE") or None,
             hb_ship_events=int(e("H2O3_TPU_HB_SHIP_EVENTS", 200)),
+            mesh_hosts=int(e("H2O3_TPU_HOSTS", 0)),
+            reduce_mode=e("H2O3_TPU_REDUCE_MODE", "hier"),
         )
 
     def describe(self) -> dict:

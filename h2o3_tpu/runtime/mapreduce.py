@@ -9,29 +9,142 @@ tree.  Code shipping requires the whole Iced/Weaver serialization machinery
 
 TPU-native redesign: there is no code shipping — a traced, jit-compiled SPMD
 program IS the shipped code, and the reduce tree IS a hardware collective.
-``map_reduce`` wraps a per-shard function in ``shard_map`` over the mesh
-"rows" axis and combines partials with ``psum`` (ICI tree/ring reduce), which
-replaces both MRTask's RPC fan-out and its binary-tree reduce.  For most
-algorithms you don't even need this: operating on row-sharded arrays inside
-``jax.jit`` lets GSPMD insert the same collectives automatically — use
-``map_reduce`` when you want the per-shard view to be explicit (histograms,
-per-partition state).
+``map_reduce`` wraps a per-shard function in ``shard_map`` over the mesh's
+row axes and combines partials with ``psum``, which replaces both MRTask's
+RPC fan-out and its binary-tree reduce.
+
+The reduce is HIERARCHICAL on the ``("hosts", "chips")`` mesh
+(runtime/cluster.py): partials first psum around each host's ICI ring
+(``"chips"``), then one small cross-host psum rides DCN (``"hosts"``).
+That mirrors the reference's two-level reduce (node-local ForkJoin fold,
+then the RPC tree) and keeps the large pre-reduce tensors off the slow
+links.  The one-collective flat schedule stays available as the oracle
+behind ``reduce_mode``:
+
+  * ``"hier"``  — staged ICI-then-DCN psum (default; H2O3_TPU_REDUCE_MODE)
+  * ``"flat"``  — single psum over the flattened product axis
+  * ``"check"`` — run both whole programs and raise ``ReduceParityError``
+                  on divergence (the ``hist_mode="check"`` analog)
+
+For most algorithms you don't even need ``map_reduce``: operating on
+row-sharded arrays inside ``jax.jit`` lets GSPMD insert the collectives
+automatically — use it when the per-shard view must be explicit
+(histograms, per-partition state).
 """
 
 from __future__ import annotations
 
+import contextlib
 import functools
+import time
 from typing import Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
-try:
-    from jax import shard_map
-except ImportError:                       # jax<0.5: experimental namespace
-    from jax.experimental.shard_map import shard_map
 
-from .cluster import cluster, ROW_AXIS
+from .cluster import CHIP_AXIS, HOST_AXIS, ROW_AXES, ROW_AXIS, cluster
+from .compat import shard_map
+
+REDUCE_MODES = ("flat", "hier", "check")
+
+_forced_mode: str | None = None
+
+
+class ReduceParityError(AssertionError):
+    """flat and hier reductions disagreed (``reduce_mode="check"``)."""
+
+
+def resolve_reduce_mode(mode: str | None = None) -> str:
+    """Effective reduce mode: explicit arg > force_reduce_mode > config."""
+    if not mode:
+        mode = _forced_mode
+    if not mode:
+        from .config import config
+        mode = config().reduce_mode
+    if mode not in REDUCE_MODES:
+        raise ValueError(
+            f"reduce_mode={mode!r} not in {REDUCE_MODES}")
+    return mode
+
+
+@contextlib.contextmanager
+def force_reduce_mode(mode: str):
+    """Scoped override of the configured reduce mode (tests, benchmarks)."""
+    if mode not in REDUCE_MODES:
+        raise ValueError(f"reduce_mode={mode!r} not in {REDUCE_MODES}")
+    global _forced_mode
+    prev = _forced_mode
+    _forced_mode = mode
+    try:
+        yield
+    finally:
+        _forced_mode = prev
+
+
+def psum_shards(x, mode: str = ""):
+    """Sum ``x`` across every row shard, from inside a shard_map'd body.
+
+    ``"flat"`` is one collective over the flattened product axis (the
+    oracle).  ``"hier"`` stages it: psum around the host-local ``"chips"``
+    ring first (ICI), then one ``"hosts"`` psum of the per-host partials
+    (DCN) — same result, but the cross-host stage moves an already-reduced
+    tensor.  ``"check"`` compiles the hier schedule here; the flat-vs-hier
+    comparison runs one level up (``checked_pair``/``map_reduce``), where
+    both whole programs can execute and be compared on the host.
+    """
+    mode = resolve_reduce_mode(mode or None)
+    if mode == "flat":
+        return jax.lax.psum(x, ROW_AXES)
+    return jax.lax.psum(jax.lax.psum(x, CHIP_AXIS), HOST_AXIS)
+
+
+def assert_reduce_parity(flat, hier, what: str = "map_reduce") -> None:
+    """Compare flat/hier pytrees: bitwise first, tiny tolerance second.
+
+    Integer-valued float stats (counts, quantized gradients) reduce
+    bitwise-identically under both schedules; genuinely fractional floats
+    may differ by reassociation ulps, which get recorded (not raised).
+    Anything beyond tolerance raises ``ReduceParityError``.
+    """
+    from . import observability as obs
+    flat_l, treedef_f = jax.tree.flatten(flat)
+    hier_l, treedef_h = jax.tree.flatten(hier)
+    if treedef_f != treedef_h:
+        raise ReduceParityError(
+            f"{what}: flat/hier output structures differ: "
+            f"{treedef_f} vs {treedef_h}")
+    for i, (a, b) in enumerate(zip(flat_l, hier_l)):
+        a, b = np.asarray(a), np.asarray(b)
+        if a.shape == b.shape and a.tobytes() == b.tobytes():
+            continue
+        if a.shape == b.shape and np.allclose(a, b, rtol=1e-5, atol=1e-6,
+                                              equal_nan=True):
+            obs.record("reduce_parity_ulp", what=what, leaf=i)
+            continue
+        diff = np.max(np.abs(a.astype(np.float64) - b.astype(np.float64))) \
+            if a.shape == b.shape else float("inf")
+        raise ReduceParityError(
+            f"{what}: flat/hier reduction divergence at leaf {i} "
+            f"(shape {a.shape} vs {b.shape}, maxdiff {diff:.3e})")
+
+
+def checked_pair(flat_fn: Callable, hier_fn: Callable,
+                 what: str = "reduce") -> Callable:
+    """Run both mode-variants of a program, compare, return the hier result.
+
+    The ``reduce_mode="check"`` dispatcher: ``flat_fn``/``hier_fn`` are the
+    same compiled program built with the two schedules (e.g. two entries of
+    a builder's LRU cache keyed on ``reduce_mode``).
+    """
+    @functools.wraps(hier_fn)
+    def run(*args, **kw):
+        flat = flat_fn(*args, **kw)
+        hier = hier_fn(*args, **kw)
+        assert_reduce_parity(flat, hier, what=what)
+        return hier
+    return run
 
 
 def map_partitions(fn: Callable, *arrays, out_spec=P(ROW_AXIS)):
@@ -47,7 +160,25 @@ def map_partitions(fn: Callable, *arrays, out_spec=P(ROW_AXIS)):
     return jax.jit(f)(*arrays)
 
 
-def map_reduce(map_fn: Callable, *arrays):
+def _map_reduce_once(map_fn: Callable, arrays, mode: str):
+    from . import observability as obs
+    mesh = cluster().mesh
+
+    def shard_fn(*local):
+        partial = map_fn(*local)
+        return jax.tree.map(lambda x: psum_shards(x, mode), partial)
+
+    specs = tuple(P(ROW_AXIS, *([None] * (a.ndim - 1))) for a in arrays)
+    f = shard_map(shard_fn, mesh=mesh, in_specs=specs, out_specs=P())
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(jax.jit(f)(*arrays))
+    obs.observe("collective_seconds", time.perf_counter() - t0,
+                axis="chips+hosts" if mode == "hier" else "rows",
+                op="map_reduce")
+    return out
+
+
+def map_reduce(map_fn: Callable, *arrays, reduce_mode: str | None = None):
     """Full MRTask: per-shard map, then ``psum`` of the partials over rows.
 
     ``map_fn(*local_shards) -> pytree of partial reductions``; the result is
@@ -55,16 +186,17 @@ def map_reduce(map_fn: Callable, *arrays):
     Non-additive reductions (min/max) should be expressed by mapping into an
     additive/idempotent form first, exactly as reference MRTasks fold their
     state into arrays that reduce elementwise (e.g. DHistogram._vals adds).
+
+    ``reduce_mode`` picks the collective schedule (module docstring); the
+    default follows ``H2O3_TPU_REDUCE_MODE``/``force_reduce_mode``.
     """
-    mesh = cluster().mesh
-
-    def shard_fn(*local):
-        partial = map_fn(*local)
-        return jax.tree.map(lambda x: jax.lax.psum(x, ROW_AXIS), partial)
-
-    specs = tuple(P(ROW_AXIS, *([None] * (a.ndim - 1))) for a in arrays)
-    f = shard_map(shard_fn, mesh=mesh, in_specs=specs, out_specs=P())
-    return jax.jit(f)(*arrays)
+    mode = resolve_reduce_mode(reduce_mode)
+    if mode == "check":
+        flat = _map_reduce_once(map_fn, arrays, "flat")
+        hier = _map_reduce_once(map_fn, arrays, "hier")
+        assert_reduce_parity(flat, hier, what="map_reduce")
+        return hier
+    return _map_reduce_once(map_fn, arrays, mode)
 
 
 def psum_rows(x):

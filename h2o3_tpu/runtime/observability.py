@@ -596,44 +596,56 @@ def jstack() -> List[Dict]:
 def network_test(sizes=(1_024, 1_048_576, 16_777_216)) -> List[Dict]:
     """Collective-bandwidth micro-bench — water/api/NetworkTestHandler.
 
-    The reference times point-to-point UDP/TCP between cloud members;
-    the mesh analog is an all-reduce (psum) across every device at a few
-    payload sizes, which is exactly the traffic training generates.
+    The reference times point-to-point UDP/TCP between cloud members; the
+    mesh analog is an all-reduce (psum) at a few payload sizes, which is
+    exactly the traffic training generates.  Each size is timed per mesh
+    stage — the host-local ``"chips"`` ring (ICI), the cross-host
+    ``"hosts"`` axis (DCN), and the flat product axis — so the report
+    separates intra-host from inter-host bandwidth; every timing also
+    lands in the ``collective_seconds{axis,op}`` histogram.
     """
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
-    try:
-        from jax import shard_map
-    except ImportError:                   # jax<0.5: experimental namespace
-        from jax.experimental.shard_map import shard_map
-    from .cluster import cluster, ROW_AXIS
+    from .cluster import CHIP_AXIS, HOST_AXIS, ROW_AXES, ROW_AXIS, cluster
+    from .compat import shard_map
 
     cl = cluster()
-    rows = cl.mesh.shape[ROW_AXIS]
+    rows = cl.n_row_shards
+    stages = [("rows", ROW_AXES)]
+    if cl.mesh.shape[CHIP_AXIS] > 1:
+        stages.append(("chips", CHIP_AXIS))
+    if cl.mesh.shape[HOST_AXIS] > 1:
+        stages.append(("hosts", HOST_AXIS))
     results = []
     for size in sizes:
         n = max(size // 4, rows)
         n = (n // rows) * rows
         x = jnp.ones((n,), jnp.float32)
+        for axis_label, axis in stages:
+            def allred(v, _axis=axis):
+                return jax.lax.psum(v, _axis)
 
-        def allred(v):
-            return jax.lax.psum(v, ROW_AXIS)
-
-        f = jax.jit(shard_map(allred, mesh=cl.mesh,
-                              in_specs=P(ROW_AXIS), out_specs=P()))
-        np_out = f(x)
-        _ = float(np_out[0])                  # warmup + compile sync
-        t0 = time.perf_counter()
-        reps = 5
-        for _ in range(reps):
-            out = f(x)
-        _ = float(out[0])                     # fetch = sync point
-        dt = (time.perf_counter() - t0) / reps
-        results.append({
-            "bytes": int(n * 4),
-            "collective": "psum",
-            "seconds": dt,
-            "gbytes_per_sec": (n * 4 / max(dt, 1e-12)) / 1e9,
-        })
+            # out spec stays row-sharded: a single-stage psum still varies
+            # over the other row axis, so no replication can be claimed
+            f = jax.jit(shard_map(allred, mesh=cl.mesh,
+                                  in_specs=P(ROW_AXIS),
+                                  out_specs=P(ROW_AXIS),
+                                  check_vma=False))
+            np_out = f(x)
+            _ = float(np_out[0])              # warmup + compile sync
+            t0 = time.perf_counter()
+            reps = 5
+            for _ in range(reps):
+                out = f(x)
+            _ = float(out[0])                 # fetch = sync point
+            dt = (time.perf_counter() - t0) / reps
+            observe("collective_seconds", dt, axis=axis_label, op="psum")
+            results.append({
+                "bytes": int(n * 4),
+                "collective": "psum",
+                "axis": axis_label,
+                "seconds": dt,
+                "gbytes_per_sec": (n * 4 / max(dt, 1e-12)) / 1e9,
+            })
     return results

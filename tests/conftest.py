@@ -17,10 +17,16 @@ import os
 # baked into the config, so we must update the live config too (before any
 # backend is initialized).
 os.environ["JAX_PLATFORMS"] = "cpu"
+# H2O3_TPU_TEST_DEVICES sizes the virtual mesh (tools/tier1.sh runs the
+# suite at 16 at least once); default stays the historical 8.
+_n_dev = int(os.environ.get("H2O3_TPU_TEST_DEVICES", "8"))
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+        flags + f" --xla_force_host_platform_device_count={_n_dev}").strip()
+# default the hierarchical mesh to 2 virtual hosts so every suite run
+# exercises the ICI-then-DCN staged reduce, not just the flat path
+os.environ.setdefault("H2O3_TPU_HOSTS", "2")
 
 import jax  # noqa: E402
 
@@ -85,7 +91,8 @@ def _release_compiled_programs():
         from h2o3_tpu.models.tree import hist as _h, shared as _s
         for fn in (_h.make_hist_fn, _h.make_fine_hist_fn,
                    _h.make_varbin_hist_fn, _h.make_subtract_level_fn,
-                   _h.make_batched_level_fn,
+                   _h.make_batched_level_fn, _h.make_sparse_level_fn,
+                   _h.make_batched_sparse_level_fn,
                    _s.make_build_tree_fn, _s.make_tree_scan_fn,
                    _s.make_multinomial_scan_fn):
             fn.cache_clear()

@@ -48,7 +48,7 @@ def test_padding_and_sharding(cl):
     f = make_frame(cl)
     v = f.vec("age")
     assert v.padded_len % cl.row_multiple() == 0
-    assert v.data.sharding.spec[0] == "rows"
+    assert v.data.sharding.spec[0] == ("hosts", "chips")
     back = v.to_numpy()
     assert len(back) == 5
     assert np.isnan(back[2])
@@ -83,7 +83,7 @@ def test_matrix(cl):
     f = make_frame(cl)
     m = f.matrix(["age", "income"])
     assert m.shape == (f.padded_rows, 2)
-    assert m.sharding.spec[0] == "rows"
+    assert m.sharding.spec[0] == ("hosts", "chips")
 
 
 def test_dkv(cl):
@@ -156,10 +156,20 @@ def test_all_missing_column_rollups(cl):
     assert np.isnan(r.mean) and np.isnan(r.vmin)
 
 
-def test_reinit_conflict_raises(cl):
-    import pytest as _pytest
-    with _pytest.raises(RuntimeError):
-        h2o3_tpu.init(model_axis=4)
+def test_reinit_geometry_change_rebuilds(cl):
+    # re-init with a different geometry rebuilds the mesh (recording a
+    # cluster_reinit event) instead of raising or silently returning the
+    # stale cached one — see tests/test_mesh_hier.py for the full contract
+    from h2o3_tpu.runtime import observability as obs
+    try:
+        c2 = h2o3_tpu.init(model_axis=4)
+        assert dict(c2.mesh.shape)["model"] == 4
+        assert any(e.get("kind") == "cluster_reinit"
+                   for e in obs.timeline_events(1000))
+    finally:
+        restored = h2o3_tpu.init(model_axis=1)
+        assert dict(restored.mesh.shape)["model"] == 1
+        assert restored.n_row_shards == cl.n_row_shards
 
 
 def test_spill_and_transparent_restore(cl, rng):

@@ -125,7 +125,10 @@ def test_jstack_and_network_test():
     assert any("MainThread" in t["name"] for t in traces)
     assert all(t["traces"] for t in traces)
     res = network_test(sizes=(1024, 65536))
-    assert len(res) == 2
+    # one row per (size, reduction stage): the flat product axis plus the
+    # single-axis "chips" / "hosts" stages of the hierarchical schedule
+    assert {r["axis"] for r in res} == {"rows", "chips", "hosts"}
+    assert len(res) == 6
     assert all(r["gbytes_per_sec"] > 0 for r in res)
 
 

@@ -245,3 +245,35 @@ def test_api_timeline_limit_and_shape():
     assert isinstance(out["counters"], dict)
     assert isinstance(out["nodes"], dict)
     assert isinstance(out["traces"], list)
+
+
+# --------------------------------------------------------- mesh data plane
+
+def test_mesh_shape_gauge_and_collective_seconds(cl):
+    """The hierarchical data plane surfaces its geometry and timings:
+    publish_mesh_gauges() emits one mesh_shape gauge per mesh axis plus
+    the device total, and map_reduce records a collective_seconds
+    observation labelled with the collective schedule — all visible in
+    the GET /metrics Prometheus text."""
+    import jax.numpy as jnp
+    import numpy as np
+    from h2o3_tpu.runtime.cluster import publish_mesh_gauges
+    from h2o3_tpu.runtime.mapreduce import map_reduce
+
+    publish_mesh_gauges()        # re-emit: _clean_registry reset the gauges
+    x = jnp.asarray(np.arange(64, dtype=np.float32))
+    map_reduce(lambda d: jnp.sum(d), x, reduce_mode="hier")
+    map_reduce(lambda d: jnp.sum(d), x, reduce_mode="flat")
+    text = obs.render_prometheus(cluster=False)
+    me = obs.node_name()
+    assert "# TYPE mesh_shape gauge" in text
+    assert f'mesh_shape{{axis="hosts",node="{me}"}} {float(cl.n_hosts)}' \
+        in text
+    assert f'mesh_shape{{axis="chips",node="{me}"}} ' \
+        f'{float(cl.n_chips_per_host)}' in text
+    assert f'mesh_shape{{axis="total",node="{me}"}} ' \
+        f'{float(cl.n_row_shards)}' in text
+    assert "# TYPE collective_seconds histogram" in text
+    assert 'axis="chips+hosts"' in text      # staged hier schedule
+    assert 'axis="rows"' in text             # flat oracle
+    assert 'op="map_reduce"' in text

@@ -16,6 +16,10 @@
 #     recovery dir, the worker rides out the outage on its retry budget,
 #     fences the new epoch, and the model matches the uninterrupted run
 #     (tests/test_chaos.py),
+#   - mesh host-kill: the same hard-kill scenario on the hierarchical
+#     2-host ("hosts","chips") mesh with the staged ICI+DCN reduce
+#     engaged; a fresh process rebuilds the same mesh, resumes, and
+#     matches the uninterrupted run (tests/test_mesh_hier.py),
 #   - WAL+snapshot rehydration, epoch fencing/re-push, exactly-once
 #     dedup across a real SIGKILL, handler hardening
 #     (tests/test_dkv_wal.py),
@@ -30,5 +34,6 @@ cd "$(dirname "$0")/.."
 timeout -k 10 1500 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_chaos.py tests/test_dkv_wal.py tests/test_dkv_retry.py \
     tests/test_snapshot_recovery.py tests/test_failure.py \
+    tests/test_mesh_hier.py::test_mesh_host_kill_resume_verify \
     -q -p no:cacheprovider -p no:xdist -p no:randomly
 exit $?

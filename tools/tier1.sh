@@ -10,6 +10,8 @@
 # 1500), and PR 5's fused-split parity suite + mid-multinomial-round
 # chaos row add ~150 s, so the budget is 1700 s — same ~1.4x headroom
 # over a clean run.  Keep the ratio when tier-1 grows again.
+# The 16-device mesh re-run at the bottom has its own 300 s budget
+# (~45 s clean) on top.
 #
 # Prints DOTS_PASSED=<n> (count of passing-test dots in the progress
 # lines) and exits with pytest's return code — the rc is captured from
@@ -34,4 +36,16 @@ sed -n '/slowest.*durations/,/^[=]/p' /tmp/_t1.log | sed '$d' \
 [ -s "$durations_file" ] && echo "DURATIONS_FILE=$durations_file"
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
     | tr -cd . | wc -c)
+# Second pass on a 16-device virtual mesh (4 hosts x 4 chips): the main
+# suite is pinned at 8 devices, so the mesh/data-plane contract tests
+# re-run here at the larger geometry at least once per tier-1 run.
+# Focused (one module) to keep the added wall clock ~1 min.
+timeout -k 10 300 env JAX_PLATFORMS=cpu H2O3_TPU_TEST_DEVICES=16 \
+    H2O3_TPU_HOSTS=4 python -m pytest tests/test_mesh_hier.py \
+    --deselect 'tests/test_mesh_hier.py::test_parity_on_larger_virtual_mesh[16-2]' \
+    --deselect 'tests/test_mesh_hier.py::test_parity_on_larger_virtual_mesh[32-4]' \
+    -q -p no:cacheprovider -p no:xdist -p no:randomly
+rc16=$?
+echo MESH16_RC=$rc16
+[ "$rc" -eq 0 ] && rc=$rc16
 exit $rc
