@@ -28,6 +28,7 @@ finishes inside the reserve.  The orchestrator always prints a JSON record and
 exits 0.
 """
 
+import contextlib
 import json
 import os
 import subprocess
@@ -42,6 +43,35 @@ REFERENCE_MERGE_10M_S = 4.0       # best of Jenkins merge interval (10M rows)
 # H2O3_BENCH_ROWS/TREES: smoke-test overrides (CI runs the full shape)
 N_ROWS = int(os.environ.get("H2O3_BENCH_ROWS", 10_000_000))
 N_TREES = int(os.environ.get("H2O3_BENCH_TREES", 50))
+
+
+def _ledger_totals():
+    """(total_compiles, total_compile_s) from the xprof compile ledger —
+    zeros when the runtime (or the ledger) is unavailable."""
+    try:
+        from h2o3_tpu.runtime import xprof
+        snap = xprof.ledger_snapshot()
+        return snap["total_compiles"], snap["total_compile_s"]
+    except Exception:                    # noqa: BLE001 — bench never dies
+        return 0, 0.0
+
+
+@contextlib.contextmanager
+def _compile_split(extra, section):
+    """Split a bench section's wall clock into compile vs steady time via
+    compile-ledger deltas, so the regression gate can tell "kernel got
+    slower" from "compile got slower"."""
+    c0, s0 = _ledger_totals()
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        wall = time.perf_counter() - t0
+        c1, s1 = _ledger_totals()
+        if c1 > c0:
+            extra[f"{section}_compile_s"] = round(s1 - s0, 3)
+            extra[f"{section}_steady_s"] = round(
+                max(wall - (s1 - s0), 0.0), 3)
 
 
 def make_airlines_like(n):
@@ -346,7 +376,8 @@ def worker_main():
     import jax
     extra = {"platform": jax.devices()[0].platform,
              "rows": N_ROWS, "trees": N_TREES}
-    tps = bench_trees(Frame, T_CAT, XGBoost)
+    with _compile_split(extra, "xgboost"):
+        tps = bench_trees(Frame, T_CAT, XGBoost)
     if os.environ.get("H2O3_BENCH_SKIP_SECONDARY"):
         extra["secondaries"] = "skipped"
     else:
@@ -362,7 +393,8 @@ def worker_main():
             extra["higgs_frame_error"] = repr(e)[:200]
         try:
             from h2o3_tpu.models import GLM
-            dt_glm = bench_glm(Frame, GLM, higgs_fr)
+            with _compile_split(extra, "glm"):
+                dt_glm = bench_glm(Frame, GLM, higgs_fr)
             glm_base = REFERENCE_GLM_HIGGS_S * N_ROWS \
                 / REFERENCE_GLM_HIGGS_ROWS
             extra["glm_higgs_shape_sec"] = round(dt_glm, 3)
@@ -375,7 +407,8 @@ def worker_main():
             extra["glm_error"] = repr(e)[:200]
         try:
             from h2o3_tpu.models import GBM
-            dt = _timed_gbm(GBM, higgs_fr, "y")
+            with _compile_split(extra, "gbm_higgs"):
+                dt = _timed_gbm(GBM, higgs_fr, "y")
             base = REFERENCE_GBM_HIGGS_S * min(N_ROWS,
                                                REFERENCE_GBM_HIGGS_ROWS) \
                 / REFERENCE_GBM_HIGGS_ROWS
@@ -414,7 +447,8 @@ def worker_main():
         try:
             import tempfile
             from h2o3_tpu.frame.parse import parse_csv
-            dt, mb = bench_parse(parse_csv, tempfile.gettempdir())
+            with _compile_split(extra, "parse"):
+                dt, mb = bench_parse(parse_csv, tempfile.gettempdir())
             extra["parse_csv_sec"] = round(dt, 3)
             extra["parse_csv_mb"] = round(mb, 1)
             extra["parse_mb_per_sec"] = round(mb / dt, 1)
@@ -432,6 +466,10 @@ def worker_main():
                                                       / dt_merge, 3)
         except Exception as e:
             extra["rapids_error"] = repr(e)[:200]
+    compiles, compile_s = _ledger_totals()
+    if compiles:
+        extra["compiles_total"] = compiles
+        extra["compile_s_total"] = round(compile_s, 3)
     print(json.dumps({
         "metric": "xgboost_trees_per_sec_airlines10m_shape",
         "value": round(tps, 3),

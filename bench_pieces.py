@@ -692,6 +692,127 @@ def obs_piece():
          note="span+histogram hooks on the hist level loop; bar is < 2%")
 
 
+def xprof_piece():
+    """Device-timing overhead bench: the same subtract-path level loop as
+    ``obs_piece``, dispatched through the compile-ledger ``_Program``
+    wrappers three ways — ``H2O3_TPU_DEVICE_TIMING=off`` (baseline),
+    ``sampled`` (every Nth dispatch block-until-ready into
+    ``tree_phase_device_seconds``), and ``full`` (every dispatch).
+
+    ``sampled`` is the mode training keeps on, so its cost must vanish
+    against a real kernel dispatch: the acceptance bar is < 2% overhead
+    vs ``off``.  Also proves the ledger side: the loop's programs appear
+    in ``ledger_snapshot()`` and the sampled run lands observations in
+    ``tree_phase_device_seconds``.
+
+    Usage (chip): python bench_pieces.py xprof
+    CPU smoke:    JAX_PLATFORMS=cpu H2O3_PIECES_ROWS=200000 \\
+                  python bench_pieces.py xprof
+    """
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    import h2o3_tpu
+    from h2o3_tpu.models.tree.hist import (make_subtract_level_fn,
+                                           offset_codes)
+    from h2o3_tpu.runtime import config as _config
+    from h2o3_tpu.runtime import observability as obs
+    from h2o3_tpu.runtime import xprof
+
+    cl = h2o3_tpu.init()
+    platform = jax.devices()[0].platform
+    n = N_ROWS - (N_ROWS % (512 * cl.n_row_shards))
+    force = "" if platform == "tpu" else "pallas_interpret"
+    reps = max(REPS // 4, 3)
+
+    key = jax.random.PRNGKey(0)
+    ks = jax.random.split(key, 16)
+    codes = jnp.stack([
+        jax.random.randint(ks[f], (n,), 0, min(bc, NBINS), dtype=jnp.int32)
+        for f, bc in enumerate(BIN_COUNTS)], axis=0)
+    gcodes = offset_codes(codes, BIN_COUNTS, NBINS)
+    g = jax.random.normal(ks[8], (n,), jnp.float32)
+    h = jnp.abs(jax.random.normal(ks[9], (n,), jnp.float32)) + 0.1
+    w = jnp.ones((n,), jnp.float32)
+
+    # same warmed leaf/carry chain as obs_piece; the level fns are
+    # _Program wrappers, so every eager call below goes through the
+    # ledger dispatch path that maybe_device_sync hooks
+    chain = []
+    leaf = jnp.zeros(n, jnp.int32)
+    fn0 = make_subtract_level_fn(0, F, B, n, bin_counts=BIN_COUNTS,
+                                 force_impl=force)
+    _, carry = fn0(gcodes, leaf, g, h, w)
+    for d in range(1, 6):
+        bit = (jax.random.uniform(ks[10 + (d % 6)], (n,)) < 0.3) \
+            .astype(jnp.int32)
+        leaf = 2 * leaf + bit
+        fn_d = make_subtract_level_fn(d, F, B, n, bin_counts=BIN_COUNTS,
+                                      force_impl=force)
+        H, next_carry = fn_d(gcodes, leaf, g, h, w, carry)   # warmup
+        jax.block_until_ready(H)
+        chain.append((fn_d, leaf, carry))
+        carry = next_carry
+
+    prev_env = os.environ.get("H2O3_TPU_DEVICE_TIMING")
+    prev_enabled = obs.set_enabled(True)
+
+    def set_mode(mode: str) -> None:
+        os.environ["H2O3_TPU_DEVICE_TIMING"] = mode
+        _config.reload()                 # re-reads env; resets telemetry
+        obs.set_enabled(True)            # timing only records when on
+
+    def run_loop() -> float:
+        t0 = _time.perf_counter()
+        for _ in range(reps):
+            for fn_d, lf, cr in chain:
+                H, _ = fn_d(gcodes, lf, g, h, w, cr)
+                jax.block_until_ready(H)
+        return (_time.perf_counter() - t0) * 1e3 / (reps * len(chain))
+
+    def emit(**rec):
+        print(json.dumps({**rec, "platform": platform, "rows": n,
+                          "reps": reps}), flush=True)
+
+    try:
+        set_mode("off")
+        run_loop()                                    # loop warmup
+        ms_off = run_loop()
+        set_mode("sampled")
+        ms_sampled = run_loop()
+        set_mode("full")
+        ms_full = run_loop()
+    finally:
+        if prev_env is None:
+            os.environ.pop("H2O3_TPU_DEVICE_TIMING", None)
+        else:
+            os.environ["H2O3_TPU_DEVICE_TIMING"] = prev_env
+        _config.reload()
+        obs.set_enabled(prev_enabled)
+
+    series = {s["n"] for s in obs.metrics_wire()}
+    snap = xprof.ledger_snapshot()
+    emit(piece="xprof_off", ms=round(ms_off, 4))
+    emit(piece="xprof_sampled", ms=round(ms_sampled, 4))
+    emit(piece="xprof_full", ms=round(ms_full, 4))
+    pct_sampled = 100.0 * (ms_sampled - ms_off) / ms_off
+    pct_full = 100.0 * (ms_full - ms_off) / ms_off
+    emit(piece="xprof_summary",
+         overhead_pct_sampled=round(pct_sampled, 3),
+         overhead_pct_full=round(pct_full, 3),
+         device_series="tree_phase_device_seconds" in series,
+         ledger_programs=len(snap["programs"]),
+         ledger_compiles=snap["total_compiles"],
+         ok=bool(pct_sampled < 2.0),
+         note="sampled block-until-ready on the per-level loop; "
+              "bar is < 2% vs off")
+
+
 def mesh_piece():
     """Hierarchical-mesh data-plane proofs: the staged ICI+DCN schedule
     vs the flat oracle, on whatever mesh the process booted with.
@@ -806,6 +927,8 @@ if __name__ == "__main__":
         deep_piece()
     elif len(sys.argv) > 1 and sys.argv[1] == "obs":
         obs_piece()
+    elif len(sys.argv) > 1 and sys.argv[1] == "xprof":
+        xprof_piece()
     elif len(sys.argv) > 1 and sys.argv[1] == "mesh":
         mesh_piece()
     else:

@@ -17,6 +17,7 @@ from __future__ import annotations
 import json
 import os
 import re
+import tempfile
 import threading
 import time
 import traceback
@@ -1091,9 +1092,49 @@ class Api:
 
     def prometheus(self) -> str:
         """GET /metrics — Prometheus text exposition: this process's
-        registry plus every heartbeating node's shipped snapshot."""
+        registry plus every heartbeating node's shipped snapshot.
+
+        Device-memory gauges refresh at SCRAPE time (not just on
+        heartbeat beats), so ``device_memory_bytes`` is current however
+        infrequently the beat thread runs."""
+        from ..runtime import cluster
         from ..runtime.observability import render_prometheus
+        try:
+            cluster.sample_memory_gauges()
+        except Exception:                # noqa: BLE001 — scrape never 500s
+            pass
         return render_prometheus(cluster=True)
+
+    def profiler_start(self, logdir: str = "", **kw) -> dict:
+        """POST /3/Profiler/start — begin an on-demand jax.profiler device
+        trace (TensorBoard-viewable).  Idempotent: a start while a capture
+        is live is a recorded no-op, not a 500."""
+        from ..runtime import observability as obs
+        if not logdir:
+            logdir = os.path.join(tempfile.gettempdir(),
+                                  f"h2o3_tpu_trace_{os.getpid()}")
+        started = obs.start_device_trace(logdir)
+        return {"started": started, "active": obs.profiler_active(),
+                "logdir": logdir}
+
+    def profiler_stop(self, **kw) -> dict:
+        """POST /3/Profiler/stop — stop the live device trace (no-op when
+        none is running)."""
+        from ..runtime import observability as obs
+        stopped = obs.stop_device_trace()
+        return {"stopped": stopped, "active": obs.profiler_active()}
+
+    def profiler_memory(self) -> bytes:
+        """GET /3/Profiler/memory — pprof-format device memory profile
+        (``jax.profiler.device_memory_profile``), served as octet-stream."""
+        import jax.profiler
+        return jax.profiler.device_memory_profile()
+
+    def compile_ledger(self) -> dict:
+        """GET /3/Profiler/compiles — the compile ledger as JSON (same
+        data the ``compile_seconds``/``program_*`` series expose)."""
+        from ..runtime import xprof
+        return xprof.ledger_snapshot()
 
     def logs(self, limit=500, **kw) -> dict:
         from ..runtime.observability import recent_logs
@@ -1224,6 +1265,8 @@ class H2OServer:
                 lambda a, c: a.nps_list(c),
             r"/3/FrameChunks/([^/]+)": lambda a, k: a.frame_chunks(k),
             r"/3/Recovery": lambda a, **kw: a.recovery_status(**kw),
+            r"/3/Profiler/memory": lambda a: a.profiler_memory(),
+            r"/3/Profiler/compiles": lambda a: a.compile_ledger(),
         }
         _Handler.routes_post = {
             r"/3/Parse": lambda a, **kw: a.parse(**kw),
@@ -1263,6 +1306,8 @@ class H2OServer:
             r"/99/ImportSQLTable": lambda a, **kw:
                 a.import_sql_table(**kw),
             r"/3/Shutdown": lambda a, **kw: a.shutdown(**kw),
+            r"/3/Profiler/start": lambda a, **kw: a.profiler_start(**kw),
+            r"/3/Profiler/stop": lambda a, **kw: a.profiler_stop(**kw),
         }
         _Handler.routes_delete = {
             r"/3/DKV/([^/]+)": lambda a, k: a.remove(k),

@@ -82,8 +82,14 @@ class RollupStats:
         return self.nrows - self.nmissing > 0 and self.vmin == self.vmax
 
 
-@functools.partial(jax.jit, static_argnames=("n",))
-def _batch_rollup_kernel(X, n: int):
+def _ledger(name, jitted, orig=None, **kw):
+    """Register a compiled frame seam with the compile ledger
+    (runtime/xprof) — the parse/rollup side of the ledger."""
+    from ..runtime import xprof
+    return xprof.register_program(name, jitted, orig=orig, **kw)
+
+
+def _batch_rollup_kernel_impl(X, n: int):
     """Rollups for a whole [C, padded] column block in ONE fused pass —
     per-column eager rollups cost a dispatch round trip each on a
     tunnelled backend (measured 203 s for a 481-column frame)."""
@@ -104,8 +110,14 @@ def _batch_rollup_kernel(X, n: int):
             nzero)
 
 
-@jax.jit
-def _rollup_kernel(data, valid):
+_batch_rollup_kernel = _ledger(
+    "frame_rollup_batch",
+    jax.jit(_batch_rollup_kernel_impl, static_argnames=("n",)),
+    static_argnums=(1,), static_argnames=("n",),
+    orig=_batch_rollup_kernel_impl)
+
+
+def _rollup_kernel_impl(data, valid):
     """One fused pass computing all rollup stats for a numeric column."""
     present = valid & ~jnp.isnan(data)
     x = jnp.where(present, data, 0.0)
@@ -120,6 +132,10 @@ def _rollup_kernel(data, valid):
     vmax = jnp.max(jnp.where(present, data, -big))
     nzero = jnp.sum(present & (data == 0.0))
     return n, mean, var * nf / jnp.maximum(nf - 1.0, 1.0), vmin, vmax, nzero
+
+
+_rollup_kernel = _ledger("frame_rollup", jax.jit(_rollup_kernel_impl),
+                         orig=_rollup_kernel_impl)
 
 
 class Vec:

@@ -202,15 +202,23 @@ def _make_family(name: str, params) -> _Family:
 
 
 # ------------------------------------------------------------------- kernels
-@jax.jit
-def _gram_kernel(X, w):
+def _ledger(name, jitted, orig=None):
+    """Register a compiled GLM seam with the compile ledger (runtime/xprof)."""
+    from ..runtime import xprof
+    return xprof.register_program(name, jitted, orig=orig)
+
+
+def _gram_kernel_impl(X, w):
     """Weighted Gram X'WX — the GramTask analog (gram/Gram.java:1017)."""
     Xw = X * w[:, None]
     return Xw.T @ X
 
 
+_gram_kernel = _ledger("glm_gram", jax.jit(_gram_kernel_impl),
+                       orig=_gram_kernel_impl)
+
+
 def _make_irls_step(family: _Family):
-    @jax.jit
     def step(X, y, w, beta, offset):
         eta = X @ beta + offset
         mu = family.linkinv(eta)
@@ -223,7 +231,7 @@ def _make_irls_step(family: _Family):
         xtwz = Xw.T @ z
         dev = family.deviance(y, mu, w)
         return gram, xtwz, dev
-    return step
+    return _ledger("glm_irls", jax.jit(step), orig=step)
 
 
 def _make_path_runner(family: _Family, l1_mode: bool, max_iter: int,
@@ -251,7 +259,6 @@ def _make_path_runner(family: _Family, l1_mode: bool, max_iter: int,
         Xw = X * wi[:, None]
         return Xw.T @ X, Xw.T @ z, family.deviance(y, mu, w)
 
-    @jax.jit
     def run(X, y, w, offset, lambdas, alpha, penalize, beta0, n,
             beta_eps):
         P = beta0.shape[0]
@@ -312,11 +319,10 @@ def _make_path_runner(family: _Family, l1_mode: bool, max_iter: int,
         gram_fin, _, dev_fin = irls_gram(X, y, w, beta_fin, offset)
         return betas, devs, iters, gram_fin, dev_fin
 
-    return run
+    return _ledger("glm_path", jax.jit(run), orig=run)
 
 
 def _make_softmax_stats(nclasses: int):
-    @jax.jit
     def stats(X, y, w, beta, offset):
         """Per-class diagonal-block Newton quantities for multinomial."""
         eta = X @ beta + offset[:, None]
@@ -335,7 +341,7 @@ def _make_softmax_stats(nclasses: int):
             grams.append(Xw.T @ X)
             xtwz.append(Xw.T @ zk)
         return jnp.stack(grams), jnp.stack(xtwz).T, ll, probs
-    return stats
+    return _ledger("glm_softmax", jax.jit(stats), orig=stats)
 
 
 # -------------------------------------------------------------------- solver

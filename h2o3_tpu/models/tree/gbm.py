@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 from typing import Optional
 
 import jax
@@ -360,10 +361,15 @@ class GBM(SharedTree):
                     # kill/resume while node-sparse deep levels are live
                     failure.maybe_inject("deep_level")
                 from ...runtime import observability as obs
+                from ...runtime import xprof
+                t0 = time.perf_counter()
                 with obs.span("tree_chunk", job=job.key, chunk=chunk_no,
                               trees=c, classes=K):
                     F, lv, vals, cov = scan_fn(wcodes, Y1, w, F, edges_mat,
                                                rng, chunk_no, c, *scalars)
+                # true device time for the whole K-tree chunk (sampled
+                # block-until-ready; no-op with H2O3_TPU_DEVICE_TIMING=off)
+                xprof.maybe_device_sync("tree_chunk", chunk_no, t0, F)
                 for k in range(K):
                     lv_k = [tuple(lvd[i][:, k] for i in range(4))
                             for lvd in lv]
@@ -418,10 +424,15 @@ class GBM(SharedTree):
                     # kill/resume while node-sparse deep levels are live
                     failure.maybe_inject("deep_level")
                 from ...runtime import observability as obs
+                from ...runtime import xprof
+                t0 = time.perf_counter()
                 with obs.span("tree_chunk", job=job.key, chunk=chunk_no,
                               trees=c):
                     F, lv, vals, cov = scan_fn(wcodes, y, w, F, edges_mat,
                                                rng, chunk_no, c, *scalars, 0)
+                # true device time for the whole tree chunk (sampled
+                # block-until-ready; no-op with H2O3_TPU_DEVICE_TIMING=off)
+                xprof.maybe_device_sync("tree_chunk", chunk_no, t0, F)
                 chunk = StackedTrees(lv, vals, cov)
                 chunks.append(chunk)
                 job.update(t_done / p.ntrees, f"tree {t_done}/{p.ntrees}")

@@ -46,6 +46,16 @@ def _row_sds(shape, dtype):
         return jax.ShapeDtypeStruct(shape, dtype)
 
 
+def _ledger(name, jitted, orig=None, **kw):
+    """Register a compiled seam with the compile ledger (runtime/xprof).
+
+    Deferred import: hist is importable without the runtime observability
+    stack loaded.  The wrapper is call-compatible with the jitted product
+    (transparent under a trace; AOT + timed compile when eager)."""
+    from ...runtime import xprof
+    return xprof.register_program(name, jitted, orig=orig, **kw)
+
+
 def _reduce_mode_dispatch(builder):
     """Resolve ``reduce_mode`` in front of a cached builder.
 
@@ -389,7 +399,7 @@ def _make_varbin_hist_fn(L: int, F: int, bin_counts: tuple, B: int,
                 P(ROW_AXIS))
     f = shard_map(local_hist, mesh=cl.mesh, in_specs=specs_in, out_specs=P(),
                   check_vma=False)
-    return jax.jit(f)
+    return _ledger("hist_varbin", jax.jit(f), orig=f)
 
 
 make_varbin_hist_fn = _reduce_mode_dispatch(_make_varbin_hist_fn)
@@ -474,7 +484,7 @@ def _make_hist_fn(L: int, F: int, B: int, n_padded: int,
     # iotas, which the vma checker can't see through pallas_call
     f = shard_map(local_hist, mesh=cl.mesh, in_specs=specs_in, out_specs=P(),
                   check_vma=False)
-    return jax.jit(f)
+    return _ledger("hist_uniform", jax.jit(f), orig=f)
 
 
 make_hist_fn = _reduce_mode_dispatch(_make_hist_fn)
@@ -573,7 +583,7 @@ def _make_subtract_level_fn(d: int, F: int, B: int, n_padded: int,
 
         f = shard_map(local0, mesh=cl.mesh, in_specs=specs_row,
                       out_specs=(P(), P(ROW_AXIS)), check_vma=False)
-        return jax.jit(f)
+        return _ledger("hist_subtract", jax.jit(f), orig=f)
 
     def locald(codes, leaf, g, h, w, carry):
         Hp = carry[0]                              # this shard's [3,Lp,F,B]
@@ -617,7 +627,7 @@ def _make_subtract_level_fn(d: int, F: int, B: int, n_padded: int,
     f = shard_map(locald, mesh=cl.mesh,
                   in_specs=specs_row + (P(ROW_AXIS),),
                   out_specs=(P(), P(ROW_AXIS)), check_vma=False)
-    return jax.jit(f)
+    return _ledger("hist_subtract", jax.jit(f), orig=f)
 
 
 make_subtract_level_fn = _reduce_mode_dispatch(_make_subtract_level_fn)
@@ -665,7 +675,7 @@ def _make_batched_level_fn(d: int, K: int, F: int, B: int, n_padded: int,
 
         f = shard_map(localf, mesh=cl.mesh, in_specs=specs_k, out_specs=P(),
                       check_vma=False)
-        return jax.jit(f)
+        return _ledger("hist_batched", jax.jit(f), orig=f)
 
     cap = n_local // 2 if d > 0 else n_local
     inner = _local_hist_impl(Lp, F, B, cap, bin_counts=bin_counts,
@@ -679,7 +689,7 @@ def _make_batched_level_fn(d: int, K: int, F: int, B: int, n_padded: int,
 
         f = shard_map(local0, mesh=cl.mesh, in_specs=specs_k,
                       out_specs=(P(), P(ROW_AXIS)), check_vma=False)
-        return jax.jit(f)
+        return _ledger("hist_batched", jax.jit(f), orig=f)
 
     def locald(codes, leafK, gK, hK, wK, carry):
         HpK = carry[0]                             # [K, 3, Lp, F, B]
@@ -718,7 +728,7 @@ def _make_batched_level_fn(d: int, K: int, F: int, B: int, n_padded: int,
 
     f = shard_map(locald, mesh=cl.mesh, in_specs=specs_k + (P(ROW_AXIS),),
                   out_specs=(P(), P(ROW_AXIS)), check_vma=False)
-    return jax.jit(f)
+    return _ledger("hist_batched", jax.jit(f), orig=f)
 
 
 make_batched_level_fn = _reduce_mode_dispatch(_make_batched_level_fn)
@@ -869,7 +879,7 @@ def _make_sparse_level_fn(A_prev: int, A: int, F: int, B: int,
                 P(ROW_AXIS), P(ROW_AXIS), P())
     f = shard_map(locald, mesh=cl.mesh, in_specs=specs_in,
                   out_specs=(P(), P(ROW_AXIS)), check_vma=False)
-    return jax.jit(f)
+    return _ledger("hist_sparse", jax.jit(f), orig=f)
 
 
 make_sparse_level_fn = _reduce_mode_dispatch(_make_sparse_level_fn)
@@ -906,7 +916,7 @@ def _make_batched_sparse_level_fn(A_prev: int, A: int, K: int, F: int,
     specs_in = (P(None, ROW_AXIS),) * 5 + (P(ROW_AXIS), P())
     f = shard_map(locald, mesh=cl.mesh, in_specs=specs_in,
                   out_specs=(P(), P(ROW_AXIS)), check_vma=False)
-    return jax.jit(f)
+    return _ledger("hist_batched_sparse", jax.jit(f), orig=f)
 
 
 make_batched_sparse_level_fn = \
@@ -1090,7 +1100,7 @@ def _make_fine_hist_fn(L: int, F: int, W: int, K: int, nbins: int,
                 P(ROW_AXIS), P())
     f = shard_map(local_hist, mesh=cl.mesh, in_specs=specs_in, out_specs=P(),
                   check_vma=False)
-    return jax.jit(f)
+    return _ledger("hist_fine", jax.jit(f), orig=f)
 
 
 make_fine_hist_fn = _reduce_mode_dispatch(_make_fine_hist_fn)
@@ -1465,6 +1475,34 @@ def finish_splits(rec, min_rows, min_split_improvement, feat_mask=None):
     return feat, bin_, na_left, best_gain, valid, children
 
 
+def _fused_best_splits_impl(Hist, nbins: int, reg_lambda, min_rows,
+                            min_split_improvement, feat_mask=None,
+                            reg_alpha=0.0, gamma=0.0, min_child_weight=0.0,
+                            force_impl: str = ""):
+    rec = split_records(Hist, nbins, reg_lambda, min_rows, reg_alpha,
+                        gamma, min_child_weight, force_impl=force_impl)
+    return finish_splits(rec, min_rows, min_split_improvement, feat_mask)
+
+
+_FUSED_SPLIT_PROGRAM = None
+
+
+def _fused_split_program():
+    """Lazy compile-ledger registration of the fused split program:
+    traced callers (the build loop) inline the plain impl exactly as
+    before; eager callers (crosschecks, benches) get the AOT path with
+    timed compiles and cost gauges."""
+    global _FUSED_SPLIT_PROGRAM
+    if _FUSED_SPLIT_PROGRAM is None:
+        _FUSED_SPLIT_PROGRAM = _ledger(
+            "fused_split",
+            jax.jit(_fused_best_splits_impl,
+                    static_argnames=("nbins", "force_impl")),
+            static_argnums=(1,), static_argnames=("nbins", "force_impl"),
+            orig=_fused_best_splits_impl)
+    return _FUSED_SPLIT_PROGRAM
+
+
 def fused_best_splits(Hist, nbins: int, reg_lambda, min_rows,
                       min_split_improvement, feat_mask=None,
                       reg_alpha=0.0, gamma=0.0, min_child_weight=0.0,
@@ -1477,9 +1515,10 @@ def fused_best_splits(Hist, nbins: int, reg_lambda, min_rows,
     features picks the same (f, b) — both resolve ties toward the lowest
     flat index.  Call inside jit (traces inline; the records kernel is the
     only launch)."""
-    rec = split_records(Hist, nbins, reg_lambda, min_rows, reg_alpha,
-                        gamma, min_child_weight, force_impl=force_impl)
-    return finish_splits(rec, min_rows, min_split_improvement, feat_mask)
+    return _fused_split_program()(
+        Hist, nbins, reg_lambda, min_rows, min_split_improvement,
+        feat_mask, reg_alpha, gamma, min_child_weight,
+        force_impl=force_impl)
 
 
 def fused_best_splits_batched(HistK, nbins: int, reg_lambda, min_rows,

@@ -34,7 +34,8 @@ from ..datainfo import DataInfo, ColumnSpec
 from ..scorekeeper import stop_early, metric_direction
 from ..distributions import make_distribution
 from .binning import BinnedFrame, fit_bins, encode_bins
-from .hist import (make_hist_fn, make_fine_hist_fn, make_varbin_hist_fn,
+from .hist import (_ledger, make_hist_fn, make_fine_hist_fn,
+                   make_varbin_hist_fn,
                    make_subtract_level_fn, make_batched_level_fn,
                    make_sparse_level_fn, make_batched_sparse_level_fn,
                    sparse_slot_budget, sparse_slot_maps,
@@ -835,7 +836,7 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
                 .astype(jnp.float32)
             return levels, vals, cover, leaf
 
-        return jax.jit(buildK)
+        return _ledger("tree_build_batched", jax.jit(buildK), orig=buildK)
     if not hier and hist_mode == "subtract":
         level_fns = [
             make_subtract_level_fn(
@@ -1088,7 +1089,7 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
         cover = jnp.stack([cl, cr], axis=1).reshape(-1).astype(jnp.float32)
         return levels, vals, cover, leaf
 
-    return jax.jit(build)
+    return _ledger("tree_build", jax.jit(build), orig=build)
 
 
 def resolve_mono(params, di) -> Optional[tuple]:
@@ -1565,7 +1566,9 @@ def make_tree_scan_fn(mode: str, tweedie_power: float, quantile_alpha: float,
         Ff, (lv, vals, covers) = jax.lax.scan(body, F0, keys)
         return Ff, list(lv), vals, covers
 
-    return jax.jit(scan_fn, donate_argnums=(3,), static_argnums=(7,))
+    return _ledger("tree_scan",
+                   jax.jit(scan_fn, donate_argnums=(3,), static_argnums=(7,)),
+                   static_argnums=(7,), orig=scan_fn)
 
 
 @functools.lru_cache(maxsize=None)
@@ -1687,7 +1690,9 @@ def make_multinomial_scan_fn(K: int, max_depth: int, nbins: int, F: int,
         Ff, (lv, vals, covers) = jax.lax.scan(body, F0, keys)
         return Ff, list(lv), vals, covers
 
-    return jax.jit(scan_fn, donate_argnums=(3,), static_argnums=(7,))
+    return _ledger("tree_scan_multinomial",
+                   jax.jit(scan_fn, donate_argnums=(3,), static_argnums=(7,)),
+                   static_argnums=(7,), orig=scan_fn)
 
 
 # jitted-program caches keyed on distribution parameters (pure functions of

@@ -173,8 +173,12 @@ def _invalidate_compiled_caches() -> None:
     The cached tree builders bind the live mesh at trace time via
     ``shard_map``; after a rebuild those executables reference dead
     devices.  Clearing the builder LRUs plus jax's global jit cache forces
-    a retrace against the new mesh.
+    a retrace against the new mesh.  The xprof compile ledger is marked
+    first, so every recompile this flush causes is attributed to
+    ``recompiles_total{reason="cluster_reinit"}``.
     """
+    from . import xprof
+    xprof.invalidate("cluster_reinit")
     for mod_name, names in (
         ("..models.tree.hist", ("make_hist_fn", "make_fine_hist_fn",
                                 "make_varbin_hist_fn",
@@ -311,11 +315,12 @@ def init(devices=None, model_axis: int | None = None,
         n_hosts = _resolve_hosts(hosts, n // model_axis)
         mesh = _build_mesh(devices, n_hosts, model_axis)
         _cluster = Cluster(mesh=mesh)
-    from . import extensions, failure, heartbeat
+    from . import extensions, failure, heartbeat, xprof
     extensions.load_all()
     heartbeat.start()
     failure.start()                 # dead-member watchdog: detection ACTS
     publish_mesh_gauges(_cluster)
+    xprof.install_monitoring_listener()   # /jax/core/compile backstop
     return _cluster
 
 

@@ -69,6 +69,12 @@ class Config:
     # "check" runs both and raises on divergence
     mesh_hosts: int = 0
     reduce_mode: str = "hier"
+    # device/compiler observability (runtime/xprof.py): true device-phase
+    # timing mode — "off" (host dispatch only), "sampled" (block-until-
+    # ready every Nth eager dispatch; bounded overhead), "full" (every
+    # dispatch) — and the sampled-mode stride
+    device_timing: str = "off"
+    device_timing_sample: int = 4
 
     @staticmethod
     def from_env() -> "Config":
@@ -103,6 +109,9 @@ class Config:
             hb_ship_events=int(e("H2O3_TPU_HB_SHIP_EVENTS", 200)),
             mesh_hosts=int(e("H2O3_TPU_HOSTS", 0)),
             reduce_mode=e("H2O3_TPU_REDUCE_MODE", "hier"),
+            device_timing=e("H2O3_TPU_DEVICE_TIMING", "off"),
+            device_timing_sample=int(
+                e("H2O3_TPU_DEVICE_TIMING_SAMPLE", 4)),
         )
 
     def describe(self) -> dict:

@@ -160,18 +160,45 @@ def map_partitions(fn: Callable, *arrays, out_spec=P(ROW_AXIS)):
     return jax.jit(f)(*arrays)
 
 
+# per-map_fn xprof wrappers, weakly keyed: a stable map_fn (module-level
+# task) reuses its AOT-compiled program across calls instead of paying
+# jax a fresh trace+compile per invocation; throwaway lambdas vanish
+# with their entry.  Keyed further by (mode, ndims) since the shard_map
+# specs depend on the operand ranks.
+_MR_PROGRAMS: "weakref.WeakKeyDictionary[Callable, dict]" = None  # type: ignore
+
+
+def _mr_program(map_fn: Callable, arrays, mode: str):
+    global _MR_PROGRAMS
+    if _MR_PROGRAMS is None:
+        import weakref
+        _MR_PROGRAMS = weakref.WeakKeyDictionary()
+    from . import xprof
+    mesh = cluster().mesh
+    key = (mode, tuple(a.ndim for a in arrays), id(mesh))
+    try:
+        per_fn = _MR_PROGRAMS.setdefault(map_fn, {})
+    except TypeError:                    # unweakrefable callable
+        per_fn = {}
+    prog = per_fn.get(key)
+    if prog is None:
+        def shard_fn(*local):
+            partial = map_fn(*local)
+            return jax.tree.map(lambda x: psum_shards(x, mode), partial)
+
+        specs = tuple(P(ROW_AXIS, *([None] * (a.ndim - 1)))
+                      for a in arrays)
+        f = shard_map(shard_fn, mesh=mesh, in_specs=specs, out_specs=P())
+        prog = xprof.register_program("map_reduce", jax.jit(f))
+        per_fn[key] = prog
+    return prog
+
+
 def _map_reduce_once(map_fn: Callable, arrays, mode: str):
     from . import observability as obs
-    mesh = cluster().mesh
-
-    def shard_fn(*local):
-        partial = map_fn(*local)
-        return jax.tree.map(lambda x: psum_shards(x, mode), partial)
-
-    specs = tuple(P(ROW_AXIS, *([None] * (a.ndim - 1))) for a in arrays)
-    f = shard_map(shard_fn, mesh=mesh, in_specs=specs, out_specs=P())
+    prog = _mr_program(map_fn, arrays, mode)
     t0 = time.perf_counter()
-    out = jax.block_until_ready(jax.jit(f)(*arrays))
+    out = jax.block_until_ready(prog(*arrays))
     obs.observe("collective_seconds", time.perf_counter() - t0,
                 axis="chips+hosts" if mode == "hier" else "rows",
                 op="map_reduce")

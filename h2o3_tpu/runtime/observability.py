@@ -558,17 +558,66 @@ def trace_forest(events: Iterable[dict]) -> List[dict]:
 
 # ----------------------------------------------------------- device traces
 
-def start_device_trace(logdir: str) -> None:
-    """Begin a jax.profiler trace (TensorBoard-viewable device timeline)."""
+_profiler_active = False
+
+
+def profiler_active() -> bool:
+    """Whether a device trace started HERE is currently capturing."""
+    with _lock:
+        return _profiler_active
+
+
+def start_device_trace(logdir: str) -> bool:
+    """Begin a jax.profiler trace (TensorBoard-viewable device timeline).
+
+    Idempotent: a second start while a capture is live (including one
+    jax.profiler reports out-of-band) records a ``profiler_noop`` event
+    and returns False instead of propagating ``RuntimeError`` — the REST
+    profiler route must never 500 a double-click.  Returns whether a new
+    capture actually started; ``profiler_active`` gauges 1 while one is
+    live (shipped in node snapshots like every other gauge)."""
+    global _profiler_active
     import jax
-    jax.profiler.start_trace(logdir)
+    with _lock:
+        active = _profiler_active
+    if active:
+        record("profiler_noop", op="start", reason="already_active")
+        return False
+    try:
+        jax.profiler.start_trace(logdir)
+    except RuntimeError as e:
+        record("profiler_noop", op="start", reason="jax_runtime",
+               error=str(e)[:200])
+        return False
+    with _lock:
+        _profiler_active = True
+    set_gauge("profiler_active", 1.0)
     record("profiler_start", logdir=logdir)
+    return True
 
 
-def stop_device_trace() -> None:
+def stop_device_trace() -> bool:
+    """Stop the live device trace; a stop with no capture running records
+    ``profiler_noop`` and returns False (idempotent, like start)."""
+    global _profiler_active
     import jax
-    jax.profiler.stop_trace()
+    with _lock:
+        active = _profiler_active
+    if not active:
+        record("profiler_noop", op="stop", reason="not_active")
+        return False
+    try:
+        jax.profiler.stop_trace()
+    except RuntimeError as e:
+        record("profiler_noop", op="stop", reason="jax_runtime",
+               error=str(e)[:200])
+        return False
+    finally:
+        with _lock:
+            _profiler_active = False
+        set_gauge("profiler_active", 0.0)
     record("profiler_stop")
+    return True
 
 
 # ------------------------------------------------------------- diagnostics

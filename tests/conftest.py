@@ -75,6 +75,39 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.slow)
 
 
+def pytest_sessionfinish(session, exitstatus):
+    """Compile-stats artifact (tools/tier1.sh sets the path env var).
+
+    Top-10 slowest compiled programs plus the total recompile count from
+    the runtime's compile ledger, written next to the durations artifact
+    so per-PR compile-time creep is attributable the same way wall-clock
+    creep is."""
+    path = os.environ.get("H2O3_TIER1_COMPILE_STATS")
+    if not path:
+        return
+    try:
+        from h2o3_tpu.runtime import xprof
+        snap = xprof.ledger_snapshot()
+    except Exception:
+        return
+    progs = sorted(snap["programs"].items(),
+                   key=lambda kv: kv[1]["compile_s"], reverse=True)
+    recompiles = sum(max(p["compiles"] - 1, 0) for _, p in progs)
+    lines = [f"total_compiles={snap['total_compiles']} "
+             f"total_compile_s={snap['total_compile_s']:.2f} "
+             f"recompiles={recompiles}",
+             f"{'compile_s':>10} {'count':>6}  program (reasons)"]
+    for name, p in progs[:10]:
+        reasons = ",".join(f"{k}={v}" for k, v in sorted(p["reasons"].items()))
+        lines.append(f"{p['compile_s']:>10.2f} {p['compiles']:>6}  "
+                     f"{name} ({reasons})")
+    try:
+        with open(path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+    except OSError:
+        pass
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _release_compiled_programs():
     """Drop compiled XLA programs between test modules.

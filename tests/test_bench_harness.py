@@ -77,3 +77,142 @@ def test_budget_arithmetic_is_total_not_per_attempt():
     assert "H2O3_BENCH_TOTAL_BUDGET" in src
     assert "deadline - time.time()" in src
     assert "H2O3_BENCH_TIMEOUT" not in src      # the old per-attempt knob
+
+
+# -------------------------------------------------------- bench_gate tests
+
+def _load_bench_gate():
+    import importlib.util
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "bench_gate.py")
+    spec = importlib.util.spec_from_file_location("bench_gate", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+bench_gate = _load_bench_gate()
+
+
+def _write(tmp_path, name, record):
+    p = tmp_path / name
+    p.write_text(json.dumps(record))
+    return str(p)
+
+
+def _record_json(tps, gbm_sec, **extra):
+    return {"metric": "trees_per_sec_bench", "value": tps,
+            "extra": {"gbm_sec": gbm_sec, "rows": 1000, **extra}}
+
+
+def _gate(tmp_path, candidate, baselines):
+    out = str(tmp_path / "report.txt")
+    argv = [candidate, "--out", out]
+    for b in baselines:
+        argv += ["--baseline", b]
+    rc = bench_gate.main(argv)
+    report = open(out).read() if os.path.exists(out) else ""
+    return rc, report
+
+
+def test_gate_improvement_passes(tmp_path):
+    base = _write(tmp_path, "BENCH_r01.json", _record_json(100.0, 10.0))
+    cand = _write(tmp_path, "cand.json", _record_json(150.0, 7.0))
+    rc, report = _gate(tmp_path, cand, [base])
+    assert rc == 0
+    assert "0 regression(s)" in report
+
+
+def test_gate_in_tolerance_noise_passes(tmp_path):
+    """-5% rate / +5% wall sits inside the default 10% band."""
+    base = _write(tmp_path, "BENCH_r01.json", _record_json(100.0, 10.0))
+    cand = _write(tmp_path, "cand.json", _record_json(95.0, 10.5))
+    rc, report = _gate(tmp_path, cand, [base])
+    assert rc == 0
+
+
+def test_gate_regression_fails(tmp_path):
+    base = _write(tmp_path, "BENCH_r01.json", _record_json(100.0, 10.0))
+    cand = _write(tmp_path, "cand.json", _record_json(50.0, 30.0))
+    rc, report = _gate(tmp_path, cand, [base])
+    assert rc == 1
+    assert "regress" in report
+    # both the rate drop and the wall-clock blow-up are flagged
+    assert "trees_per_sec_bench" in report and "gbm_sec" in report
+
+
+def test_gate_new_metric_passes_as_new(tmp_path):
+    base = _write(tmp_path, "BENCH_r01.json", _record_json(100.0, 10.0))
+    cand = _write(tmp_path, "cand.json",
+                  _record_json(100.0, 10.0, glm_sec=3.0))
+    rc, report = _gate(tmp_path, cand, [base])
+    assert rc == 0
+    assert "1 new" in report
+
+
+def test_gate_skips_unreadable_baseline(tmp_path, capsys):
+    """A corrupt baseline round drops out with a note; the rest gate."""
+    bad = _write(tmp_path, "BENCH_r01.json", {})
+    (tmp_path / "BENCH_r02.json").write_text("not json {")
+    good = _write(tmp_path, "BENCH_r03.json", _record_json(100.0, 10.0))
+    cand = _write(tmp_path, "cand.json", _record_json(100.0, 10.0))
+    rc, _ = _gate(tmp_path, cand,
+                  [bad, str(tmp_path / "BENCH_r02.json"), good])
+    assert rc == 0
+    assert "skipping unreadable baseline" in capsys.readouterr().err
+
+
+def test_gate_no_baselines_is_config_error(tmp_path):
+    cand = _write(tmp_path, "cand.json", _record_json(100.0, 10.0))
+    rc = bench_gate.main([cand, "--baseline",
+                          str(tmp_path / "missing.json"),
+                          "--out", str(tmp_path / "r.txt")])
+    assert rc == 2
+
+
+def test_gate_references_latest_round_not_alltime_best(tmp_path):
+    """The r04/r05 scenario: an older round's metric beat the latest
+    because the workload shape changed; a candidate equal to the latest
+    round must still pass (best is context only)."""
+    r04 = _write(tmp_path, "BENCH_r04.json", _record_json(500.0, 1.7))
+    r05 = _write(tmp_path, "BENCH_r05.json", _record_json(100.0, 8.3))
+    cand = _write(tmp_path, "cand.json", _record_json(100.0, 8.3))
+    rc, report = _gate(tmp_path, cand, [r04, r05])
+    assert rc == 0
+    assert "500.000" in report               # all-time best shown as context
+    rounds = bench_gate.load_baselines([r04, r05])
+    rows = {r["name"]: r for r in bench_gate.evaluate(
+        bench_gate.flatten(_record_json(100.0, 8.3)), rounds)}
+    tps = rows["trees_per_sec_bench"]
+    assert tps["status"] == "pass"
+    assert tps["ref_file"] == "BENCH_r05.json"   # gated vs the latest round
+    assert tps["best_file"] == "BENCH_r04.json"  # best is context only
+
+
+def test_gate_flattens_multichip_entries(tmp_path):
+    rec = {"bench": "multichip", "entries": [
+        {"n_devices": 8, "trees_per_sec": 10.0, "wall_s": 5.0},
+        {"n_devices": 32, "trees_per_sec": 30.0, "wall_s": 6.0}],
+        "scaling_8_to_32": 3.0}
+    flat = bench_gate.flatten(rec)
+    assert flat == {"multichip_trees_per_sec_8dev": 10.0,
+                    "multichip_wall_s_8dev": 5.0,
+                    "multichip_trees_per_sec_32dev": 30.0,
+                    "multichip_wall_s_32dev": 6.0,
+                    "scaling_8_to_32": 3.0}
+    base = _write(tmp_path, "MULTICHIP_r01.json", rec)
+    worse = dict(rec, scaling_8_to_32=2.0)   # -33% > the 15% band
+    cand = _write(tmp_path, "cand.json", worse)
+    rc, report = _gate(tmp_path, cand, [base])
+    assert rc == 1 and "scaling_8_to_32" in report
+
+
+def test_gate_direction_classifier():
+    assert bench_gate.classify("trees_per_sec_x") == "higher"
+    assert bench_gate.classify("scaling_8_to_32") == "higher"
+    assert bench_gate.classify("glm_higgs_shape_sec") == "lower"
+    assert bench_gate.classify("bench_wall_s") == "lower"
+    assert bench_gate.classify("rows") == "info"
+    assert bench_gate.classify("xgboost_compile_s") == "info"
+    assert bench_gate.classify("gbm_higgs_steady_s") == "info"
+    assert bench_gate.classify("compiles_total") == "info"

@@ -82,6 +82,11 @@ def test_worker_metrics_and_trace_stitch_across_processes(tmp_path):
         shipped_names = {s["n"] for s in stamp["metrics"]}
         assert "dkv_rpc_seconds" in shipped_names
         assert "tree_phase_seconds" in shipped_names
+        # the compile ledger rides the same snapshot: the worker's train
+        # compiled at least the tree-scan program, so its compile series
+        # and cost gauges land on the coordinator without extra plumbing
+        assert "compile_seconds" in shipped_names
+        assert "recompiles_total" in shipped_names
 
         # -- one scrape covers both processes, split by the node label
         text = obs.render_prometheus(cluster=True)
@@ -92,6 +97,10 @@ def test_worker_metrics_and_trace_stitch_across_processes(tmp_path):
                    for ln in worker_lines)
         assert any(ln.startswith("tree_phase_seconds_bucket")
                    for ln in worker_lines)
+        assert any(ln.startswith("compile_seconds_bucket")
+                   for ln in worker_lines)
+        assert any(ln.startswith("recompiles_total{")
+                   and 'reason="first"' in ln for ln in worker_lines)
         # the coordinator side of the same RPCs, under its own label
         assert any(ln.startswith("dkv_handle_seconds_bucket")
                    and f'node="{me}"' in ln for ln in text.splitlines())

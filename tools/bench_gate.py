@@ -1,0 +1,253 @@
+#!/usr/bin/env python
+"""bench_gate: regression gate over the BENCH_*/MULTICHIP_* trajectory.
+
+Diffs a fresh ``bench.py`` (or ``bench.py --multichip``) JSON record
+against the accepted baseline rounds with per-metric tolerance bands,
+emits a pass/regress table artifact, and exits nonzero on regression —
+the compareBenchmarksStage.groovy analog for this repo's bench history.
+
+Reference semantics: each metric is GATED against the most recent
+baseline round that reports it (the current accepted state).  The
+all-time best across rounds is shown as context, not gated on — bench
+workload shapes evolve between rounds (e.g. BENCH_r04's GLM section ran
+a different shape than r05's), so an all-time-best gate would misfire
+on metrics whose meaning shifted.  A candidate identical to the latest
+baseline therefore always passes.
+
+Metric direction is classified by name: ``*_per_sec``, ``*_vs_baseline``,
+``trees/sec``-style rates and ``scaling_*`` are higher-better;
+``*_sec``/``*_s`` wall clocks are lower-better.  Sizes and configuration
+echoes (rows, trees, platform, ``parse_csv_mb``) and the compile-split
+diagnostics (``*_compile_s``/``*_steady_s``, ``compiles_total``) are
+informational only.
+
+Usage:
+  python tools/bench_gate.py CANDIDATE.json [--baseline FILE ...]
+      [--tolerance PCT] [--out REPORT]
+
+Defaults: baselines are the repo's BENCH_r*.json (or MULTICHIP_r*.json
+when the candidate is a multichip record), tolerance 10% (25% for
+``bench_wall_s``), report written to ``bench_gate_report.txt`` next to
+the candidate.  Exit codes: 0 pass, 1 regression, 2 usage/config error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+DEFAULT_TOLERANCE_PCT = 10.0
+# noisy or environment-dominated metrics get looser bands
+TOLERANCE_OVERRIDES_PCT = {
+    "bench_wall_s": 25.0,
+    "scaling_8_to_32": 15.0,
+}
+# echoes of configuration / sizes / diagnostics: reported, never gated
+INFORMATIONAL = ("platform", "rows", "trees", "parse_csv_mb",
+                 "secondaries", "compiles_total", "compile_s_total")
+_INFO_SUFFIXES = ("_compile_s", "_steady_s", "_error")
+
+_HIGHER_HINTS = ("per_sec", "_vs_baseline", "samples_per_sec",
+                 "trees_per_sec", "scaling")
+
+
+def classify(name: str) -> str:
+    """'higher' | 'lower' | 'info' for a flattened metric name."""
+    if name in INFORMATIONAL or name.endswith(_INFO_SUFFIXES):
+        return "info"
+    if any(h in name for h in _HIGHER_HINTS):
+        return "higher"
+    if name.endswith(("_sec", "_s")):
+        return "lower"
+    return "info"
+
+
+def flatten(record: dict) -> dict:
+    """One bench JSON record -> flat {metric: numeric} dict.
+
+    Accepts the raw worker record ({metric, value, vs_baseline, extra}),
+    a driver wrapper ({parsed: record}), or a multichip summary
+    ({entries: [{n_devices, trees_per_sec, ...}], scaling_8_to_32})."""
+    if not isinstance(record, dict):
+        return {}
+    if "parsed" in record and isinstance(record["parsed"], dict):
+        record = record["parsed"]
+    out = {}
+    if "entries" in record and isinstance(record["entries"], list):
+        for ent in record["entries"]:
+            nd = ent.get("n_devices")
+            if nd is None:
+                continue
+            for k in ("trees_per_sec", "wall_s"):
+                if isinstance(ent.get(k), (int, float)):
+                    out[f"multichip_{k}_{nd}dev"] = float(ent[k])
+        if isinstance(record.get("scaling_8_to_32"), (int, float)):
+            out["scaling_8_to_32"] = float(record["scaling_8_to_32"])
+        return out
+    metric = record.get("metric")
+    if isinstance(metric, str) and isinstance(record.get("value"),
+                                              (int, float)):
+        out[metric] = float(record["value"])
+        if isinstance(record.get("vs_baseline"), (int, float)):
+            out[f"{metric}_vs_baseline"] = float(record["vs_baseline"])
+    for k, v in (record.get("extra") or {}).items():
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[k] = float(v)
+    return out
+
+
+def _round_of(path: str) -> int:
+    m = re.search(r"r(\d+)", os.path.basename(path))
+    return int(m.group(1)) if m else -1
+
+
+def load_baselines(paths) -> list:
+    """[(round_no, path, flat_metrics)] sorted oldest -> newest; rounds
+    that produced no metrics (failed runs like BENCH_r02/r03) drop out."""
+    rounds = []
+    for p in paths:
+        try:
+            with open(p) as f:
+                flat = flatten(json.load(f))
+        except (OSError, ValueError) as e:
+            print(f"bench_gate: skipping unreadable baseline {p}: {e}",
+                  file=sys.stderr)
+            continue
+        if flat:
+            rounds.append((_round_of(p), p, flat))
+    rounds.sort(key=lambda t: t[0])
+    return rounds
+
+
+def evaluate(candidate: dict, rounds: list,
+             tolerance_pct: float = DEFAULT_TOLERANCE_PCT) -> list:
+    """Per-metric verdicts: list of dicts with name/status/detail.
+
+    status: 'pass' | 'regress' | 'new' | 'info'."""
+    latest = {}
+    best = {}
+    for _, path, flat in rounds:              # oldest -> newest
+        for name, val in flat.items():
+            latest[name] = (val, path)
+            direction = classify(name)
+            if direction == "info":
+                continue
+            prev = best.get(name)
+            better = (prev is None
+                      or (direction == "higher" and val > prev[0])
+                      or (direction == "lower" and val < prev[0]))
+            if better:
+                best[name] = (val, path)
+    results = []
+    for name in sorted(candidate):
+        val = candidate[name]
+        direction = classify(name)
+        row = {"name": name, "value": val, "direction": direction}
+        if direction == "info":
+            row.update(status="info", detail="informational")
+            results.append(row)
+            continue
+        if name not in latest:
+            row.update(status="new", detail="no baseline for this metric")
+            results.append(row)
+            continue
+        ref, ref_path = latest[name]
+        tol = TOLERANCE_OVERRIDES_PCT.get(name, tolerance_pct) / 100.0
+        if direction == "higher":
+            ok = val >= ref * (1.0 - tol)
+            delta_pct = (val / ref - 1.0) * 100.0 if ref else 0.0
+        else:
+            ok = val <= ref * (1.0 + tol)
+            delta_pct = (ref / val - 1.0) * 100.0 if val else 0.0
+        row.update(status="pass" if ok else "regress",
+                   ref=ref, ref_file=os.path.basename(ref_path),
+                   delta_pct=round(delta_pct, 1),
+                   tolerance_pct=TOLERANCE_OVERRIDES_PCT.get(
+                       name, tolerance_pct))
+        if name in best:
+            row["best"] = best[name][0]
+            row["best_file"] = os.path.basename(best[name][1])
+        results.append(row)
+    return results
+
+
+def render_table(results: list) -> str:
+    hdr = (f"{'metric':42} {'value':>12} {'ref':>12} {'Δ%':>7} "
+           f"{'best':>12} {'status':>8}")
+    lines = [hdr, "-" * len(hdr)]
+    order = {"regress": 0, "new": 1, "pass": 2, "info": 3}
+    for r in sorted(results, key=lambda r: (order[r["status"]], r["name"])):
+        ref = f"{r['ref']:.3f}" if "ref" in r else "-"
+        bst = f"{r['best']:.3f}" if "best" in r else "-"
+        dlt = f"{r['delta_pct']:+.1f}" if "delta_pct" in r else "-"
+        lines.append(f"{r['name']:42} {r['value']:>12.3f} {ref:>12} "
+                     f"{dlt:>7} {bst:>12} {r['status']:>8}")
+    n_reg = sum(1 for r in results if r["status"] == "regress")
+    n_gated = sum(1 for r in results if r["status"] in ("pass", "regress"))
+    lines.append("")
+    lines.append(f"gated {n_gated} metrics, {n_reg} regression(s), "
+                 f"{sum(1 for r in results if r['status'] == 'new')} new")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("candidate", help="fresh bench JSON record to gate")
+    ap.add_argument("--baseline", action="append", default=[],
+                    help="baseline JSON (repeatable; default: repo "
+                         "BENCH_r*.json / MULTICHIP_r*.json)")
+    ap.add_argument("--tolerance", type=float,
+                    default=DEFAULT_TOLERANCE_PCT,
+                    help="default tolerance band in percent")
+    ap.add_argument("--out", default="",
+                    help="report artifact path (default: "
+                         "bench_gate_report.txt next to the candidate)")
+    args = ap.parse_args(argv)
+
+    try:
+        with open(args.candidate) as f:
+            candidate = flatten(json.load(f))
+    except (OSError, ValueError) as e:
+        print(f"bench_gate: cannot read candidate {args.candidate}: {e}",
+              file=sys.stderr)
+        return 2
+    if not candidate:
+        print(f"bench_gate: candidate {args.candidate} carries no metrics",
+              file=sys.stderr)
+        return 2
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    baselines = args.baseline
+    if not baselines:
+        pat = "MULTICHIP_r*.json" if any(
+            k.startswith(("multichip_", "scaling_")) for k in candidate) \
+            else "BENCH_r*.json"
+        baselines = sorted(glob.glob(os.path.join(repo, pat)))
+    rounds = load_baselines(baselines)
+    if not rounds:
+        print("bench_gate: no readable baselines "
+              f"(looked at {len(baselines)} file(s))", file=sys.stderr)
+        return 2
+
+    results = evaluate(candidate, rounds, tolerance_pct=args.tolerance)
+    table = render_table(results)
+    out_path = args.out or os.path.join(
+        os.path.dirname(os.path.abspath(args.candidate)) or ".",
+        "bench_gate_report.txt")
+    try:
+        with open(out_path, "w") as f:
+            f.write(table + "\n")
+        print(f"bench_gate: report -> {out_path}")
+    except OSError as e:
+        print(f"bench_gate: cannot write report {out_path}: {e}",
+              file=sys.stderr)
+    print(table)
+    return 1 if any(r["status"] == "regress" for r in results) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

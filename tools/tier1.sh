@@ -25,6 +25,11 @@
 set -o pipefail
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log
+# Compile-stats artifact: conftest's pytest_sessionfinish hook dumps the
+# runtime compile ledger (top-10 slowest compiles + recompile count) to
+# this path — the compile-time analog of the durations artifact.
+compile_stats_file=${H2O3_TIER1_COMPILE_STATS:-/tmp/tier1_compile_stats.txt}
+export H2O3_TIER1_COMPILE_STATS="$compile_stats_file"
 timeout -k 10 1700 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow and not heavy' --continue-on-collection-errors \
     --durations=25 --durations-min=1.0 \
@@ -34,6 +39,7 @@ durations_file=${H2O3_TIER1_DURATIONS:-/tmp/tier1_durations.txt}
 sed -n '/slowest.*durations/,/^[=]/p' /tmp/_t1.log | sed '$d' \
     > "$durations_file" || true
 [ -s "$durations_file" ] && echo "DURATIONS_FILE=$durations_file"
+[ -s "$compile_stats_file" ] && echo "COMPILE_STATS_FILE=$compile_stats_file"
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
     | tr -cd . | wc -c)
 # Second pass on a 16-device virtual mesh (4 hosts x 4 chips): the main
