@@ -466,6 +466,18 @@ def worker_main():
                                                       / dt_merge, 3)
         except Exception as e:
             extra["rapids_error"] = repr(e)[:200]
+        try:
+            # online serving: packed fused-traversal latency/throughput
+            # through the continuous micro-batcher (bench_pieces serve)
+            from bench_pieces import serve_piece
+            sv = serve_piece()
+            extra["serve_p50_ms"] = round(sv["serve_p50_ms"], 3)
+            extra["serve_p99_ms"] = round(sv["serve_p99_ms"], 3)
+            extra["serve_qps"] = round(sv["serve_qps"], 1)
+            extra["serve_packed_speedup_vs_numpy"] = round(
+                sv["serve_speedup"], 2)
+        except Exception as e:
+            extra["serve_error"] = repr(e)[:200]
     compiles, compile_s = _ledger_totals()
     if compiles:
         extra["compiles_total"] = compiles

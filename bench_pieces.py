@@ -916,6 +916,138 @@ def mesh_piece():
                "flat=all chips on every host, hier=one per host")
 
 
+def serve_piece():
+    """Online-scoring latency bench: the packed fused-traversal program
+    vs the ``ScoringModel`` numpy scorer, plus the continuous
+    micro-batcher's request-level p50/p99/QPS.
+
+    The bench ensemble is a binomial-GBM-shaped forest (trees/depth via
+    H2O3_SERVE_TREES / H2O3_SERVE_DEPTH, default 300 x depth 10 over 32
+    features — the airlines-shape serving profile) scored at B=256.
+    Acceptance: packed >= 5x the numpy scorer at B=256.
+
+    Usage (chip): python bench_pieces.py serve
+    CPU smoke:    JAX_PLATFORMS=cpu python bench_pieces.py serve
+    """
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import threading
+    import time as _time
+
+    import jax
+
+    import h2o3_tpu
+    from h2o3_tpu.export.scoring import ScoringModel
+    from h2o3_tpu.serving.batcher import MicroBatcher
+    from h2o3_tpu.serving.kernel import PackedScorer
+
+    h2o3_tpu.init()
+    platform = jax.devices()[0].platform
+    T = int(os.environ.get("H2O3_SERVE_TREES", 300))
+    depth = int(os.environ.get("H2O3_SERVE_DEPTH", 10))
+    Fs, Bb = 32, 256
+    rng = np.random.default_rng(7)
+
+    # synthetic binomial-GBM export: ~85%-split heap trees, f32 planes
+    arrays = {}
+    valid_prev = np.ones((T, 1), bool)
+    for d in range(depth):
+        W = 2 ** d
+        arrays[f"feat_{d}"] = rng.integers(0, Fs, (T, W)).astype(np.int32)
+        arrays[f"thr_{d}"] = rng.normal(size=(T, W)).astype(np.float32)
+        arrays[f"na_left_{d}"] = rng.integers(0, 2, (T, W)).astype(bool)
+        exist = np.repeat(valid_prev, 2, axis=1) if d else \
+            np.ones((T, 1), bool)
+        v = (rng.random((T, W)) < 0.85) & exist
+        arrays[f"valid_{d}"] = v
+        valid_prev = v
+    arrays["values"] = (rng.normal(size=(T, 2 ** depth)) * 0.1) \
+        .astype(np.float32)
+    meta = {
+        "algo": "gbm", "family": "tree", "tree_average": False,
+        "nclass_trees": 1, "ntrees": T, "depth": depth,
+        "link": "identity", "init_score": 0.0, "default_threshold": 0.5,
+        "datainfo": {
+            "specs": [{"name": f"x{i}", "type": "num", "domain": None,
+                       "mean": 0.0, "sigma": 1.0, "offset": i, "width": 1}
+                      for i in range(Fs)],
+            "response_domain": ["no", "yes"], "response_column": "y",
+            "use_all_factor_levels": False, "standardize": False,
+            "add_intercept": False, "nfeatures": Fs,
+        },
+    }
+    sm = ScoringModel(meta, arrays)
+    ps = PackedScorer(sm)
+    X = rng.normal(size=(Bb, Fs)).astype(np.float32)
+    X[rng.random((Bb, Fs)) < 0.02] = np.nan
+    cols = {f"x{i}": X[:, i] for i in range(Fs)}
+
+    def emit(piece, **rec):
+        print(json.dumps({"piece": piece, "platform": platform,
+                          "trees": T, "depth": depth, "batch": Bb,
+                          **rec}), flush=True)
+
+    def timed_ms(fn, reps):
+        fn()                                       # warm (AOT compile)
+        t0 = _time.perf_counter()
+        for _ in range(reps):
+            fn()
+        return (_time.perf_counter() - t0) * 1e3 / reps
+
+    reps = max(REPS, 20)
+    ref_ms = timed_ms(lambda: sm._score(cols, Bb), max(reps // 4, 5))
+    packed_ms = timed_ms(lambda: ps.score(X), reps)
+    speedup = ref_ms / packed_ms if packed_ms else float("inf")
+    emit("serve_ref", ms=round(ref_ms, 4),
+         note="ScoringModel numpy scorer (featurize + packed walk)")
+    emit("serve_packed", ms=round(packed_ms, 4),
+         n_nodes=ps.packed.n_nodes,
+         packed_mb=round(ps.packed.nbytes() / 2 ** 20, 2))
+    emit("serve_speedup", speedup=round(speedup, 2), ok=bool(speedup >= 5),
+         note="acceptance bar: packed >= 5x numpy at B=256")
+
+    # request-level latency through the continuous micro-batcher:
+    # closed-loop clients, single-row requests (the REST realtime shape)
+    mb = MicroBatcher(ps, max_batch=Bb, tick_ms=1.0, queue_depth=8192)
+    mb.warmup()
+    lat: list = []
+    lat_lock = threading.Lock()
+    n_clients, n_reqs = 8, 50
+    rows1 = [np.ascontiguousarray(X[i % Bb:i % Bb + 1])
+             for i in range(n_clients * n_reqs)]
+
+    def client(c):
+        mine = []
+        for i in range(n_reqs):
+            xi = rows1[c * n_reqs + i]
+            t0 = _time.perf_counter()
+            mb.submit(xi)
+            mine.append((_time.perf_counter() - t0) * 1e3)
+        with lat_lock:
+            lat.extend(mine)
+
+    t0 = _time.perf_counter()
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = _time.perf_counter() - t0
+    mb.close()
+    p50 = float(np.percentile(lat, 50))
+    p99 = float(np.percentile(lat, 99))
+    qps = len(lat) / wall
+    emit("serve_latency", serve_p50_ms=round(p50, 3),
+         serve_p99_ms=round(p99, 3), serve_qps=round(qps, 1),
+         clients=n_clients, requests=len(lat),
+         note="single-row closed-loop clients through the micro-batcher")
+    return {"serve_ref_ms": ref_ms, "serve_packed_ms": packed_ms,
+            "serve_speedup": speedup, "serve_p50_ms": p50,
+            "serve_p99_ms": p99, "serve_qps": qps}
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "parse":
         parse_piece()
@@ -931,5 +1063,7 @@ if __name__ == "__main__":
         xprof_piece()
     elif len(sys.argv) > 1 and sys.argv[1] == "mesh":
         mesh_piece()
+    elif len(sys.argv) > 1 and sys.argv[1] == "serve":
+        serve_piece()
     else:
         main()

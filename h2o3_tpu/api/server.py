@@ -438,6 +438,43 @@ class Api:
         return {"predictions_frame": {"name": dest},
                 "frames": [_frame_schema(dest, pred)]}
 
+    # ------------------------------------------------------- online serving
+    def predict_realtime(self, model_key: str, **kw) -> dict:
+        """POST /3/Predictions/realtime/{model} — online row scoring
+        through the packed-ensemble micro-batcher (h2o3_tpu/serving/).
+
+        Body: ``{"row": {...}}`` or ``{"rows": [{...}, ...]}``; optional
+        ``score_mode`` ("packed" | "ref" | "check") for parity drills.
+        """
+        from .. import serving
+        entry = serving.ensure_published(model_key)
+        rows = kw.get("rows")
+        if rows is None and "row" in kw:
+            rows = [kw["row"]]
+        if not rows or not isinstance(rows, list):
+            raise ValueError("realtime predict needs 'row' (object) or "
+                             "'rows' (list of objects)")
+        out = entry.predict_rows(rows, score_mode=kw.get("score_mode"))
+        preds = []
+        for i in range(len(rows)):
+            p = {"predict": out["predict"][i]}
+            if "probabilities" in out:
+                p["probabilities"] = out["probabilities"][i]
+            preds.append(p)
+        return {"model_id": {"name": model_key}, "predictions": preds}
+
+    def publish_realtime(self, model_key: str, **kw) -> dict:
+        """POST /3/Predictions/realtime/{model}/warmup — pack, publish
+        and AOT-warm the serving executable at model-publish time so the
+        first live request never pays a compile."""
+        from .. import serving
+        entry = serving.publish(model_key)
+        pk = entry.scorer.packed
+        return {"model_id": {"name": model_key}, "published": True,
+                "warmup_seconds": entry.warmup_s,
+                "n_nodes": pk.n_nodes, "packed_bytes": pk.nbytes(),
+                "max_batch": entry.batcher.max_batch}
+
     # ----------------------------------------------------------------- grids
     def grid_train(self, algo: str, **params) -> dict:
         """POST /99/Grid/{algo} — hyperparameter search
@@ -1274,6 +1311,10 @@ class H2OServer:
                 a.train(algo, **kw),
             r"/3/Predictions/models/([^/]+)/frames/([^/]+)":
                 lambda a, m, f, **kw: a.predict(m, f, **kw),
+            r"/3/Predictions/realtime/([^/]+)":
+                lambda a, m, **kw: a.predict_realtime(m, **kw),
+            r"/3/Predictions/realtime/([^/]+)/warmup":
+                lambda a, m, **kw: a.publish_realtime(m, **kw),
             r"/99/Rapids": lambda a, **kw: a.rapids(**kw),
             r"/3/Frames/([^/]+)/export": lambda a, k, **kw:
                 a.export_frame(k, **kw),

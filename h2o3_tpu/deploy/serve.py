@@ -77,6 +77,7 @@ def main(argv=None):
     cl = h2o3_tpu.init(coordinator=args.coordinator,
                        num_processes=args.num_processes,
                        process_id=args.process_id)
+    server = None
     if jax.process_index() == 0:
         from h2o3_tpu.api.server import start_server
         server = start_server(port=args.port, username=args.username,
@@ -100,6 +101,22 @@ def main(argv=None):
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     stop.wait()
+    # graceful rollout (k8s sends SIGTERM first): stop accepting new
+    # requests and drain in-flight handlers — bounded by
+    # H2O3_TPU_REST_DRAIN_TIMEOUT — then stop the serving batchers and
+    # detach the cluster, so pod restarts never drop scoring requests
+    if server is not None:
+        try:
+            server.stop()
+            print("h2o3_tpu REST drained", flush=True)
+        except Exception as e:          # noqa: BLE001 — still detach
+            print(f"h2o3_tpu REST drain failed: {e!r}", flush=True)
+    try:
+        from h2o3_tpu.serving import batcher as _serving_batcher
+        _serving_batcher.shutdown_all()
+    except Exception:                   # noqa: BLE001 — optional plane
+        pass
+    h2o3_tpu.shutdown()
     return 0
 
 

@@ -142,30 +142,33 @@ class ScoringModel:
             return np.stack([1 - mu, mu], axis=1)
         return mu
 
-    def _traverse(self, X, prefix=""):
-        """Sum of stacked-tree leaf values — GenModel tree walk.
+    def _packed(self, prefix=""):
+        """Bitpacked node planes for one class group, packed once and
+        cached — the layout serving/kernel.py puts on device."""
+        from ..serving import pack as _pack
+        cache = self.__dict__.setdefault("_pack_cache", {})
+        pk = cache.get(prefix)
+        if pk is None:
+            pk = _pack.pack_group(self.arrays, int(self.meta["depth"]),
+                                  prefix=prefix)
+            cache[prefix] = pk
+        return pk
 
-        Vectorized over trees: node state is [n, T], one gather+compare per
-        depth level (not per tree) — the arrays are already [T, nodes].
+    def _traverse(self, X, prefix=""):
+        """Sum of packed-tree leaf values — GenModel tree walk.
+
+        The heap-layout level arrays flatten once into the serving
+        pack's bitpacked node planes, then descend iteratively: one
+        gather+compare per depth step over live nodes only, with an
+        early exit once every (row, tree) sits on a leaf — node-sparse
+        deep trees (PR 7) stop at their real frontier instead of
+        walking 2^d-wide dead levels to depth 12.
         """
-        T = int(self.meta["ntrees"])
-        depth = int(self.meta["depth"])
-        n = len(X)
-        values = self.arrays[f"{prefix}values"]          # [T, 2^depth]
-        node = np.zeros((n, T), dtype=np.int64)
-        t_idx = np.arange(T)[None, :]
-        for d in range(depth):
-            feat = self.arrays[f"{prefix}feat_{d}"]      # [T, 2^d]
-            thr = self.arrays[f"{prefix}thr_{d}"]
-            nal = self.arrays[f"{prefix}na_left_{d}"]
-            val = self.arrays[f"{prefix}valid_{d}"]
-            f = feat[t_idx, node]                        # [n, T]
-            x = np.take_along_axis(X, f.reshape(n, -1), axis=1)
-            right = np.where(np.isnan(x), ~nal[t_idx, node],
-                             x >= thr[t_idx, node])
-            right = right & val[t_idx, node]
-            node = 2 * node + right.astype(np.int64)
-        return values[t_idx, node].sum(axis=1)
+        from ..serving import pack as _pack
+        i32, f32, roots = self._packed(prefix)
+        leaves = _pack.traverse(i32, f32, roots, X,
+                                int(self.meta["depth"]))
+        return leaves.sum(axis=1)
 
     def _score_tree(self, data, n):
         X = self._design_raw(data, n)

@@ -75,6 +75,16 @@ class Config:
     # dispatch) — and the sampled-mode stride
     device_timing: str = "off"
     device_timing_sample: int = 4
+    # online scoring plane (serving/): micro-batch tick interval, device
+    # batch capacity (one compiled signature — requests pad into it),
+    # admission queue depth in ROWS (overflow is rejected, not queued),
+    # parity mode ("packed" | "ref" | "check") and traversal impl
+    # ("auto" | "xla" | "pallas" | "pallas_interpret")
+    serve_tick_ms: float = 2.0
+    serve_max_batch: int = 256
+    serve_queue_depth: int = 4096
+    serve_score_mode: str = "packed"
+    serve_impl: str = "auto"
 
     @staticmethod
     def from_env() -> "Config":
@@ -112,6 +122,11 @@ class Config:
             device_timing=e("H2O3_TPU_DEVICE_TIMING", "off"),
             device_timing_sample=int(
                 e("H2O3_TPU_DEVICE_TIMING_SAMPLE", 4)),
+            serve_tick_ms=float(e("H2O3_TPU_SERVE_TICK_MS", 2.0)),
+            serve_max_batch=int(e("H2O3_TPU_SERVE_MAX_BATCH", 256)),
+            serve_queue_depth=int(e("H2O3_TPU_SERVE_QUEUE", 4096)),
+            serve_score_mode=e("H2O3_TPU_SERVE_SCORE_MODE", "packed"),
+            serve_impl=e("H2O3_TPU_SERVE_IMPL", "auto"),
         )
 
     def describe(self) -> dict:

@@ -1,0 +1,38 @@
+"""Online scoring plane: bitpacked ensembles, fused traversal, batching.
+
+Layout:
+
+* ``pack``    — numpy-only bitpacked node-array packer (imported by
+  ``export/scoring.py``; keep it jax-free).
+* ``kernel``  — the fused device traversal (XLA twin + Pallas variant)
+  behind ``PackedScorer`` with ``score_mode="packed"|"ref"|"check"``.
+* ``batcher`` — continuous micro-batching + the published-model
+  registry behind ``POST /3/Predictions/realtime/{model}``.
+
+Imports are lazy so ``pack`` stays importable without pulling jax.
+"""
+
+from __future__ import annotations
+
+_LAZY = {
+    "PackedScorer": ("kernel", "PackedScorer"),
+    "MicroBatcher": ("batcher", "MicroBatcher"),
+    "publish": ("batcher", "publish"),
+    "ensure_published": ("batcher", "ensure_published"),
+    "unpublish": ("batcher", "unpublish"),
+    "shutdown_all": ("batcher", "shutdown_all"),
+}
+
+
+def __getattr__(name: str):
+    import importlib
+    if name == "pack":
+        return importlib.import_module(".pack", __name__)
+    if name in _LAZY:
+        mod_name, attr = _LAZY[name]
+        mod = importlib.import_module(f".{mod_name}", __name__)
+        return getattr(mod, attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = ["pack", *_LAZY]

@@ -1,0 +1,263 @@
+"""Continuous micro-batching + the published-model registry.
+
+Requests land in an admission queue; a ticker thread drains up to
+``serve_max_batch`` rows per tick into the fixed device-shaped
+``[max_batch, F]`` buffer (ONE compiled signature — short batches pad,
+so the AOT executable from warm-up serves every launch), runs the
+packed scoring program once, and demuxes slices of the result back to
+the waiting callers.  Knobs ride ``H2O3_TPU_SERVE_*`` (runtime/config):
+tick interval, batch capacity, queue depth.
+
+Prometheus series (runtime/observability registry, already exposed at
+``GET /metrics``): ``serve_batch_size`` (rows per launch),
+``serve_queue_depth`` (rows waiting at drain), and
+``serve_latency_seconds{phase=queue|device|total}``.
+
+``publish(key, model)`` packs a trained model, starts its batcher, and
+warms the executable so the first real request never pays a compile;
+the REST layer calls ``ensure_published`` lazily on first traffic.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..runtime import observability as obs
+from ..runtime.config import config
+
+_BATCH_BUCKETS = (1., 2., 4., 8., 16., 32., 64., 128., 256., 512., 1024.)
+
+
+class _Pending:
+    __slots__ = ("X", "out", "error", "event", "t_enqueue", "t_launch")
+
+    def __init__(self, X: np.ndarray):
+        self.X = X
+        self.out: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+        self.event = threading.Event()
+        self.t_enqueue = time.perf_counter()
+        self.t_launch = 0.0
+
+
+class MicroBatcher:
+    """Continuous micro-batcher in front of one ``PackedScorer``."""
+
+    def __init__(self, scorer, max_batch: Optional[int] = None,
+                 tick_ms: Optional[float] = None,
+                 queue_depth: Optional[int] = None):
+        cfg = config()
+        self.scorer = scorer
+        self.max_batch = int(max_batch or cfg.serve_max_batch)
+        self.tick_s = float(tick_ms if tick_ms is not None
+                            else cfg.serve_tick_ms) / 1000.0
+        self.queue_depth = int(queue_depth or cfg.serve_queue_depth)
+        self._queue: "collections.deque[_Pending]" = collections.deque()
+        self._queued_rows = 0
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._closed = False
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="serve-batcher")
+        self._thread.start()
+
+    # ---------------------------------------------------------- callers
+    def submit(self, X: np.ndarray,
+               score_mode: Optional[str] = None) -> np.ndarray:
+        """Score a raw f32 design matrix; blocks until the demuxed
+        result is ready.  Requests wider than the device buffer score
+        in max_batch-row chunks through the same queue."""
+        X = np.ascontiguousarray(X, dtype=np.float32)
+        if score_mode not in (None, "", "packed"):
+            # parity modes bypass the shared buffer: they are a
+            # debugging surface, not the hot path
+            return self.scorer.score(X, score_mode=score_mode)
+        chunks = [X[i:i + self.max_batch]
+                  for i in range(0, X.shape[0], self.max_batch)] or [X]
+        pending = []
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("serving batcher is shut down")
+            if self._queued_rows + X.shape[0] > self.queue_depth:
+                obs.inc("serve_rejected_total")
+                raise RuntimeError(
+                    f"serving queue full ({self._queued_rows} rows "
+                    f"waiting, depth {self.queue_depth})")
+            for c in chunks:
+                p = _Pending(c)
+                self._queue.append(p)
+                pending.append(p)
+            self._queued_rows += X.shape[0]
+            self._cv.notify()
+        outs = []
+        for p in pending:
+            p.event.wait()
+            if p.error is not None:
+                raise p.error
+            outs.append(p.out)
+            obs.observe("serve_latency_seconds",
+                        time.perf_counter() - p.t_enqueue, phase="total")
+        return outs[0] if len(outs) == 1 else np.concatenate(outs)
+
+    def warmup(self) -> float:
+        """Compile + launch the full-buffer signature; returns seconds."""
+        t0 = time.perf_counter()
+        dummy = np.zeros((self.max_batch, self.scorer.nfeatures),
+                         dtype=np.float32)
+        self.scorer.score(dummy)
+        return time.perf_counter() - t0
+
+    def close(self):
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._thread.join(timeout=2.0)
+        with self._cv:
+            leftovers = list(self._queue)
+            self._queue.clear()
+            self._queued_rows = 0
+        for p in leftovers:
+            p.error = RuntimeError("serving batcher shut down")
+            p.event.set()
+
+    # ----------------------------------------------------------- ticker
+    def _drain_locked(self):
+        batch, rows = [], 0
+        while self._queue and rows + self._queue[0].X.shape[0] \
+                <= self.max_batch:
+            p = self._queue.popleft()
+            rows += p.X.shape[0]
+            batch.append(p)
+        self._queued_rows -= rows
+        return batch, rows
+
+    def _run(self):
+        cfg_F = self.scorer.nfeatures
+        buf = np.zeros((self.max_batch, cfg_F), dtype=np.float32)
+        while True:
+            with self._cv:
+                while not self._queue and not self._closed:
+                    self._cv.wait()
+                if self._closed:
+                    return
+                # continuous batching: the tick window lets co-arriving
+                # requests coalesce into one launch
+                deadline = self._queue[0].t_enqueue + self.tick_s
+            delay = deadline - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            with self._cv:
+                batch, rows = self._drain_locked()
+                obs.set_gauge("serve_queue_depth", self._queued_rows)
+            if not batch:
+                continue
+            if obs.enabled():
+                obs.histogram("serve_batch_size",
+                              buckets=_BATCH_BUCKETS).observe(rows)
+            t_launch = time.perf_counter()
+            for p in batch:
+                p.t_launch = t_launch
+                obs.observe("serve_latency_seconds",
+                            t_launch - p.t_enqueue, phase="queue")
+            buf[:] = 0.0
+            off = 0
+            for p in batch:
+                buf[off:off + p.X.shape[0]] = p.X
+                off += p.X.shape[0]
+            try:
+                out = self.scorer.score(buf)
+            except Exception as e:       # noqa: BLE001 — demux the error
+                for p in batch:
+                    p.error = e
+                    p.event.set()
+                continue
+            obs.observe("serve_latency_seconds",
+                        time.perf_counter() - t_launch, phase="device")
+            off = 0
+            for p in batch:
+                p.out = out[off:off + p.X.shape[0]]
+                off += p.X.shape[0]
+                p.event.set()
+
+
+# ----------------------------------------------------------- publishing
+
+class ServingEntry:
+    """One published model: packed scorer + its micro-batcher."""
+
+    def __init__(self, key: str, scorer, batcher: MicroBatcher,
+                 warmup_s: float):
+        self.key = key
+        self.scorer = scorer
+        self.batcher = batcher
+        self.warmup_s = warmup_s
+
+    def predict_rows(self, rows, score_mode: Optional[str] = None) -> dict:
+        X = self.scorer.featurize(rows)
+        probs = self.batcher.submit(X, score_mode=score_mode)
+        return self.scorer.decode(np.asarray(probs))
+
+
+_registry: Dict[str, ServingEntry] = {}
+_registry_lock = threading.Lock()
+
+
+def publish(key: str, model=None, warm: bool = True) -> ServingEntry:
+    """Pack + batch + warm one model for realtime scoring (idempotent).
+
+    ``model=None`` resolves the key from the DKV — the REST layer's
+    model-publish hook.
+    """
+    with _registry_lock:
+        ent = _registry.get(key)
+    if ent is not None:
+        return ent
+    if model is None:
+        from ..runtime import dkv
+        model = dkv.get(key)
+        if model is None:
+            raise KeyError(f"no model {key!r}")
+    from ..export import mojo
+    from .kernel import PackedScorer
+    meta, arrays = mojo._extract(model)
+    from ..export.scoring import ScoringModel
+    scorer = PackedScorer(ScoringModel(meta, arrays))
+    batcher = MicroBatcher(scorer)
+    warmup_s = batcher.warmup() if warm else 0.0
+    ent = ServingEntry(key, scorer, batcher, warmup_s)
+    with _registry_lock:
+        ent = _registry.setdefault(key, ent)
+    if ent.batcher is not batcher:       # lost the publish race
+        batcher.close()
+    obs.set_gauge("serve_published_models", len(_registry))
+    return ent
+
+
+def ensure_published(key: str) -> ServingEntry:
+    with _registry_lock:
+        ent = _registry.get(key)
+    return ent if ent is not None else publish(key)
+
+
+def unpublish(key: str) -> bool:
+    with _registry_lock:
+        ent = _registry.pop(key, None)
+    if ent is None:
+        return False
+    ent.batcher.close()
+    obs.set_gauge("serve_published_models", len(_registry))
+    return True
+
+
+def shutdown_all():
+    """Drain-and-stop every published batcher (process shutdown)."""
+    with _registry_lock:
+        entries = list(_registry.values())
+        _registry.clear()
+    for ent in entries:
+        ent.batcher.close()

@@ -216,3 +216,22 @@ def test_gate_direction_classifier():
     assert bench_gate.classify("xgboost_compile_s") == "info"
     assert bench_gate.classify("gbm_higgs_steady_s") == "info"
     assert bench_gate.classify("compiles_total") == "info"
+    # serving metrics gate from their first recorded round
+    assert bench_gate.classify("serve_p50_ms") == "lower"
+    assert bench_gate.classify("serve_p99_ms") == "lower"
+    assert bench_gate.classify("serve_latency_seconds") == "lower"
+    assert bench_gate.classify("warmup_seconds") == "lower"
+    assert bench_gate.classify("serve_qps") == "higher"
+
+
+def test_gate_serving_latency_regression(tmp_path):
+    rec = {"metric": "serve_qps", "value": 2000.0,
+           "extra": {"serve_p50_ms": 2.0, "serve_p99_ms": 5.0,
+                     "serve_qps": 2000.0}}
+    base = _write(tmp_path, "BENCH_r01.json", rec)
+    worse = {"metric": "serve_qps", "value": 2000.0,
+             "extra": {"serve_p50_ms": 4.0, "serve_p99_ms": 5.0,
+                       "serve_qps": 2000.0}}
+    cand = _write(tmp_path, "cand.json", worse)
+    rc, report = _gate(tmp_path, cand, [base])
+    assert rc == 1 and "serve_p50_ms" in report

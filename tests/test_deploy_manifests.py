@@ -113,3 +113,25 @@ def test_helm_chart_full_options_render(chart):
     vols = doc["spec"]["template"]["spec"]["volumes"]
     assert vols[0]["secret"]["secretName"] == "my-tls"
     assert doc["spec"]["parallelism"] == values["cluster"]["hosts"]
+
+
+def test_helm_loadtest_render(chart):
+    base, values = chart
+    tpl = open(os.path.join(base, "templates", "loadtest-job.yaml")).read()
+    # disabled by default: the whole template is if-wrapped -> no document
+    assert yaml.safe_load(_render(tpl, values, "rel")) is None
+    values = yaml.safe_load(yaml.safe_dump(values))  # deep copy
+    values["loadtest"]["enabled"] = True
+    values["loadtest"]["model"] = "gbm_1"
+    doc = yaml.safe_load(_render(tpl, values, "rel"))
+    assert doc["kind"] == "Job"
+    assert doc["metadata"]["name"] == "rel-loadtest"
+    ctr = doc["spec"]["template"]["spec"]["containers"][0]
+    url = ctr["args"][-1]
+    # targets the coordinator service on the REST port, realtime route
+    assert url == ("http://rel-coordinator:54321"
+                   "/3/Predictions/realtime/gbm_1")
+    assert "POST" in ctr["args"]
+    # closed-loop knobs flow through
+    i = ctr["args"].index("-n")
+    assert ctr["args"][i + 1] == str(values["loadtest"]["requests"])

@@ -15,8 +15,10 @@ on metrics whose meaning shifted.  A candidate identical to the latest
 baseline therefore always passes.
 
 Metric direction is classified by name: ``*_per_sec``, ``*_vs_baseline``,
-``trees/sec``-style rates and ``scaling_*`` are higher-better;
-``*_sec``/``*_s`` wall clocks are lower-better.  Sizes and configuration
+``trees/sec``-style rates, ``*qps`` and ``scaling_*`` are higher-better;
+``*_sec``/``*_s``/``*_ms``/``*_seconds`` wall clocks and ``*latency*``
+series are lower-better (serving latencies gate correctly from their
+first recorded round).  Sizes and configuration
 echoes (rows, trees, platform, ``parse_csv_mb``) and the compile-split
 diagnostics (``*_compile_s``/``*_steady_s``, ``compiles_total``) are
 informational only.
@@ -52,7 +54,8 @@ INFORMATIONAL = ("platform", "rows", "trees", "parse_csv_mb",
 _INFO_SUFFIXES = ("_compile_s", "_steady_s", "_error")
 
 _HIGHER_HINTS = ("per_sec", "_vs_baseline", "samples_per_sec",
-                 "trees_per_sec", "scaling")
+                 "trees_per_sec", "scaling", "qps")
+_LOWER_SUFFIXES = ("_sec", "_s", "_ms", "_seconds")
 
 
 def classify(name: str) -> str:
@@ -61,7 +64,7 @@ def classify(name: str) -> str:
         return "info"
     if any(h in name for h in _HIGHER_HINTS):
         return "higher"
-    if name.endswith(("_sec", "_s")):
+    if name.endswith(_LOWER_SUFFIXES) or "latency" in name:
         return "lower"
     return "info"
 

@@ -10,6 +10,11 @@
 # 1500), and PR 5's fused-split parity suite + mid-multinomial-round
 # chaos row add ~150 s, so the budget is 1700 s — same ~1.4x headroom
 # over a clean run.  Keep the ratio when tier-1 grows again.
+# PR 11's online-serving suite (tests/test_serving.py: pack parity,
+# packed-vs-ref check mode across the four tree algos, micro-batcher
+# demux, REST realtime round-trip) rides inside `tests/` and adds ~70 s,
+# still within the 1700 s budget; its SIGTERM-drain launcher test is
+# `heavy` and runs only in the full suite.
 # The 16-device mesh re-run at the bottom has its own 300 s budget
 # (~45 s clean) on top.
 #
