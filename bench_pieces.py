@@ -1048,6 +1048,77 @@ def serve_piece():
             "serve_p99_ms": p99, "serve_qps": qps}
 
 
+def sched_piece():
+    """Fair-share co-residency bench: small-job makespan beside a
+    pod-holding large job, fair-share vs FIFO-behind-the-big-job.
+
+    Synthetic chip-holding jobs (sleeps) isolate scheduler behavior
+    from kernel throughput: the large job holds its chips for
+    H2O3_SCHED_BIG_S seconds, each small job for H2O3_SCHED_SMALL_S.
+    Fair-share gives the large job half the mesh (device_budget=0.5)
+    so the smalls co-reside and finish in ~SMALL_S; the FIFO baseline
+    gives it the full pod, so the smalls queue out the whole large job
+    first.  Metrics feed tools/bench_gate.py: the makespans gate
+    lower-is-better, ``sched_fair_vs_baseline`` higher-is-better.
+
+    Usage: python bench_pieces.py sched    (host-side only; no chips)
+    """
+    import time as _time
+
+    from h2o3_tpu.runtime.job import Job
+    from h2o3_tpu.runtime.scheduler import ClusterScheduler
+
+    BIG_S = float(os.environ.get("H2O3_SCHED_BIG_S", 2.0))
+    SMALL_S = float(os.environ.get("H2O3_SCHED_SMALL_S", 0.3))
+    N_SMALL = int(os.environ.get("H2O3_SCHED_SMALLS", 3))
+
+    def hold(seconds):
+        def fn(job):
+            end = _time.monotonic() + seconds
+            while _time.monotonic() < end:
+                _time.sleep(0.01)
+        return fn
+
+    def small_makespan(big_budget):
+        s = ClusterScheduler(capacity=8, queue_limit=64, elastic=False)
+        try:
+            big = Job("sched-bench big")
+            s.submit(big, hold(BIG_S), device_budget=big_budget,
+                     user="bench-big")
+            t0 = _time.monotonic()
+            smalls = []
+            for i in range(N_SMALL):
+                j = Job(f"sched-bench small {i}")
+                s.submit(j, hold(SMALL_S), device_budget=1,
+                         user=f"bench-small-{i}")
+                smalls.append(j)
+            for j in smalls:
+                j.join()
+            span = _time.monotonic() - t0
+            big.join()
+            return span
+        finally:
+            s.stop()
+
+    def emit(piece, **rec):
+        print(json.dumps({"piece": piece, **rec}), flush=True)
+
+    fifo = small_makespan(1.0)      # pod-holding: smalls wait it out
+    fair = small_makespan(0.5)      # half the mesh: smalls co-reside
+    ratio = fifo / fair if fair else float("inf")
+    emit("sched_fifo", sched_small_makespan_fifo_s=round(fifo, 3),
+         big_s=BIG_S, small_s=SMALL_S, n_small=N_SMALL,
+         note="baseline: large job holds the full pod")
+    emit("sched_fair", sched_small_makespan_fair_s=round(fair, 3),
+         note="large job at device_budget=0.5; smalls co-resident")
+    emit("sched_speedup", sched_fair_vs_baseline=round(ratio, 2),
+         ok=bool(fair < fifo),
+         note="acceptance bar: fair-share makespan below FIFO")
+    return {"sched_small_makespan_fifo_s": fifo,
+            "sched_small_makespan_fair_s": fair,
+            "sched_fair_vs_baseline": ratio}
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "parse":
         parse_piece()
@@ -1065,5 +1136,7 @@ if __name__ == "__main__":
         mesh_piece()
     elif len(sys.argv) > 1 and sys.argv[1] == "serve":
         serve_piece()
+    elif len(sys.argv) > 1 and sys.argv[1] == "sched":
+        sched_piece()
     else:
         main()

@@ -243,6 +243,10 @@ class _Handler(BaseHTTPRequestHandler):
                 except KeyError as e:
                     return self._reply(404, {"error": str(e)})
                 except Exception as e:      # noqa: BLE001
+                    from ..serving.batcher import DeadlineExceeded
+                    if isinstance(e, DeadlineExceeded):
+                        # shed, not failed: retryable service pressure
+                        return self._reply(503, {"error": str(e)})
                     return self._reply(400, {
                         "error": repr(e),
                         "stacktrace": traceback.format_exc().splitlines()})
@@ -1040,6 +1044,14 @@ class Api:
                 # records, dedup window — the restart-runbook facts
                 "coordinator": dkv.wal_stats()}
 
+    def scheduler_status(self, **kw) -> dict:
+        """GET /3/Scheduler — the cluster scheduler's live view: chip
+        capacity/usage, admission queue, running assignments with
+        budgets and per-tenant fair-share usage, elastic-membership
+        state (known hosts, armed rebuild) and the flap quarantine."""
+        from ..runtime.job import scheduler
+        return {"scheduler": scheduler().describe()}
+
     _nps: dict = {}
 
     def nps_put(self, category: str, name: str, value: str = "",
@@ -1302,6 +1314,7 @@ class H2OServer:
                 lambda a, c: a.nps_list(c),
             r"/3/FrameChunks/([^/]+)": lambda a, k: a.frame_chunks(k),
             r"/3/Recovery": lambda a, **kw: a.recovery_status(**kw),
+            r"/3/Scheduler": lambda a, **kw: a.scheduler_status(**kw),
             r"/3/Profiler/memory": lambda a: a.profiler_memory(),
             r"/3/Profiler/compiles": lambda a: a.compile_ledger(),
         }

@@ -73,6 +73,15 @@ class Parameters:
     # "parallelization" + hex/ParallelModelBuilder.java): 0 = auto
     # (bounded pool), 1 = sequential, n>1 = exactly n builder threads
     parallelism: int = 0
+    # cluster-scheduler placement (runtime/scheduler.py): dispatch
+    # priority (None = PRIORITY_BUILD; lower runs first), device budget
+    # as a mesh fraction in (0, 1] or an explicit chip count >= 1
+    # (None = the scheduler's default share), and how many times a job
+    # interrupted by a dead host may be requeued from its progress
+    # snapshot before it is failed
+    priority: Optional[int] = None
+    device_budget: Optional[float] = None
+    retry_budget: int = 0
 
     def effective_seed(self) -> int:
         return np.random.default_rng().integers(2**31) if self.seed in (-1, None) \
@@ -342,11 +351,19 @@ class ModelBuilder:
         with the user's own parameters."""
         def _driver(job: Job) -> Model:
             from ..runtime import recovery
-            journal = recovery.journal_start(
+            # reuse a submit-time (or previous-life) journal entry: a
+            # requeued job keeps its snapshot pointer for the next resume
+            journal = job.journal_uri or recovery.journal_start(
                 self, frame, job, params=orig_params)
             job.journal_uri = journal      # gates in-training snapshots
             try:
-                model = self._driver_body(job, frame, di, valid, journal)
+                # the device lease serializes compiled-program launches
+                # across co-resident jobs (XLA in-process collectives
+                # deadlock on concurrent launches); chunk_fence yields
+                # it at every chunk boundary so jobs still interleave
+                from ..runtime import scheduler as _sched
+                with _sched.device_slot():
+                    model = self._driver_body(job, frame, di, valid, journal)
             except BaseException as e:
                 # cancelled / deterministically failing jobs must not be
                 # resurrected as if the process had died — but a failure
@@ -394,30 +411,54 @@ class ModelBuilder:
         """Hook after _fit (calibration, etc.); default no-op."""
 
     def train_async(self, frame: Frame, valid: Optional[Frame] = None,
-                    priority: Optional[int] = None) -> Job:
-        """Queue training on the priority scheduler; returns the Job.
+                    priority: Optional[int] = None,
+                    user: Optional[str] = None) -> Job:
+        """Queue training on the cluster scheduler; returns the Job.
 
-        The h2o.train(..., async) analog over the F/J-pool replacement
-        (runtime/job.JobScheduler): poll ``job.status`` / ``/3/Jobs`` or
-        ``job.join()`` for the model.
+        The h2o.train(..., async) analog over the fair-share scheduler
+        (runtime/scheduler.py): poll ``job.status`` / ``/3/Jobs`` or
+        ``job.join()`` for the model.  Placement comes from the params —
+        ``priority`` (arg overrides), ``device_budget``,
+        ``retry_budget`` — and the journal entry is written at SUBMIT
+        time, so even a queued-but-unstarted job survives a coordinator
+        restart via ``scheduler.readmit()``.
         """
+        from ..runtime import recovery
         from ..runtime.job import scheduler, JobScheduler
         self._validate(frame)
         frame, bal = self._apply_balance(frame)
+        orig_async = self.params
         if bal is not None:
             # stays installed while the queued driver runs; the driver's
             # finally restores it (concurrent reuse of one builder with
             # balance_classes is not supported)
-            orig_async = self.params
             self.params = bal
             valid = self._balance_valid(valid, orig_async)
+        p = self.params
         di = self._make_datainfo(frame)
         self.job = Job(f"{self.algo} train",
                        dest_key=dkv.make_key(self.algo))
-        return scheduler().submit(
-            self.job, self._make_driver(frame, di, valid, orig_params=orig_async if bal is not None else None),
-            priority=JobScheduler.PRIORITY_BUILD
-            if priority is None else priority)
+        self.job.journal_uri = recovery.journal_start(
+            self, frame, self.job,
+            params=orig_async if bal is not None else None)
+        if priority is None:
+            priority = JobScheduler.PRIORITY_BUILD \
+                if p.priority is None else p.priority
+        try:
+            return scheduler().submit(
+                self.job,
+                self._make_driver(frame, di, valid,
+                                  orig_params=orig_async
+                                  if bal is not None else None),
+                priority=priority,
+                device_budget=p.device_budget,
+                retry_budget=p.retry_budget or 0,
+                user=user)
+        except BaseException as e:
+            # admission rejected: the submit-time journal entry must not
+            # be resurrected as if the process had died
+            recovery.journal_fail(self.job.journal_uri, repr(e))
+            raise
 
     # -- cross-validation (hex/CVModelBuilder.java:10) -----------------------
     def _train_cv(self, job: Job, frame: Frame, di: DataInfo,

@@ -452,10 +452,13 @@ class DeepLearning(ModelBuilder):
         seen = 0
         import time as _time
         t0 = _time.time()
-        from ..runtime import failure
+        from ..runtime import failure, scheduler
         stopped_at = n_iters
         for it in range(n_iters):
             failure.maybe_inject("dl_iter")
+            # per-iteration device-lease yield (tree drivers yield at
+            # chunk boundaries): co-resident jobs interleave here
+            scheduler.DEVICE_LEASE.yield_turn()
             params, opt_state, mean_loss = train_steps(params, opt_state,
                                                        rng, it, X, y, w)
             seen += steps_per_iter * batch

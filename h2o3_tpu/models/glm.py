@@ -772,13 +772,16 @@ class GLM(ModelBuilder):
         best = None
         hist = []
         dev = np.inf
-        from ..runtime import failure, snapshot
+        from ..runtime import failure, scheduler, snapshot
         for li, lam in enumerate(lambdas):
             # the host lambda loop journals its position: the in-progress
             # state (warm-start beta) is not a loadable model, so this is
             # a cursor-only progress record (bounded-rework accounting +
             # the /3/Recovery status view), throttled like full snapshots
             failure.maybe_inject("glm_lambda")
+            # per-lambda device-lease yield: co-resident jobs interleave
+            # here (the tree drivers yield at chunk boundaries)
+            scheduler.DEVICE_LEASE.yield_turn()
             snapshot.progress(job, {"lambda_index": li,
                                     "lambda": float(lam)})
             for it in range(p.max_iterations):
@@ -821,9 +824,10 @@ class GLM(ModelBuilder):
         hist = []
         lam = lambdas[-1]
         ll_prev = np.inf
-        from ..runtime import failure, snapshot
+        from ..runtime import failure, scheduler, snapshot
         for it in range(p.max_iterations):
             failure.maybe_inject("glm_lambda")
+            scheduler.DEVICE_LEASE.yield_turn()
             snapshot.progress(job, {"iteration": it})
             # batched fetch of the SMALL outputs only — [:3] keeps the
             # [N, K] probs (4th return) on device

@@ -1728,12 +1728,16 @@ def chunk_schedule(ntrees: int, score_tree_interval: int,
     compiled scan program; chunk boundaries land exactly on scoring
     intervals so early-stopping semantics match the per-tree loop.
     """
-    from ...runtime import failure
+    from ...runtime import failure, scheduler
     interval = max(1, min(score_tree_interval, ntrees))
     cap = min(chunk_cap, interval)
     t = 0
     while t < ntrees:
         failure.maybe_inject("tree_chunk")
+        # chunk boundaries are the fence for elastic mesh rebuilds: a
+        # host join armed by the membership observer applies here, and
+        # the next compile re-traces against the rebuilt mesh
+        scheduler.chunk_fence()
         c = min(cap, ntrees - t, interval - (t % interval))
         t += c
         yield c, t, (t % interval == 0 or t >= ntrees)

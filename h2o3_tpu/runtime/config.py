@@ -18,8 +18,21 @@ from typing import Optional
 class Config:
     # REST
     port: int = 54321
-    # scheduler
+    # scheduler (runtime/scheduler.py): legacy fixed-pool width (kept for
+    # direct JobScheduler construction), chip capacity override (0 = the
+    # live mesh's row-shard count), bounded admission queue, default
+    # device-budget fraction for jobs submitted without one, elastic
+    # membership (host join/leave drives fenced mesh rebuilds), the
+    # membership poll cadence, and the flap-quarantine policy (a host may
+    # trigger at most sched_quarantine_flaps rebuilds per window)
     scheduler_workers: int = 2
+    sched_capacity: int = 0
+    sched_queue_limit: int = 64
+    sched_default_budget: float = 0.5
+    sched_elastic: bool = False
+    sched_member_poll_s: float = 1.0
+    sched_quarantine_window_s: float = 60.0
+    sched_quarantine_flaps: int = 2
     # HBM guardrail share (cluster._check_hbm_budget)
     hbm_guardrail_fraction: float = 0.9
     # logging
@@ -85,6 +98,11 @@ class Config:
     serve_queue_depth: int = 4096
     serve_score_mode: str = "packed"
     serve_impl: str = "auto"
+    # per-request serving deadline in ms (0 = none): a request that
+    # cannot be dispatched to the device before its deadline is shed
+    # with a 503 instead of waiting in the queue — also during SIGTERM
+    # drain, so a terminating pod never strands queued requests
+    serve_deadline_ms: float = 0.0
 
     @staticmethod
     def from_env() -> "Config":
@@ -92,6 +110,17 @@ class Config:
         return Config(
             port=int(e("H2O3_TPU_PORT", 54321)),
             scheduler_workers=int(e("H2O3_TPU_SCHEDULER_WORKERS", 2)),
+            sched_capacity=int(e("H2O3_TPU_SCHED_CAPACITY", 0)),
+            sched_queue_limit=int(e("H2O3_TPU_SCHED_QUEUE", 64)),
+            sched_default_budget=float(
+                e("H2O3_TPU_SCHED_DEFAULT_BUDGET", 0.5)),
+            sched_elastic=e("H2O3_TPU_SCHED_ELASTIC", "0")
+            not in ("0", "false", "no"),
+            sched_member_poll_s=float(e("H2O3_TPU_SCHED_MEMBER_POLL", 1.0)),
+            sched_quarantine_window_s=float(
+                e("H2O3_TPU_SCHED_QUARANTINE_WINDOW", 60.0)),
+            sched_quarantine_flaps=int(
+                e("H2O3_TPU_SCHED_QUARANTINE_FLAPS", 2)),
             hbm_guardrail_fraction=float(
                 e("H2O3_TPU_HBM_GUARDRAIL", 0.9)),
             log_level=e("H2O3_TPU_LOG_LEVEL", "INFO"),
@@ -127,6 +156,7 @@ class Config:
             serve_queue_depth=int(e("H2O3_TPU_SERVE_QUEUE", 4096)),
             serve_score_mode=e("H2O3_TPU_SERVE_SCORE_MODE", "packed"),
             serve_impl=e("H2O3_TPU_SERVE_IMPL", "auto"),
+            serve_deadline_ms=float(e("H2O3_TPU_SERVE_DEADLINE_MS", 0.0)),
         )
 
     def describe(self) -> dict:

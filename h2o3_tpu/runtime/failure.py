@@ -41,7 +41,12 @@ models a LOST RESPONSE, the exactly-once dedup case), ``dkv_handle``
 ``:coordinator:<nth>:kill`` it hard-kills the coordinator at the nth
 handled connection), ``parse_range``, ``cv_fold``, ``grid_member``,
 ``automl_member``, ``glm_lambda``, ``snapshot_write``,
-``deep_level``.  ``ktree_round`` fires at the top of every batched
+``deep_level``, ``sched_assign``, ``host_join``.  ``sched_assign``
+fires when the cluster scheduler (runtime/scheduler.py) hands a job to
+a worker thread — kill/raise there proves admission state survives a
+lost assignment; ``host_join`` fires when the elastic membership
+observer sees a newly-alive host, before quarantine/rebuild arming, so
+join-time crashes are injectable.  ``ktree_round`` fires at the top of every batched
 K-tree boosting round (the fused multinomial/multiclass level
 program), so kill/resume mid-round exercises snapshot recovery of the
 one-launch-per-level path.  ``deep_level`` fires at the top of a tree
@@ -152,8 +157,18 @@ def _on_dead(node: str, info: dict) -> None:
         f"worker {node} lost mid-job (heartbeat dead for {age:.1f}s); "
         "collectives cannot complete — restart the cluster, re-import "
         "frames, then runtime.recovery.resume() to resurrect the job")
+    # degraded-mode continuation: the scheduler requeues its in-flight
+    # jobs with retry budget from their journal snapshots onto the
+    # shrunken mesh; only what it cannot requeue is failed below
+    requeued: set = set()
+    try:
+        from . import scheduler as _sched
+        requeued = _sched.on_node_dead(node, err)
+    except Exception:                # noqa: BLE001 — fall back to fail-all
+        requeued = set()
     for job in list_jobs():
-        if job is not None and getattr(job, "is_running", False):
+        if job is not None and getattr(job, "is_running", False) \
+                and job.key not in requeued:
             job.fail(err)
 
 

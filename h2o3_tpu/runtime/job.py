@@ -52,8 +52,19 @@ class Job:
         # H2O3_TPU_RECOVERY_DIR is active); gates progress snapshots
         self.journal_uri: Optional[str] = None
         self._queued = False                 # on a scheduler queue
+        self._owner = None                   # the scheduler it queued on
         self._thread: Optional[threading.Thread] = None
         self.result: Any = None
+        # scheduling metadata (set by ClusterScheduler.submit)
+        self.priority: Optional[int] = None
+        self.device_budget: Any = None
+        self.retry_budget: int = 0
+        self.user: Optional[str] = None
+        self.retries = 0
+        # run-token: each (re)run holds a fresh token; epilogues only
+        # apply when the token still matches, so a worker thread wedged
+        # in a dead collective cannot clobber a requeued job's state
+        self._run_token: Optional[object] = None
         dkv.put(self.key, self)
 
     # ------------------------------------------------------------- lifecycle
@@ -64,33 +75,39 @@ class Job:
         processes — the context rides the RPC envelope) shares one
         trace_id, so /3/Timeline renders the job as a single tree."""
         from .observability import record, trace
+        token = object()
+        self._run_token = token
         self.status = RUNNING
         self.start_time = time.time()
-        record("job_start", job=self.key, description=self.description)
+        record("job_start", job=self.key, description=self.description,
+               attempt=self.retries)
         try:
             with trace("job", job=self.key, description=self.description):
                 self._mirror()
-                self.result = fn(self)
-            if self.status == RUNNING:   # an external fail() wins the race
-                self.status = DONE
-                self.progress = 1.0
-            return self.result
+                result = fn(self)
+            if self._run_token is token:
+                self.result = result
+                if self.status == RUNNING:  # external fail() wins the race
+                    self.status = DONE
+                    self.progress = 1.0
+            return result
         except JobCancelled:
-            if self.status == RUNNING:
+            if self._run_token is token and self.status == RUNNING:
                 self.status = CANCELLED
             raise
         except BaseException as e:
-            if self.status == RUNNING:
+            if self._run_token is token and self.status == RUNNING:
                 self.status = FAILED
                 self.exception = e
                 self.traceback = traceback.format_exc()
             raise
         finally:
-            self.end_time = time.time()
-            self._done.set()
-            record("job_end", job=self.key, status=self.status,
-                   duration_s=round(self.run_time, 4))
-            self._mirror()
+            if self._run_token is token:
+                self.end_time = time.time()
+                self._done.set()
+                record("job_end", job=self.key, status=self.status,
+                       duration_s=round(self.run_time, 4))
+                self._mirror()
 
     def _mirror(self) -> None:
         """Replicate a plain status stamp under ``!job/<key>``.
@@ -141,7 +158,42 @@ class Job:
             raise JobCancelled(self.description)
 
     def cancel(self) -> None:
+        """Request cancellation.  A queued-but-unstarted job is dequeued
+        from the scheduler and marked CANCELLED immediately — it never
+        runs; a running job cancels cooperatively at its next update()."""
         self._cancel_requested.set()
+        if self._queued and self.status == CREATED:
+            s = self._owner or _scheduler
+            if s is not None:
+                try:
+                    s.try_cancel(self)
+                except Exception:   # noqa: BLE001 — cooperative flag stands
+                    pass
+
+    def _mark_cancelled(self) -> None:
+        """Terminal CANCELLED for a job that never started (dequeued)."""
+        if self.status != CREATED:
+            return
+        from .observability import record
+        self.status = CANCELLED
+        self.end_time = time.time()
+        self._done.set()
+        record("job_cancelled", job=self.key, queued=True)
+        self._mirror()
+
+    def _reset_for_retry(self) -> None:
+        """Rearm for another run on the SAME object (degraded-mode
+        requeue): joiners keep waiting on the same completion event; a
+        fresh run token orphans the stale worker thread."""
+        self._run_token = object()
+        self.status = CREATED
+        self.exception = None
+        self.end_time = None
+        self.progress = 0.0
+        self._done.clear()
+        self._queued = True
+        self.retries += 1
+        self._mirror()
 
     def fail(self, exc: BaseException) -> None:
         """Externally abort a job (failure watchdog): mark FAILED and
@@ -174,6 +226,9 @@ class Job:
             "msg": self.progress_msg, "dest": self.dest_key,
             "run_time": self.run_time,
             "exception": repr(self.exception) if self.exception else None,
+            "priority": self.priority, "device_budget": self.device_budget,
+            "retry_budget": self.retry_budget, "user": self.user,
+            "retries": self.retries,
         }
 
 
@@ -218,6 +273,7 @@ class JobScheduler:
             if self._shutdown:
                 raise RuntimeError("job scheduler is stopped")
             job._queued = True
+            job._owner = self
             self._seq += 1
             heapq.heappush(self._heap, (priority, self._seq, job, fn))
             self._cv.notify()
@@ -233,8 +289,25 @@ class JobScheduler:
                 _, _, job, fn = heapq.heappop(self._heap)
             try:
                 job.run(fn)
-            except BaseException:
-                pass                      # recorded on the job
+            except BaseException as e:    # noqa: BLE001
+                # Job.run records its own failures; anything that still
+                # escapes (e.g. a raise from run's epilogue) must reach
+                # the job so joiners are released, never swallowed
+                if not job._done.is_set():
+                    job.fail(e)
+
+    def try_cancel(self, job: "Job") -> bool:
+        """Drop a still-queued job from the heap; False if it left."""
+        with self._cv:
+            for i, item in enumerate(self._heap):
+                if item[2] is job:
+                    self._heap.pop(i)
+                    heapq.heapify(self._heap)
+                    break
+            else:
+                return False
+        job._mark_cancelled()
+        return True
 
     def stop(self):
         """Stop accepting work; workers drain what is already queued."""
@@ -247,16 +320,19 @@ class JobScheduler:
                 _scheduler = None
 
 
-_scheduler: Optional[JobScheduler] = None
+_scheduler = None          # JobScheduler | scheduler.ClusterScheduler
 _sched_lock = threading.Lock()
 
 
-def scheduler() -> JobScheduler:
-    """Process-wide scheduler, created on first use."""
+def scheduler():
+    """Process-wide scheduler, created on first use.
+
+    Returns the elastic fair-share ``ClusterScheduler``
+    (runtime/scheduler.py); the legacy fixed-pool ``JobScheduler``
+    above remains for direct construction in tests."""
     global _scheduler
     with _sched_lock:
         if _scheduler is None:
-            from .config import config
-            _scheduler = JobScheduler(
-                workers=config().scheduler_workers)
+            from .scheduler import ClusterScheduler
+            _scheduler = ClusterScheduler()
         return _scheduler

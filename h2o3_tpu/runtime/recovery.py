@@ -82,6 +82,11 @@ def journal_start(builder, frame, job=None, params=None) -> Optional[str]:
         "status": "running",
     }
     job = job or builder.job
+    if job is not None:
+        # job identity lets scheduler.readmit() re-join the entry with
+        # its WAL-persisted !sched/ scheduling record after a restart
+        entry["job"] = job.key
+        entry["dest_key"] = job.dest_key
     uri = _entry_uri(base, job.key if job else "unkeyed")
     try:
         _write_entry(uri, entry)
@@ -195,6 +200,121 @@ def _load_snapshot_prior(entry: dict, uri: str):
         return None
 
 
+def journal_entries(recovery_dir: Optional[str] = None) -> List[tuple]:
+    """Readable journal entries as ``(uri, entry)`` pairs."""
+    from .. import persist
+    from .observability import log
+    base = recovery_dir or _dir()
+    if not base:
+        return []
+    out: List[tuple] = []
+    for uri in persist.list_uris(f"{base.rstrip('/')}/job_*.json"):
+        try:
+            out.append((uri, _read_entry(uri)))
+        except Exception as e:                 # noqa: BLE001
+            log.warning("recovery: unreadable journal entry %s: %r", uri, e)
+    return out
+
+
+def resume_entry(uri: str, entry: Optional[dict] = None, job=None):
+    """Resume ONE journal entry; returns the retrained Model.
+
+    Returns None when the entry is not resumable (already finished,
+    frame not re-importable, unknown algo) — unless ``job`` is given, in
+    which case those conditions raise so the carrying job fails loudly.
+    Training errors always propagate; the caller decides between
+    ``journal_fail`` (deterministic failure) and another retry.
+
+    With ``job`` the SAME Job object carries the retrained run — the
+    scheduler's degraded-mode requeue and post-restart ``readmit()``
+    paths use this so callers blocked in ``job.join()`` still receive
+    the model.  The builder's own driver runs under that job, so journal
+    bookkeeping, snapshots and a possible second resume keep working.
+    """
+    from .. import persist
+    from . import dkv
+    from .observability import log, record
+    import h2o3_tpu.models as models
+    if entry is None:
+        entry = _read_entry(uri)
+    if entry.get("status") != "running":
+        return None
+    frame = dkv.get(entry.get("frame_key") or "")
+    if frame is None and entry.get("frame_source"):
+        # automated re-import from the journaled source URI
+        from ..frame.parse import import_file
+        try:
+            frame = import_file(entry["frame_source"],
+                                destination_frame=entry["frame_key"])
+            log.info("recovery: re-imported %r from %r",
+                     entry.get("frame_key"), entry["frame_source"])
+        except Exception as e:                 # noqa: BLE001
+            log.warning("recovery: re-import of %r failed: %r",
+                        entry.get("frame_source"), e)
+    if frame is None:
+        log.warning("recovery: frame %r not re-imported; skipping %s",
+                    entry.get("frame_key"), uri)
+        if job is not None:
+            raise RuntimeError(
+                f"recovery: frame {entry.get('frame_key')!r} not "
+                f"available for {uri}")
+        return None
+    cls = getattr(models, entry["algo"], None)
+    if cls is None:
+        log.warning("recovery: unknown algo %r in %s", entry["algo"], uri)
+        if job is not None:
+            raise RuntimeError(
+                f"recovery: unknown algo {entry['algo']!r} in {uri}")
+        return None
+    params = {k: v for k, v in entry["params"].items()
+              if v is not None}
+    prior = _load_snapshot_prior(entry, uri)
+    cursor = entry.get("snapshot_cursor") or {}
+    if prior is None and params.get("checkpoint") \
+            and dkv.get(params["checkpoint"]) is None:
+        # a resumed run that died again before its first snapshot
+        # journaled a checkpoint key that no longer resolves —
+        # fall back to a from-scratch retrain instead of failing
+        log.warning("recovery: journaled checkpoint %r not in DKV; "
+                    "%s restarts from scratch",
+                    params["checkpoint"], uri)
+        params.pop("checkpoint")
+    if prior is not None:
+        params["checkpoint"] = prior.key
+        # builder-specific continuation adjustments journaled with
+        # the cursor (e.g. deeplearning's remaining epochs)
+        for k, v in (cursor.get("resume_params") or {}).items():
+            params[k] = v
+        record("resume_from_snapshot", entry=uri,
+               snapshot=entry.get("snapshot_uri"), cursor=cursor)
+    builder = cls(**params)
+    if job is None:
+        model = builder.train(frame)
+    else:
+        builder._validate(frame)
+        di = builder._make_datainfo(frame)
+        builder.job = job
+        if not job.dest_key:
+            job.dest_key = dkv.make_key(builder.algo)
+        model = builder._make_driver(frame, di, None)(job)
+    if prior is not None:
+        model.output["resumed_from_snapshot"] = {
+            "snapshot_uri": entry.get("snapshot_uri"),
+            "cursor": cursor}
+        try:
+            dkv.remove(prior.key)
+            persist.delete(entry["snapshot_uri"])
+        except Exception:                      # noqa: BLE001
+            pass
+    try:
+        # the retrained run journaled (and cleaned up) under its own job
+        # key; the original entry is superseded either way
+        persist.delete(uri)
+    except Exception:                          # noqa: BLE001
+        pass
+    return model
+
+
 def resume(recovery_dir: Optional[str] = None) -> List[str]:
     """Re-train every journaled job still marked running.
 
@@ -206,80 +326,21 @@ def resume(recovery_dir: Optional[str] = None) -> List[str]:
     entries whose frame is missing are left in the journal and reported
     via the log.
     """
-    from .. import persist
-    from . import dkv
-    from .observability import log, record
+    from .observability import log
     base = recovery_dir or _dir()
     if not base:
         return []
-    import h2o3_tpu.models as models
     done: List[str] = []
-    for uri in persist.list_uris(f"{base.rstrip('/')}/job_*.json"):
-        try:
-            entry = _read_entry(uri)
-        except Exception as e:                 # noqa: BLE001
-            log.warning("recovery: unreadable journal entry %s: %r", uri, e)
-            continue
+    for uri, entry in journal_entries(base):
         if entry.get("status") != "running":
             continue
-        frame = dkv.get(entry.get("frame_key") or "")
-        if frame is None and entry.get("frame_source"):
-            # automated re-import from the journaled source URI
-            from ..frame.parse import import_file
-            try:
-                frame = import_file(entry["frame_source"],
-                                    destination_frame=entry["frame_key"])
-                log.info("recovery: re-imported %r from %r",
-                         entry.get("frame_key"), entry["frame_source"])
-            except Exception as e:             # noqa: BLE001
-                log.warning("recovery: re-import of %r failed: %r",
-                            entry.get("frame_source"), e)
-        if frame is None:
-            log.warning("recovery: frame %r not re-imported; skipping %s",
-                        entry.get("frame_key"), uri)
-            continue
-        cls = getattr(models, entry["algo"], None)
-        if cls is None:
-            log.warning("recovery: unknown algo %r in %s",
-                        entry["algo"], uri)
-            continue
-        params = {k: v for k, v in entry["params"].items()
-                  if v is not None}
-        prior = _load_snapshot_prior(entry, uri)
-        cursor = entry.get("snapshot_cursor") or {}
-        if prior is None and params.get("checkpoint") \
-                and dkv.get(params["checkpoint"]) is None:
-            # a resumed run that died again before its first snapshot
-            # journaled a checkpoint key that no longer resolves —
-            # fall back to a from-scratch retrain instead of failing
-            log.warning("recovery: journaled checkpoint %r not in DKV; "
-                        "%s restarts from scratch",
-                        params["checkpoint"], uri)
-            params.pop("checkpoint")
-        if prior is not None:
-            params["checkpoint"] = prior.key
-            # builder-specific continuation adjustments journaled with
-            # the cursor (e.g. deeplearning's remaining epochs)
-            for k, v in (cursor.get("resume_params") or {}).items():
-                params[k] = v
-            record("resume_from_snapshot", entry=uri,
-                   snapshot=entry.get("snapshot_uri"), cursor=cursor)
         try:
-            model = cls(**params).train(frame)
+            model = resume_entry(uri, entry=entry)
         except Exception as e:                 # noqa: BLE001
             log.warning("recovery: resumed %s failed (%r); marking "
                         "failed", uri, e)
             journal_fail(uri, repr(e))
             continue
-        if prior is not None:
-            model.output["resumed_from_snapshot"] = {
-                "snapshot_uri": entry.get("snapshot_uri"),
-                "cursor": cursor}
-            try:
-                dkv.remove(prior.key)
-                persist.delete(entry["snapshot_uri"])
-            except Exception:                  # noqa: BLE001
-                pass
-        done.append(model.key)
-        persist.delete(uri)
+        if model is not None:
+            done.append(model.key)
     return done

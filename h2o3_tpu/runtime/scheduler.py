@@ -1,0 +1,675 @@
+"""Elastic, admission-controlled, fair-share cluster scheduler.
+
+Reference: ``water/H2O.java`` runs one fork/join pool per priority level
+and locks cloud membership at the first job — a cluster can never grow,
+and a big build monopolizes the JVM until it finishes.  Here the scarce
+resource is the device mesh, and membership is heartbeat-driven, so the
+scheduler composes the repo's existing planes instead:
+
+* **Admission + fair share** — jobs arrive with ``priority`` (lower runs
+  first), a ``device_budget`` (fraction of the row mesh, or an explicit
+  chip count) and a ``retry_budget``.  The dispatcher packs jobs whose
+  budgets fit the free chip count; ties within a priority level break on
+  accumulated per-tenant chip-seconds (classic fair share), then FIFO.
+  A bounded admission queue rejects overload instead of buffering it.
+  On the virtual-host CI backend every compiled program still timeshares
+  the full mesh — the budget ledger bounds *co-residency* (how many jobs
+  run at once), which is what the makespan bench measures; true submesh
+  placement slots into ``_chips_for`` when per-job meshes land.
+
+* **Durability** — queue/assignment state is mirrored as plain records
+  under ``!sched/<jobkey>`` so a WAL-backed coordinator (runtime/dkv.py)
+  persists it across restarts; ``readmit()`` walks the recovery journal
+  (runtime/recovery.py) plus those records and re-submits every job that
+  was queued or in flight, resuming from progress snapshots where they
+  exist.
+
+* **Degraded mode** — when the failure watchdog classifies a host dead,
+  ``on_node_dead`` requeues that host's in-flight jobs from their
+  journal entries (snapshot-resume) instead of failing them; the SAME
+  Job object is re-dispatched onto the shrunken mesh, so callers blocked
+  in ``join()`` still get their model.  Jobs without retry budget or
+  journal fall through to the watchdog's normal fail path.
+
+* **Elastic membership** — with ``H2O3_TPU_SCHED_ELASTIC=1`` an observer
+  thread watches ``heartbeat.members()``; a newly-alive host arms a
+  fenced mesh rebuild that ``chunk_fence()`` applies at the next
+  job-chunk boundary (tree drivers call it from ``chunk_schedule``),
+  driving ``cluster.init(hosts=...)`` -> ``_invalidate_compiled_caches``
+  exactly once.  A ``Quarantine`` ledger damps flapping hosts so a
+  kill/rejoin loop cannot thrash rebuilds.
+
+Prometheus series: ``sched_queue_depth``, ``sched_running_jobs``,
+``sched_admission_rejected_total{reason}``, ``sched_requeue_total{reason}``,
+``sched_rebuild_total{reason}``, ``sched_join_total``,
+``sched_join_quarantined_total``, ``sched_quarantined_hosts``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Callable, Optional
+
+from . import dkv
+from .config import config
+from .observability import inc, log, record, set_gauge
+
+#: plain DKV records holding queue/assignment state (WAL-durable on a
+#: coordinator, epoch-repushed to a restarted one)
+SCHED_PREFIX = "!sched/"
+
+# reference-like priority levels (water/H2O.java H2OCountedCompleter)
+PRIORITY_ADMIN = 0
+PRIORITY_INTERACTIVE = 50
+PRIORITY_BUILD = 100
+
+
+# ---------------------------------------------------------------- device lease
+class DeviceLease:
+    """Serializes compiled-program launches across concurrent jobs.
+
+    XLA's in-process collectives deadlock when two SPMD programs that
+    contain cross-module collectives execute concurrently: each device
+    stream picks up work from whichever program enqueued first, so the
+    per-device participants of the two executions interleave at the
+    collective rendezvous and neither can complete.  Training drivers
+    hold the lease for the device-touching part of a fit and *yield* it
+    at every chunk boundary, so concurrent jobs time-share the mesh
+    chunk-by-chunk — small jobs still finish far ahead of a co-resident
+    large one — without ever launching collectives on top of each other.
+
+    Reentrant per thread (CV folds fit inline under the outer fit's
+    lease).  ``force_release`` breaks the lease of a worker wedged in a
+    collective that lost a member, so a requeued retry can launch.
+    """
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._owner: Optional[threading.Thread] = None
+        self._depth = 0
+        self._waiters = 0
+
+    def acquire(self) -> None:
+        me = threading.current_thread()
+        with self._cv:
+            if self._owner is not None and self._owner is not me:
+                self._waiters += 1
+                try:
+                    while self._owner is not None and self._owner is not me:
+                        self._cv.wait(timeout=1.0)
+                finally:
+                    self._waiters -= 1
+            self._owner = me
+            self._depth += 1
+
+    def release(self) -> None:
+        with self._cv:
+            if self._owner is not threading.current_thread():
+                return
+            self._depth -= 1
+            if self._depth <= 0:
+                self._owner, self._depth = None, 0
+                self._cv.notify_all()
+
+    def yield_turn(self) -> None:
+        """Give waiters a chunk-sized window; no-op when not the owner."""
+        me = threading.current_thread()
+        with self._cv:
+            if self._owner is not me:
+                return
+            if not self._waiters:       # uncontended: keep the lease
+                return
+            depth, self._owner, self._depth = self._depth, None, 0
+            self._cv.notify_all()
+        # Condition wakeups are not fair: without this pause the
+        # releasing thread usually re-acquires before any waiter runs
+        time.sleep(0.001)
+        with self._cv:
+            self._waiters += 1
+            try:
+                while self._owner is not None:
+                    self._cv.wait(timeout=1.0)
+            finally:
+                self._waiters -= 1
+            self._owner, self._depth = me, depth
+
+    def force_release(self, thread: Optional[threading.Thread]) -> None:
+        """Break the lease held by a wedged worker (node-death requeue)."""
+        with self._cv:
+            if thread is not None and self._owner is thread:
+                self._owner, self._depth = None, 0
+                self._cv.notify_all()
+
+
+#: process-wide — the hazard is per-backend, not per-scheduler
+DEVICE_LEASE = DeviceLease()
+
+
+@contextmanager
+def device_slot():
+    """Hold the device lease for a driver's device-touching section."""
+    DEVICE_LEASE.acquire()
+    try:
+        yield
+    finally:
+        DEVICE_LEASE.release()
+
+
+# ------------------------------------------------------------------ quarantine
+class Quarantine:
+    """Flap damping for elastic membership.
+
+    A host may join (and trigger a rebuild) at most ``max_flaps`` times
+    per sliding ``window_s``; past that it is quarantined until the
+    window expires — joins are acknowledged but arm no rebuild, so a
+    kill/rejoin loop costs at most ``max_flaps`` rebuilds per window.
+    """
+
+    def __init__(self, window_s: float = 60.0, max_flaps: int = 2):
+        self.window_s = float(window_s)
+        self.max_flaps = int(max_flaps)
+        self._joins: dict = {}      # host -> [join ts within window]
+        self._until: dict = {}      # host -> quarantined-until ts
+
+    def note_join(self, host: str, now: Optional[float] = None) -> bool:
+        """Record a join; True if the host is admitted (may rebuild)."""
+        now = time.time() if now is None else now
+        ts = [t for t in self._joins.get(host, ()) if now - t < self.window_s]
+        ts.append(now)
+        self._joins[host] = ts
+        if now < self._until.get(host, 0.0):
+            return False
+        if len(ts) > self.max_flaps:
+            self._until[host] = now + self.window_s
+            log.warning("scheduler: quarantining flapping host %s "
+                        "(%d joins in %.0fs window)", host, len(ts),
+                        self.window_s)
+            record("host_quarantined", node=host, joins=len(ts))
+            return False
+        return True
+
+    def is_quarantined(self, host: str, now: Optional[float] = None) -> bool:
+        now = time.time() if now is None else now
+        return now < self._until.get(host, 0.0)
+
+    def active(self, now: Optional[float] = None) -> list:
+        now = time.time() if now is None else now
+        return sorted(h for h, u in self._until.items() if u > now)
+
+    def describe(self, now: Optional[float] = None) -> dict:
+        now = time.time() if now is None else now
+        return {"window_s": self.window_s, "max_flaps": self.max_flaps,
+                "quarantined": self.active(now)}
+
+
+# --------------------------------------------------------------------- entries
+class _Entry:
+    __slots__ = ("job", "fn", "priority", "budget", "retry_budget", "user",
+                 "seq", "chips", "submit_ts", "released", "thread")
+
+    def __init__(self, job, fn, priority, budget, retry_budget, user, seq):
+        self.job = job
+        self.fn = fn
+        self.priority = priority
+        self.budget = budget
+        self.retry_budget = retry_budget
+        self.user = user
+        self.seq = seq
+        self.chips = 0
+        self.submit_ts = time.time()
+        self.released = False
+        self.thread: Optional[threading.Thread] = None
+
+
+class ClusterScheduler:
+    """Admission-controlled fair-share scheduler (see module docstring).
+
+    Keeps the ``JobScheduler`` contract — ``PRIORITY_*`` constants and
+    ``submit(job, fn, priority=...)`` — so existing callers run
+    unchanged; they just get budget-aware packing instead of a fixed
+    2-worker pool.
+    """
+
+    PRIORITY_ADMIN = PRIORITY_ADMIN
+    PRIORITY_INTERACTIVE = PRIORITY_INTERACTIVE
+    PRIORITY_BUILD = PRIORITY_BUILD
+
+    def __init__(self, capacity: Optional[int] = None,
+                 queue_limit: Optional[int] = None,
+                 elastic: Optional[bool] = None):
+        cfg = config()
+        self._capacity_override = capacity or cfg.sched_capacity or None
+        self._queue_limit = (queue_limit if queue_limit is not None
+                             else cfg.sched_queue_limit)
+        self._default_budget = cfg.sched_default_budget
+        self._queue: list = []               # pending _Entry, submit order
+        self._running: dict = {}             # job.key -> _Entry
+        self._used_chips = 0
+        self._usage: dict = {}               # tenant -> chip-seconds served
+        self._cv = threading.Condition()
+        self._seq = 0
+        self._shutdown = False
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True, name="sched-dispatch")
+        self._dispatcher.start()
+        # ------------------------------------------------ elastic membership
+        self._elastic = cfg.sched_elastic if elastic is None else elastic
+        self._known: set = set()             # alive hosts last observed
+        self._seeded = False                 # first observation baselines
+        self._pending_rebuild = False
+        self._rebuild_lock = threading.Lock()
+        self.quarantine = Quarantine(cfg.sched_quarantine_window_s,
+                                     cfg.sched_quarantine_flaps)
+        self._stop_member = threading.Event()
+        if self._elastic:
+            threading.Thread(target=self._member_loop, daemon=True,
+                             name="sched-membership").start()
+
+    # -------------------------------------------------------------- capacity
+    def capacity(self) -> int:
+        """Row-mesh chip count — from the live mesh when booted."""
+        if self._capacity_override:
+            return int(self._capacity_override)
+        from . import cluster as _cluster_mod
+        cl = _cluster_mod._cluster
+        if cl is not None:
+            return int(cl.n_row_shards)
+        return 8                    # pre-boot fallback; real value on boot
+
+    def _chips_for(self, budget, cap: int) -> int:
+        """Budget spec -> chip count.  ``None`` -> scheduler default
+        fraction; float in (0, 1] -> fraction of the row mesh; int >= 1
+        -> explicit chip count (capped at the mesh)."""
+        if budget is None:
+            budget = self._default_budget
+        if isinstance(budget, float) and 0.0 < budget <= 1.0:
+            return max(1, round(budget * cap))
+        n = int(budget)
+        if n < 1:
+            raise ValueError(f"device_budget must be a fraction in (0, 1] "
+                             f"or a chip count >= 1, got {budget!r}")
+        return min(n, cap)
+
+    # ---------------------------------------------------------------- submit
+    def submit(self, job, fn: Callable[[Any], Any],
+               priority: int = PRIORITY_BUILD,
+               device_budget=None, retry_budget: int = 0,
+               user: Optional[str] = None):
+        """Admit ``fn(job)``; returns the job immediately (poll/join it).
+
+        Raises ``RuntimeError`` when the admission queue is full — the
+        caller sheds load instead of the cluster buffering it."""
+        self._chips_for(device_budget, self.capacity())   # validate early
+        with self._cv:
+            if self._shutdown:
+                raise RuntimeError("job scheduler is stopped")
+            if len(self._queue) >= self._queue_limit:
+                inc("sched_admission_rejected_total", reason="queue_full")
+                raise RuntimeError(
+                    f"scheduler admission queue full "
+                    f"({len(self._queue)} queued, limit {self._queue_limit})")
+            self._seq += 1
+            ent = _Entry(job, fn, priority, device_budget, retry_budget,
+                         user, self._seq)
+            job._queued = True
+            job._owner = self
+            job.priority = priority
+            job.device_budget = device_budget
+            job.retry_budget = retry_budget
+            job.user = user
+            self._queue.append(ent)
+            set_gauge("sched_queue_depth", len(self._queue))
+            self._persist(ent, "queued")
+            self._cv.notify_all()
+        return job
+
+    def _persist(self, ent: _Entry, state: str, **extra) -> None:
+        """Mirror scheduling state as a plain (WAL-durable) DKV record."""
+        try:
+            dkv.put(SCHED_PREFIX + ent.job.key, {
+                "job": ent.job.key, "description": ent.job.description,
+                "priority": ent.priority, "device_budget": ent.budget,
+                "retry_budget": ent.retry_budget, "user": ent.user,
+                "state": state, "chips": ent.chips, "seq": ent.seq,
+                "retries": getattr(ent.job, "retries", 0),
+                "ts": time.time(), **extra})
+        except Exception:           # noqa: BLE001 — state mirror best-effort
+            pass
+
+    def _unpersist(self, job) -> None:
+        try:
+            dkv.remove(SCHED_PREFIX + job.key)
+        except Exception:           # noqa: BLE001
+            pass
+
+    # -------------------------------------------------------------- dispatch
+    def _pick_locked(self) -> Optional[_Entry]:
+        """Best admissible entry: (priority, tenant usage, seq) order among
+        those whose chip demand fits the free capacity.  An idle mesh
+        always admits the front-runner so demand > capacity cannot
+        deadlock the queue."""
+        cap = self.capacity()
+        free = cap - self._used_chips
+        best = None
+        best_key = None
+        best_chips = 0
+        for ent in self._queue:
+            try:
+                chips = self._chips_for(ent.budget, cap)
+            except ValueError:
+                chips = cap
+            if chips > free and self._used_chips > 0:
+                continue
+            k = (ent.priority, self._usage.get(ent.user or "", 0.0), ent.seq)
+            if best_key is None or k < best_key:
+                best, best_key, best_chips = ent, k, chips
+        if best is not None:
+            self._queue.remove(best)
+            best.chips = best_chips
+            self._used_chips += best.chips
+            self._running[best.job.key] = best
+            set_gauge("sched_queue_depth", len(self._queue))
+            set_gauge("sched_running_jobs", len(self._running))
+        return best
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._cv:
+                ent = self._pick_locked()
+                while ent is None:
+                    if self._shutdown and not self._queue:
+                        return
+                    self._cv.wait(timeout=0.25)
+                    ent = self._pick_locked()
+            threading.Thread(target=self._run_entry, args=(ent,),
+                             daemon=True,
+                             name=f"sched-run-{ent.job.key}").start()
+
+    def _run_entry(self, ent: _Entry) -> None:
+        from . import failure
+        job = ent.job
+        ent.thread = threading.current_thread()
+        self._persist(ent, "running")
+        t0 = time.monotonic()
+        try:
+            failure.maybe_inject("sched_assign")
+            job.run(ent.fn)
+        except BaseException as e:   # noqa: BLE001
+            # a worker-thread exception must always reach the job — even
+            # one thrown outside Job.run (injection, scheduler bugs)
+            if not job._done.is_set():
+                job.fail(e)
+        finally:
+            with self._cv:
+                if self._running.get(job.key) is ent:
+                    self._running.pop(job.key)
+                if not ent.released:
+                    ent.released = True
+                    self._used_chips -= ent.chips
+                tenant = ent.user or ""
+                self._usage[tenant] = (self._usage.get(tenant, 0.0)
+                                       + ent.chips * (time.monotonic() - t0))
+                requeued = any(e.job is job for e in self._queue)
+                set_gauge("sched_running_jobs", len(self._running))
+                self._cv.notify_all()
+            if not requeued:
+                if job.status == "FAILED":
+                    self._persist(ent, "failed")
+                else:
+                    self._unpersist(job)
+
+    # ---------------------------------------------------------------- cancel
+    def try_cancel(self, job) -> bool:
+        """Dequeue a queued-but-unstarted job and mark it CANCELLED
+        without ever running it.  False if it already left the queue
+        (Job.cancel's cooperative flag covers the running case)."""
+        with self._cv:
+            for ent in self._queue:
+                if ent.job is job:
+                    self._queue.remove(ent)
+                    set_gauge("sched_queue_depth", len(self._queue))
+                    break
+            else:
+                return False
+        job._mark_cancelled()
+        self._unpersist(job)
+        record("sched_cancel_dequeued", job=job.key)
+        return True
+
+    # --------------------------------------------------------- degraded mode
+    def on_node_dead(self, node: str, err: BaseException) -> set:
+        """Requeue the dead host's in-flight jobs from their journal
+        entries; returns the requeued job keys (the watchdog fails the
+        rest).  The wedged worker thread's chips are released NOW — a
+        gang that lost a member never completes, and the run-token guard
+        in Job.run keeps the stale thread from clobbering the retry."""
+        requeued: set = set()
+        with self._cv:
+            for key, ent in list(self._running.items()):
+                job = ent.job
+                retries = getattr(job, "retries", 0)
+                if (ent.retry_budget and retries < ent.retry_budget
+                        and job.journal_uri):
+                    self._running.pop(key)
+                    if not ent.released:
+                        ent.released = True
+                        self._used_chips -= ent.chips
+                    job._reset_for_retry()
+                    self._seq += 1
+                    new = _Entry(job, _resume_fn(job.journal_uri),
+                                 ent.priority, ent.budget, ent.retry_budget,
+                                 ent.user, self._seq)
+                    self._queue.append(new)
+                    inc("sched_requeue_total", reason="node_dead")
+                    record("sched_requeue", job=key, node=node,
+                           retries=job.retries)
+                    log.warning("scheduler: requeueing %s after %s died "
+                                "(retry %d/%d)", key, node, job.retries,
+                                ent.retry_budget)
+                    self._persist(new, "queued")
+                    requeued.add(key)
+                    # the stale worker may be wedged inside a collective
+                    # that lost a member — holding the device lease; the
+                    # retry cannot launch until the lease is broken
+                    DEVICE_LEASE.force_release(ent.thread)
+            set_gauge("sched_queue_depth", len(self._queue))
+            set_gauge("sched_running_jobs", len(self._running))
+            self._cv.notify_all()
+        return requeued
+
+    # ------------------------------------------------------------ membership
+    def _member_loop(self) -> None:
+        cfg = config()
+        while not self._stop_member.wait(cfg.sched_member_poll_s):
+            if self._shutdown:
+                return
+            try:
+                self.observe_members()
+            except Exception:        # noqa: BLE001 — observer must survive
+                pass
+
+    def observe_members(self, members: Optional[dict] = None,
+                        now: Optional[float] = None) -> None:
+        """One membership observation: new alive hosts arm a fenced
+        rebuild (unless quarantined).  The first observation baselines
+        the membership — booting next to an existing cloud must not arm
+        a rebuild for hosts that were always there."""
+        from . import failure, heartbeat
+        if members is None:
+            members = heartbeat.members()
+        now = time.time() if now is None else now
+        alive = {n for n, m in members.items()
+                 if m.get("status") == "alive"}
+        with self._cv:
+            joined = set() if not self._seeded else alive - self._known
+            self._seeded = True
+            self._known = alive
+        for node in sorted(joined):
+            failure.maybe_inject("host_join")
+            if self.quarantine.note_join(node, now):
+                inc("sched_join_total")
+                record("host_join", node=node)
+                log.warning("scheduler: host %s joined; mesh rebuild armed "
+                            "for the next chunk boundary", node)
+                with self._cv:
+                    self._pending_rebuild = True
+            else:
+                inc("sched_join_quarantined_total")
+                record("host_join_quarantined", node=node)
+        set_gauge("sched_quarantined_hosts",
+                  len(self.quarantine.active(now)))
+
+    def apply_rebuild(self) -> bool:
+        """Apply an armed mesh rebuild (called at a chunk boundary)."""
+        with self._rebuild_lock:
+            with self._cv:
+                if not self._pending_rebuild:
+                    return False
+                self._pending_rebuild = False
+                alive = len(self._known) or 1
+            from . import cluster as _cluster_mod
+            cl = _cluster_mod._cluster
+            if cl is None:
+                return False
+            n_row = cl.n_row_shards
+            hosts = _fit_hosts(alive, n_row)
+            if hosts == cl.mesh.shape[_cluster_mod.HOST_AXIS]:
+                record("sched_rebuild_skipped", hosts=hosts)
+                return False
+            log.warning("scheduler: fenced mesh rebuild -> hosts=%d "
+                        "(%d alive)", hosts, alive)
+            _cluster_mod.init(hosts=hosts)
+            inc("sched_rebuild_total", reason="host_join")
+            record("sched_rebuild", hosts=hosts, alive=alive)
+            return True
+
+    # ------------------------------------------------------------- introspect
+    def describe(self) -> dict:
+        with self._cv:
+            cap = self.capacity()
+            return {
+                "capacity_chips": cap,
+                "used_chips": self._used_chips,
+                "free_chips": cap - self._used_chips,
+                "queue_limit": self._queue_limit,
+                "elastic": self._elastic,
+                "pending_rebuild": self._pending_rebuild,
+                "known_hosts": sorted(self._known),
+                "fair_share_usage": dict(self._usage),
+                "quarantine": self.quarantine.describe(),
+                "queued": [{
+                    "job": e.job.key, "description": e.job.description,
+                    "priority": e.priority, "device_budget": e.budget,
+                    "retry_budget": e.retry_budget, "user": e.user,
+                    "waiting_s": round(time.time() - e.submit_ts, 3),
+                } for e in self._queue],
+                "running": [{
+                    "job": e.job.key, "description": e.job.description,
+                    "priority": e.priority, "chips": e.chips,
+                    "user": e.user, "retries": getattr(e.job, "retries", 0),
+                } for e in self._running.values()],
+            }
+
+    def stop(self) -> None:
+        """Stop accepting work; the dispatcher drains what is queued."""
+        from . import job as _job_mod
+        with self._cv:
+            self._shutdown = True
+            self._stop_member.set()
+            self._cv.notify_all()
+        with _job_mod._sched_lock:
+            if _job_mod._scheduler is self:
+                _job_mod._scheduler = None
+
+
+# ----------------------------------------------------------------- module api
+def _fit_hosts(alive: int, n_row: int) -> int:
+    """Largest host-axis size <= alive that divides the row mesh."""
+    for h in range(min(alive, n_row), 0, -1):
+        if n_row % h == 0:
+            return h
+    return 1
+
+
+def _resume_fn(uri: str) -> Callable[[Any], Any]:
+    """Driver fn that resumes one journal entry onto the current mesh."""
+    def _fn(job):
+        from . import recovery
+        return recovery.resume_entry(uri, job=job)
+    return _fn
+
+
+def _active() -> Optional[ClusterScheduler]:
+    """The live singleton, or None — never constructs (hot paths)."""
+    from . import job as _job_mod
+    s = _job_mod._scheduler
+    return s if isinstance(s, ClusterScheduler) else None
+
+
+def chunk_fence() -> bool:
+    """Per-chunk hook for training drivers: applies an armed elastic
+    mesh rebuild at this chunk boundary (True if the mesh was rebuilt —
+    the driver's next compile re-traces against the new mesh), then
+    yields the device lease so co-resident jobs interleave
+    chunk-by-chunk instead of launching collectives concurrently."""
+    s = _active()
+    rebuilt = False
+    if s is not None and s._pending_rebuild:
+        rebuilt = s.apply_rebuild()
+    DEVICE_LEASE.yield_turn()
+    return rebuilt
+
+
+def on_node_dead(node: str, err: BaseException) -> set:
+    """Failure-watchdog hook: requeue the scheduler's in-flight jobs for
+    a dead node.  Returns requeued job keys ({} when no scheduler)."""
+    s = _active()
+    if s is None:
+        return set()
+    return s.on_node_dead(node, err)
+
+
+def readmit(block: bool = False) -> list:
+    """Re-admit journaled work after a coordinator restart.
+
+    Walks the recovery journal for resumable entries, enriches each with
+    the WAL-persisted ``!sched/`` record (priority/budget/tenant survive
+    the restart), and re-submits through the scheduler — restart
+    re-admits rather than loses jobs.  Returns the re-admitted Jobs
+    (``block=True`` joins them first)."""
+    from . import recovery
+    from .job import Job, scheduler
+    s = scheduler()
+    metas = {}
+    for k in dkv.keys(SCHED_PREFIX):
+        rec = dkv.get(k)
+        if isinstance(rec, dict) and rec.get("state") in ("queued",
+                                                          "running"):
+            metas[rec.get("job")] = rec
+    jobs = []
+    for uri, entry in recovery.journal_entries():
+        if entry.get("status") != "running":
+            continue
+        jobkey = entry.get("job") or ""
+        meta = metas.get(jobkey, {})
+        job = Job(f"readmit {entry.get('algo', '?')} train",
+                  dest_key=entry.get("dest_key"))
+        pr = meta.get("priority")
+        s.submit(job, _resume_fn(uri),
+                 priority=PRIORITY_BUILD if pr is None else pr,
+                 device_budget=meta.get("device_budget"),
+                 retry_budget=meta.get("retry_budget") or 0,
+                 user=meta.get("user"))
+        if jobkey and jobkey != job.key:
+            try:
+                dkv.remove(SCHED_PREFIX + jobkey)   # superseded record
+            except Exception:        # noqa: BLE001
+                pass
+        record("sched_readmit", job=job.key, journal=uri)
+        jobs.append(job)
+    if block:
+        for job in jobs:
+            job.join()
+    return jobs

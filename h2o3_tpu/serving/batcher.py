@@ -10,8 +10,12 @@ tick interval, batch capacity, queue depth.
 
 Prometheus series (runtime/observability registry, already exposed at
 ``GET /metrics``): ``serve_batch_size`` (rows per launch),
-``serve_queue_depth`` (rows waiting at drain), and
-``serve_latency_seconds{phase=queue|device|total}``.
+``serve_queue_depth`` (rows waiting at drain),
+``serve_latency_seconds{phase=queue|device|total}``, and
+``serve_rejected_total{reason=queue_full|deadline}`` — the latter when
+admission overflows or a request exceeds its per-request deadline
+(``H2O3_TPU_SERVE_DEADLINE_MS``; shed with HTTP 503, also during
+SIGTERM drain).
 
 ``publish(key, model)`` packs a trained model, starts its batcher, and
 warms the executable so the first real request never pays a compile;
@@ -33,6 +37,13 @@ from ..runtime.config import config
 _BATCH_BUCKETS = (1., 2., 4., 8., 16., 32., 64., 128., 256., 512., 1024.)
 
 
+class DeadlineExceeded(RuntimeError):
+    """A request waited in the serving queue past its per-request
+    deadline (``H2O3_TPU_SERVE_DEADLINE_MS``) and was shed — the REST
+    layer maps this to HTTP 503 so clients retry elsewhere instead of
+    hanging behind a backed-up device."""
+
+
 class _Pending:
     __slots__ = ("X", "out", "error", "event", "t_enqueue", "t_launch")
 
@@ -50,13 +61,18 @@ class MicroBatcher:
 
     def __init__(self, scorer, max_batch: Optional[int] = None,
                  tick_ms: Optional[float] = None,
-                 queue_depth: Optional[int] = None):
+                 queue_depth: Optional[int] = None,
+                 deadline_ms: Optional[float] = None):
         cfg = config()
         self.scorer = scorer
         self.max_batch = int(max_batch or cfg.serve_max_batch)
         self.tick_s = float(tick_ms if tick_ms is not None
                             else cfg.serve_tick_ms) / 1000.0
         self.queue_depth = int(queue_depth or cfg.serve_queue_depth)
+        # per-request queue deadline (0 = none): expired requests are
+        # shed at drain time and during close(), never dispatched
+        self.deadline_s = float(deadline_ms if deadline_ms is not None
+                                else cfg.serve_deadline_ms) / 1000.0
         self._queue: "collections.deque[_Pending]" = collections.deque()
         self._queued_rows = 0
         self._lock = threading.Lock()
@@ -84,7 +100,7 @@ class MicroBatcher:
             if self._closed:
                 raise RuntimeError("serving batcher is shut down")
             if self._queued_rows + X.shape[0] > self.queue_depth:
-                obs.inc("serve_rejected_total")
+                obs.inc("serve_rejected_total", reason="queue_full")
                 raise RuntimeError(
                     f"serving queue full ({self._queued_rows} rows "
                     f"waiting, depth {self.queue_depth})")
@@ -121,19 +137,38 @@ class MicroBatcher:
             leftovers = list(self._queue)
             self._queue.clear()
             self._queued_rows = 0
+        # SIGTERM drain: requests already past their deadline shed as
+        # 503s, the rest error as a shutdown — nothing hangs
+        now = time.perf_counter()
         for p in leftovers:
-            p.error = RuntimeError("serving batcher shut down")
-            p.event.set()
+            if self.deadline_s > 0 and now - p.t_enqueue > self.deadline_s:
+                self._expire(p, now)
+            else:
+                p.error = RuntimeError("serving batcher shut down")
+                p.event.set()
 
     # ----------------------------------------------------------- ticker
+    def _expire(self, p: "_Pending", now: float) -> None:
+        obs.inc("serve_rejected_total", reason="deadline")
+        p.error = DeadlineExceeded(
+            f"request waited {(now - p.t_enqueue) * 1e3:.0f}ms in the "
+            f"serving queue, past its {self.deadline_s * 1e3:.0f}ms "
+            f"deadline")
+        p.event.set()
+
     def _drain_locked(self):
         batch, rows = [], 0
+        now = time.perf_counter()
         while self._queue and rows + self._queue[0].X.shape[0] \
                 <= self.max_batch:
             p = self._queue.popleft()
+            self._queued_rows -= p.X.shape[0]
+            if self.deadline_s > 0 \
+                    and now - p.t_enqueue > self.deadline_s:
+                self._expire(p, now)     # shed, don't dispatch
+                continue
             rows += p.X.shape[0]
             batch.append(p)
-        self._queued_rows -= rows
         return batch, rows
 
     def _run(self):

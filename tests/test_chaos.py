@@ -481,6 +481,206 @@ def test_coordinator_hard_kill_midtrain_rehydrate_reattach(cl, tmp_path):
                 p.wait(timeout=15)
 
 
+# -------------------------------------------------- multi-tenant host kill
+
+MT_BIG_TREES = 16          # 8 chunks of 2 trees
+MT_SMALL_TREES = 12        # 6 chunks each
+MT_KILL_AT_HIT = 5         # shared tree_chunk counter: < any job's 6th
+                           # chunk-top, so NO job can have completed
+
+_TENANT_TRAIN = textwrap.dedent("""
+    import sys
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import h2o3_tpu
+    h2o3_tpu.init()
+    from h2o3_tpu.frame.parse import import_file
+    from h2o3_tpu.models import GBM
+    from h2o3_tpu.runtime import dkv
+    dkv.serve(host="127.0.0.1", port=0)   # coordinator role: WAL on
+    fr = import_file(sys.argv[1], destination_frame="mt_fr")
+    jobs = []
+    big = GBM(response_column="y", ntrees={big}, max_depth=3,
+              learn_rate=0.2, seed=7, score_tree_interval=2,
+              device_budget=0.5, retry_budget=1)
+    jobs.append((7, big.train_async(fr, user="alice")))
+    for seed, user in ((101, "bob"), (102, "carol"), (103, "dave")):
+        small = GBM(response_column="y", ntrees={small}, max_depth=2,
+                    learn_rate=0.2, seed=seed, score_tree_interval=2,
+                    device_budget=0.125, retry_budget=1)
+        jobs.append((seed, small.train_async(fr, user=user)))
+    for seed, job in jobs:
+        m = job.join(timeout=600)
+        assert job.status == "DONE", (seed, job.status, job.exception)
+        np.save(sys.argv[2] + "_" + str(seed) + ".npy",
+                m.predict(fr).to_numpy()[:, 0])
+    print("TRAINED_ALL", len(jobs))
+""").format(big=MT_BIG_TREES, small=MT_SMALL_TREES)
+
+_TENANT_READMIT = textwrap.dedent("""
+    import json
+    import sys
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import h2o3_tpu
+    h2o3_tpu.init()
+    from h2o3_tpu.frame.parse import import_file
+    from h2o3_tpu.runtime import dkv, scheduler
+    dkv.serve(host="127.0.0.1", port=0)   # rehydrates the WAL: the
+    # !sched/ scheduling records and the make_key counter come back
+    fr = import_file(sys.argv[1], destination_frame="mt_fr")
+    jobs = scheduler.readmit(block=True)
+    assert len(jobs) == 4, [j.describe() for j in jobs]
+    users = set()
+    for job in jobs:
+        assert job.status == "DONE", (job.key, job.status, job.exception)
+        users.add(job.user)
+        m = job.result
+        np.save(sys.argv[2] + "_" + str(m.params.seed) + ".npy",
+                m.predict(fr).to_numpy()[:, 0])
+    print("READMIT_INFO", json.dumps({"n": len(jobs),
+                                      "users": sorted(users)}))
+""")
+
+
+def test_host_kill_mid_multitenant_load(cl, tmp_path):
+    """Chaos row: one large + three small tenant jobs run CONCURRENTLY
+    under the fair-share scheduler when the host is hard-killed.  A fresh
+    process rehydrates the coordinator WAL, re-imports the frame, and
+    ``scheduler.readmit()`` re-admits all four jobs with their original
+    tenants — zero job failures, every prediction matches an
+    uninterrupted run."""
+    csv = _write_csv(tmp_path / "mt.csv")
+    base_dir = tmp_path / "base_mt"
+    base_dir.mkdir()
+
+    base_prefix = str(tmp_path / "base_mt_pred")
+    out = _run(_TENANT_TRAIN, _chaos_env(base_dir), csv, base_prefix,
+               timeout=600)
+    assert "TRAINED_ALL 4" in out.stdout
+    assert not list(base_dir.glob("job_*.json"))    # all journals consumed
+
+    # hard-kill while all four jobs are in flight: the shared injection
+    # counter guarantees no job has reached its final chunk by hit 5
+    kill_dir = tmp_path / "kill_mt"
+    kill_dir.mkdir()
+    _run(_TENANT_TRAIN,
+         _chaos_env(kill_dir,
+                    {"H2O3_TPU_FAULT_INJECT":
+                     f"tree_chunk:0:{MT_KILL_AT_HIT}"}),
+         csv, str(tmp_path / "unused_mt"), expect_rc=137, timeout=600)
+    entries = [json.loads(p.read_text())
+               for p in kill_dir.glob("job_*.json")]
+    assert len(entries) == 4                        # every tenant journaled
+    assert all(e["status"] == "running" for e in entries)
+
+    res_prefix = str(tmp_path / "res_mt_pred")
+    out = _run(_TENANT_READMIT, _chaos_env(kill_dir), csv, res_prefix,
+               timeout=600)
+    info = json.loads(
+        next(line for line in out.stdout.splitlines()
+             if line.startswith("READMIT_INFO ")).split(" ", 1)[1])
+    assert info["n"] == 4
+    assert info["users"] == ["alice", "bob", "carol", "dave"]
+    assert not list(kill_dir.glob("job_*.json"))
+
+    for seed in (7, 101, 102, 103):
+        np.testing.assert_allclose(
+            np.load(f"{res_prefix}_{seed}.npy"),
+            np.load(f"{base_prefix}_{seed}.npy"),
+            rtol=1e-4, atol=1e-4, err_msg=f"tenant model seed={seed}")
+
+
+# ------------------------------------------------- host join / fenced rebuild
+
+_JOIN_TRAIN = textwrap.dedent("""
+    import json
+    import sys
+    import time
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import h2o3_tpu
+    h2o3_tpu.init()
+    from h2o3_tpu.frame.parse import import_file
+    from h2o3_tpu.models import GBM
+    from h2o3_tpu.runtime import cluster, dkv, heartbeat
+    from h2o3_tpu.runtime import observability as obs
+    from h2o3_tpu.runtime.job import scheduler
+    s = scheduler()              # elastic membership observer is on
+    heartbeat.start(interval=0.5)
+    time.sleep(0.5)              # first poll baselines the membership
+    fr = import_file(sys.argv[1], destination_frame="join_fr")
+    big = GBM(response_column="y", ntrees={nt}, max_depth=3,
+              learn_rate=0.2, seed=7, score_tree_interval=2,
+              device_budget=1.0)
+    job = big.train_async(fr, user="alice")
+    deadline = time.time() + 300
+    while job.progress < 0.15 and time.time() < deadline:
+        time.sleep(0.05)
+    assert job.progress >= 0.15, job.describe()
+    if sys.argv[3] == "join":
+        # a new host appears mid-train: an alive stamp the observer will
+        # pick up within its poll; the rebuild applies at a chunk fence
+        dkv.put("!hb/joiner:1",
+                {{"ts": time.time(), "interval": 10.0, "pid": 1}})
+    m = job.join(timeout=600)
+    assert job.status == "DONE", (job.status, job.exception)
+    reinits = [e for e in obs.timeline_events(5000)
+               if e["kind"] == "cluster_reinit"]
+    wire = obs.metrics_wire()
+    print("JOIN_INFO", json.dumps({{
+        "reinits": len(reinits),
+        "rebuild_total": sum(s["v"] for s in wire
+                             if s["n"] == "sched_rebuild_total"),
+        "reinit_recompiles": sum(
+            s["v"] for s in wire if s["n"] == "recompiles_total"
+            and s["l"].get("reason") == "cluster_reinit"),
+        "hosts_axis": cluster._cluster.mesh.shape["hosts"]}}))
+    np.save(sys.argv[2], m.predict(fr).to_numpy()[:, 0])
+    heartbeat.stop(remove=False)
+""").format(nt=NTREES)
+
+
+def test_host_join_fenced_rebuild_midtrain(cl, tmp_path):
+    """Chaos row: a host joins mid-train on an elastic 1-host cluster.
+    The membership observer arms a rebuild, ``chunk_fence()`` applies
+    EXACTLY ONE fenced ``cluster.init(hosts=2)`` at a chunk boundary
+    (proven by the timeline + ``recompiles_total{reason=cluster_reinit}``),
+    and the finished model still matches an uninterrupted 1-host run."""
+    csv = _write_csv(tmp_path / "join.csv")
+    elastic = {"H2O3_TPU_HOSTS": "1", "H2O3_TPU_SCHED_ELASTIC": "1",
+               "H2O3_TPU_SCHED_MEMBER_POLL": "0.2"}
+
+    base_dir = tmp_path / "base_join"
+    base_dir.mkdir()
+    base_npy = str(tmp_path / "base_join.npy")
+    out = _run(_JOIN_TRAIN, _chaos_env(base_dir, elastic), csv, base_npy,
+               "nojoin", timeout=600)
+    info = json.loads(
+        next(line for line in out.stdout.splitlines()
+             if line.startswith("JOIN_INFO ")).split(" ", 1)[1])
+    assert info["reinits"] == 0 and info["hosts_axis"] == 1
+
+    join_dir = tmp_path / "join_run"
+    join_dir.mkdir()
+    join_npy = str(tmp_path / "join_run.npy")
+    out = _run(_JOIN_TRAIN, _chaos_env(join_dir, elastic), csv, join_npy,
+               "join", timeout=600)
+    info = json.loads(
+        next(line for line in out.stdout.splitlines()
+             if line.startswith("JOIN_INFO ")).split(" ", 1)[1])
+    assert info["reinits"] == 1                # exactly one fenced rebuild
+    assert info["rebuild_total"] == 1
+    assert info["reinit_recompiles"] >= 1      # attributed recompiles
+    assert info["hosts_axis"] == 2             # mesh actually grew
+
+    np.testing.assert_allclose(np.load(join_npy), np.load(base_npy),
+                               rtol=1e-4, atol=1e-4)
+
+
 def test_kill_without_snapshot_still_resumes_from_zero(cl, tmp_path):
     """Matrix row 2: killed before the first snapshot could land
     (snapshot_write is the kill point) — the journal has no snapshot_uri

@@ -301,6 +301,84 @@ def test_microbatcher_queue_overflow(cl, rng):
         mb.close()
 
 
+def test_microbatcher_deadline_sheds(cl, rng):
+    """A request that waits past H2O3_TPU_SERVE_DEADLINE_MS is shed at
+    drain time (counted, never dispatched), not scored late."""
+    from h2o3_tpu.runtime import observability as obs
+    from h2o3_tpu.serving.batcher import DeadlineExceeded
+    _, fr_bin, data = _frames(rng)
+    m = GBM(response_column="y", ntrees=3, seed=1).train(fr_bin)
+    ps = _scorer(m)
+    # the tick lands the first drain well past the 50 ms deadline
+    mb = MicroBatcher(ps, max_batch=8, tick_ms=300.0, queue_depth=64,
+                      deadline_ms=50.0)
+    try:
+        before = obs.counter("serve_rejected_total",
+                             reason="deadline").value
+        X = ps.featurize(_na_rows(data, rng, k=2))
+        with pytest.raises(DeadlineExceeded, match="deadline"):
+            mb.submit(X)
+        if obs.enabled():
+            assert obs.counter("serve_rejected_total",
+                               reason="deadline").value > before
+    finally:
+        mb.close()
+
+
+def test_microbatcher_close_sheds_expired(cl, rng):
+    """SIGTERM drain: close() sheds already-expired requests as deadline
+    rejections instead of erroring them as a plain shutdown."""
+    from h2o3_tpu.serving.batcher import DeadlineExceeded
+    _, fr_bin, data = _frames(rng)
+    m = GBM(response_column="y", ntrees=3, seed=1).train(fr_bin)
+    ps = _scorer(m)
+    mb = MicroBatcher(ps, max_batch=8, tick_ms=500.0, queue_depth=64,
+                      deadline_ms=30.0)
+    X = ps.featurize(_na_rows(data, rng, k=2))
+    errs = []
+
+    def client():
+        try:
+            mb.submit(X)
+        except BaseException as e:           # noqa: BLE001
+            errs.append(e)
+
+    t = threading.Thread(target=client, daemon=True)
+    t.start()
+    import time
+    time.sleep(0.1)                          # stale by close time
+    mb.close()
+    t.join(timeout=10)
+    assert len(errs) == 1
+    assert isinstance(errs[0], DeadlineExceeded)
+
+
+def test_rest_deadline_returns_503(cl, rng):
+    """The REST layer maps a shed request to HTTP 503 so clients retry
+    elsewhere instead of treating it as a bad request."""
+    from h2o3_tpu.api import start_server
+    from h2o3_tpu import serving
+    _, fr_bin, data = _frames(rng)
+    m = GBM(response_column="y", ntrees=3, seed=1).train(fr_bin)
+    s = start_server(port=0)
+    try:
+        ent = serving.ensure_published(m.key)
+        ent.batcher.warmup()
+        ent.batcher.tick_s = 0.3             # drain lands past...
+        ent.batcher.deadline_s = 0.02        # ...a 20 ms deadline
+        rows = _na_rows(data, rng, k=2)
+        req = urllib.request.Request(
+            s.url + f"/3/Predictions/realtime/{m.key}",
+            data=json.dumps({"rows": rows}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req)
+        assert e.value.code == 503
+    finally:
+        serving.shutdown_all()
+        s.stop()
+
+
 def test_microbatcher_close_errors_waiters(cl, rng):
     _, fr_bin, data = _frames(rng)
     m = GBM(response_column="y", ntrees=3, seed=1).train(fr_bin)

@@ -1,39 +1,83 @@
 #!/usr/bin/env bash
 # chaos.sh — THE chaos-suite entry point (ROADMAP lists it next to
-# tier1.sh).  One command runs the full survivable-training matrix:
+# tier1.sh).  One command runs the full survivable-training matrix,
+# one ROW at a time, and writes a per-row PASS/FAIL summary artifact
+# (${H2O3_CHAOS_ROWS:-/tmp/chaos_rows.txt}) so CI surfaces exactly
+# which scenario regressed:
 #
-#   - kill-resume-verify: a real subprocess is hard-killed (exit 137)
+#   - kill-resume:        a real subprocess is hard-killed (exit 137)
 #     mid-GBM via H2O3_TPU_FAULT_INJECT, a fresh process re-imports the
 #     journaled frame and recovery.resume() continues from the progress
-#     snapshot; final predictions must match an uninterrupted run
+#     snapshot; final predictions must match an uninterrupted run,
+#     including the deep-level sparse layout and multinomial variants
+#     and the no-snapshot resume-from-zero row (tests/test_chaos.py),
+#   - coordinator-kill:   the DKV coordinator os._exit(137)s mid-GBM,
+#     is restarted on the same port + recovery dir, the worker rides
+#     out the outage on its retry budget and fences the new epoch
 #     (tests/test_chaos.py),
-#   - deep-level kill: the same kill-resume-verify scenario with the
-#     node-sparse deep-level layout engaged (hist_layout="sparse" past
-#     its depth threshold; deep_level injection point)
-#     (tests/test_chaos.py),
-#   - coordinator hard-kill: the DKV coordinator os._exit(137)s mid-GBM
-#     (dkv_handle:coordinator:N), is restarted on the same port +
-#     recovery dir, the worker rides out the outage on its retry budget,
-#     fences the new epoch, and the model matches the uninterrupted run
-#     (tests/test_chaos.py),
-#   - mesh host-kill: the same hard-kill scenario on the hierarchical
-#     2-host ("hosts","chips") mesh with the staged ICI+DCN reduce
-#     engaged; a fresh process rebuilds the same mesh, resumes, and
-#     matches the uninterrupted run (tests/test_mesh_hier.py),
+#   - multitenant-kill:   1 large + 3 small concurrent jobs under the
+#     fair-share scheduler, host hard-killed mid-load; a fresh process
+#     re-admits every journaled job (scheduler.readmit) and all four
+#     models match uninterrupted runs (tests/test_chaos.py),
+#   - host-join:          a host joins mid-train; the elastic observer
+#     arms exactly one fenced mesh rebuild at a chunk boundary
+#     (recompiles_total{reason="cluster_reinit"}) (tests/test_chaos.py),
+#   - scheduler:          fair-share/admission/requeue/readmit/
+#     quarantine unit matrix (tests/test_scheduler.py),
+#   - mesh host-kill: the hard-kill scenario on the hierarchical 2-host
+#     ("hosts","chips") mesh with the staged ICI+DCN reduce engaged
+#     (tests/test_mesh_hier.py),
 #   - WAL+snapshot rehydration, epoch fencing/re-push, exactly-once
 #     dedup across a real SIGKILL, handler hardening
 #     (tests/test_dkv_wal.py),
 #   - DKV retry budget + exactly-once under dropped responses, plain and
 #     TLS (tests/test_dkv_retry.py),
 #   - in-process snapshot/journal/resume contracts
-#     (tests/test_snapshot_recovery.py).
+#     (tests/test_snapshot_recovery.py),
+#   - failure watchdog classification + degraded mode
+#     (tests/test_failure.py).
 #
-# Exits with pytest's return code.
+# Exits nonzero if ANY row fails (every row still runs).
 set -o pipefail
 cd "$(dirname "$0")/.."
-timeout -k 10 1500 env JAX_PLATFORMS=cpu python -m pytest \
-    tests/test_chaos.py tests/test_dkv_wal.py tests/test_dkv_retry.py \
-    tests/test_snapshot_recovery.py tests/test_failure.py \
-    tests/test_mesh_hier.py::test_mesh_host_kill_resume_verify \
-    -q -p no:cacheprovider -p no:xdist -p no:randomly
-exit $?
+
+ROWS_FILE="${H2O3_CHAOS_ROWS:-/tmp/chaos_rows.txt}"
+ROW_TIMEOUT="${H2O3_CHAOS_ROW_TIMEOUT:-1200}"
+: > "$ROWS_FILE"
+FAILED=0
+
+run_row() {
+    local name="$1"; shift
+    local t0=$SECONDS
+    timeout -k 10 "$ROW_TIMEOUT" env JAX_PLATFORMS=cpu python -m pytest \
+        "$@" -q -p no:cacheprovider -p no:xdist -p no:randomly
+    local rc=$?
+    local dt=$((SECONDS - t0))
+    if [ $rc -eq 0 ]; then
+        echo "PASS $name ${dt}s" >> "$ROWS_FILE"
+    else
+        echo "FAIL $name ${dt}s (rc=$rc)" >> "$ROWS_FILE"
+        FAILED=1
+    fi
+}
+
+run_row kill-resume tests/test_chaos.py \
+    --deselect tests/test_chaos.py::test_coordinator_hard_kill_midtrain_rehydrate_reattach \
+    --deselect tests/test_chaos.py::test_host_kill_mid_multitenant_load \
+    --deselect tests/test_chaos.py::test_host_join_fenced_rebuild_midtrain
+run_row coordinator-kill \
+    tests/test_chaos.py::test_coordinator_hard_kill_midtrain_rehydrate_reattach
+run_row multitenant-kill \
+    tests/test_chaos.py::test_host_kill_mid_multitenant_load
+run_row host-join \
+    tests/test_chaos.py::test_host_join_fenced_rebuild_midtrain
+run_row scheduler tests/test_scheduler.py
+run_row mesh-host-kill tests/test_mesh_hier.py::test_mesh_host_kill_resume_verify
+run_row dkv-wal tests/test_dkv_wal.py
+run_row dkv-retry tests/test_dkv_retry.py
+run_row snapshot-recovery tests/test_snapshot_recovery.py
+run_row failure-watchdog tests/test_failure.py
+
+echo "---- chaos rows ($ROWS_FILE) ----"
+cat "$ROWS_FILE"
+exit $FAILED
