@@ -1048,6 +1048,68 @@ def serve_piece():
             "serve_p99_ms": p99, "serve_qps": qps}
 
 
+def remat_piece():
+    """Partial-vs-full recovery bench (the shard-lineage data plane).
+
+    Times recovering ONE lost shard of a 4-host frame from lineage
+    (survivor copy + a single ranged re-parse of the dead host's byte
+    range) against the pre-lineage recovery unit: a full re-import of
+    the source file.  ``remat_partial_vs_baseline`` is the speedup the
+    gate tracks — the partial path must stay well under a full ingest.
+
+    Usage:      python bench_pieces.py remat
+    CPU smoke:  JAX_PLATFORMS=cpu H2O3_PIECES_ROWS=120000 \\
+                python bench_pieces.py remat
+    """
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import tempfile
+
+    import h2o3_tpu
+    h2o3_tpu.init(hosts=4)
+    from h2o3_tpu.frame import lineage
+    from h2o3_tpu.frame.parse import import_file
+    from h2o3_tpu.runtime import dkv, remat
+
+    rows = min(N_ROWS, 500_000)
+    rng = np.random.default_rng(11)
+    body = np.column_stack([rng.random((rows, 4)).astype(np.float32),
+                            rng.random(rows).astype(np.float32)])
+    path = os.path.join(tempfile.gettempdir(), f"remat_bench_{rows}.csv")
+    with open(path, "w") as f:
+        f.write("x0,x1,x2,x3,y\n")
+        f.write("\n".join(",".join(f"{v:.7g}" for v in r) for r in body))
+        f.write("\n")
+    mb = os.path.getsize(path) / 1e6
+
+    import_file(path, destination_frame="remat_bench_fr")
+    rec = lineage.get_record("remat_bench_fr")
+    assert rec is not None and rec["n_shards"] == 4, "no lineage record"
+
+    t0 = time.perf_counter()
+    remat.recover_frame("remat_bench_fr", lost={1})
+    partial = time.perf_counter() - t0
+    s1 = rec["shards"][1]
+    assert remat.last_stats["reparsed"] == [[s1["lo"], s1["hi"]]], \
+        "partial recovery touched more than the lost shard's byte range"
+
+    dkv.remove("remat_bench_fr")
+    t0 = time.perf_counter()
+    import_file(path, destination_frame="remat_bench_fr")
+    full = time.perf_counter() - t0
+
+    dkv.remove("remat_bench_fr")
+    lineage.drop_record("remat_bench_fr")
+    os.remove(path)
+    print(json.dumps({
+        "piece": "remat", "rows": rows, "mb": round(mb, 1),
+        "remat_partial_s": round(partial, 3),
+        "remat_full_s": round(full, 3),
+        "remat_partial_vs_baseline": round(full / partial, 2)
+        if partial else float("inf")}), flush=True)
+
+
 def sched_piece():
     """Fair-share co-residency bench: small-job makespan beside a
     pod-holding large job, fair-share vs FIFO-behind-the-big-job.
@@ -1138,5 +1200,7 @@ if __name__ == "__main__":
         serve_piece()
     elif len(sys.argv) > 1 and sys.argv[1] == "sched":
         sched_piece()
+    elif len(sys.argv) > 1 and sys.argv[1] == "remat":
+        remat_piece()
     else:
         main()

@@ -1040,6 +1040,9 @@ class Api:
                 "entries": entries,
                 "resumable": sum(1 for e in entries
                                  if e.get("status") == "running"),
+                # resumes that silently weren't: entries whose frame
+                # re-import failed and trained from scratch (or skipped)
+                "downgraded": sum(1 for e in entries if e.get("downgrade")),
                 # coordinator durability/fencing: epoch, WAL generation/
                 # records, dedup window — the restart-runbook facts
                 "coordinator": dkv.wal_stats()}

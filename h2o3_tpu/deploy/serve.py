@@ -94,6 +94,14 @@ def main(argv=None):
             if resumed:
                 print(f"h2o3_tpu recovery resumed {len(resumed)} job(s): "
                       f"{resumed}", flush=True)
+            # bring the serving plane back too: every `!serve/`-journaled
+            # model is re-published into the micro-batcher registry
+            from h2o3_tpu.serving import batcher as _serving_batcher
+            republished = _serving_batcher.republish_journaled()
+            if republished:
+                print(f"h2o3_tpu serving re-published "
+                      f"{len(republished)} model(s): {republished}",
+                      flush=True)
     else:
         print(f"h2o3_tpu worker {jax.process_index()} joined "
               f"(mesh: {dict(cl.mesh.shape)})", flush=True)

@@ -45,6 +45,7 @@ class Frame:
         self.key = key
         self._matrix_cache: Dict[tuple, jax.Array] = {}
         self._atime = time.monotonic()       # LRU clock for the Cleaner
+        self._lineage: Optional[dict] = None  # frame/lineage.py provenance
         if key is not None:
             dkv.put(key, self)
 
@@ -71,7 +72,9 @@ class Frame:
     def __getitem__(self, cols) -> "Frame":
         if isinstance(cols, str):
             cols = [cols]
-        return Frame(cols, [self.vec(c) for c in cols])
+        from . import lineage
+        return lineage.derive(Frame(cols, [self.vec(c) for c in cols]),
+                              self, {"op": "cols", "cols": list(cols)})
 
     def types(self) -> Dict[str, str]:
         return {n: v.type for n, v in zip(self.names, self.vecs)}
@@ -109,12 +112,18 @@ class Frame:
         return Frame(self.names + other.names, self.vecs + other.vecs)
 
     def rename(self, mapping: Dict[str, str]) -> "Frame":
-        return Frame([mapping.get(n, n) for n in self.names], self.vecs)
+        from . import lineage
+        return lineage.derive(
+            Frame([mapping.get(n, n) for n in self.names], self.vecs),
+            self, {"op": "rename", "mapping": dict(mapping)})
 
     def drop(self, cols: Sequence[str]) -> "Frame":
         cols = set([cols] if isinstance(cols, str) else cols)
         keep = [(n, v) for n, v in zip(self.names, self.vecs) if n not in cols]
-        return Frame([n for n, _ in keep], [v for _, v in keep])
+        from . import lineage
+        return lineage.derive(
+            Frame([n for n, _ in keep], [v for _, v in keep]),
+            self, {"op": "drop", "cols": sorted(cols)})
 
     def with_vec(self, name: str, vec: Vec) -> "Frame":
         if name in self.names:
@@ -134,7 +143,8 @@ class Frame:
                 col = np.asarray(v.data)[: v.nrows][index]
                 out.append(Vec.from_numpy(col, v.type, domain=v.domain,
                                           time_base=v.time_base))
-        return Frame(self.names, out)
+        from . import lineage
+        return lineage.derive_rows(Frame(self.names, out), self, index)
 
     def filter(self, mask: np.ndarray) -> "Frame":
         mask = np.asarray(mask, dtype=bool)
@@ -149,8 +159,15 @@ class Frame:
             bounds = np.append(bounds, 1.0)
         bounds[-1] = np.inf  # last piece takes everything remaining
         pieces, lo = [], 0.0
-        for hi in bounds:
-            pieces.append(self.filter((u >= lo) & (u < hi)))
+        from . import lineage
+        for i, hi in enumerate(bounds):
+            p = self.filter((u >= lo) & (u < hi))
+            # a (ratios, seed, piece) triple replays smaller than the
+            # row index the filter recorded — override it
+            lineage.derive(p, self, {"op": "split",
+                                     "ratios": [float(r) for r in ratios],
+                                     "seed": int(seed), "piece": i})
+            pieces.append(p)
             lo = hi
         return pieces
 

@@ -1,0 +1,441 @@
+"""Shard lineage — provenance records that make frame recovery partial.
+
+Reference contract (PAPER.md L4/DKV; runtime/recovery.py:9): data is
+never durable — after any host loss the whole frame is re-imported from
+source.  This module replaces that cliff with lineage: every shard of a
+parsed frame is a deterministic function of a byte range of its source
+plus a replayable op chain, so losing a host costs re-deriving *its*
+shards, not the dataset (the DrJAX pure-sharded-function view of the
+map-reduce plane, applied to ingest).
+
+Three record kinds live under WAL-durable ``!lineage/<frame>`` DKV keys
+(plain dicts, so they rehydrate across a coordinator restart):
+
+- ``parse``      — source path, effective parse config, and one shard
+  per mesh host: the newline-aligned byte range whose lines ARE that
+  host's row block, a sha1 of those source bytes, and (for frames under
+  ``lineage_hash_below_mb``) a sha1 of the shard's canonical column
+  values for bitwise verification after re-materialization.
+- ``derived``    — the root (parse/checkpoint) frame key plus a compact
+  list of replayable op descriptors (column select/drop/rename, bounded
+  row gathers, split_frame pieces, rapids sort/impute/scale) instead of
+  copied provenance.  Chains deeper than ``lineage_max_chain`` force a
+  checkpoint-materialization at registration time.
+- ``checkpoint`` — a pickled canonical-column snapshot under the
+  recovery dir; rebuilding is a load, not a replay.
+
+Hot-frame replicas: frames at or under ``replicate_below_mb`` keep one
+replica of every shard's canonical columns under ``!replica/<frame>/<i>``
+with a DCN-neighbor placement recorded in the lineage record, so their
+recovery is a copy verified by content hash, not a recompute.
+
+``runtime/remat.py`` is the consumer: given the set of lost host/shard
+ids it walks these records back to bytes (replica copy → ranged
+re-parse + op replay → caller falls back to full re-import).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import re
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..runtime.config import config
+from .vec import T_CAT, T_STR, T_TIME, T_UUID, Vec
+
+LINEAGE_PREFIX = "!lineage/"
+REPLICA_PREFIX = "!replica/"
+
+# ops replayed by runtime/remat.py — anything else breaks the chain
+REPLAYABLE_OPS = ("cols", "drop", "rename", "rows", "split",
+                  "sort", "impute", "scale")
+
+
+def enabled() -> bool:
+    return config().lineage_enabled
+
+
+def lineage_key(frame_key: str) -> str:
+    return LINEAGE_PREFIX + frame_key
+
+
+def replica_key(frame_key: str, shard: int) -> str:
+    return f"{REPLICA_PREFIX}{frame_key}/{shard}"
+
+
+def get_record(frame_key: str) -> Optional[dict]:
+    from ..runtime import dkv
+    rec = dkv.get(lineage_key(frame_key))
+    return rec if isinstance(rec, dict) else None
+
+
+def drop_record(frame_key: str) -> None:
+    """Remove a frame's lineage + replica records (frame deletion)."""
+    from ..runtime import dkv
+    try:
+        dkv.remove(lineage_key(frame_key))
+        for k in dkv.keys(f"{REPLICA_PREFIX}{frame_key}/"):
+            dkv.remove(k)
+    except Exception:                    # noqa: BLE001 — best-effort
+        pass
+
+
+# ----------------------------------------------------------- canonical values
+
+def canonical_cols(frame) -> List[np.ndarray]:
+    """Engine-independent host form of every column (Vec.canonical_host):
+    num→f32, cat→i32 codes, time→f64 ms, str/uuid→object."""
+    return [v.canonical_host() for v in frame.vecs]
+
+
+def _canonical_nbytes(cols: Sequence[np.ndarray], types: Sequence[str]) -> int:
+    total = 0
+    for arr, t in zip(cols, types):
+        if t in (T_STR, T_UUID):
+            total += sum(len(str(v)) if v is not None else 1 for v in arr)
+        else:
+            total += int(arr.nbytes)
+    return total
+
+
+def hash_cols(cols: Sequence[np.ndarray], types: Sequence[str],
+              lo: int, hi: int) -> str:
+    """sha1 over the canonical bytes of rows [lo, hi) of every column —
+    the bitwise-equality check for re-materialized/replicated shards."""
+    h = hashlib.sha1()
+    for arr, t in zip(cols, types):
+        part = arr[lo:hi]
+        if t in (T_STR, T_UUID):
+            h.update("\x1f".join("\x00" if v is None else str(v)
+                                 for v in part).encode())
+        else:
+            h.update(np.ascontiguousarray(part).tobytes())
+        h.update(b"|")
+    return h.hexdigest()
+
+
+def schema_of(frame) -> dict:
+    return {
+        "names": list(frame.names),
+        "types": [v.type for v in frame.vecs],
+        "domains": {n: [str(x) for x in v.domain]
+                    for n, v in zip(frame.names, frame.vecs)
+                    if v.type == T_CAT and v.domain is not None},
+        "time_base": {n: float(v.time_base)
+                      for n, v in zip(frame.names, frame.vecs)
+                      if v.type == T_TIME},
+    }
+
+
+def shard_row_bounds(nrows: int, n_shards: int,
+                     padded: Optional[int] = None) -> List[Tuple[int, int]]:
+    """Per-host logical row blocks, mirroring device placement: the mesh
+    is hosts-major, hosts own contiguous blocks of the padded buffer, and
+    padding rows live at the tail (so only trailing hosts clip)."""
+    if padded is None:
+        from ..runtime.cluster import cluster
+        padded = cluster().pad_rows(nrows)
+    per = max(padded // max(n_shards, 1), 1)
+    return [(min(i * per, nrows), min((i + 1) * per, nrows))
+            for i in range(n_shards)]
+
+
+# ------------------------------------------------------------- parse stamping
+
+_NL = 10
+_CR = 13
+
+
+def _row_byte_starts(view: np.ndarray, has_header: bool
+                     ) -> Optional[np.ndarray]:
+    """Byte offset of every non-blank data line (the parser engines all
+    drop blank lines); None when the file has no body."""
+    nl = np.flatnonzero(view == _NL)
+    body = 0
+    if has_header:
+        if not len(nl):
+            return None                  # header-only file
+        body = int(nl[0]) + 1
+    starts = np.concatenate(
+        [np.array([body], np.int64), nl[nl >= body].astype(np.int64) + 1])
+    starts = starts[starts < len(view)]
+    if not len(starts):
+        return None
+    ch = view[starts]
+    nxt = np.full(len(starts), _NL, np.uint8)
+    ok = starts + 1 < len(view)
+    nxt[ok] = view[starts[ok] + 1]
+    blank = (ch == _NL) | ((ch == _CR) & (nxt == _NL))
+    return starts[~blank]
+
+
+def compute_parse_shards(path: str, has_header: bool, nrows: int,
+                         n_shards: int) -> Optional[List[dict]]:
+    """Newline-aligned byte ranges whose lines ARE the per-host row
+    blocks, each stamped with a sha1 of its source bytes.  None when the
+    file's line structure cannot account for every parsed row (quoted
+    embedded newlines, parser-dropped lines, …) — lineage then refuses
+    to claim ranged re-parse is safe and recovery falls back."""
+    size = os.path.getsize(path)
+    if size > config().lineage_max_mb * 1e6:
+        return None
+    with open(path, "rb") as f:
+        view = np.frombuffer(f.read(), np.uint8)
+    row_starts = _row_byte_starts(view, has_header)
+    if row_starts is None or len(row_starts) != nrows:
+        return None
+    bounds = shard_row_bounds(nrows, n_shards)
+    shards = []
+    for i, (lo, hi) in enumerate(bounds):
+        if hi <= lo:
+            shards.append({"shard": i, "row_lo": int(lo), "rows": 0,
+                           "lo": 0, "hi": 0,
+                           "src_sha1": hashlib.sha1(b"").hexdigest()})
+            continue
+        b_lo = int(row_starts[lo])
+        b_hi = int(row_starts[hi]) if hi < nrows else len(view)
+        shards.append({
+            "shard": i, "row_lo": int(lo), "rows": int(hi - lo),
+            "lo": b_lo, "hi": b_hi,
+            "src_sha1": hashlib.sha1(
+                np.ascontiguousarray(view[b_lo:b_hi]).tobytes()).hexdigest(),
+        })
+    return shards
+
+
+def record_parse(frame, path: str, header: Optional[bool] = None,
+                 sep: Optional[str] = None,
+                 col_types: Optional[Dict[str, str]] = None,
+                 col_names: Optional[Sequence[str]] = None) -> Optional[dict]:
+    """Stamp a just-parsed frame with ranged provenance and publish the
+    WAL-durable ``!lineage/<frame>`` record.  Never raises; a source that
+    can't be safely range-split simply leaves no record (recovery then
+    uses the journaled source URI, the pre-lineage contract)."""
+    if not enabled() or getattr(frame, "key", None) is None:
+        return None
+    try:
+        if not isinstance(path, str) or "://" in path \
+                or path.lower().endswith((".gz", ".zip", ".bz2", ".xz")) \
+                or not os.path.isfile(path):
+            return None
+        from .parse import _guess_numeric
+        sepc = sep if sep is not None else ","
+        if header is None:
+            with open(path, "rb") as f:
+                first = f.readline().decode(errors="replace").rstrip("\r\n")
+            cells = [c.strip().strip('"') for c in first.split(sepc)]
+            has_header = not _guess_numeric(cells)
+        else:
+            has_header = bool(header)
+        from ..runtime.cluster import cluster
+        n_shards = cluster().n_hosts
+        shards = compute_parse_shards(path, has_header, frame.nrows,
+                                      n_shards)
+        if shards is None:
+            return None
+        rec = {
+            "kind": "parse",
+            "source": os.path.abspath(path),
+            "parse": {"header": has_header, "sep": sep,
+                      "col_types": dict(col_types or {}),
+                      "col_names": list(col_names) if col_names else None},
+            "n_shards": n_shards,
+            "shards": shards,
+        }
+        frame._lineage = rec
+        return publish(frame)
+    except Exception as e:               # noqa: BLE001 — stamping is optional
+        from ..runtime.observability import log
+        log.debug("lineage: parse stamp of %r skipped: %r", path, e)
+        frame._lineage = None
+        return None
+
+
+# ------------------------------------------------------------- derived chains
+
+def _pack_index(index) -> Optional[bytes]:
+    index = np.asarray(index, np.int64)
+    if index.size > config().lineage_max_index:
+        return None
+    return zlib.compress(index.tobytes(), 1)
+
+
+def unpack_index(blob: bytes) -> np.ndarray:
+    return np.frombuffer(zlib.decompress(blob), np.int64)
+
+
+def derive(out, base, op: Optional[dict]):
+    """Attach a derived-lineage record to ``out``: the root frame key of
+    ``base``'s chain plus ``base``'s ops with ``op`` appended.  ``op=None``
+    (or a base with no lineage) breaks the chain.  Registered outputs
+    publish immediately; anonymous intermediates stay in-memory until
+    :func:`register` gives them a key.  Never raises."""
+    try:
+        if op is None or not enabled():
+            out._lineage = None
+            return out
+        rec = getattr(base, "_lineage", None)
+        if not isinstance(rec, dict):
+            out._lineage = None
+            return out
+        kind = rec.get("kind")
+        if kind in ("parse", "checkpoint"):
+            root = getattr(base, "key", None) or rec.get("frame")
+            ops: List[dict] = [op]
+        elif kind == "derived":
+            root = rec.get("root")
+            ops = list(rec.get("ops") or []) + [op]
+        else:
+            root = None
+            ops = []
+        if not root:
+            out._lineage = None
+            return out
+        out._lineage = {"kind": "derived", "root": root, "ops": ops}
+        if getattr(out, "key", None):
+            publish(out)
+    except Exception:                    # noqa: BLE001 — lineage is optional
+        out._lineage = None
+    return out
+
+
+def derive_rows(out, base, index):
+    """Row-gather op; indexes past ``lineage_max_index`` break the chain
+    (an unbounded index would bloat the WAL past any replay savings)."""
+    blob = None
+    try:
+        blob = _pack_index(index)
+    except Exception:                    # noqa: BLE001
+        blob = None
+    return derive(out, base, None if blob is None
+                  else {"op": "rows", "index": blob})
+
+
+def register(frame, key: str):
+    """Give a derived frame a DKV identity and persist its lineage — the
+    step that makes an anonymous split/munge output recoverable (and
+    journal-able as a training frame) after a restart."""
+    from ..runtime import dkv
+    frame.key = key
+    dkv.put(key, frame)
+    publish(frame)
+    return frame
+
+
+# ------------------------------------------------------------- checkpointing
+
+def _safe_name(key: str) -> str:
+    return re.sub(r"[^A-Za-z0-9_.-]", "_", key)
+
+
+def _checkpoint_uri(key: str) -> Optional[str]:
+    from ..runtime import recovery
+    base = recovery.recovery_dir()
+    if not base:
+        return None
+    return f"{base.rstrip('/')}/lineage_ckpt_{_safe_name(key)}.pkl"
+
+
+def write_checkpoint(frame, key: str) -> Optional[dict]:
+    """Materialize a frame's canonical columns under the recovery dir and
+    return a checkpoint-kind record (None without a recovery dir)."""
+    uri = _checkpoint_uri(key)
+    if uri is None:
+        return None
+    from .. import persist
+    schema = schema_of(frame)
+    cols = canonical_cols(frame)
+    with persist.open_write(uri) as f:
+        pickle.dump({"schema": schema, "nrows": int(frame.nrows),
+                     "cols": cols}, f)
+    return {"kind": "checkpoint", "uri": uri}
+
+
+def load_checkpoint(rec: dict) -> Tuple[dict, int, List[np.ndarray]]:
+    from .. import persist
+    with persist.open_read(rec["uri"]) as f:
+        blob = pickle.load(f)            # our own recovery-dir artifact
+    return blob["schema"], int(blob["nrows"]), list(blob["cols"])
+
+
+# ----------------------------------------------------------------- publishing
+
+def publish(frame, key: Optional[str] = None) -> Optional[dict]:
+    """Persist ``frame``'s in-memory lineage as the WAL-durable
+    ``!lineage/<key>`` record: attach schema + per-shard value hashes
+    (for frames under ``lineage_hash_below_mb``), checkpoint-materialize
+    over-deep derived chains, and cut hot-frame replicas for frames
+    under ``replicate_below_mb``.  Never raises."""
+    key = key or getattr(frame, "key", None)
+    rec = getattr(frame, "_lineage", None)
+    if key is None or not isinstance(rec, dict) or not enabled():
+        return None
+    try:
+        from ..runtime import dkv
+        from ..runtime.observability import log, set_gauge
+        cfg = config()
+        rec = dict(rec)
+        if rec.get("kind") == "derived" \
+                and len(rec.get("ops") or []) > cfg.lineage_max_chain:
+            ck = None
+            try:
+                ck = write_checkpoint(frame, key)
+            except Exception as e:       # noqa: BLE001
+                log.warning("lineage: checkpoint of %r failed (%r); "
+                            "keeping the deep op chain", key, e)
+            if ck is not None:
+                rec = ck
+        rec["frame"] = key
+        rec["nrows"] = int(frame.nrows)
+        rec["schema"] = schema_of(frame)
+        types = rec["schema"]["types"]
+        n_shards = rec.get("n_shards")
+        if n_shards is None:
+            from ..runtime.cluster import cluster
+            rec["n_shards"] = n_shards = cluster().n_hosts
+        bounds = shard_row_bounds(frame.nrows, n_shards)
+        if "shards" not in rec:
+            rec["shards"] = [{"shard": i, "row_lo": int(lo),
+                              "rows": int(hi - lo)}
+                             for i, (lo, hi) in enumerate(bounds)]
+        cols = None
+        size_mb = None
+        if cfg.lineage_hash_below_mb > 0 or cfg.replicate_below_mb > 0:
+            cols = canonical_cols(frame)
+            size_mb = _canonical_nbytes(cols, types) / 1e6
+        if cols is not None and size_mb <= cfg.lineage_hash_below_mb:
+            for s in rec["shards"]:
+                lo = s["row_lo"]
+                s["val_sha1"] = hash_cols(cols, types, lo, lo + s["rows"])
+        if cols is not None and cfg.replicate_below_mb > 0 \
+                and size_mb <= cfg.replicate_below_mb and n_shards > 1:
+            rec["replicas"] = {}
+            for s in rec["shards"]:
+                i, lo = s["shard"], s["row_lo"]
+                hi = lo + s["rows"]
+                neighbor = (i + 1) % n_shards    # DCN-neighbor placement
+                sha = s.get("val_sha1") or hash_cols(cols, types, lo, hi)
+                dkv.put(replica_key(key, i),
+                        {"cols": [np.ascontiguousarray(c[lo:hi])
+                                  if c.dtype != object else c[lo:hi]
+                                  for c in cols],
+                         "sha1": sha, "host": neighbor,
+                         "row_lo": lo, "rows": hi - lo})
+                rec["replicas"][str(i)] = {"host": neighbor, "sha1": sha}
+        dkv.put(lineage_key(key), rec)
+        frame._lineage = rec
+        try:
+            set_gauge("lineage_records",
+                      float(len(dkv.keys(LINEAGE_PREFIX))))
+        except Exception:                # noqa: BLE001
+            pass
+        return rec
+    except Exception as e:               # noqa: BLE001 — lineage is optional
+        from ..runtime.observability import log
+        log.debug("lineage: publish of %r skipped: %r", key, e)
+        return None

@@ -379,7 +379,12 @@ def parse_csv(path_or_buf, destination_frame: Optional[str] = None,
     key = destination_frame or dkv.make_key(
         os.path.basename(str(path_or_buf)) if isinstance(path_or_buf, str)
         else "frame")
-    return Frame(names, vecs, key=key)
+    fr = Frame(names, vecs, key=key)
+    if isinstance(path_or_buf, str):
+        from . import lineage
+        lineage.record_parse(fr, path_or_buf, header=header, sep=sep,
+                             col_types=col_types, col_names=col_names)
+    return fr
 
 
 def _assemble_vec(col, name: str, coltype: Optional[str]) -> Vec:

@@ -327,6 +327,20 @@ class Vec:
         from ..runtime.cluster import fetch
         return fetch(self.data)[: self.nrows]
 
+    def canonical_host(self) -> np.ndarray:
+        """Engine-independent host form for lineage hashing/replicas:
+        num -> float32, cat -> int32 codes (-1 NA), time -> float64
+        ms-since-epoch, str/uuid -> object (None NA).  A re-materialized
+        shard is correct iff its canonical bytes match the original's."""
+        arr = self.to_numpy()
+        if self.type == T_CAT:
+            return np.ascontiguousarray(arr, dtype=np.int32)
+        if self.type == T_TIME:
+            return np.ascontiguousarray(arr, dtype=np.float64)
+        if self.type in (T_STR, T_UUID):
+            return np.asarray(arr, dtype=object)
+        return np.ascontiguousarray(arr, dtype=np.float32)
+
     def decoded(self) -> np.ndarray:
         """Host column with categorical codes mapped back to labels."""
         arr = self.to_numpy()

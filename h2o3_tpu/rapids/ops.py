@@ -14,6 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..frame.frame import Frame
+from ..frame import lineage
 from ..frame.vec import Vec, T_CAT, T_NUM, T_STR, T_TIME
 from ..runtime.cluster import cluster, fetch
 from . import device as dev
@@ -29,7 +30,9 @@ def sort(frame: Frame, by: Union[str, Sequence[str]],
         raise ValueError("ascending must match by")
     keys = [dev.sort_key(frame.vec(c)) for c in by]
     order = dev.lex_order(keys, asc)
-    return dev.gather_rows(frame, order, frame.nrows)
+    return lineage.derive(dev.gather_rows(frame, order, frame.nrows), frame,
+                          {"op": "sort", "by": by,
+                           "ascending": [bool(a) for a in asc]})
 
 
 def filter_rows(frame: Frame, mask) -> Frame:
@@ -214,7 +217,8 @@ def impute(frame: Frame, column: str, method: str = "mean",
         code = (v.domain or []).index(mode_lbl)
         data = jnp.where(v.data < 0, code, v.data)
         newv = Vec(data, T_CAT, v.nrows, domain=v.domain)
-        return frame.with_vec(column, newv)
+        return _impute_lin(frame.with_vec(column, newv), frame,
+                           column, method, combine_method)
     qmethod = {"interpolate": "linear", "lo": "lower",
                "hi": "higher", "low": "lower", "high": "higher",
                "average": "linear"}.get(combine_method, "linear")
@@ -227,7 +231,8 @@ def impute(frame: Frame, column: str, method: str = "mean",
         fill = float(np.nanquantile(host, 0.5, method=qmethod)) \
             if method == "median" else float(host[finite].mean())
         host[~finite] = fill
-        return frame.with_vec(column, Vec.from_numpy(host, T_TIME))
+        return _impute_lin(frame.with_vec(column, Vec.from_numpy(host, T_TIME)),
+                           frame, column, method, combine_method)
     if method == "median":
         x = v.to_numpy()
         fill = float(np.nanquantile(x, 0.5, method=qmethod)) \
@@ -235,7 +240,15 @@ def impute(frame: Frame, column: str, method: str = "mean",
     else:
         fill = v.mean()
     data = jnp.where(jnp.isnan(v.data), jnp.float32(fill), v.data)
-    return frame.with_vec(column, Vec(data, v.type, v.nrows))
+    return _impute_lin(frame.with_vec(column, Vec(data, v.type, v.nrows)),
+                       frame, column, method, combine_method)
+
+
+def _impute_lin(out: Frame, base: Frame, column: str, method: str,
+                combine_method: str) -> Frame:
+    return lineage.derive(out, base, {"op": "impute", "column": column,
+                                      "method": method,
+                                      "combine_method": combine_method})
 
 
 def cut(vec: Vec, breaks: Sequence[float],
@@ -273,7 +286,9 @@ def scale(frame: Frame, center: bool = True,
             vecs.append(Vec((v.data - mu) / sd, T_NUM, v.nrows))
         else:
             vecs.append(v)
-    return Frame(frame.names, vecs)
+    return lineage.derive(Frame(frame.names, vecs), frame,
+                          {"op": "scale", "center": bool(center),
+                           "scale": bool(scale_)})
 
 
 # ---------------------------------------------------------------- group-by

@@ -103,6 +103,21 @@ class Config:
     # with a 503 instead of waiting in the queue — also during SIGTERM
     # drain, so a terminating pod never strands queued requests
     serve_deadline_ms: float = 0.0
+    # shard-lineage data plane (frame/lineage.py + runtime/remat.py):
+    # master switch for provenance stamping at parse, the op-chain depth
+    # past which a registered derived frame checkpoint-materializes, the
+    # largest rows()-index recorded as a replayable op, the largest
+    # source file stamped at all, the largest frame whose per-shard
+    # value hashes are computed at publish (bigger frames keep only the
+    # source-byte hashes), and the hot-frame replica threshold (0 = no
+    # replicas): frames at or under it keep one DCN-neighbor replica
+    # shard in the DKV so recovery is a copy, not a recompute
+    lineage_enabled: bool = True
+    lineage_max_chain: int = 8
+    lineage_max_index: int = 1_000_000
+    lineage_max_mb: float = 512.0
+    lineage_hash_below_mb: float = 32.0
+    replicate_below_mb: float = 0.0
 
     @staticmethod
     def from_env() -> "Config":
@@ -157,6 +172,16 @@ class Config:
             serve_score_mode=e("H2O3_TPU_SERVE_SCORE_MODE", "packed"),
             serve_impl=e("H2O3_TPU_SERVE_IMPL", "auto"),
             serve_deadline_ms=float(e("H2O3_TPU_SERVE_DEADLINE_MS", 0.0)),
+            lineage_enabled=e("H2O3_TPU_LINEAGE", "1")
+            not in ("0", "false", "no"),
+            lineage_max_chain=int(e("H2O3_TPU_LINEAGE_MAX_CHAIN", 8)),
+            lineage_max_index=int(
+                e("H2O3_TPU_LINEAGE_MAX_INDEX", 1_000_000)),
+            lineage_max_mb=float(e("H2O3_TPU_LINEAGE_MAX_MB", 512.0)),
+            lineage_hash_below_mb=float(
+                e("H2O3_TPU_LINEAGE_HASH_BELOW_MB", 32.0)),
+            replicate_below_mb=float(
+                e("H2O3_TPU_REPLICATE_BELOW_MB", 0.0)),
         )
 
     def describe(self) -> dict:

@@ -17,6 +17,13 @@ the coordinator service carries the *metadata* plane — key listings, job
 status, small host objects — and gives non-zero processes and external
 clients (REST) a consistent view.  The API mirrors DKV.get/put/remove.
 
+Well-known ``!``-prefixed (plain, WAL-durable) key families: ``!hb/``
+heartbeat stamps, ``!failures/`` dead-member records, ``!sched/``
+scheduling records, ``!lineage/<frame>`` shard-provenance records and
+``!replica/<frame>/<shard>`` hot-frame replica shards
+(frame/lineage.py), and ``!serve/<model>`` journaled serving publishes
+(serving/batcher.py).
+
 Crash-recoverable coordinator (the reference survives coordinator loss via
 Paxos membership + UDP retransmit; the TCP control plane needs all three
 explicitly):

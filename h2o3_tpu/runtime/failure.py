@@ -39,9 +39,11 @@ converge.  Injection points: ``tree_chunk``, ``ktree_round``,
 models a LOST RESPONSE, the exactly-once dedup case), ``dkv_handle``
 (top of the coordinator's connection handler — with
 ``:coordinator:<nth>:kill`` it hard-kills the coordinator at the nth
-handled connection), ``parse_range``, ``cv_fold``, ``grid_member``,
-``automl_member``, ``glm_lambda``, ``snapshot_write``,
-``deep_level``, ``sched_assign``, ``host_join``.  ``sched_assign``
+handled connection), ``parse_range``, ``remat`` (top of every
+lineage-driven shard re-materialization, runtime/remat.py — raise there
+proves a failed remat degrades to full re-import, never to wrong data),
+``cv_fold``, ``grid_member``, ``automl_member``, ``glm_lambda``,
+``snapshot_write``, ``deep_level``, ``sched_assign``, ``host_join``.  ``sched_assign``
 fires when the cluster scheduler (runtime/scheduler.py) hands a job to
 a worker thread — kill/raise there proves admission state survives a
 lost assignment; ``host_join`` fires when the elastic membership
@@ -148,15 +150,21 @@ def _on_dead(node: str, info: dict) -> None:
     log.error("worker %s declared dead (no heartbeat for %.1fs); "
               "aborting running jobs", node, age)
     try:
+        # host_index (the heartbeat's stamped jax process index) tells
+        # runtime/remat.py WHICH frame shards died with this member
         dkv.put(FAILURES_PREFIX + node,
-                {"ts": time.time(), "age": age, "pid": info.get("pid")})
+                {"ts": time.time(), "age": age, "pid": info.get("pid"),
+                 "host_index": info.get("proc")})
     except Exception:                # noqa: BLE001 — coordinator may be gone
         pass
     from .job import list_jobs
     err = NodeFailedError(
         f"worker {node} lost mid-job (heartbeat dead for {age:.1f}s); "
-        "collectives cannot complete — restart the cluster, re-import "
-        "frames, then runtime.recovery.resume() to resurrect the job")
+        "collectives cannot complete — the scheduler's degraded-mode "
+        "requeue re-materializes the lost frame shards from lineage "
+        "(runtime/remat.py) and retries; after a full cluster restart, "
+        "runtime.recovery.resume() rebuilds frames from lineage (falling "
+        "back to source re-import) and resurrects the job")
     # degraded-mode continuation: the scheduler requeues its in-flight
     # jobs with retry budget from their journal snapshots onto the
     # shrunken mesh; only what it cannot requeue is failed below

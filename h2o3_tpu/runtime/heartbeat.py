@@ -50,6 +50,15 @@ def _beat(name: str, interval: float) -> None:
         "pid": os.getpid(),
         "keys": dkv.local_size(),
     }
+    try:
+        import sys
+        if "jax" in sys.modules:        # never boot jax from the beat
+            import jax
+            # which mesh host (frame shard block) dies with this process
+            # — failure._on_dead forwards it to runtime/remat.py
+            stamp["proc"] = int(jax.process_index())
+    except Exception:                   # noqa: BLE001 — stamp still valid
+        pass
     # telemetry rides the stamp: the full (cumulative) metric registry
     # plus a bounded event tail.  Cumulative — not a delta — so a lost
     # or duplicated stamp cannot skew the coordinator's merged view, and

@@ -5,9 +5,13 @@ interrupted training should be re-runnable after a cluster restart.
 When ``H2O3_TPU_RECOVERY_DIR`` is set (any persist URI), every
 ModelBuilder.train writes a journal entry (algo, params, frame key)
 before fitting and marks it done after; ``resume()`` re-trains every
-entry still marked running, provided its training frame has been
-re-imported under the same key (the reference's contract too — data is
-not journaled, only the work description).
+entry still marked running.  The reference's contract is that data is
+not journaled, only the work description; here the shard-lineage layer
+(frame/lineage.py + runtime/remat.py) goes further: a missing training
+frame is first re-materialized from its lineage record — lost shards
+only, replica copy → ranged re-parse → op replay — and only when no
+lineage can prove a correct rebuild does ``resume_entry`` fall back to
+a full re-import of the journaled source URI.
 
 Beyond the reference: long-running builders also persist in-training
 progress snapshots (runtime/snapshot.py) and the journal entry tracks
@@ -177,6 +181,7 @@ def journal_status(recovery_dir: Optional[str] = None) -> List[dict]:
             "snapshot_cursor": entry.get("snapshot_cursor"),
             "snapshot_ts": entry.get("snapshot_ts"),
             "error": entry.get("error"),
+            "downgrade": entry.get("downgrade"),
         })
     return out
 
@@ -232,14 +237,40 @@ def resume_entry(uri: str, entry: Optional[dict] = None, job=None):
     bookkeeping, snapshots and a possible second resume keep working.
     """
     from .. import persist
-    from . import dkv
-    from .observability import log, record
+    from . import dkv, failure, remat
+    from .observability import inc, log, record
     import h2o3_tpu.models as models
     if entry is None:
         entry = _read_entry(uri)
     if entry.get("status") != "running":
         return None
-    frame = dkv.get(entry.get("frame_key") or "")
+    fkey = entry.get("frame_key") or ""
+    frame = dkv.get(fkey)
+    if frame is not None and fkey and failure.any_dead():
+        # degraded-mode requeue: the frame object survived but a dead
+        # host's shards did not — lineage repairs only those (the frame
+        # stays usable as the copy source for survivor shards)
+        try:
+            repaired = remat.repair(fkey, remat.lost_host_indices())
+            if repaired is not None:
+                frame = repaired
+        except remat.RematError as e:
+            log.warning("recovery: shard repair of %r failed (%r); "
+                        "falling back to full re-import", fkey, e)
+            record("remat_fallback", frame=fkey, error=repr(e)[:200])
+            frame = None
+    if frame is None and fkey:
+        # lineage-first rebuild: the only automated path for derived
+        # frames (their journaled frame_source is None)
+        try:
+            frame = remat.repair(fkey)
+            if frame is not None:
+                log.info("recovery: re-materialized %r from lineage", fkey)
+        except remat.RematError as e:
+            log.warning("recovery: lineage rebuild of %r failed (%r); "
+                        "falling back to source re-import", fkey, e)
+            record("remat_fallback", frame=fkey, error=repr(e)[:200])
+            frame = None
     if frame is None and entry.get("frame_source"):
         # automated re-import from the journaled source URI
         from ..frame.parse import import_file
@@ -251,6 +282,20 @@ def resume_entry(uri: str, entry: Optional[dict] = None, job=None):
         except Exception as e:                 # noqa: BLE001
             log.warning("recovery: re-import of %r failed: %r",
                         entry.get("frame_source"), e)
+            # surface the downgrade: this resume is about to be skipped
+            # (or fail loudly under a job) — operators must see it
+            import time as _time
+            inc("recovery_reimport_failed_total")
+            record("recovery_reimport_failed", entry=uri,
+                   frame=entry.get("frame_key"),
+                   source=entry.get("frame_source"), error=repr(e)[:200])
+            entry["downgrade"] = {"reimport_failed": True,
+                                  "error": repr(e)[:200],
+                                  "ts": _time.time()}
+            try:
+                _write_entry(uri, entry)
+            except Exception:                  # noqa: BLE001
+                pass
     if frame is None:
         log.warning("recovery: frame %r not re-imported; skipping %s",
                     entry.get("frame_key"), uri)

@@ -1,0 +1,476 @@
+"""Partial re-materialization of lost frame shards from lineage.
+
+The reference's recovery contract (Recovery.java:72-81; recovery.py:9)
+treats data loss as total: any host death means the whole frame is
+re-imported from source.  This resolver walks the ``!lineage/`` records
+``frame/lineage.py`` stamps at parse/derive time and rebuilds ONLY what
+was lost, cheapest source first:
+
+1. **copy** — shards still held by the live frame (or an up-to-date
+   survivor) are copied, not recomputed;
+2. **replica** — hot frames under ``H2O3_TPU_REPLICATE_BELOW_MB`` keep a
+   DCN-neighbor replica of every shard in the DKV: recovery is a fetch
+   verified by content hash;
+3. **reparse / checkpoint** — parse-kind records re-parse only the lost
+   shard's newline-aligned byte range (the source span's sha1 is checked
+   first, so a mutated file can never rebuild silently-wrong rows);
+   checkpoint-kind records load the canonical snapshot;
+4. **replay** — derived-kind records recover their root frame the same
+   way, then replay the recorded op chain.
+
+Every rebuilt shard with a recorded value hash is verified bitwise
+(canonical column bytes); a mismatch raises :class:`RematError` and the
+caller — ``recovery.resume_entry`` / the scheduler's degraded-mode
+requeue — degrades to the old full re-import.  Wrong data is never
+produced silently: the failure mode is cost, not corruption.
+
+Metrics: ``remat_shards_total{mode}``, ``remat_seconds``,
+``lineage_records`` (docs/operations.md "Data plane recovery").
+Fault-injection point ``remat`` (failure.py) fires at the top of every
+recovery attempt so chaos rows can prove the degrade path.
+"""
+
+from __future__ import annotations
+
+import csv
+import hashlib
+import io
+import os
+import time
+from typing import Dict, List, Optional, Sequence, Set
+
+import numpy as np
+
+from ..frame import lineage
+from ..frame.vec import (T_CAT, T_NUM, T_STR, T_TIME, T_UUID, Vec,
+                         encode_domain)
+
+
+class RematError(RuntimeError):
+    """Lineage-driven re-materialization failed (or would be unsafe);
+    callers degrade to full re-import from source."""
+
+
+# most recent recovery, for tests/REST: frame, per-mode shard lists,
+# reparsed byte ranges, wall seconds
+last_stats: Dict[str, object] = {}
+
+
+def lost_host_indices() -> Optional[Set[int]]:
+    """Host (shard) indices of members declared dead by the failure
+    watchdog — read from the ``!failures/`` records, which carry the
+    process index the heartbeat stamped.  None when no death carries a
+    usable index (callers then treat every shard as lost)."""
+    from . import dkv
+    from .failure import FAILURES_PREFIX
+    lost: Set[int] = set()
+    try:
+        for k in dkv.keys(FAILURES_PREFIX):
+            rec = dkv.get(k)
+            if isinstance(rec, dict) and rec.get("host_index") is not None:
+                lost.add(int(rec["host_index"]))
+    except Exception:                    # noqa: BLE001 — coordinator gone
+        return None
+    return lost or None
+
+
+def repair(frame_key: str, lost: Optional[Sequence[int]] = None):
+    """Degraded-mode entry point: rebuild a frame's lost shards if (and
+    only if) it has lineage.  Returns the repaired Frame, or None when
+    no lineage record exists — the caller keeps its old fallback."""
+    if lineage.get_record(frame_key) is None:
+        return None
+    return recover_frame(frame_key, lost)
+
+
+def recover_frame(frame_key: str, lost: Optional[Sequence[int]] = None):
+    """Rebuild ``frame_key`` from its lineage record.  ``lost`` is the
+    set of shard indices to re-materialize (None = all, the fresh-
+    process restart case).  Registers and returns the rebuilt Frame;
+    raises :class:`RematError` when lineage cannot prove a correct
+    rebuild."""
+    from . import dkv
+    from .failure import maybe_inject
+    from .observability import inc, log, observe, record
+    t0 = time.perf_counter()
+    rec = lineage.get_record(frame_key)
+    if rec is None:
+        raise RematError(f"no lineage record for {frame_key!r}")
+    stats: Dict[str, object] = {"frame": frame_key, "copied": [],
+                                "replica": [], "reparsed": [],
+                                "checkpoint": [], "replay": []}
+    try:
+        maybe_inject("remat")
+        if rec.get("kind") == "derived":
+            frame = _recover_derived(rec, lost, stats)
+        else:
+            frame = _recover_base(rec, lost, stats)
+    except RematError:
+        raise
+    except Exception as e:               # noqa: BLE001 — normalize
+        raise RematError(
+            f"re-materialization of {frame_key!r} failed: {e!r}") from e
+    frame._lineage = rec
+    if rec.get("kind") == "parse":
+        frame.source_uri = rec.get("source")
+    dt = time.perf_counter() - t0
+    stats["seconds"] = round(dt, 4)
+    stats["mode"] = "replay" if stats["replay"] else (
+        "reparse" if stats["reparsed"] else (
+            "replica" if stats["replica"] else (
+                "checkpoint" if stats["checkpoint"] else "copy")))
+    last_stats.clear()
+    last_stats.update(stats)
+    for key, mode in (("copied", "copy"), ("replica", "replica"),
+                      ("reparsed", "reparse"), ("checkpoint", "checkpoint"),
+                      ("replay", "replay")):
+        n = len(stats[key])
+        if n:
+            inc("remat_shards_total", n, mode=mode)
+    observe("remat_seconds", dt)
+    record("remat", frame=frame_key, mode=stats["mode"],
+           seconds=stats["seconds"],
+           lost=sorted(int(i) for i in lost) if lost is not None else None)
+    try:
+        from .observability import set_gauge
+        set_gauge("lineage_records",
+                  float(len(dkv.keys(lineage.LINEAGE_PREFIX))))
+    except Exception:                    # noqa: BLE001
+        pass
+    log.info("remat: rebuilt %r via %s in %.3fs (copied=%d replica=%d "
+             "reparsed=%d checkpoint=%d replay=%d)", frame_key,
+             stats["mode"], dt, len(stats["copied"]), len(stats["replica"]),
+             len(stats["reparsed"]), len(stats["checkpoint"]),
+             len(stats["replay"]))
+    return frame
+
+
+# --------------------------------------------------------------- base frames
+
+def _alloc_cols(types: Sequence[str], nrows: int) -> List[np.ndarray]:
+    out = []
+    for t in types:
+        if t == T_CAT:
+            out.append(np.full(nrows, -1, np.int32))
+        elif t == T_TIME:
+            out.append(np.full(nrows, np.nan, np.float64))
+        elif t in (T_STR, T_UUID):
+            out.append(np.full(nrows, None, object))
+        else:
+            out.append(np.full(nrows, np.nan, np.float32))
+    return out
+
+
+def _live_canonical(rec) -> Optional[List[np.ndarray]]:
+    from . import dkv
+    live = dkv.get(rec["frame"])
+    if live is None or getattr(live, "nrows", None) != rec["nrows"] \
+            or getattr(live, "names", None) != rec["schema"]["names"]:
+        return None
+    try:
+        return lineage.canonical_cols(live)
+    except Exception:                    # noqa: BLE001 — shards may be gone
+        return None
+
+
+def _copy_shard(dst: List[np.ndarray], src: Sequence[np.ndarray],
+                lo: int, hi: int) -> None:
+    for d, s in zip(dst, src):
+        d[lo:hi] = s[lo:hi]
+
+
+def _try_replica(rec, shard: int, cols: List[np.ndarray],
+                 types: Sequence[str], lo: int, hi: int) -> bool:
+    """Fill a shard from its ``!replica/`` record; True on verified hit."""
+    from . import dkv
+    meta = (rec.get("replicas") or {}).get(str(shard))
+    if meta is None:
+        return False
+    rep = dkv.get(lineage.replica_key(rec["frame"], shard))
+    if not isinstance(rep, dict) or len(rep.get("cols", ())) != len(cols):
+        return False
+    for d, s in zip(cols, rep["cols"]):
+        if len(s) != hi - lo:
+            return False
+        d[lo:hi] = s
+    if lineage.hash_cols(cols, types, lo, hi) != meta.get("sha1"):
+        from .observability import log
+        log.warning("remat: replica of %r shard %d fails its content "
+                    "hash; falling back to recompute", rec["frame"], shard)
+        return False
+    return True
+
+
+def _recover_base(rec, lost: Optional[Sequence[int]], stats) -> object:
+    """Rebuild a parse- or checkpoint-kind frame shard by shard."""
+    schema = rec["schema"]
+    types = schema["types"]
+    nrows = int(rec["nrows"])
+    n_shards = int(rec["n_shards"])
+    lost_set = set(range(n_shards)) if lost is None \
+        else {int(i) for i in lost}
+    live_cols = _live_canonical(rec)
+    if live_cols is None:
+        lost_set = set(range(n_shards))
+    cols = _alloc_cols(types, nrows)
+    ckpt = None
+    for s in rec["shards"]:
+        i, lo = int(s["shard"]), int(s["row_lo"])
+        hi = lo + int(s["rows"])
+        if hi <= lo:
+            continue
+        want = s.get("val_sha1")
+        if i not in lost_set:
+            _copy_shard(cols, live_cols, lo, hi)
+            if want is None or lineage.hash_cols(cols, types, lo, hi) == want:
+                stats["copied"].append(i)
+                continue                 # verified survivor
+            # survivor failed its hash: rebuild it like a lost shard
+        if _try_replica(rec, i, cols, types, lo, hi):
+            stats["replica"].append(i)
+            continue
+        if rec.get("kind") == "checkpoint":
+            if ckpt is None:
+                _, ck_rows, ckpt = lineage.load_checkpoint(rec)
+                if ck_rows != nrows:
+                    raise RematError(
+                        f"checkpoint of {rec['frame']!r} has {ck_rows} "
+                        f"rows, lineage says {nrows}")
+            _copy_shard(cols, ckpt, lo, hi)
+            stats["checkpoint"].append(i)
+        else:
+            _reparse_span(rec, s, cols, types, schema)
+            stats["reparsed"].append([int(s["lo"]), int(s["hi"])])
+        if want is not None \
+                and lineage.hash_cols(cols, types, lo, hi) != want:
+            raise RematError(
+                f"rebuilt shard {i} of {rec['frame']!r} fails its content "
+                "hash — source or engine drift; use full re-import")
+    return _frame_from_canonical(schema, cols, rec["frame"])
+
+
+def _frame_from_canonical(schema, cols: List[np.ndarray], key: str):
+    from ..frame.frame import Frame
+    vecs = []
+    for name, t, c in zip(schema["names"], schema["types"], cols):
+        if t == T_CAT:
+            vecs.append(Vec.from_numpy(
+                c, T_CAT, domain=(schema.get("domains") or {}).get(name)))
+        elif t == T_TIME:
+            vecs.append(Vec.from_numpy(
+                c, T_TIME,
+                time_base=(schema.get("time_base") or {}).get(name)))
+        elif t in (T_STR, T_UUID):
+            vecs.append(Vec(None, t, len(c), host_data=c))
+        else:
+            vecs.append(Vec.from_numpy(c, T_NUM))
+    return Frame(schema["names"], vecs, key=key)
+
+
+# ---------------------------------------------------------- span re-parsing
+
+def _reparse_span(rec, shard: dict, cols: List[np.ndarray],
+                  types: Sequence[str], schema) -> None:
+    """Re-parse ONE shard's byte range of the source file into ``cols``
+    rows [row_lo, row_lo+rows) — the fastcsv ranged fan-out applied to
+    recovery.  The span's sha1 is verified against the lineage stamp
+    before any value is trusted."""
+    from .failure import maybe_inject
+    path = rec["source"]
+    lo_b, hi_b = int(shard["lo"]), int(shard["hi"])
+    row_lo, n = int(shard["row_lo"]), int(shard["rows"])
+    try:
+        with open(path, "rb") as f:
+            f.seek(lo_b)
+            span = f.read(hi_b - lo_b)
+    except OSError as e:
+        raise RematError(f"source {path!r} unreadable: {e!r}") from e
+    if len(span) != hi_b - lo_b \
+            or hashlib.sha1(span).hexdigest() != shard["src_sha1"]:
+        raise RematError(
+            f"source {path!r} bytes [{lo_b},{hi_b}) no longer match their "
+            "lineage hash — file changed since parse; use full re-import")
+    maybe_inject("parse_range")
+    sepc = rec["parse"].get("sep") or ","
+    parsed = _tokenize_span(span, sepc, len(types))
+    if parsed is None:
+        raise RematError(f"cannot tokenize span of {path!r}")
+    vals, flags, text = parsed
+    if len(vals) != n:
+        raise RematError(
+            f"span of {path!r} re-parsed to {len(vals)} rows, lineage "
+            f"says {n}")
+    for j, t in enumerate(types):
+        cols[j][row_lo:row_lo + n] = _typed_column(
+            t, vals, flags, text, j, schema, j_name=schema["names"][j])
+
+
+def _tokenize_span(span: bytes, sepc: str, ncols: int):
+    """Tokenize a byte span: native fastcsv when available, stdlib csv
+    otherwise.  Returns (vals f64 [n,ncols], flags u8 [n,ncols],
+    text(j) -> object column) or None."""
+    from .. import native
+    if len(sepc) == 1 and native.load() is not None:
+        out = native.parse_bytes(span, sepc, ncols=ncols)
+        if out is not None:
+            vals, flags, offs, consumed = out
+            if consumed == len(span):
+                from ..frame.parse import _decode_text_column
+                return (np.asarray(vals), np.asarray(flags),
+                        lambda j: _decode_text_column(span, offs, j))
+    rows = [r for r in csv.reader(io.StringIO(
+        span.decode(errors="replace")), delimiter=sepc) if r]
+    n = len(rows)
+    vals = np.full((n, ncols), np.nan, np.float64)
+    flags = np.zeros((n, ncols), np.uint8)
+    cells = np.full((n, ncols), "", object)
+    for i, r in enumerate(rows):
+        for j in range(min(len(r), ncols)):
+            c = r[j].strip()
+            cells[i, j] = c
+            try:
+                vals[i, j] = float(c)
+            except ValueError:
+                flags[i, j] = 1
+    return vals, flags, lambda j: cells[:, j]
+
+
+def _typed_column(t: str, vals, flags, text, j: int, schema,
+                  j_name: str) -> np.ndarray:
+    """One span column in canonical form, typed by the SCHEMA (never
+    re-guessed: a subset of rows must not change a column's type)."""
+    from ..frame.parse import _NA
+    if t == T_NUM and not flags[:, j].any():
+        return vals[:, j].astype(np.float32)
+    sv = np.asarray(text(j)).astype(str)
+    na = np.isin(sv, list(_NA))
+    if t == T_NUM:
+        out = np.full(len(sv), np.nan, np.float64)
+        ok = ~na
+        out[ok] = sv[ok].astype(np.float64)
+        return out.astype(np.float32)
+    if t == T_CAT:
+        dom = (schema.get("domains") or {}).get(j_name) or []
+        return encode_domain(sv, dom, na_mask=na)
+    if t == T_TIME:
+        import pandas as pd
+        with np.errstate(all="ignore"):
+            dt = pd.to_datetime(pd.Series(sv.astype(object)),
+                                errors="coerce", format="mixed")
+        ms = dt.to_numpy().astype("datetime64[ms]").astype("int64") \
+            .astype(np.float64)
+        ms[dt.isna().to_numpy() | na] = np.nan
+        return ms
+    out = sv.astype(object)
+    out[na] = None
+    return out
+
+
+# -------------------------------------------------------------- derived replay
+
+_MAX_ROOT_DEPTH = 4                      # checkpoint cap bounds real chains
+
+
+def _recover_derived(rec, lost: Optional[Sequence[int]], stats,
+                     depth: int = 0) -> object:
+    """Rebuild a derived-kind frame: replica shards first (no recompute),
+    else recover the root and replay the recorded op chain."""
+    schema = rec["schema"]
+    types = schema["types"]
+    nrows = int(rec["nrows"])
+    n_shards = int(rec["n_shards"])
+    lost_set = set(range(n_shards)) if lost is None \
+        else {int(i) for i in lost}
+    live_cols = _live_canonical(rec)
+    if live_cols is None:
+        lost_set = set(range(n_shards))
+    # cheap path: every missing shard patched from survivors + replicas
+    cols = _alloc_cols(types, nrows)
+    patched, copied, replicated = True, [], []
+    for s in rec["shards"]:
+        i, lo = int(s["shard"]), int(s["row_lo"])
+        hi = lo + int(s["rows"])
+        if hi <= lo:
+            continue
+        want = s.get("val_sha1")
+        if i not in lost_set:
+            _copy_shard(cols, live_cols, lo, hi)
+            if want is None or lineage.hash_cols(cols, types, lo, hi) == want:
+                copied.append(i)
+                continue
+        if _try_replica(rec, i, cols, types, lo, hi):
+            replicated.append(i)
+            continue
+        patched = False
+        break
+    if patched:
+        stats["copied"] += copied
+        stats["replica"] += replicated
+        return _frame_from_canonical(schema, cols, rec["frame"])
+    # replay path: a correct root, then the op chain
+    if depth > _MAX_ROOT_DEPTH:
+        raise RematError(f"lineage root chain of {rec['frame']!r} too deep")
+    from . import dkv
+    root_key = rec["root"]
+    root = dkv.get(root_key) if lost is None else None
+    if root is None:
+        root_rec = lineage.get_record(root_key)
+        if root_rec is None:
+            raise RematError(
+                f"derived frame {rec['frame']!r} has no recoverable root "
+                f"{root_key!r}")
+        if root_rec.get("kind") == "derived":
+            root = _recover_derived(root_rec, lost, stats, depth + 1)
+        else:
+            root = _recover_base(root_rec, lost, stats)
+    out = root
+    for op in rec.get("ops") or []:
+        out = _apply_op(out, op)
+    if out.nrows != nrows or list(out.names) != list(schema["names"]):
+        raise RematError(
+            f"replayed chain of {rec['frame']!r} produced "
+            f"{out.nrows}x{list(out.names)}, lineage says "
+            f"{nrows}x{schema['names']}")
+    re_cols = lineage.canonical_cols(out)
+    for s in rec["shards"]:
+        want = s.get("val_sha1")
+        if want is None or not s["rows"]:
+            continue
+        lo = int(s["row_lo"])
+        if lineage.hash_cols(re_cols, types, lo, lo + int(s["rows"])) != want:
+            raise RematError(
+                f"replayed shard {s['shard']} of {rec['frame']!r} fails "
+                "its content hash — use full re-import")
+        stats["replay"].append(int(s["shard"]))
+    if not stats["replay"]:
+        stats["replay"] += [int(s["shard"]) for s in rec["shards"]
+                            if s["rows"]]
+    out.key = rec["frame"]
+    dkv.put(out.key, out)
+    return out
+
+
+def _apply_op(fr, op: dict):
+    kind = op.get("op")
+    if kind == "cols":
+        return fr[list(op["cols"])]
+    if kind == "drop":
+        return fr.drop(list(op["cols"]))
+    if kind == "rename":
+        return fr.rename(dict(op["mapping"]))
+    if kind == "rows":
+        return fr.rows(lineage.unpack_index(op["index"]))
+    if kind == "split":
+        return fr.split_frame(list(op["ratios"]),
+                              seed=int(op["seed"]))[int(op["piece"])]
+    from ..rapids import ops as rapids_ops
+    if kind == "sort":
+        return rapids_ops.sort(fr, list(op["by"]),
+                               ascending=list(op["ascending"]))
+    if kind == "impute":
+        return rapids_ops.impute(fr, op["column"], method=op["method"],
+                                 combine_method=op["combine_method"])
+    if kind == "scale":
+        return rapids_ops.scale(fr, center=bool(op["center"]),
+                                scale_=bool(op["scale"]))
+    raise RematError(f"unknown lineage op {kind!r}")

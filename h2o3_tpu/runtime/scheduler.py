@@ -444,7 +444,11 @@ class ClusterScheduler:
         entries; returns the requeued job keys (the watchdog fails the
         rest).  The wedged worker thread's chips are released NOW — a
         gang that lost a member never completes, and the run-token guard
-        in Job.run keeps the stale thread from clobbering the retry."""
+        in Job.run keeps the stale thread from clobbering the retry.
+        The requeued entry resumes through ``recovery.resume_entry``,
+        which repairs the training frame's lost shards from lineage
+        (``runtime/remat.py``) before retraining — the data-plane half
+        of degraded-mode survival."""
         requeued: set = set()
         with self._cv:
             for key, ent in list(self._running.items()):

@@ -27,7 +27,7 @@ from __future__ import annotations
 import collections
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -241,12 +241,44 @@ class ServingEntry:
 _registry: Dict[str, ServingEntry] = {}
 _registry_lock = threading.Lock()
 
+# journaled publishes: a WAL-durable `!serve/<model>` DKV record + model
+# artifact under the recovery dir, so the serving plane survives a
+# coordinator restart (republish_journaled() in deploy/serve.py's
+# relaunch path) — the in-memory _registry alone did not
+SERVE_PREFIX = "!serve/"
 
-def publish(key: str, model=None, warm: bool = True) -> ServingEntry:
+
+def _journal_uri(key: str) -> Optional[str]:
+    from ..runtime import recovery
+    base = recovery.recovery_dir()
+    if not base:
+        return None
+    import re
+    safe = re.sub(r"[^A-Za-z0-9_.-]", "_", key)
+    return f"{base.rstrip('/')}/serve_{safe}.model"
+
+
+def _journal_publish(key: str, model, warm: bool) -> None:
+    """Best-effort: persist the model artifact + a `!serve/` pointer."""
+    uri = _journal_uri(key)
+    if uri is None or model is None:
+        return
+    try:
+        from ..runtime import dkv
+        model.save(uri)
+        dkv.put(SERVE_PREFIX + key,
+                {"uri": uri, "warm": bool(warm), "ts": time.time()})
+    except Exception as e:               # noqa: BLE001 — serving still up
+        obs.log.warning("serving: journal of publish %r failed: %r", key, e)
+
+
+def publish(key: str, model=None, warm: bool = True,
+            journal: bool = True) -> ServingEntry:
     """Pack + batch + warm one model for realtime scoring (idempotent).
 
     ``model=None`` resolves the key from the DKV — the REST layer's
-    model-publish hook.
+    model-publish hook.  With a recovery dir configured the publish is
+    journaled (``journal=False`` only on the re-publish path itself).
     """
     with _registry_lock:
         ent = _registry.get(key)
@@ -270,6 +302,8 @@ def publish(key: str, model=None, warm: bool = True) -> ServingEntry:
     if ent.batcher is not batcher:       # lost the publish race
         batcher.close()
     obs.set_gauge("serve_published_models", len(_registry))
+    if journal:
+        _journal_publish(key, model, warm)
     return ent
 
 
@@ -282,11 +316,51 @@ def ensure_published(key: str) -> ServingEntry:
 def unpublish(key: str) -> bool:
     with _registry_lock:
         ent = _registry.pop(key, None)
+    try:                                 # retract the journaled publish
+        from .. import persist
+        from ..runtime import dkv
+        if dkv.get(SERVE_PREFIX + key) is not None:
+            dkv.remove(SERVE_PREFIX + key)
+        uri = _journal_uri(key)
+        if uri:
+            persist.delete(uri)
+    except Exception:                    # noqa: BLE001 — best-effort
+        pass
     if ent is None:
         return False
     ent.batcher.close()
     obs.set_gauge("serve_published_models", len(_registry))
     return True
+
+
+def republish_journaled() -> List[str]:
+    """Re-publish every journaled serving model not already live — the
+    coordinator-restart path (deploy/serve.py relaunch): models are
+    reloaded from their saved artifacts when the DKV lost them."""
+    from ..runtime import dkv
+    out: List[str] = []
+    for k in dkv.keys(SERVE_PREFIX):
+        key = k[len(SERVE_PREFIX):]
+        with _registry_lock:
+            if key in _registry:
+                continue
+        rec = dkv.get(k)
+        if not isinstance(rec, dict):
+            continue
+        try:
+            model = dkv.get(key)
+            if model is None and rec.get("uri"):
+                from ..models.base import Model
+                model = Model.load(rec["uri"])   # re-registers under key
+            publish(key, model, warm=bool(rec.get("warm", True)),
+                    journal=False)
+            out.append(key)
+        except Exception as e:           # noqa: BLE001 — keep going
+            obs.log.warning("serving: re-publish of journaled %r "
+                            "failed: %r", key, e)
+    if out:
+        obs.record("serve_republish", models=out)
+    return out
 
 
 def shutdown_all():

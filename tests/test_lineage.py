@@ -1,0 +1,294 @@
+"""Shard lineage + partial re-materialization correctness (tier-1).
+
+The contract under test: a re-materialized shard is BITWISE equal to the
+original (canonical column bytes, content-hash verified), whether it was
+rebuilt by replica copy, ranged source re-parse, op-chain replay, or a
+checkpoint load — and a rebuild that cannot be proven correct raises
+RematError instead of producing wrong data.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.frame import lineage
+from h2o3_tpu.frame.parse import parse_csv
+from h2o3_tpu.frame.vec import T_CAT, T_NUM, T_STR, T_TIME
+from h2o3_tpu.runtime import dkv, failure, remat
+from h2o3_tpu.runtime.config import reload as config_reload
+
+
+def _write_csv(tmp_path, name="data.csv", n=240):
+    """Mixed-type CSV: numeric, numeric-with-NA, categorical, date, and
+    a high-cardinality string column."""
+    lines = ["num,gappy,cat,when,tag"]
+    for i in range(n):
+        gap = "NA" if i % 11 == 0 else f"{i * 0.25}"
+        cat = ["red", "green", "blue"][i % 3] if i % 13 else "NA"
+        day = f"2021-{(i % 12) + 1:02d}-{(i % 27) + 1:02d}"
+        lines.append(f"{i},{gap},{cat},{day},tag_{i:05d}")
+    path = tmp_path / name
+    path.write_text("\n".join(lines) + "\n")
+    return str(path)
+
+
+def _assert_canonical_equal(a, b, what=""):
+    ca, cb = lineage.canonical_cols(a), lineage.canonical_cols(b)
+    assert a.names == b.names and a.nrows == b.nrows, what
+    for name, x, y in zip(a.names, ca, cb):
+        if x.dtype == object:
+            assert list(x) == list(y), f"{what}: column {name}"
+        else:
+            assert x.dtype == y.dtype, f"{what}: column {name} dtype"
+            np.testing.assert_array_equal(x, y, err_msg=f"{what}: {name}")
+
+
+@pytest.fixture(autouse=True)
+def _clean(cl):
+    failure.reset()
+    yield
+    failure.reset()
+    os.environ.pop("H2O3_TPU_FAULT_INJECT", None)
+    for k in ("H2O3_TPU_REPLICATE_BELOW_MB", "H2O3_TPU_LINEAGE_MAX_CHAIN",
+              "H2O3_TPU_LINEAGE_MAX_INDEX"):
+        os.environ.pop(k, None)
+    config_reload()
+
+
+# ----------------------------------------------------------- parse records
+
+def test_parse_stamps_lineage_record(cl, tmp_path):
+    path = _write_csv(tmp_path)
+    fr = parse_csv(path, destination_frame="lin_parse")
+    rec = lineage.get_record("lin_parse")
+    assert rec is not None and rec["kind"] == "parse"
+    assert rec["source"] == os.path.abspath(path)
+    assert rec["n_shards"] == cl.n_hosts
+    assert rec["nrows"] == fr.nrows
+    assert rec["schema"]["names"] == fr.names
+    assert rec["schema"]["types"] == [v.type for v in fr.vecs]
+    assert set(rec["schema"]["types"]) == {T_NUM, T_CAT, T_TIME, T_STR}
+    # shards tile the rows exactly, in order, and carry both hashes
+    row = 0
+    for s in rec["shards"]:
+        assert s["row_lo"] == row
+        row += s["rows"]
+        assert len(s["src_sha1"]) == 40
+        assert len(s["val_sha1"]) == 40
+    assert row == fr.nrows
+    # byte ranges are contiguous over the body (no header overlap)
+    spans = [(s["lo"], s["hi"]) for s in rec["shards"] if s["rows"]]
+    assert all(a[1] == b[0] for a, b in zip(spans, spans[1:]))
+    assert spans[0][0] > 0               # header excluded from shard 0
+    dkv.remove("lin_parse")
+    lineage.drop_record("lin_parse")
+
+
+def test_unsafe_sources_leave_no_record(cl, tmp_path):
+    # quoted embedded newline: physical lines != rows, so the byte-range
+    # claim would be wrong — lineage must refuse to stamp
+    path = tmp_path / "quoted.csv"
+    path.write_text('a,b\n1,"x\ny"\n2,z\n')
+    fr = parse_csv(str(path), destination_frame="lin_quoted")
+    assert lineage.get_record("lin_quoted") is None
+    dkv.remove("lin_quoted")
+    # in-memory buffers have no byte provenance at all
+    fr2 = parse_csv(b"a,b\n1,2\n", destination_frame="lin_buf")
+    assert lineage.get_record("lin_buf") is None
+    dkv.remove("lin_buf")
+    del fr, fr2
+
+
+# ------------------------------------------------------- re-materialization
+
+def test_full_rebuild_is_bitwise_equal(cl, tmp_path):
+    fr = parse_csv(_write_csv(tmp_path), destination_frame="lin_full")
+    dkv.remove("lin_full")
+    fr2 = remat.recover_frame("lin_full")
+    _assert_canonical_equal(fr, fr2, "full rebuild")
+    assert remat.last_stats["mode"] == "reparse"
+    assert dkv.get("lin_full") is fr2    # re-registered under its key
+    dkv.remove("lin_full")
+    lineage.drop_record("lin_full")
+
+
+def test_partial_repair_reparses_only_lost_shard(cl, tmp_path):
+    fr = parse_csv(_write_csv(tmp_path), destination_frame="lin_part")
+    rec = lineage.get_record("lin_part")
+    lost = rec["n_shards"] - 1
+    # a second ranged re-parse would raise: proves exactly one happens
+    failure.reset()
+    os.environ["H2O3_TPU_FAULT_INJECT"] = "parse_range:0:2:raise"
+    fr2 = remat.recover_frame("lin_part", lost={lost})
+    os.environ.pop("H2O3_TPU_FAULT_INJECT")
+    _assert_canonical_equal(fr, fr2, "partial repair")
+    assert remat.last_stats["reparsed"] == [
+        [rec["shards"][lost]["lo"], rec["shards"][lost]["hi"]]]
+    assert sorted(remat.last_stats["copied"]) == [
+        s["shard"] for s in rec["shards"] if s["shard"] != lost]
+    dkv.remove("lin_part")
+    lineage.drop_record("lin_part")
+
+
+def test_changed_source_raises_never_rebuilds_wrong(cl, tmp_path):
+    path = _write_csv(tmp_path, "mutates.csv")
+    parse_csv(path, destination_frame="lin_mut")
+    dkv.remove("lin_mut")
+    body = open(path).read().replace("tag_00001", "tag_XXXXX")
+    open(path, "w").write(body)
+    with pytest.raises(remat.RematError, match="no longer match"):
+        remat.recover_frame("lin_mut")
+    lineage.drop_record("lin_mut")
+
+
+def test_metrics_and_timeline(cl, tmp_path):
+    from h2o3_tpu.runtime.observability import counter, timeline_events
+    fr = parse_csv(_write_csv(tmp_path), destination_frame="lin_met")
+    before = counter("remat_shards_total", mode="reparse").value
+    dkv.remove("lin_met")
+    remat.recover_frame("lin_met")
+    gained = counter("remat_shards_total", mode="reparse").value - before
+    assert gained == sum(1 for s in lineage.get_record("lin_met")["shards"]
+                         if s["rows"])
+    ev = [e for e in timeline_events(500) if e.get("kind") == "remat"]
+    assert ev and ev[-1]["frame"] == "lin_met"
+    del fr
+    dkv.remove("lin_met")
+    lineage.drop_record("lin_met")
+
+
+# ------------------------------------------------------------ derived chains
+
+def test_derived_chain_replays_bitwise(cl, tmp_path):
+    fr = parse_csv(_write_csv(tmp_path), destination_frame="lin_root")
+    piece = fr.drop(["tag"]).split_frame([0.7, 0.3], seed=11)[1]
+    lineage.register(piece, "lin_valid")
+    rec = lineage.get_record("lin_valid")
+    assert rec["kind"] == "derived" and rec["root"] == "lin_root"
+    assert [o["op"] for o in rec["ops"]] == ["drop", "split"]
+    dkv.remove("lin_valid")
+    back = remat.recover_frame("lin_valid")
+    _assert_canonical_equal(piece, back, "derived replay")
+    assert remat.last_stats["mode"] == "replay"
+    for k in ("lin_root", "lin_valid"):
+        dkv.remove(k)
+        lineage.drop_record(k)
+
+
+def test_rapids_ops_replay_bitwise(cl, tmp_path):
+    from h2o3_tpu.rapids import ops
+    fr = parse_csv(_write_csv(tmp_path), destination_frame="lin_rap")
+    out = ops.scale(ops.impute(ops.sort(fr.drop(["tag"]), "cat"), "gappy"))
+    rec = out._lineage
+    assert [o["op"] for o in rec["ops"]] == ["drop", "sort", "impute",
+                                             "scale"]
+    lineage.register(out, "lin_munged")
+    dkv.remove("lin_munged")
+    back = remat.recover_frame("lin_munged")
+    _assert_canonical_equal(out, back, "rapids replay")
+    for k in ("lin_rap", "lin_munged"):
+        dkv.remove(k)
+        lineage.drop_record(k)
+
+
+def test_rows_with_huge_index_breaks_chain(cl, tmp_path):
+    os.environ["H2O3_TPU_LINEAGE_MAX_INDEX"] = "10"
+    config_reload()
+    fr = parse_csv(_write_csv(tmp_path), destination_frame="lin_idx")
+    small = fr.rows(np.arange(5))        # under the cap: replayable
+    assert small._lineage is not None
+    big = fr.rows(np.arange(100))        # over the cap: chain broken
+    assert big._lineage is None
+    dkv.remove("lin_idx")
+    lineage.drop_record("lin_idx")
+
+
+def test_unreplayable_op_breaks_chain(cl, tmp_path):
+    fr = parse_csv(_write_csv(tmp_path), destination_frame="lin_brk")
+    merged = fr.cbind(fr.rename({c: f"{c}_2" for c in fr.names}))
+    assert merged._lineage is None       # cbind is not replayable
+    lineage.register(merged, "lin_cbind")
+    assert lineage.get_record("lin_cbind") is None
+    with pytest.raises(remat.RematError, match="no lineage"):
+        remat.recover_frame("lin_cbind")
+    for k in ("lin_brk", "lin_cbind"):
+        dkv.remove(k)
+        lineage.drop_record(k)
+
+
+def test_deep_chain_checkpoints(cl, tmp_path, monkeypatch):
+    monkeypatch.setenv("H2O3_TPU_RECOVERY_DIR", str(tmp_path / "rec"))
+    os.makedirs(tmp_path / "rec", exist_ok=True)
+    os.environ["H2O3_TPU_LINEAGE_MAX_CHAIN"] = "2"
+    config_reload()
+    fr = parse_csv(_write_csv(tmp_path), destination_frame="lin_deep")
+    out = fr
+    for _ in range(4):                   # chain depth 4 > cap 2
+        out = out.drop([]).rename({})
+    assert len(out._lineage["ops"]) == 8
+    lineage.register(out, "lin_ckpt")
+    rec = lineage.get_record("lin_ckpt")
+    assert rec["kind"] == "checkpoint" and rec["uri"]
+    dkv.remove("lin_ckpt")
+    back = remat.recover_frame("lin_ckpt")
+    _assert_canonical_equal(out, back, "checkpoint rebuild")
+    assert remat.last_stats["mode"] == "checkpoint"
+    for k in ("lin_deep", "lin_ckpt"):
+        dkv.remove(k)
+        lineage.drop_record(k)
+
+
+# -------------------------------------------------------------- replicas
+
+def test_hot_frame_replicas_recover_without_reparse(cl, tmp_path):
+    os.environ["H2O3_TPU_REPLICATE_BELOW_MB"] = "10"
+    config_reload()
+    fr = parse_csv(_write_csv(tmp_path), destination_frame="lin_rep")
+    rec = lineage.get_record("lin_rep")
+    assert len(rec["replicas"]) == rec["n_shards"]
+    for i, meta in rec["replicas"].items():
+        assert meta["host"] == (int(i) + 1) % rec["n_shards"]  # neighbor
+        assert dkv.get(lineage.replica_key("lin_rep", int(i))) is not None
+    # any re-parse would raise: recovery must ride the replicas
+    failure.reset()
+    os.environ["H2O3_TPU_FAULT_INJECT"] = "parse_range:0:1:raise"
+    fr2 = remat.recover_frame("lin_rep", lost={0})
+    os.environ.pop("H2O3_TPU_FAULT_INJECT")
+    _assert_canonical_equal(fr, fr2, "replica recovery")
+    assert remat.last_stats["replica"] == [0]
+    assert not remat.last_stats["reparsed"]
+    dkv.remove("lin_rep")
+    lineage.drop_record("lin_rep")
+
+
+def test_corrupt_replica_falls_back_to_reparse(cl, tmp_path):
+    os.environ["H2O3_TPU_REPLICATE_BELOW_MB"] = "10"
+    config_reload()
+    fr = parse_csv(_write_csv(tmp_path), destination_frame="lin_bad")
+    rep_key = lineage.replica_key("lin_bad", 0)
+    rep = dict(dkv.get(rep_key))
+    rep["cols"] = [np.asarray(c).copy() for c in rep["cols"]]
+    bad = rep["cols"][0]
+    bad[0] = -999.0                      # silent bitflip in the replica
+    dkv.put(rep_key, rep)
+    fr2 = remat.recover_frame("lin_bad", lost={0})
+    # the replica failed its hash; the shard came from a re-parse instead
+    assert remat.last_stats["reparsed"]
+    _assert_canonical_equal(fr, fr2, "corrupt replica fallback")
+    dkv.remove("lin_bad")
+    lineage.drop_record("lin_bad")
+
+
+def test_lineage_disabled_leaves_no_records(cl, tmp_path, monkeypatch):
+    monkeypatch.setenv("H2O3_TPU_LINEAGE", "0")
+    config_reload()
+    try:
+        fr = parse_csv(_write_csv(tmp_path), destination_frame="lin_off")
+        assert lineage.get_record("lin_off") is None
+        assert fr.drop(["tag"])._lineage is None
+    finally:
+        monkeypatch.delenv("H2O3_TPU_LINEAGE")
+        config_reload()
+    dkv.remove("lin_off")

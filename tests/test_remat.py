@@ -1,0 +1,219 @@
+"""Chaos row: kill a host mid-GBM on a 4-host virtual mesh and prove
+recovery re-parses ONLY the dead host's byte ranges (counted via the
+``parse_range`` injection point), with predictions matching an
+uninterrupted run.  Also: derived frames resume through lineage replay
+(no source URI journaled — previously unresumable), a failed re-mat
+degrades to full re-import instead of producing wrong data, and a failed
+re-import is surfaced as a visible downgrade rather than a silent skip.
+``tools/chaos.sh`` runs this module as the ``remat-partial`` row.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.frame import lineage
+from h2o3_tpu.frame.parse import import_file
+from h2o3_tpu.models import GBM
+from h2o3_tpu.runtime import dkv, failure, heartbeat, recovery, remat
+from h2o3_tpu.runtime.observability import counter, timeline_events
+
+NTREES = 8
+_GBM_PARAMS = dict(response_column="y", ntrees=NTREES, max_depth=3,
+                   learn_rate=0.2, seed=7, score_tree_interval=2)
+
+
+def _write_csv(path, seed=11, n=600):
+    rng = np.random.default_rng(seed)
+    X = rng.random((n, 4))
+    y = (10 * np.sin(np.pi * X[:, 0]) + 5 * X[:, 1] ** 2
+         + 3 * X[:, 2] + 0.1 * rng.normal(size=n))
+    rows = np.column_stack([X, y])
+    path.write_text("x0,x1,x2,x3,y\n" + "\n".join(
+        ",".join(f"{v:.9g}" for v in r) for r in rows))
+    return str(path)
+
+
+def _drop(*keys):
+    for k in keys:
+        dkv.remove(k)
+        lineage.drop_record(k)
+
+
+def test_host_kill_midtrain_repairs_only_lost_shards(cl, tmp_path,
+                                                     monkeypatch):
+    """The acceptance scenario: host 2 of 4 dies mid-GBM.  The watchdog
+    stamps its jax process index into the failure record, the journal
+    keeps the job 'running', and resume() repairs the frame by copying
+    the three survivor shards and re-parsing exactly ONE byte range —
+    proven by arming ``parse_range`` to raise on its second invocation."""
+    monkeypatch.setenv("H2O3_TPU_RECOVERY_DIR", str(tmp_path))
+    csv = _write_csv(tmp_path / "remat4.csv")
+    orig_hosts = cl.n_hosts
+    h2o3_tpu.init(hosts=4)
+    failure.reset()
+    try:
+        fr = import_file(csv, destination_frame="remat4_fr")
+        rec = lineage.get_record("remat4_fr")
+        assert rec is not None and rec["n_shards"] == 4
+
+        ref = GBM(**_GBM_PARAMS).train(fr)
+        ref_pred = ref.predict(fr).to_numpy()[:, 0]
+        assert not list(tmp_path.glob("job_*.json"))   # clean baseline
+
+        # host 2 stops heartbeating long enough to be classified dead;
+        # its stamp carries the jax process index the repair needs
+        dkv.put(heartbeat.PREFIX + "ghost:9",
+                {"ts": time.time() - 60.0, "interval": 5.0, "pid": 9,
+                 "proc": 2})
+        assert failure.check(hb_interval=5.0) == ["ghost:9"]
+        frec = dkv.get(failure.FAILURES_PREFIX + "ghost:9")
+        assert frec["host_index"] == 2
+        assert remat.lost_host_indices() == {2}
+
+        # the in-flight train dies on the degraded cluster: the journal
+        # entry must stay 'running' (resumable), not flip to 'failed'
+        monkeypatch.setenv("H2O3_TPU_FAULT_INJECT", "tree_chunk:0:2:raise")
+        with pytest.raises(failure.InjectedFault):
+            GBM(**_GBM_PARAMS).train(fr)
+        (entry_path,) = tmp_path.glob("job_*.json")
+        assert json.loads(entry_path.read_text())["status"] == "running"
+
+        # resume while degraded: a SECOND ranged re-parse would raise —
+        # recovery must touch only the dead host's byte range
+        monkeypatch.setenv("H2O3_TPU_FAULT_INJECT", "parse_range:0:2:raise")
+        before_copy = counter("remat_shards_total", mode="copy").value
+        done = recovery.resume(str(tmp_path))
+        monkeypatch.delenv("H2O3_TPU_FAULT_INJECT")
+        assert len(done) == 1
+
+        s2 = rec["shards"][2]
+        assert remat.last_stats["frame"] == "remat4_fr"
+        assert remat.last_stats["reparsed"] == [[s2["lo"], s2["hi"]]]
+        assert sorted(remat.last_stats["copied"]) == [0, 1, 3]
+        assert counter("remat_shards_total", mode="copy").value \
+            == before_copy + 3
+
+        model = dkv.get(done[0])
+        assert model.output["ntrees_trained"] == NTREES
+        res_pred = model.predict(dkv.get("remat4_fr")).to_numpy()[:, 0]
+        np.testing.assert_allclose(res_pred, ref_pred, rtol=1e-4, atol=1e-4)
+        assert not list(tmp_path.glob("job_*.json"))
+    finally:
+        monkeypatch.delenv("H2O3_TPU_FAULT_INJECT", raising=False)
+        failure.reset()
+        dkv.remove(heartbeat.PREFIX + "ghost:9")
+        dkv.remove(failure.FAILURES_PREFIX + "ghost:9")
+        _drop("remat4_fr")
+        h2o3_tpu.init(hosts=orig_hosts)
+
+
+def test_derived_frame_resumes_via_lineage_replay(cl, tmp_path,
+                                                  monkeypatch):
+    """A job trained on a split piece has NO journaled source URI — after
+    a restart that loses the frame, lineage replay is the only automated
+    path back (previously these entries were unresumable)."""
+    monkeypatch.setenv("H2O3_TPU_RECOVERY_DIR", str(tmp_path))
+    failure.reset()
+    csv = _write_csv(tmp_path / "derived.csv", seed=13)
+    try:
+        root = import_file(csv, destination_frame="remat_droot")
+        train = root.split_frame([0.8, 0.2], seed=5)[0]
+        lineage.register(train, "remat_dtrain")
+        ref = GBM(**_GBM_PARAMS).train(train)
+        ref_pred = ref.predict(train).to_numpy()[:, 0]
+
+        failure._handled.add("ghost")   # degraded: journal stays running
+
+        class BoomGBM(GBM):
+            def _fit(self, *a, **k):
+                raise RuntimeError("collective aborted: peer gone")
+
+        BoomGBM.__name__ = "GBM"
+        with pytest.raises(RuntimeError):
+            BoomGBM(**_GBM_PARAMS).train(train)
+        (entry_path,) = tmp_path.glob("job_*.json")
+        entry = json.loads(entry_path.read_text())
+        assert entry["status"] == "running"
+        assert entry["frame_source"] is None      # nothing to re-import
+
+        # "restart": frames gone from the DKV, cluster healthy again
+        failure.reset()
+        dkv.remove("remat_dtrain")
+        dkv.remove("remat_droot")
+        done = recovery.resume(str(tmp_path))
+        assert len(done) == 1
+        assert remat.last_stats["frame"] == "remat_dtrain"
+        assert remat.last_stats["mode"] == "replay"
+        model = dkv.get(done[0])
+        res_pred = model.predict(train).to_numpy()[:, 0]
+        np.testing.assert_allclose(res_pred, ref_pred, rtol=1e-4, atol=1e-4)
+    finally:
+        failure.reset()
+        _drop("remat_droot", "remat_dtrain")
+
+
+def test_failed_remat_degrades_to_full_reimport(cl, tmp_path, monkeypatch):
+    """The ``remat`` injection point fires at the top of every rebuild:
+    a raise there must degrade to a full source re-import — never wrong
+    data, and the downgrade lands on the timeline."""
+    monkeypatch.setenv("H2O3_TPU_RECOVERY_DIR", str(tmp_path))
+    failure.reset()
+    csv = _write_csv(tmp_path / "degrade.csv", seed=17)
+    try:
+        fr = import_file(csv, destination_frame="remat_degr_fr")
+        ref = GBM(**_GBM_PARAMS).train(fr)
+        ref_pred = ref.predict(fr).to_numpy()[:, 0]
+
+        failure._handled.add("ghost")
+
+        class BoomGBM(GBM):
+            def _fit(self, *a, **k):
+                raise RuntimeError("collective aborted: peer gone")
+
+        BoomGBM.__name__ = "GBM"
+        with pytest.raises(RuntimeError):
+            BoomGBM(**_GBM_PARAMS).train(fr)
+        failure.reset()
+        dkv.remove("remat_degr_fr")
+
+        monkeypatch.setenv("H2O3_TPU_FAULT_INJECT", "remat:0:1:raise")
+        done = recovery.resume(str(tmp_path))
+        monkeypatch.delenv("H2O3_TPU_FAULT_INJECT")
+        assert len(done) == 1
+        falls = [e for e in timeline_events(500)
+                 if e.get("kind") == "remat_fallback"]
+        assert falls and falls[-1]["frame"] == "remat_degr_fr"
+        model = dkv.get(done[0])
+        res_pred = model.predict(dkv.get("remat_degr_fr")).to_numpy()[:, 0]
+        np.testing.assert_allclose(res_pred, ref_pred, rtol=1e-4, atol=1e-4)
+    finally:
+        monkeypatch.delenv("H2O3_TPU_FAULT_INJECT", raising=False)
+        failure.reset()
+        _drop("remat_degr_fr")
+
+
+def test_reimport_failure_surfaces_downgrade(cl, tmp_path):
+    """Satellite: when lineage can't rebuild AND the source re-import
+    fails, the skip is no longer silent — counter bump, timeline event,
+    and a ``downgrade`` stanza in the journal entry + status report."""
+    entry = {"algo": "GBM", "params": {}, "frame_key": "vanished_fr",
+             "frame_source": str(tmp_path / "missing.csv"),
+             "status": "running"}
+    p = tmp_path / "job_vanished.json"
+    p.write_text(json.dumps(entry))
+    before = counter("recovery_reimport_failed_total").value
+    assert recovery.resume(str(tmp_path)) == []
+    assert counter("recovery_reimport_failed_total").value == before + 1
+    evs = [e for e in timeline_events(500)
+           if e.get("kind") == "recovery_reimport_failed"]
+    assert evs and evs[-1]["frame"] == "vanished_fr"
+    updated = json.loads(p.read_text())
+    assert updated["downgrade"]["reimport_failed"]
+    assert updated["downgrade"]["error"]
+    status = recovery.journal_status(str(tmp_path))
+    assert any((e.get("downgrade") or {}).get("reimport_failed")
+               for e in status)

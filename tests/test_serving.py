@@ -509,3 +509,44 @@ def test_deploy_serve_sigterm_drains_realtime(cl, rng, tmp_path):
         if p.poll() is None:
             p.kill()
             p.wait()
+
+
+def test_publish_journal_survives_coordinator_restart(cl, rng, tmp_path,
+                                                      monkeypatch):
+    """A journaled publish (`!serve/` record + saved artifact) brings the
+    serving plane back after a coordinator restart: the registry is wiped
+    AND the model is gone from the DKV, yet ``republish_journaled()``
+    reloads the artifact and scoring output is unchanged."""
+    monkeypatch.setenv("H2O3_TPU_RECOVERY_DIR", str(tmp_path))
+    from h2o3_tpu import serving
+    from h2o3_tpu.runtime import dkv
+    from h2o3_tpu.serving import batcher
+    _, fr_bin, data = _frames(rng)
+    m = GBM(response_column="y", ntrees=6, seed=1).train(fr_bin)
+    rows = _na_rows(data, rng, k=12)
+    try:
+        ent = batcher.publish(m.key, m, warm=False)
+        ref = ent.predict_rows(rows)
+        rec = dkv.get(batcher.SERVE_PREFIX + m.key)
+        assert rec and rec["uri"].endswith(".model") and rec["warm"] is False
+
+        # "restart": serving registry cleared and the model lost with it
+        serving.shutdown_all()
+        dkv.remove(m.key)
+        assert batcher.republish_journaled() == [m.key]
+        assert dkv.get(m.key) is not None      # Model.load re-registered it
+
+        out = batcher.ensure_published(m.key).predict_rows(rows)
+        assert (out["predict"] == ref["predict"]).all()
+        np.testing.assert_allclose(out["probabilities"],
+                                   ref["probabilities"], rtol=1e-5)
+        # idempotent: everything already live
+        assert batcher.republish_journaled() == []
+        # unpublish retracts the journal so the model stays retired
+        assert batcher.unpublish(m.key)
+        assert dkv.get(batcher.SERVE_PREFIX + m.key) is None
+        assert batcher.republish_journaled() == []
+    finally:
+        serving.shutdown_all()
+        dkv.remove(batcher.SERVE_PREFIX + m.key)
+        dkv.remove(m.key)

@@ -47,6 +47,10 @@ DEFAULT_TOLERANCE_PCT = 10.0
 TOLERANCE_OVERRIDES_PCT = {
     "bench_wall_s": 25.0,
     "scaling_8_to_32": 15.0,
+    # recovery timings are I/O-noisy on shared hosts
+    "remat_partial_s": 25.0,
+    "remat_full_s": 25.0,
+    "remat_partial_vs_baseline": 25.0,
 }
 # echoes of configuration / sizes / diagnostics: reported, never gated
 INFORMATIONAL = ("platform", "rows", "trees", "parse_csv_mb",

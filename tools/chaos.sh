@@ -35,7 +35,12 @@
 #   - in-process snapshot/journal/resume contracts
 #     (tests/test_snapshot_recovery.py),
 #   - failure watchdog classification + degraded mode
-#     (tests/test_failure.py).
+#     (tests/test_failure.py),
+#   - remat-partial:      a host dies mid-GBM on a 4-host virtual mesh;
+#     recovery re-parses ONLY the dead host's byte ranges (proved by the
+#     parse_range injection counter), derived frames replay from
+#     lineage, a failed re-mat degrades to full re-import — never wrong
+#     data (tests/test_remat.py).
 #
 # Exits nonzero if ANY row fails (every row still runs).
 set -o pipefail
@@ -77,6 +82,7 @@ run_row dkv-wal tests/test_dkv_wal.py
 run_row dkv-retry tests/test_dkv_retry.py
 run_row snapshot-recovery tests/test_snapshot_recovery.py
 run_row failure-watchdog tests/test_failure.py
+run_row remat-partial tests/test_remat.py
 
 echo "---- chaos rows ($ROWS_FILE) ----"
 cat "$ROWS_FILE"
