@@ -478,6 +478,21 @@ def worker_main():
                 sv["serve_speedup"], 2)
         except Exception as e:
             extra["serve_error"] = repr(e)[:200]
+        try:
+            # autotuner: cold/warm-cache "auto" knobs vs the best
+            # hand-set configuration (bench_pieces autotune); the gate
+            # holds autotune_vs_best to an absolute 0.97 floor
+            from bench_pieces import autotune_piece
+            at = autotune_piece()
+            extra["autotune_hand_trees_per_sec"] = round(
+                at["autotune_hand_trees_per_sec"], 2)
+            extra["autotune_cold_trees_per_sec"] = round(
+                at["autotune_cold_trees_per_sec"], 2)
+            extra["autotune_warm_trees_per_sec"] = round(
+                at["autotune_warm_trees_per_sec"], 2)
+            extra["autotune_vs_best"] = round(at["autotune_vs_best"], 3)
+        except Exception as e:
+            extra["autotune_error"] = repr(e)[:200]
     compiles, compile_s = _ledger_totals()
     if compiles:
         extra["compiles_total"] = compiles

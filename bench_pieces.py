@@ -1181,6 +1181,133 @@ def sched_piece():
             "sched_fair_vs_baseline": ratio}
 
 
+def autotune_piece():
+    """Cost-model autotuner bench: cold-cache vs warm-cache vs best
+    hand-set trees/s on one GBM signature.
+
+    Three trainings of the same airlines-shaped regression GBM:
+      * best hand-set — each hand-tunable (hist_mode, split_mode)
+        combination timed steady-state, best throughput kept;
+      * auto, cold cache — knobs "auto" with an empty cache dir, so the
+        roofline model seeds the choice at trace time;
+      * auto, warm cache — tuner state reset but the cache file kept,
+        so the choice comes back source="cache" with zero re-measures.
+
+    ``autotune_vs_best`` (warm auto / best hand-set) is the gate metric:
+    tools/bench_gate.py holds it to an absolute floor of 0.97 — the
+    tuner is never allowed to be meaningfully slower than the best
+    hand-set configuration on a seen signature.
+
+    Usage (chip): python bench_pieces.py autotune
+    CPU smoke:    JAX_PLATFORMS=cpu H2O3_PIECES_ROWS=50000 \\
+                  python bench_pieces.py autotune
+    """
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import shutil
+    import tempfile
+    import time as _time
+
+    import jax
+
+    import h2o3_tpu
+    from h2o3_tpu import Frame
+    from h2o3_tpu.models.tree.gbm import GBM
+    from h2o3_tpu.runtime import autotune
+    from h2o3_tpu.runtime import config as _cfg
+
+    h2o3_tpu.init()
+    platform = jax.devices()[0].platform
+    rows = min(N_ROWS, 200_000)
+    trees = int(os.environ.get("H2O3_AUTOTUNE_TREES", 16))
+    reps = int(os.environ.get("H2O3_AUTOTUNE_REPS", 3))
+    rng = np.random.default_rng(5)
+    Fs = 8
+    X = rng.normal(size=(rows, Fs)).astype(np.float64)
+    y = (X[:, 0] * 0.7 - X[:, 1] ** 2 * 0.2
+         + 0.1 * rng.normal(size=rows))
+    fr = Frame.from_numpy(
+        {**{f"x{i}": X[:, i] for i in range(Fs)}, "y": y})
+    kw = dict(response_column="y", ntrees=trees, max_depth=6, nbins=64,
+              min_rows=10, seed=3)
+
+    def timed(**knob_kw):
+        t0 = _time.perf_counter()
+        GBM(**kw, **knob_kw).train(fr)
+        return _time.perf_counter() - t0
+
+    def tps(**knob_kw):
+        """Steady-state trees/s: warm the jit caches once, then take
+        the best of ``reps`` timed trainings."""
+        GBM(**kw, **knob_kw).train(fr)
+        return trees / min(timed(**knob_kw) for _ in range(reps))
+
+    saved = {k: os.environ.get(k) for k in
+             ("H2O3_TPU_AUTOTUNE", "H2O3_TPU_AUTOTUNE_CACHE_DIR")}
+    cache_dir = tempfile.mkdtemp(prefix="autotune_bench_")
+    try:
+        # hand-set sweep (tuner off: the knobs mean what they say)
+        os.environ["H2O3_TPU_AUTOTUNE"] = "off"
+        _cfg.reload()
+        autotune.reset()
+        hand = {}
+        for hm, sm in (("subtract", "fused"), ("full", "fused"),
+                       ("subtract", "separate")):
+            hand[f"{hm}|{sm}"] = tps(hist_mode=hm, split_mode=sm)
+        best_key = max(hand, key=hand.get)
+        bhm, bsm = best_key.split("|")
+
+        os.environ["H2O3_TPU_AUTOTUNE"] = "on"
+        os.environ["H2O3_TPU_AUTOTUNE_CACHE_DIR"] = cache_dir
+        _cfg.reload()
+        autotune.reset()
+        cold = tps()                       # model-seeded decision
+        autotune.reset()                   # drop memory, keep the file
+        # warm-cache vs best-hand-set: interleaved timings so host-side
+        # drift (GC, turbo, noisy neighbors) hits both sides equally —
+        # the choices usually name the SAME kernels, and the gate ratio
+        # must reflect the tuner's decision, not the clock's mood
+        GBM(**kw).train(fr)                          # warm: cache hit
+        GBM(**kw, hist_mode=bhm, split_mode=bsm).train(fr)
+        t_warm, t_hand = float("inf"), float("inf")
+        for _ in range(reps):
+            t_hand = min(t_hand, timed(hist_mode=bhm, split_mode=bsm))
+            t_warm = min(t_warm, timed())
+        warm = trees / t_warm
+        hand[best_key] = max(hand[best_key], trees / t_hand)
+        ratio = t_hand / t_warm if t_warm else float("inf")
+        table = autotune.decision_table()
+        warm_sources = sorted({d["source"] for d in table["decisions"]
+                               if d["signature"].startswith("gbm")}) \
+            or ["none"]
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        _cfg.reload()
+        autotune.reset()
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+    print(json.dumps({
+        "piece": "autotune", "platform": platform, "rows": rows,
+        "trees": trees,
+        "autotune_hand_best": best_key,
+        "autotune_hand_trees_per_sec": round(hand[best_key], 2),
+        "autotune_cold_trees_per_sec": round(cold, 2),
+        "autotune_warm_trees_per_sec": round(warm, 2),
+        "autotune_vs_best": round(ratio, 3),
+        "warm_sources": warm_sources,
+        "note": "gate: autotune_vs_best >= 0.97 absolute floor"}),
+        flush=True)
+    return {"autotune_hand_trees_per_sec": hand[best_key],
+            "autotune_cold_trees_per_sec": cold,
+            "autotune_warm_trees_per_sec": warm,
+            "autotune_vs_best": ratio}
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "parse":
         parse_piece()
@@ -1202,5 +1329,7 @@ if __name__ == "__main__":
         sched_piece()
     elif len(sys.argv) > 1 and sys.argv[1] == "remat":
         remat_piece()
+    elif len(sys.argv) > 1 and sys.argv[1] == "autotune":
+        autotune_piece()
     else:
         main()

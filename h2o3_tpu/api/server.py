@@ -1188,6 +1188,13 @@ class Api:
         from ..runtime import xprof
         return xprof.ledger_snapshot()
 
+    def autotune_table(self) -> dict:
+        """GET /3/Profiler/autotune — the autotuner's decision table:
+        program signature -> chosen knobs, decision source, and
+        predicted vs measured tree-phase seconds."""
+        from ..runtime import autotune
+        return autotune.decision_table()
+
     def logs(self, limit=500, **kw) -> dict:
         from ..runtime.observability import recent_logs
         return {"log": recent_logs(int(limit))}
@@ -1320,6 +1327,7 @@ class H2OServer:
             r"/3/Scheduler": lambda a, **kw: a.scheduler_status(**kw),
             r"/3/Profiler/memory": lambda a: a.profiler_memory(),
             r"/3/Profiler/compiles": lambda a: a.compile_ledger(),
+            r"/3/Profiler/autotune": lambda a: a.autotune_table(),
         }
         _Handler.routes_post = {
             r"/3/Parse": lambda a, **kw: a.parse(**kw),

@@ -30,8 +30,7 @@ from .binning import fit_bins, edges_matrix
 from .shared import (SharedTree, SharedTreeModel, SharedTreeParameters,
                      StackedTrees, TreeList, chunk_schedule, dense_mem_cap,
                      make_multinomial_scan_fn, make_tree_scan_fn,
-                     resolve_hist_layout, resolve_hist_mode,
-                     resolve_split_mode, run_hist_crosscheck,
+                     run_hist_crosscheck,
                      run_layout_crosscheck, run_split_crosscheck,
                      traverse_jit, use_hier_split_search)
 from ...metrics.core import make_metrics
@@ -98,13 +97,19 @@ class DRF(SharedTree):
                                                      frame.nrows)
         # resolve the kernel-strategy knobs ONCE, up front — the layout
         # changes the effective-depth cap, so checkpoint validation and
-        # the recorded depth must see the resolved layout (see gbm.py)
-        hist_mode = resolve_hist_mode(p)
-        split_mode = resolve_split_mode(
-            p, plan=plan, hier=use_hier_split_search(p, N))
-        hist_layout = resolve_hist_layout(
-            p, hist_mode=hist_mode, plan=plan,
-            hier=use_hier_split_search(p, N))
+        # the recorded depth must see the resolved layout (see gbm.py);
+        # "auto" knobs route through the cost-model autotuner
+        from ...runtime import autotune
+        knobs = autotune.resolve_tree_knobs(
+            p, kind=self.algo, F=Fw, N=N, K=K,
+            plan=plan, hier=use_hier_split_search(p, N),
+            checkpoint=prior is not None)
+        autotune.activate(knobs)
+        hist_mode, split_mode, hist_layout = (
+            knobs.hist_mode, knobs.split_mode, knobs.hist_layout)
+        if knobs.sparse_depth_threshold != p.sparse_depth_threshold:
+            p = dataclasses.replace(
+                p, sparse_depth_threshold=knobs.sparse_depth_threshold)
         if prior is not None:
             from .shared import validate_checkpoint_depth
             validate_checkpoint_depth(prior, 0 if K > 1 else None,

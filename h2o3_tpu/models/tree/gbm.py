@@ -34,8 +34,7 @@ from .binning import fit_bins, edges_matrix
 from .shared import (SharedTree, SharedTreeModel, SharedTreeParameters,
                      StackedTrees, Tree, TreeList, build_tree,
                      chunk_schedule, dense_mem_cap, make_build_tree_fn,
-                     make_tree_scan_fn, resolve_hist_layout,
-                     resolve_hist_mode, resolve_split_mode,
+                     make_tree_scan_fn,
                      run_hist_crosscheck, run_layout_crosscheck,
                      run_split_crosscheck, stack_trees,
                      traverse_jit, use_hier_split_search)
@@ -146,13 +145,24 @@ class GBM(SharedTree):
         # resolve the kernel-strategy knobs ONCE, up front: the layout
         # changes the effective-depth cap (node-sparse levels drop the
         # dense 64 MB histogram bound), so checkpoint validation and the
-        # recorded depth must see the resolved layout, not the raw knob
-        hist_mode = resolve_hist_mode(p)
-        split_mode = resolve_split_mode(
-            p, mono=mono, plan=plan, hier=use_hier_split_search(p, N))
-        hist_layout = resolve_hist_layout(
-            p, hist_mode=hist_mode, mono=mono, plan=plan,
-            hier=use_hier_split_search(p, N))
+        # recorded depth must see the resolved layout, not the raw knob.
+        # "auto" knobs route through the cost-model autotuner (a no-op
+        # resolving to the fixed defaults with H2O3_TPU_AUTOTUNE=off);
+        # activate() scopes sampled device timings to this decision.
+        from ...runtime import autotune
+        knobs = autotune.resolve_tree_knobs(
+            p, kind=self.algo, F=Fw, N=N, K=K if multinomial else 1,
+            mono=mono, plan=plan, hier=use_hier_split_search(p, N),
+            checkpoint=prior is not None)
+        autotune.activate(knobs)
+        hist_mode, split_mode, hist_layout = (
+            knobs.hist_mode, knobs.split_mode, knobs.hist_layout)
+        if knobs.sparse_depth_threshold != p.sparse_depth_threshold:
+            # the tuned threshold must flow to EVERY consumer (effective
+            # depth, scan factories, checkpoint validation, the params
+            # echo records the effective value)
+            p = dataclasses.replace(
+                p, sparse_depth_threshold=knobs.sparse_depth_threshold)
         if prior is not None:
             from .shared import validate_checkpoint_depth
             validate_checkpoint_depth(prior, 0 if multinomial else None,

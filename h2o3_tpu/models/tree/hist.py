@@ -748,6 +748,41 @@ def sparse_slot_budget(F: int, B: int,
     return int(max(16, min(4096, (a // 8) * 8)))
 
 
+def hist_level_bytes(n_rows: int, F: int, B: int, width: int, K: int = 1,
+                     *, layout: str = "dense",
+                     hist_mode: str = "subtract",
+                     cap_bytes: int = 64 * 1024 * 1024):
+    """Roofline byte traffic for ONE level's histogram build — the cost
+    atom ``runtime/autotune.py`` seeds its model from, kept next to the
+    kernels it prices so a kernel change updates the model in one place.
+
+    Reads: int32 codes + f32 g/h/w per contributing row per feature
+    (subtract levels stream only the compacted smaller siblings,
+    <= n/2 rows; the full oracle streams every row).  Writes: the
+    [width|A, F, B] triple-plane grid, f32.  Returns ``None`` when the
+    dense grid for ``width`` leaves exceeds the histogram budget — that
+    config cannot run and the model must price it out."""
+    rows = n_rows if (hist_mode == "full" or width <= 1) else n_rows // 2
+    read = rows * F * (4 + 3 * 4) * max(K, 1)
+    slots = width if layout == "dense" else min(width, sparse_slot_budget(
+        F, B, cap_bytes))
+    grid = slots * F * B * 3 * 4 * max(K, 1)
+    if layout == "dense" and grid > cap_bytes * max(K, 1):
+        return None
+    if layout == "sparse":
+        # slot-map gathers + compaction traffic: a small constant factor
+        # over the dense write path, paid for unbounded depth
+        grid = int(grid * 1.15) + rows * 4
+    return float(read + grid)
+
+
+def split_search_passes(split_mode: str) -> float:
+    """Histogram re-read factor of the split search: the fused
+    winner-record kernel reads the grid once; the separate multi-pass
+    oracle scans it ~3x (gains, argmax, record)."""
+    return 1.0 if split_mode == "fused" else 3.0
+
+
 def sparse_slot_maps(valid_prev, A_next: int):
     """Child-slot assignment for the next node-sparse level.
 

@@ -98,8 +98,11 @@ class SharedTreeParameters(Parameters):
     #     the larger sibling as parent - small (hist.make_subtract_level_fn);
     #   "full"     — histogram every child from all N rows (the oracle);
     #   "check"    — driver assert mode: grow one tree both ways on the
-    #     real data and raise on divergence, then train with "subtract".
-    hist_mode: str = "subtract"
+    #     real data and raise on divergence, then train with "subtract";
+    #   "auto"     (default) — the cost-model autotuner picks per
+    #     (shape, depth, K, mesh) signature (runtime/autotune.py); with
+    #     H2O3_TPU_AUTOTUNE=off this is exactly "subtract".
+    hist_mode: str = "auto"
     # split-search strategy per level (mirrors hist_mode):
     #   "fused"    (default) — single-pass winner-record kernel between the
     #     histogram and the tiny feature-argmax epilogue (hist.py
@@ -110,9 +113,11 @@ class SharedTreeParameters(Parameters):
     #     K-iteration class loops (the pre-batching pipeline, kept whole);
     #   "check"    — driver assert mode: grow the first round both ways on
     #     the real data and raise on divergence, then train with "fused".
+    #   "auto"     (default) — autotuner-decided, as with hist_mode
+    #     ("fused" with the tuner off).
     # Monotone constraints, EFB bundling and the hierarchical search stay
     # on the separate path (drivers downgrade automatically).
-    split_mode: str = "fused"
+    split_mode: str = "auto"
     # per-level histogram LAYOUT (mirrors hist_mode/split_mode):
     #   "auto"   (default) — dense [2^d, F, B] slot grids above
     #     sparse_depth_threshold, node-sparse [A, F, B] slots keyed by the
@@ -1153,10 +1158,16 @@ def use_hier_split_search(params, n_padded: int) -> bool:
 
 def resolve_hist_mode(params) -> str:
     """Validate + normalize the ``hist_mode`` knob (drivers call this once;
-    ``"check"`` is resolved to ``"subtract"`` AFTER run_hist_crosscheck)."""
-    mode = str(getattr(params, "hist_mode", "subtract")).lower()
+    ``"check"`` is resolved to ``"subtract"`` AFTER run_hist_crosscheck).
+    ``"auto"`` resolves to the fixed default here — drivers that route
+    through ``autotune.resolve_tree_knobs`` get the tuned choice
+    instead; this fallback is what the tuner's "off" mode serves."""
+    mode = str(getattr(params, "hist_mode", "auto")).lower()
+    if mode == "auto":
+        return "subtract"
     if mode not in ("subtract", "full", "check"):
-        raise ValueError(f"hist_mode={mode!r}: use subtract | full | check")
+        raise ValueError(
+            f"hist_mode={mode!r}: use auto | subtract | full | check")
     return mode
 
 
@@ -1167,11 +1178,14 @@ def resolve_split_mode(params, *, mono=None, plan=None,
     to ``"fused"`` AFTER run_split_crosscheck).  Monotone constraints, EFB
     bundling and the hierarchical search have no fused implementation, so
     those builds downgrade to ``"separate"`` here — silently, matching
-    the drivers' existing auto-gating of those features."""
-    mode = str(getattr(params, "split_mode", "fused")).lower()
+    the drivers' existing auto-gating of those features.  ``"auto"``
+    resolves to the fixed default here (see resolve_hist_mode)."""
+    mode = str(getattr(params, "split_mode", "auto")).lower()
+    if mode == "auto":
+        mode = "fused"
     if mode not in ("fused", "separate", "check"):
         raise ValueError(
-            f"split_mode={mode!r}: use fused | separate | check")
+            f"split_mode={mode!r}: use auto | fused | separate | check")
     if mode != "separate" and (mono is not None or plan is not None
                                or hier):
         return "separate"

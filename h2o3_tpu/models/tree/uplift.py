@@ -34,8 +34,7 @@ from .hist import (make_batched_level_fn, make_batched_sparse_level_fn,
                    sparse_slot_budget, sparse_slot_maps, table_lookup)
 from .shared import (SharedTreeModel, SharedTree, SharedTreeParameters,
                      StackedTrees, Tree, TreeList, dense_mem_cap,
-                     resolve_hist_layout, resolve_hist_mode,
-                     resolve_split_mode, traverse_jit)
+                     traverse_jit)
 
 _EPS = 1e-6
 
@@ -196,8 +195,17 @@ class UpliftDRF(SharedTree):
         # reconstructs the larger arm histograms from the per-shard parent
         # carries — the same <= N/2 row stream as GBM/DRF.  hist_mode="full"
         # keeps the oracle (the old always-full build); "check" grows the
-        # first tree both ways and asserts identical splits.
-        hist_mode = resolve_hist_mode(p)
+        # first tree both ways and asserts identical splits.  "auto"
+        # knobs route through the cost-model autotuner (K=2: the two
+        # arms ride the batched level program as the class axis)
+        from ...runtime import autotune
+        knobs = autotune.resolve_tree_knobs(p, kind=self.algo, F=F, N=N,
+                                            K=2)
+        autotune.activate(knobs)
+        if knobs.sparse_depth_threshold != p.sparse_depth_threshold:
+            p = dataclasses.replace(
+                p, sparse_depth_threshold=knobs.sparse_depth_threshold)
+        hist_mode = knobs.hist_mode
         level_fns = [make_subtract_level_fn(d, F, B, N)
                      for d in range(p.max_depth)] \
             if hist_mode in ("subtract", "check") else None
@@ -209,7 +217,7 @@ class UpliftDRF(SharedTree):
         # one hist launch per level instead of two; the divergence split
         # search itself stays _uplift_best_splits.  "check" grows the
         # first tree both ways and asserts, then trains batched.
-        split_mode = resolve_split_mode(p)
+        split_mode = knobs.split_mode
         bfns = [make_batched_level_fn(
                     d, 2, F, B, N, subtract=(hist_mode != "full"))
                 for d in range(p.max_depth)] \
@@ -218,7 +226,7 @@ class UpliftDRF(SharedTree):
         # histograms by ALIVE-leaf slots [A, F, B] instead of the dense
         # [2^d, F, B] grid (both arms share one slot map — the leaf
         # assignment is shared).  "check" grows the first tree both ways.
-        hist_layout = resolve_hist_layout(p, hist_mode=hist_mode)
+        hist_layout = knobs.hist_layout
         if hist_layout == "check" and (hist_mode == "check"
                                        or split_mode == "check"):
             raise ValueError(

@@ -1,0 +1,684 @@
+"""Cost-model-driven autotuner: the performance knobs choose themselves.
+
+The tree pipeline carries six interacting performance knobs (``hist_mode``,
+``hist_layout``, ``split_mode``, ``sparse_depth_threshold``,
+``reduce_mode``, the serving traversal ``impl``) whose best setting flips
+with (shape, depth, K, mesh geometry) — the GPU tree-boosting literature
+shows the histogram/split strategy genuinely inverts with bin count and
+depth.  PR 10's compile ledger already publishes the signals a tuner
+needs (``program_flops`` / ``program_bytes_accessed`` per seam, sampled
+``tree_phase_device_seconds``), so this module closes the loop, TVM-style:
+
+1. **Signature** — each build is keyed by
+   ``(kind, F, log2(N), K, max_depth, nbins, mesh geometry, backend)``.
+   Decisions are per signature, not per process: two jobs with the same
+   shape share one decision; a different mesh is a different signature.
+
+2. **Cost model seed** — every candidate configuration is scored by a
+   roofline-style estimate built from the per-level histogram bytes/flops
+   the kernels in ``models/tree/hist.py`` report (``hist_level_bytes`` /
+   ``split_search_passes``), normalized by per-platform peak bandwidth
+   and calibrated against the ledger's measured ``cost_analysis()``
+   figures when available.  The model's argmin is served immediately
+   (``source="model"``) — no warm-up builds.
+
+3. **Measured refinement** — with ``H2O3_TPU_DEVICE_TIMING`` sampling on,
+   ``xprof.maybe_device_sync`` feeds true dispatch→ready seconds back via
+   ``on_device_sample``; every ``autotune_explore_every``-th resolve of a
+   model-seeded signature runs the runner-up candidate instead
+   (epsilon-greedy, deterministic counter — no RNG), so an early
+   mis-prediction self-corrects: once two candidates carry measurements
+   the faster one wins permanently (``source="measured"``).
+
+4. **Warm-start cache** — decisions persist as JSON under
+   ``<H2O3_TPU_RECOVERY_DIR>/autotune/`` (WAL-adjacent, atomic
+   tmp+rename), keyed by signature + backend + jax version, so a fresh
+   cluster skips straight to ``source="cache"`` and never re-measures.
+   A corrupt or version-stale file silently degrades to model-seeded
+   decisions — the tuner can never error a training path.  A
+   ``cluster_reinit`` epoch bump (``invalidate()``, wired into
+   ``cluster._invalidate_compiled_caches``) drops every in-memory
+   decision AND the loaded file snapshot: a geometry change can never
+   serve a stale choice.
+
+The master switch is ``H2O3_TPU_AUTOTUNE`` = ``on`` (default) | ``off`` |
+``cache_only``.  ``off`` resolves every ``"auto"`` knob to the historical
+fixed default (subtract / fused / sparse-below-threshold / hier), giving
+bit-identical kernels to the pre-tuner tree — tier-1 pins it.
+``cache_only`` serves cached + model decisions but never explores.  The
+``*="check"`` oracles remain the correctness net under every decision the
+tuner makes: checks bypass tuning entirely and crosscheck the real data.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import math
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from . import observability as obs
+
+_lock = threading.RLock()
+
+# signature -> decision entry; dropped wholesale by invalidate()
+_DECISIONS: Dict[str, dict] = {}
+
+# mirrors the xprof ledger epoch discipline: invalidate() bumps it and
+# marks any already-loaded cache file dead for the rest of the process
+_EPOCH = 0
+_file_loaded = False
+_file_dead = False
+
+# threshold candidates the model ranks for sparse_depth_threshold="auto"
+# (the default 8 is always a candidate, so "off" and "on" agree when the
+# model finds no better setting)
+_THRESHOLD_CANDIDATES = (4, 6, 8, 10)
+
+# the int sentinel meaning "tune me": the dataclass default.  Any other
+# user-set value is treated as pinned (see docs/operations.md).
+DEFAULT_SPARSE_THRESHOLD = 8
+
+# per-platform (peak_flops/s, peak_HBM_bytes/s) for the roofline seed —
+# deliberately coarse: only candidate *ranking* matters, and measured
+# refinement corrects absolute error
+_PEAKS = {
+    "tpu": (1.97e14, 8.19e11),      # v4-class MXU / HBM2
+    "gpu": (1.0e14, 1.0e12),
+    "cpu": (5.0e10, 5.0e10),
+}
+
+# thread-local measurement scope: the decision entry whose chosen config
+# is currently executing on this thread (drivers activate it at resolve)
+_tls = threading.local()
+
+
+# ------------------------------------------------------------------ mode
+
+def autotune_mode() -> str:
+    """Effective ``H2O3_TPU_AUTOTUNE``: ``on`` | ``off`` | ``cache_only``
+    (unknown values read as ``off`` — misconfiguration never tunes)."""
+    from .config import config
+    mode = config().autotune
+    return mode if mode in ("on", "cache_only") else "off"
+
+
+def _explore_every() -> int:
+    from .config import config
+    return max(int(config().autotune_explore_every), 2)
+
+
+# ------------------------------------------------------------- signature
+
+def _backend() -> str:
+    try:
+        import jax
+        return jax.default_backend()
+    except Exception:                    # noqa: BLE001 — pre-jax callers
+        return "unknown"
+
+
+def _jax_version() -> str:
+    try:
+        import jax
+        return jax.__version__
+    except Exception:                    # noqa: BLE001
+        return "unknown"
+
+
+def _mesh_geometry() -> Tuple[int, int, int]:
+    """(hosts, chips, model) of the live mesh; falls back to the flat
+    device count so the tuner works before (or without) cluster init."""
+    try:
+        from .cluster import _cluster
+        if _cluster is not None:
+            s = dict(_cluster.mesh.shape)
+            return (s.get("hosts", 1), s.get("chips", 1), s.get("model", 1))
+    except Exception:                    # noqa: BLE001
+        pass
+    try:
+        import jax
+        return (1, jax.device_count(), 1)
+    except Exception:                    # noqa: BLE001
+        return (1, 1, 1)
+
+
+def _signature(kind: str, F: int, N: int, K: int, max_depth: int,
+               nbins: int) -> str:
+    hosts, chips, model = _mesh_geometry()
+    nb = int(math.log2(max(N, 1))) if N else 0
+    return (f"{kind}:F{F}:N2^{nb}:K{K}:d{max_depth}:b{nbins}"
+            f":mesh{hosts}x{chips}x{model}:{_backend()}")
+
+
+# ------------------------------------------------------------ cost model
+
+def _peaks() -> Tuple[float, float]:
+    return _PEAKS.get(_backend(), _PEAKS["cpu"])
+
+
+def _ledger_calibration() -> float:
+    """Bytes-per-second scale factor from the compile ledger: when the
+    tree scan program reports ``bytes_accessed`` and a measured device
+    time exists, trust achieved bandwidth over the roofline constant."""
+    try:
+        from . import xprof
+        snap = xprof.ledger_snapshot()["programs"]
+        for name in ("tree_scan", "tree_scan_multinomial", "tree_build"):
+            ent = snap.get(name)
+            if ent and ent.get("bytes_accessed"):
+                # achieved bandwidth unknown without a paired wall time;
+                # the ledger figure still rescales CPU-vs-TPU sanely
+                return 1.0
+    except Exception:                    # noqa: BLE001
+        pass
+    return 1.0
+
+
+def _predict_tree_cost(F: int, N: int, K: int, max_depth: int, nbins: int,
+                       *, hist_mode: str, split_mode: str,
+                       hist_layout: str, threshold: int) -> float:
+    """Roofline seconds for one K-tree build under one candidate config.
+
+    Per-level byte/flop counts come from ``hist.hist_level_bytes`` /
+    ``hist.split_search_passes`` so the estimate lives next to the
+    kernels it models; infeasible configs (dense grid over the histogram
+    budget) price at +inf and can never win."""
+    from ..models.tree.hist import hist_level_bytes, split_search_passes
+    peak_f, peak_b = _peaks()
+    B = nbins + 1
+    total_bytes = 0.0
+    total_flops = 0.0
+    for d in range(max_depth):
+        layout_d = ("sparse" if hist_layout == "sparse" and d >= threshold
+                    else "dense")
+        b = hist_level_bytes(N, F, B, 2 ** d, K,
+                             layout=layout_d, hist_mode=hist_mode)
+        if b is None:
+            return float("inf")
+        total_bytes += b * split_search_passes(split_mode)
+        # one multiply-add per (row, feature, class) scatter contribution
+        rows = N if (hist_mode == "full" or d == 0) else N // 2
+        total_flops += 2.0 * rows * F * K
+    return max(total_flops / peak_f,
+               total_bytes / peak_b) * _ledger_calibration()
+
+
+def _tree_candidates(F: int, N: int, K: int, max_depth: int, nbins: int,
+                     *, mono, plan, hier: bool,
+                     tuned: dict) -> List[dict]:
+    """Joint candidate configs over the knobs being tuned; knobs pinned by
+    the user keep their pinned value in every candidate.  The same
+    feature-compat downgrades the shared.py resolvers apply constrain the
+    space, so a candidate is always runnable."""
+    from ..models.tree.shared import dense_mem_cap, sparse_layout_active
+    hist_modes = (("subtract", "full") if tuned.get("hist_mode")
+                  else (tuned.get("_hist_mode_pin", "subtract"),))
+    split_modes = (("fused", "separate") if tuned.get("split_mode")
+                   else (tuned.get("_split_mode_pin", "fused"),))
+    if mono is not None or plan is not None or hier:
+        split_modes = ("separate",)
+    out = []
+    for hm in hist_modes:
+        layouts: Tuple[Tuple[str, int], ...]
+        sparse_ok = sparse_layout_active("auto", hm, mono=mono, plan=plan,
+                                         hier=hier)
+        cap = max(1, dense_mem_cap(nbins, F))
+        if tuned.get("hist_layout"):
+            layouts = (("dense", max_depth),)
+            if sparse_ok:
+                cands = (_THRESHOLD_CANDIDATES
+                         if tuned.get("sparse_depth_threshold")
+                         else (tuned.get("_threshold_pin",
+                                         DEFAULT_SPARSE_THRESHOLD),))
+                layouts += tuple(("sparse", min(t, cap)) for t in cands
+                                 if t < max_depth)
+        else:
+            pin = tuned.get("_hist_layout_pin", "sparse")
+            t_pin = min(tuned.get("_threshold_pin",
+                                  DEFAULT_SPARSE_THRESHOLD), cap)
+            layouts = ((pin, t_pin if pin == "sparse" else max_depth),)
+            if pin == "sparse" and tuned.get("sparse_depth_threshold") \
+                    and sparse_ok:
+                layouts = tuple(("sparse", min(t, cap))
+                                for t in _THRESHOLD_CANDIDATES
+                                if t < max_depth) or layouts
+        for sm in split_modes:
+            for layout, thr in dict.fromkeys(layouts):
+                if layout == "sparse" and not sparse_ok:
+                    continue
+                out.append({"hist_mode": hm, "split_mode": sm,
+                            "hist_layout": layout,
+                            "sparse_depth_threshold": int(thr)})
+    # dedupe while keeping model-preferred ordering stable
+    seen, uniq = set(), []
+    for c in out:
+        k = _cand_key(c)
+        if k not in seen:
+            seen.add(k)
+            uniq.append(c)
+    return uniq
+
+
+def _cand_key(c: dict) -> str:
+    return (f"{c['hist_mode']}|{c['split_mode']}|{c['hist_layout']}"
+            f"|t{c['sparse_depth_threshold']}")
+
+
+def _predict_costs(F: int, N: int, K: int, max_depth: int, nbins: int,
+                   candidates: List[dict]) -> Dict[str, float]:
+    """Per-candidate roofline seconds (tests monkeypatch this to force a
+    wrong model and prove measured refinement self-corrects)."""
+    return {
+        _cand_key(c): _predict_tree_cost(
+            F, N, K, max_depth, nbins, hist_mode=c["hist_mode"],
+            split_mode=c["split_mode"], hist_layout=c["hist_layout"],
+            threshold=c["sparse_depth_threshold"])
+        for c in candidates
+    }
+
+
+# ------------------------------------------------------------- decisions
+
+def _note_decision(knobs: dict, source: str) -> None:
+    for knob, choice in knobs.items():
+        obs.inc("autotune_decisions_total", knob=knob, choice=str(choice),
+                source=source)
+
+
+def _publish_cache_gauge() -> None:
+    obs.set_gauge("autotune_cache_entries", float(len(_DECISIONS)))
+
+
+def _measured_best(ent: dict) -> Optional[str]:
+    """Candidate key with the lowest measured EMA, when at least two
+    candidates carry measurements (one measurement proves nothing about
+    the alternatives)."""
+    meas = {k: v["ema"] for k, v in ent["measured"].items() if v["n"] > 0}
+    if len(meas) < 2:
+        return None
+    return min(meas, key=meas.get)
+
+
+def _decide(sig: str, candidates: List[dict], predicted: Dict[str, float],
+            mode: str) -> dict:
+    """Look up / create the decision entry for ``sig`` and pick the config
+    to RUN this resolve (usually the decision; sometimes the epsilon
+    exploration of the runner-up)."""
+    ent = _DECISIONS.get(sig)
+    if ent is None:
+        cached = _load_cached_entry(sig)
+        if cached is not None:
+            ent = cached
+        else:
+            best = min(predicted, key=predicted.get)
+            ent = {"sig": sig, "choice": best, "source": "model",
+                   "predicted": predicted, "measured": {}, "resolves": 0,
+                   "explore": None, "epoch": _EPOCH}
+        _DECISIONS[sig] = ent
+        ent["candidates"] = {_cand_key(c): c for c in candidates}
+        _publish_cache_gauge()
+    ent.setdefault("candidates", {_cand_key(c): c for c in candidates})
+    for c in candidates:                 # constraint set may have grown
+        ent["candidates"].setdefault(_cand_key(c), c)
+    ent["resolves"] += 1
+    run_key = ent["choice"]
+    ent["explore"] = None
+    if (mode == "on" and ent["source"] in ("model", "measured")
+            and len(ent["candidates"]) > 1
+            and ent["resolves"] % _explore_every() == 0):
+        # deterministic epsilon-greedy: re-measure the best *other*
+        # candidate by predicted cost so a mis-seeded model gets evidence
+        others = {k: v for k, v in ent["predicted"].items()
+                  if k != ent["choice"] and k in ent["candidates"]
+                  and v != float("inf")}
+        if others:
+            run_key = min(others, key=others.get)
+            ent["explore"] = run_key
+    if run_key not in ent["candidates"]:
+        run_key = ent["choice"] = min(
+            (k for k in ent["candidates"]),
+            key=lambda k: ent["predicted"].get(k, float("inf")))
+    return {"entry": ent, "run_key": run_key,
+            "run": ent["candidates"][run_key]}
+
+
+def on_device_sample(phase: str, seconds: float) -> None:
+    """Measurement sink for ``xprof.maybe_device_sync``: attribute one
+    true device-phase timing to the config currently executing under the
+    active decision scope, and let the evidence overturn the model."""
+    scope = getattr(_tls, "scope", None)
+    if scope is None or autotune_mode() != "on" \
+            or not phase.startswith("tree"):
+        return
+    sig, run_key = scope
+    with _lock:
+        ent = _DECISIONS.get(sig)
+        if ent is None or ent["source"] == "cache":
+            return
+        m = ent["measured"].setdefault(run_key, {"ema": 0.0, "n": 0})
+        m["ema"] = seconds if m["n"] == 0 \
+            else 0.7 * m["ema"] + 0.3 * seconds
+        m["n"] += 1
+        best = _measured_best(ent)
+        if best is not None and best != ent["choice"]:
+            old = ent["choice"]
+            ent["choice"] = best
+            ent["source"] = "measured"
+            obs.record("autotune_flip", sig=sig, old=old, new=best)
+            _note_decision({"config": best}, "measured")
+        elif best is not None:
+            ent["source"] = "measured"
+    _save_cache()
+
+
+@contextlib.contextmanager
+def _measurement_scope(sig: Optional[str], run_key: Optional[str]):
+    prev = getattr(_tls, "scope", None)
+    _tls.scope = (sig, run_key) if sig is not None else None
+    try:
+        yield
+    finally:
+        _tls.scope = prev
+
+
+def activate(knobs: "TreeKnobs") -> None:
+    """Pin the measurement scope for the calling (driver) thread: device
+    samples taken until the next ``activate``/``deactivate`` on this
+    thread attribute to this resolve's running config."""
+    _tls.scope = (knobs.sig, knobs.run_key) if knobs.sig else None
+
+
+def deactivate() -> None:
+    _tls.scope = None
+
+
+# ------------------------------------------------------------ tree knobs
+
+@dataclasses.dataclass(frozen=True)
+class TreeKnobs:
+    """One resolve's effective kernel-strategy knobs (builder values)."""
+    hist_mode: str
+    split_mode: str
+    hist_layout: str                     # dense | sparse | check
+    sparse_depth_threshold: int
+    sources: dict                        # knob -> user|default|model|...
+    sig: Optional[str] = None            # signature when the tuner engaged
+    run_key: Optional[str] = None        # config key actually running
+
+
+def resolve_tree_knobs(params, *, kind: str, F: int, N: int, K: int = 1,
+                       mono=None, plan=None, hier: bool = False,
+                       checkpoint: bool = False) -> TreeKnobs:
+    """The drivers' single up-front knob resolution point.
+
+    Explicit knob values (anything but ``"auto"``, including the
+    ``"check"`` oracle modes) pass straight through the shared.py
+    resolvers untouched.  ``"auto"`` knobs resolve to the historical
+    fixed defaults when the tuner is off (bit-identical kernels), or to
+    the per-signature decision when it is on.  Checkpoint continuations
+    pin ``sparse_depth_threshold`` to the params value so resumed trees
+    keep the depth ledger they were validated against."""
+    from ..models.tree.shared import (resolve_hist_layout,
+                                      resolve_hist_mode,
+                                      resolve_split_mode)
+    hm_raw = str(getattr(params, "hist_mode", "auto")).lower()
+    sm_raw = str(getattr(params, "split_mode", "auto")).lower()
+    hl_raw = str(getattr(params, "hist_layout", "auto")).lower()
+    thr_raw = int(getattr(params, "sparse_depth_threshold",
+                          DEFAULT_SPARSE_THRESHOLD))
+    max_depth = int(getattr(params, "max_depth", 5))
+    nbins = int(getattr(params, "nbins", 64))
+
+    # the baseline resolution every path starts from (validation +
+    # feature-compat downgrades live in shared.py, exactly as before)
+    hist_mode = resolve_hist_mode(params)
+    split_mode = resolve_split_mode(params, mono=mono, plan=plan, hier=hier)
+    hist_layout = resolve_hist_layout(params, hist_mode=hist_mode,
+                                      mono=mono, plan=plan, hier=hier)
+    sources = {
+        "hist_mode": "default" if hm_raw == "auto" else "user",
+        "split_mode": "default" if sm_raw == "auto" else "user",
+        "hist_layout": "default" if hl_raw == "auto" else "user",
+        "sparse_depth_threshold":
+            "default" if thr_raw == DEFAULT_SPARSE_THRESHOLD else "user",
+    }
+    tuned = {
+        "hist_mode": hm_raw == "auto",
+        "split_mode": sm_raw == "auto",
+        "hist_layout": hl_raw == "auto",
+        "sparse_depth_threshold":
+            thr_raw == DEFAULT_SPARSE_THRESHOLD and not checkpoint
+            and hist_layout in ("sparse", "auto"),
+        "_hist_mode_pin": hist_mode,
+        "_split_mode_pin": split_mode,
+        "_hist_layout_pin": hist_layout,
+        "_threshold_pin": thr_raw,
+    }
+    mode = autotune_mode()
+    # checks bypass tuning (the oracle decides), off bypasses everything
+    if (mode == "off" or "check" in (hist_mode, split_mode, hist_layout)
+            or not any(tuned[k] for k in ("hist_mode", "split_mode",
+                                          "hist_layout",
+                                          "sparse_depth_threshold"))):
+        return TreeKnobs(hist_mode, split_mode, hist_layout, thr_raw,
+                         sources)
+
+    sig = _signature(kind, F, N, K, max_depth, nbins)
+    with _lock:
+        candidates = _tree_candidates(F, N, K, max_depth, nbins, mono=mono,
+                                      plan=plan, hier=hier, tuned=tuned)
+        if not candidates:
+            return TreeKnobs(hist_mode, split_mode, hist_layout, thr_raw,
+                             sources)
+        predicted = _predict_costs(F, N, K, max_depth, nbins, candidates)
+        picked = _decide(sig, candidates, predicted, mode)
+        ent, run = picked["entry"], picked["run"]
+        knobs_out = {}
+        for knob in ("hist_mode", "split_mode", "hist_layout",
+                     "sparse_depth_threshold"):
+            if tuned[knob]:
+                knobs_out[knob] = run[knob]
+                sources[knob] = ("explore" if picked["run_key"] ==
+                                 ent["explore"] else ent["source"])
+        _note_decision(knobs_out, ent["source"])
+    _save_cache()
+    return TreeKnobs(
+        knobs_out.get("hist_mode", hist_mode),
+        knobs_out.get("split_mode", split_mode),
+        knobs_out.get("hist_layout", hist_layout),
+        int(knobs_out.get("sparse_depth_threshold", thr_raw)),
+        sources, sig=sig, run_key=picked["run_key"])
+
+
+# -------------------------------------------------- reduce / serve knobs
+
+def resolve_reduce_mode_auto() -> str:
+    """``reduce_mode="auto"``: hier when a DCN (multi-host) stage exists
+    — the staged psum moves an already-reduced tensor across hosts — and
+    flat on a single host, where the extra stage is pure overhead.  Off
+    keeps the historical fixed default (``hier``)."""
+    if autotune_mode() == "off":
+        return "hier"
+    hosts, _, _ = _mesh_geometry()
+    choice = "hier" if hosts > 1 else "flat"
+    with _lock:
+        sig = f"reduce:mesh{hosts}:{_backend()}"
+        if sig not in _DECISIONS:
+            _DECISIONS[sig] = {"sig": sig, "choice": choice,
+                               "source": "model", "predicted": {},
+                               "measured": {}, "resolves": 0,
+                               "explore": None, "epoch": _EPOCH,
+                               "candidates": {}}
+            _note_decision({"reduce_mode": choice}, "model")
+            _publish_cache_gauge()
+        _DECISIONS[sig]["resolves"] += 1
+    return choice
+
+
+def resolve_serve_impl(*, depth: int, R: int, F: int, B: int) -> str:
+    """``serve impl="auto"``: the pallas fused traversal wins on TPU (its
+    tiling matches the packed layout); everywhere else the XLA twin is
+    the fast correct path.  Decision recorded per batch signature so the
+    /3/Profiler/autotune table shows what serving actually runs."""
+    choice = "pallas" if _backend() == "tpu" else "xla"
+    if autotune_mode() == "off":
+        return choice
+    with _lock:
+        sig = f"serve:d{depth}:R{R}:F{F}:B{B}:{_backend()}"
+        if sig not in _DECISIONS:
+            _DECISIONS[sig] = {"sig": sig, "choice": choice,
+                               "source": "model", "predicted": {},
+                               "measured": {}, "resolves": 0,
+                               "explore": None, "epoch": _EPOCH,
+                               "candidates": {}}
+            _note_decision({"serve_impl": choice}, "model")
+            _publish_cache_gauge()
+        _DECISIONS[sig]["resolves"] += 1
+    return choice
+
+
+# ----------------------------------------------------------------- cache
+
+def _cache_dir() -> Optional[str]:
+    from .config import config
+    d = config().autotune_cache_dir
+    if d:
+        return d
+    from . import recovery
+    base = recovery.recovery_dir()
+    return os.path.join(base, "autotune") if base else None
+
+
+def _cache_path() -> Optional[str]:
+    d = _cache_dir()
+    return os.path.join(d, "autotune_cache.json") if d else None
+
+
+def _cache_header() -> dict:
+    return {"version": 1, "backend": _backend(), "jax": _jax_version()}
+
+
+_file_entries: Dict[str, dict] = {}
+
+
+def _load_cache_file() -> None:
+    """Read the persisted decision table once; corrupt or version-stale
+    files silently degrade to model-seeded decisions (never an error)."""
+    global _file_loaded
+    if _file_loaded or _file_dead:
+        return
+    _file_loaded = True
+    path = _cache_path()
+    if not path:
+        return
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        if not isinstance(data, dict) or \
+                data.get("header") != _cache_header():
+            return
+        entries = data.get("entries")
+        if isinstance(entries, dict):
+            _file_entries.update({k: v for k, v in entries.items()
+                                  if isinstance(v, dict) and "choice" in v})
+    except Exception:                    # noqa: BLE001 — degrade, never err
+        return
+
+
+def _load_cached_entry(sig: str) -> Optional[dict]:
+    _load_cache_file()
+    raw = _file_entries.get(sig)
+    if raw is None:
+        return None
+    ent = {"sig": sig, "choice": str(raw["choice"]), "source": "cache",
+           "predicted": {k: float(v) for k, v in
+                         (raw.get("predicted") or {}).items()},
+           "measured": {k: dict(v) for k, v in
+                        (raw.get("measured") or {}).items()},
+           "resolves": 0, "explore": None, "epoch": _EPOCH}
+    return ent
+
+
+def _save_cache() -> None:
+    """Atomically persist the decision table (tmp + rename, the WAL
+    pattern).  No recovery dir configured means in-memory only."""
+    path = _cache_path()
+    if not path:
+        return
+    with _lock:
+        entries = {
+            sig: {"choice": ent["choice"], "source": ent["source"],
+                  "predicted": {k: v for k, v in ent["predicted"].items()
+                                if v != float("inf")},
+                  "measured": ent["measured"]}
+            for sig, ent in _DECISIONS.items()
+        }
+    payload = {"header": _cache_header(), "entries": entries}
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+    except Exception:                    # noqa: BLE001 — cache is best-effort
+        pass
+
+
+# ----------------------------------------------------------- maintenance
+
+def invalidate(reason: str = "cluster_reinit") -> None:
+    """Drop every memoized decision (and the loaded cache-file snapshot):
+    called from ``cluster._invalidate_compiled_caches`` so a mesh rebuild
+    can never serve a choice tuned for the dead geometry.  Fresh
+    processes re-read the persisted cache; this process will not."""
+    global _EPOCH, _file_loaded, _file_dead
+    with _lock:
+        _EPOCH += 1
+        _DECISIONS.clear()
+        _file_entries.clear()
+        _file_loaded = False
+        if reason == "cluster_reinit":
+            _file_dead = True
+        _publish_cache_gauge()
+    obs.record("autotune_invalidate", reason=reason)
+
+
+def reset() -> None:
+    """Tests only: full reset including the cache-file dead flag."""
+    global _EPOCH, _file_loaded, _file_dead
+    with _lock:
+        _EPOCH += 1
+        _DECISIONS.clear()
+        _file_entries.clear()
+        _file_loaded = False
+        _file_dead = False
+        _publish_cache_gauge()
+    _tls.scope = None
+
+
+def decision_table() -> dict:
+    """Plain-data decision table for ``GET /3/Profiler/autotune``:
+    signature -> choice, source, predicted vs measured seconds."""
+    with _lock:
+        rows = []
+        for sig, ent in _DECISIONS.items():
+            meas = {k: round(v["ema"], 6)
+                    for k, v in ent["measured"].items() if v["n"]}
+            rows.append({
+                "signature": sig,
+                "choice": ent["choice"],
+                "source": ent["source"],
+                "resolves": ent["resolves"],
+                "predicted_s": {k: (None if v == float("inf")
+                                    else round(v, 6))
+                                for k, v in ent["predicted"].items()},
+                "measured_s": meas,
+                "exploring": ent["explore"],
+            })
+        return {"mode": autotune_mode(), "epoch": _EPOCH,
+                "entries": len(rows), "decisions": rows,
+                "cache_file": _cache_path()}

@@ -179,6 +179,11 @@ def _invalidate_compiled_caches() -> None:
     """
     from . import xprof
     xprof.invalidate("cluster_reinit")
+    # the autotuner's per-signature mode decisions bind the mesh geometry
+    # the same way the compiled programs do: drop them with the caches,
+    # or a rebuilt mesh could be served a choice tuned for the dead one
+    from . import autotune
+    autotune.invalidate("cluster_reinit")
     for mod_name, names in (
         ("..models.tree.hist", ("make_hist_fn", "make_fine_hist_fn",
                                 "make_varbin_hist_fn",

@@ -79,9 +79,21 @@ class Config:
     # > 1 carve VIRTUAL hosts out of the local devices for CI/laptops) and
     # the cross-shard reduction strategy — "hier" psums within a host's
     # ICI ring then across DCN, "flat" is the one-collective oracle,
-    # "check" runs both and raises on divergence
+    # "check" runs both and raises on divergence, "auto" (default) lets
+    # the autotuner pick per mesh geometry (hier with the tuner off)
     mesh_hosts: int = 0
-    reduce_mode: str = "hier"
+    reduce_mode: str = "auto"
+    # cost-model autotuner (runtime/autotune.py): master switch for the
+    # per-signature kernel-strategy tuner — "on" (model-seeded decisions
+    # + epsilon-greedy measured refinement), "cache_only" (cached + model
+    # decisions, never explores), "off" ("auto" knobs resolve to the
+    # historical fixed defaults: bit-identical kernels, what tier-1
+    # pins); the cache directory override (default
+    # <H2O3_TPU_RECOVERY_DIR>/autotune) and the exploration period (every
+    # Nth resolve of a model-seeded signature re-measures the runner-up)
+    autotune: str = "on"
+    autotune_cache_dir: Optional[str] = None
+    autotune_explore_every: int = 16
     # device/compiler observability (runtime/xprof.py): true device-phase
     # timing mode — "off" (host dispatch only), "sampled" (block-until-
     # ready every Nth eager dispatch; bounded overhead), "full" (every
@@ -162,7 +174,11 @@ class Config:
             log_file=e("H2O3_TPU_LOG_FILE") or None,
             hb_ship_events=int(e("H2O3_TPU_HB_SHIP_EVENTS", 200)),
             mesh_hosts=int(e("H2O3_TPU_HOSTS", 0)),
-            reduce_mode=e("H2O3_TPU_REDUCE_MODE", "hier"),
+            reduce_mode=e("H2O3_TPU_REDUCE_MODE", "auto"),
+            autotune=e("H2O3_TPU_AUTOTUNE", "on"),
+            autotune_cache_dir=e("H2O3_TPU_AUTOTUNE_CACHE_DIR") or None,
+            autotune_explore_every=int(
+                e("H2O3_TPU_AUTOTUNE_EXPLORE", 16)),
             device_timing=e("H2O3_TPU_DEVICE_TIMING", "off"),
             device_timing_sample=int(
                 e("H2O3_TPU_DEVICE_TIMING_SAMPLE", 4)),

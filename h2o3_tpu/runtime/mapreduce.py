@@ -57,22 +57,30 @@ class ReduceParityError(AssertionError):
 
 
 def resolve_reduce_mode(mode: str | None = None) -> str:
-    """Effective reduce mode: explicit arg > force_reduce_mode > config."""
+    """Effective reduce mode: explicit arg > force_reduce_mode > config.
+
+    ``"auto"`` (the config default) defers to the autotuner, which picks
+    hier/flat per mesh geometry — and resolves to the historical fixed
+    default (``hier``) when the tuner is off, so pinned runs stay
+    bit-identical."""
     if not mode:
         mode = _forced_mode
     if not mode:
         from .config import config
         mode = config().reduce_mode
+    if mode == "auto":
+        from . import autotune
+        mode = autotune.resolve_reduce_mode_auto()
     if mode not in REDUCE_MODES:
         raise ValueError(
-            f"reduce_mode={mode!r} not in {REDUCE_MODES}")
+            f"reduce_mode={mode!r} not in {REDUCE_MODES} + ('auto',)")
     return mode
 
 
 @contextlib.contextmanager
 def force_reduce_mode(mode: str):
     """Scoped override of the configured reduce mode (tests, benchmarks)."""
-    if mode not in REDUCE_MODES:
+    if mode not in REDUCE_MODES and mode != "auto":
         raise ValueError(f"reduce_mode={mode!r} not in {REDUCE_MODES}")
     global _forced_mode
     prev = _forced_mode

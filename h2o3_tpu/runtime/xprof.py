@@ -394,6 +394,14 @@ def maybe_device_sync(phase: str, seq: int, started: float, out) -> bool:
         jax.block_until_ready(out)
     except Exception:                    # noqa: BLE001 — tracers, tokens
         return False
-    obs.observe("tree_phase_device_seconds",
-                time.perf_counter() - started, phase=phase)
+    dt = time.perf_counter() - started
+    obs.observe("tree_phase_device_seconds", dt, phase=phase)
+    try:
+        # feed the autotuner's measured-refinement loop: the sample
+        # attributes to whatever config the calling thread's active
+        # decision scope is running (no scope -> no-op)
+        from . import autotune
+        autotune.on_device_sample(phase, dt)
+    except Exception:                    # noqa: BLE001 — observer only
+        pass
     return True

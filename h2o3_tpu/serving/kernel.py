@@ -103,7 +103,8 @@ def _make_pallas_traverse(depth: int, R: int, F: int, tile_b: int,
 def _traverse_impl(impl: str, depth: int, R: int, F: int, B: int):
     """Resolve the traversal implementation for one batch signature."""
     if impl in ("", "auto"):
-        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+        from ..runtime import autotune
+        impl = autotune.resolve_serve_impl(depth=depth, R=R, F=F, B=B)
     if impl == "xla":
         return functools.partial(_traverse_xla, depth=depth)
     if impl in ("pallas", "pallas_interpret"):

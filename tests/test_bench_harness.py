@@ -235,3 +235,33 @@ def test_gate_serving_latency_regression(tmp_path):
     cand = _write(tmp_path, "cand.json", worse)
     rc, report = _gate(tmp_path, cand, [base])
     assert rc == 1 and "serve_p50_ms" in report
+
+
+def test_gate_absolute_floor_gates_new_metric(tmp_path):
+    """autotune_vs_best carries an absolute 0.97 floor: it is GATED even
+    on its first round (normal new metrics pass ungated), and a value
+    below the floor regresses regardless of history."""
+    base = _write(tmp_path, "BENCH_r01.json", _record_json(100.0, 10.0))
+    good = _write(tmp_path, "cand.json",
+                  _record_json(100.0, 10.0, autotune_vs_best=0.99))
+    rc, report = _gate(tmp_path, good, [base])
+    assert rc == 0
+    assert "absolute floor" in report
+
+    bad = _write(tmp_path, "cand2.json",
+                 _record_json(100.0, 10.0, autotune_vs_best=0.90))
+    rc, report = _gate(tmp_path, bad, [base])
+    assert rc == 1
+    assert "below absolute floor" in report
+
+
+def test_gate_absolute_floor_beats_tolerance_band(tmp_path):
+    """A bad prior round cannot drag the floor down: within-tolerance of
+    a sub-floor baseline still regresses."""
+    base = _write(tmp_path, "BENCH_r01.json",
+                  _record_json(100.0, 10.0, autotune_vs_best=0.92))
+    cand = _write(tmp_path, "cand.json",
+                  _record_json(100.0, 10.0, autotune_vs_best=0.93))
+    rc, report = _gate(tmp_path, cand, [base])
+    assert rc == 1
+    assert "below absolute floor" in report

@@ -246,6 +246,44 @@ def test_reinit_same_geometry_is_cached(cl):
     assert h2o3_tpu.init(hosts=cl.n_hosts) is h2o3_tpu.init()
 
 
+def test_reinit_drops_autotune_decisions(cl):
+    """Regression: _invalidate_compiled_caches must also flush the
+    autotuner's per-signature mode decisions — they bind the mesh
+    geometry exactly like compiled programs do, and a rebuilt mesh must
+    never serve a choice tuned for the dead one."""
+    import os
+    from h2o3_tpu.runtime import autotune, config
+    saved = os.environ.get("H2O3_TPU_AUTOTUNE")
+    orig_hosts = cl.n_hosts
+    new_hosts = 4 if orig_hosts != 4 else 2
+    try:
+        os.environ["H2O3_TPU_AUTOTUNE"] = "on"
+        config.reload()
+        autotune.reset()
+        import types
+        p = types.SimpleNamespace(hist_mode="auto", split_mode="auto",
+                                  hist_layout="auto",
+                                  sparse_depth_threshold=8,
+                                  max_depth=6, nbins=32)
+        k = autotune.resolve_tree_knobs(p, kind="gbm", F=4, N=4096)
+        assert k.sig is not None
+        assert autotune.decision_table()["entries"] == 1
+        h2o3_tpu.init(hosts=new_hosts)
+        assert autotune.decision_table()["entries"] == 0, \
+            "mesh rebuild left stale autotune decisions behind"
+        # fresh decisions on the new geometry carry its mesh signature
+        k2 = autotune.resolve_tree_knobs(p, kind="gbm", F=4, N=4096)
+        assert f"mesh{new_hosts}x" in k2.sig
+    finally:
+        h2o3_tpu.init(hosts=orig_hosts)
+        if saved is None:
+            os.environ.pop("H2O3_TPU_AUTOTUNE", None)
+        else:
+            os.environ["H2O3_TPU_AUTOTUNE"] = saved
+        config.reload()
+        autotune.reset()
+
+
 # --------------------------------------- 16/32-device subprocess parity
 
 _PARITY_SCRIPT = textwrap.dedent("""

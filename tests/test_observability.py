@@ -529,3 +529,58 @@ def test_mesh_shape_gauge_and_collective_seconds(cl):
     assert 'axis="chips+hosts"' in text      # staged hier schedule
     assert 'axis="rows"' in text             # flat oracle
     assert 'op="map_reduce"' in text
+
+
+# ------------------------------------------------------------- autotuner
+
+def test_autotune_series_and_rest_route(cl):
+    """The autotuner's observability surface: every resolve increments
+    autotune_decisions_total{knob,choice,source}, the table size is the
+    autotune_cache_entries gauge, both render in GET /metrics, and
+    GET /3/Profiler/autotune dumps the decision table (signature ->
+    choice, source, predicted vs measured seconds)."""
+    import json
+    import types
+
+    from h2o3_tpu.api.server import Api
+    from h2o3_tpu.runtime import autotune, config
+
+    saved = os.environ.get("H2O3_TPU_AUTOTUNE")
+    try:
+        os.environ["H2O3_TPU_AUTOTUNE"] = "on"
+        config.reload()
+        autotune.reset()
+        p = types.SimpleNamespace(hist_mode="auto", split_mode="auto",
+                                  hist_layout="auto",
+                                  sparse_depth_threshold=8,
+                                  max_depth=6, nbins=32)
+        k = autotune.resolve_tree_knobs(p, kind="gbm", F=4, N=4096)
+        assert k.sig is not None
+        autotune.resolve_serve_impl(depth=8, R=100, F=16, B=128)
+
+        text = obs.render_prometheus(cluster=False)
+        assert "# TYPE autotune_decisions_total counter" in text
+        assert 'knob="hist_mode"' in text
+        assert 'source="model"' in text
+        assert "# TYPE autotune_cache_entries gauge" in text
+        me = obs.node_name()
+        assert f'autotune_cache_entries{{node="{me}"}} 2.0' in text
+
+        table = Api().autotune_table()
+        json.dumps(table)                       # REST payload: plain data
+        assert table["mode"] == "on" and table["entries"] == 2
+        sigs = {d["signature"] for d in table["decisions"]}
+        assert k.sig in sigs
+        assert any(s.startswith("serve:") for s in sigs)
+        row = next(d for d in table["decisions"]
+                   if d["signature"] == k.sig)
+        assert row["source"] == "model"
+        assert set(row) >= {"signature", "choice", "source", "resolves",
+                            "predicted_s", "measured_s", "exploring"}
+    finally:
+        if saved is None:
+            os.environ.pop("H2O3_TPU_AUTOTUNE", None)
+        else:
+            os.environ["H2O3_TPU_AUTOTUNE"] = saved
+        config.reload()
+        autotune.reset()

@@ -51,13 +51,21 @@ TOLERANCE_OVERRIDES_PCT = {
     "remat_partial_s": 25.0,
     "remat_full_s": 25.0,
     "remat_partial_vs_baseline": 25.0,
+    "autotune_vs_best": 3.0,
+}
+# absolute floors: gated even when the metric has no baseline round yet
+# ("new" metrics normally pass ungated).  autotune_vs_best is a ratio of
+# tuner-chosen throughput to the best hand-set configuration — the
+# acceptance bar is >= 0.97 regardless of history.
+ABSOLUTE_FLOORS = {
+    "autotune_vs_best": 0.97,
 }
 # echoes of configuration / sizes / diagnostics: reported, never gated
 INFORMATIONAL = ("platform", "rows", "trees", "parse_csv_mb",
                  "secondaries", "compiles_total", "compile_s_total")
 _INFO_SUFFIXES = ("_compile_s", "_steady_s", "_error")
 
-_HIGHER_HINTS = ("per_sec", "_vs_baseline", "samples_per_sec",
+_HIGHER_HINTS = ("per_sec", "_vs_baseline", "_vs_best", "samples_per_sec",
                  "trees_per_sec", "scaling", "qps")
 _LOWER_SUFFIXES = ("_sec", "_s", "_ms", "_seconds")
 
@@ -158,8 +166,20 @@ def evaluate(candidate: dict, rounds: list,
             row.update(status="info", detail="informational")
             results.append(row)
             continue
+        floor = ABSOLUTE_FLOORS.get(name)
+        if floor is not None and val < floor:
+            row.update(status="regress", floor=floor,
+                       detail=f"below absolute floor {floor}")
+            results.append(row)
+            continue
         if name not in latest:
-            row.update(status="new", detail="no baseline for this metric")
+            if floor is not None:
+                row.update(status="pass", floor=floor,
+                           detail=f"meets absolute floor {floor} "
+                                  "(no baseline yet)")
+            else:
+                row.update(status="new",
+                           detail="no baseline for this metric")
             results.append(row)
             continue
         ref, ref_path = latest[name]
@@ -191,8 +211,9 @@ def render_table(results: list) -> str:
         ref = f"{r['ref']:.3f}" if "ref" in r else "-"
         bst = f"{r['best']:.3f}" if "best" in r else "-"
         dlt = f"{r['delta_pct']:+.1f}" if "delta_pct" in r else "-"
+        note = f"  [{r['detail']}]" if "floor" in r else ""
         lines.append(f"{r['name']:42} {r['value']:>12.3f} {ref:>12} "
-                     f"{dlt:>7} {bst:>12} {r['status']:>8}")
+                     f"{dlt:>7} {bst:>12} {r['status']:>8}{note}")
     n_reg = sum(1 for r in results if r["status"] == "regress")
     n_gated = sum(1 for r in results if r["status"] in ("pass", "regress"))
     lines.append("")
