@@ -493,6 +493,19 @@ def worker_main():
             extra["autotune_vs_best"] = round(at["autotune_vs_best"], 3)
         except Exception as e:
             extra["autotune_error"] = repr(e)[:200]
+        try:
+            # streaming ingest: end-to-end StreamingFrame + stream=
+            # training vs parse-then-train (bench_pieces stream); the
+            # gate holds stream_overlap_vs_baseline to an absolute
+            # 1.176 floor (streamed <= 0.85x batch wall-clock)
+            from bench_pieces import stream_piece
+            st = stream_piece()
+            extra["stream_batch_s"] = round(st["stream_batch_s"], 3)
+            extra["stream_overlap_s"] = round(st["stream_overlap_s"], 3)
+            extra["stream_overlap_vs_baseline"] = round(
+                st["stream_overlap_vs_baseline"], 3)
+        except Exception as e:
+            extra["stream_error"] = repr(e)[:200]
     compiles, compile_s = _ledger_totals()
     if compiles:
         extra["compiles_total"] = compiles

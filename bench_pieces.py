@@ -1308,6 +1308,134 @@ def autotune_piece():
             "autotune_vs_best": ratio}
 
 
+def stream_piece():
+    """Streaming-ingest overlap bench: end-to-end wall-clock of
+    (StreamingFrame + stream= GBM training) vs (parse fully, then
+    train) on the same synthetic CSV.
+
+    The streamed run starts boosting once half the rows have landed
+    (H2O3_TPU_STREAM_MIN_ROWS = rows/2, quantized via
+    H2O3_TPU_STREAM_ROUND_ROWS so repeat runs reuse compiled shapes):
+    early trees train on the landed prefix while the rest of the file
+    tokenizes, so ingest disappears from the critical path and the
+    prefix segments are cheaper than full-frame rounds.  Both paths are
+    run once to warm the jit caches, then timed.
+
+    ``stream_overlap_vs_baseline`` (batch / streamed, higher is better)
+    is the gate metric: tools/bench_gate.py holds it to an absolute
+    floor of 1.176 — streamed end-to-end must stay at or under 0.85x of
+    parse-then-train wall-clock.
+
+    Usage (chip): python bench_pieces.py stream
+    CPU smoke:    JAX_PLATFORMS=cpu H2O3_PIECES_ROWS=120000 \\
+                  python bench_pieces.py stream
+    """
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import tempfile
+    import time as _time
+
+    import jax
+
+    import h2o3_tpu
+    from h2o3_tpu.frame.parse import parse_csv
+    from h2o3_tpu.models.tree.gbm import GBM
+    from h2o3_tpu.runtime import config as _cfg
+    from h2o3_tpu.runtime import dkv
+
+    h2o3_tpu.init()
+    platform = jax.devices()[0].platform
+    rows = min(N_ROWS, int(os.environ.get("H2O3_STREAM_ROWS", 400_000)))
+    trees = int(os.environ.get("H2O3_STREAM_TREES", 24))
+    rng = np.random.default_rng(11)
+    Fs = 8
+    path = os.path.join(tempfile.gettempdir(), f"stream_bench_{rows}.csv")
+    if not os.path.exists(path):
+        with open(path, "w") as f:
+            f.write(",".join(f"x{i}" for i in range(Fs)) + ",g,y\n")
+            block = 50_000
+            for lo in range(0, rows, block):
+                n = min(block, rows - lo)
+                X = rng.normal(size=(n, Fs))
+                g = rng.integers(0, 12, size=n)
+                yv = (X[:, 0] * 0.7 - X[:, 1] ** 2 * 0.2 + 0.05 * g
+                      + 0.2 * rng.normal(size=n)) > 0
+                for r_ in range(n):
+                    f.write(",".join(f"{v:.5f}" for v in X[r_]) +
+                            f",lvl{g[r_]},c{int(yv[r_])}\n")
+    kw = dict(response_column="y", ntrees=trees, max_depth=6, nbins=64,
+              min_rows=10, seed=7, score_tree_interval=4)
+
+    saved = {k: os.environ.get(k) for k in
+             ("H2O3_TPU_STREAM_MIN_ROWS", "H2O3_TPU_STREAM_ROUND_ROWS",
+              "H2O3_TPU_STREAM_GROW_MIN_FRAC",
+              "H2O3_TPU_STREAM_BUFFER_ROWS", "H2O3_PARSE_RANGE_MIN")}
+    # smoke-sized files must still land as MANY ranges (the default
+    # 4 MB ranged-parse threshold would make the whole file one range
+    # and the watermark a single step)
+    os.environ["H2O3_PARSE_RANGE_MIN"] = str(
+        min(1 << 22, max(65536, os.path.getsize(path) // 16)))
+    os.environ["H2O3_TPU_STREAM_MIN_ROWS"] = str(rows // 2)
+    os.environ["H2O3_TPU_STREAM_ROUND_ROWS"] = str(rows // 2)
+    os.environ["H2O3_TPU_STREAM_GROW_MIN_FRAC"] = "0.25"
+    # backpressure at 3/4 of the file: landing can never run more than
+    # that ahead of training, so the first segment ALWAYS boosts on the
+    # half-frame prefix while the tail is still in flight — the overlap
+    # being measured, made deterministic across file sizes — and the
+    # landed-fraction tree budget lets ~3/4 of the trees train on the
+    # cheap prefix before the cut
+    os.environ["H2O3_TPU_STREAM_BUFFER_ROWS"] = str(3 * rows // 4)
+    _cfg.reload()
+
+    def batch_run(tag):
+        t0 = _time.perf_counter()
+        fr = parse_csv(path, destination_frame=tag)
+        m = GBM(**kw).train(fr)
+        dt = _time.perf_counter() - t0
+        dkv.remove(tag)
+        return dt, m
+
+    def stream_run(tag):
+        t0 = _time.perf_counter()
+        sf = h2o3_tpu.stream_file(path, destination_frame=tag)
+        m = GBM(**kw, stream=True).train(sf)
+        sf.frame()               # model AND fully-landed frame ready
+        dt = _time.perf_counter() - t0
+        dkv.remove(tag)
+        return dt, m
+
+    try:
+        batch_run("stb_warm")       # warm jit caches: full-frame shapes
+        stream_run("sts_warm")      # ... and the half-frame segment
+        reps = int(os.environ.get("H2O3_STREAM_REPS", 2))
+        batch_s = min(batch_run(f"stb_t{i}")[0] for i in range(reps))
+        stream_s, m = min((stream_run(f"sts_t{i}") for i in range(reps)),
+                          key=lambda r: r[0])
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        _cfg.reload()
+    ratio = batch_s / stream_s
+    print(json.dumps({
+        "piece": "stream", "platform": platform, "rows": rows,
+        "trees": trees,
+        "stream_batch_s": round(batch_s, 3),
+        "stream_overlap_s": round(stream_s, 3),
+        "stream_overlap_vs_baseline": round(ratio, 3),
+        "stream_segments": m.output.get("stream_segments"),
+        "stream_coverage": m.output.get("stream_coverage"),
+        "note": "gate: stream_overlap_vs_baseline >= 1.176 absolute "
+                "floor (streamed <= 0.85x batch wall-clock)"}),
+        flush=True)
+    return {"stream_batch_s": batch_s, "stream_overlap_s": stream_s,
+            "stream_overlap_vs_baseline": ratio,
+            "stream_segments": m.output.get("stream_segments")}
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "parse":
         parse_piece()
@@ -1331,5 +1459,7 @@ if __name__ == "__main__":
         remat_piece()
     elif len(sys.argv) > 1 and sys.argv[1] == "autotune":
         autotune_piece()
+    elif len(sys.argv) > 1 and sys.argv[1] == "stream":
+        stream_piece()
     else:
         main()

@@ -26,6 +26,15 @@ from .frame.create import (create_frame, insert_missing_values, interaction,
                            tabulate, dct_transform)
 from .datasets import load_dataset
 from .export.mojo import import_mojo
+from .ingest import StreamingFrame
+
+
+def stream_file(path: str, destination_frame=None, **kw) -> StreamingFrame:
+    """Start a streaming ingest of a local CSV/parquet file: rows land on
+    a background thread while training consumes the watermark prefix.
+    See docs/operations.md "Streaming ingest & warm-start"."""
+    return StreamingFrame(path, destination_frame=destination_frame,
+                          **kw).start()
 
 
 def save_model(model, path: str) -> str:

@@ -256,6 +256,131 @@ def record_parse(frame, path: str, header: Optional[bool] = None,
         return None
 
 
+# ------------------------------------------------- columnar (row-group) parse
+
+def compute_columnar_shards(path: str, nrows: int,
+                            n_shards: int) -> Optional[List[dict]]:
+    """Row-group-granularity provenance for a parquet source: each
+    per-host row block carries the span of row groups covering it
+    (``group_lo``..``group_hi``) plus the contiguous byte range of those
+    groups' column chunks, sha1'd — the columnar analog of the CSV
+    newline-aligned ranges.  None when the row-group metadata cannot
+    account for every parsed row."""
+    import pyarrow.parquet as pq
+    size = os.path.getsize(path)
+    if size > config().lineage_max_mb * 1e6:
+        return None
+    md = pq.ParquetFile(path).metadata
+    if md.num_row_groups == 0:
+        return None
+    g_rows = [md.row_group(i).num_rows for i in range(md.num_row_groups)]
+    if sum(g_rows) != nrows:
+        return None
+    g_starts = np.concatenate(
+        [np.array([0], np.int64), np.cumsum(g_rows).astype(np.int64)])
+    spans = []                           # per-group [byte_lo, byte_hi)
+    for gi in range(md.num_row_groups):
+        rg = md.row_group(gi)
+        b_lo, b_hi = None, None
+        for ci in range(rg.num_columns):
+            cc = rg.column(ci)
+            start = cc.dictionary_page_offset \
+                if cc.dictionary_page_offset is not None \
+                else cc.data_page_offset
+            end = start + cc.total_compressed_size
+            b_lo = start if b_lo is None else min(b_lo, start)
+            b_hi = end if b_hi is None else max(b_hi, end)
+        spans.append((int(b_lo), int(b_hi)))
+    with open(path, "rb") as f:
+        view = np.frombuffer(f.read(), np.uint8)
+    bounds = shard_row_bounds(nrows, n_shards)
+    shards = []
+    for i, (lo, hi) in enumerate(bounds):
+        if hi <= lo:
+            shards.append({"shard": i, "row_lo": int(lo), "rows": 0,
+                           "group_lo": 0, "group_hi": 0, "lo": 0, "hi": 0,
+                           "src_sha1": hashlib.sha1(b"").hexdigest()})
+            continue
+        g_lo = int(np.searchsorted(g_starts, lo, side="right") - 1)
+        g_hi = int(np.searchsorted(g_starts, hi - 1, side="right"))
+        b_lo = min(spans[g][0] for g in range(g_lo, g_hi))
+        b_hi = max(spans[g][1] for g in range(g_lo, g_hi))
+        shards.append({
+            "shard": i, "row_lo": int(lo), "rows": int(hi - lo),
+            "group_lo": g_lo, "group_hi": g_hi,
+            "group_row_lo": int(g_starts[g_lo]),
+            "lo": b_lo, "hi": b_hi,
+            "src_sha1": hashlib.sha1(
+                np.ascontiguousarray(view[b_lo:b_hi]).tobytes()).hexdigest(),
+        })
+    return shards
+
+
+def record_parse_columnar(frame, path: str,
+                          fmt: str = "parquet") -> Optional[dict]:
+    """Stamp a parquet-parsed frame with row-group provenance and publish
+    the ``!lineage/<frame>`` record — the columnar peer of
+    :func:`record_parse`.  Never raises; sources that can't be safely
+    group-split leave no record."""
+    if not enabled() or getattr(frame, "key", None) is None:
+        return None
+    try:
+        if fmt != "parquet" or not isinstance(path, str) or "://" in path \
+                or not os.path.isfile(path):
+            return None
+        from ..runtime.cluster import cluster
+        n_shards = cluster().n_hosts
+        shards = compute_columnar_shards(path, frame.nrows, n_shards)
+        if shards is None:
+            return None
+        rec = {
+            "kind": "parse",
+            "source": os.path.abspath(path),
+            "parse": {"format": "parquet"},
+            "n_shards": n_shards,
+            "shards": shards,
+        }
+        frame._lineage = rec
+        return publish(frame)
+    except Exception as e:               # noqa: BLE001 — stamping is optional
+        from ..runtime.observability import log
+        log.debug("lineage: columnar stamp of %r skipped: %r", path, e)
+        frame._lineage = None
+        return None
+
+
+# --------------------------------------------------- streaming (partial) recs
+
+def stream_record_start(frame_key: str, source: str, parse: dict,
+                        total_bytes: int) -> Optional[dict]:
+    """Open a partial streaming-parse record: ``complete=False`` plus an
+    (initially empty) landed-range list.  A host dying mid-stream leaves
+    this record behind, and :meth:`ingest.stream.StreamingFrame.resume`
+    re-parses ONLY the ranges missing from it."""
+    if not enabled():
+        return None
+    from ..runtime import dkv
+    rec = {"kind": "parse", "streaming": True, "complete": False,
+           "source": os.path.abspath(source), "parse": dict(parse),
+           "total_bytes": int(total_bytes), "ranges": []}
+    dkv.put(lineage_key(frame_key), rec)
+    return rec
+
+
+def stream_record_range(frame_key: str, rng: dict) -> None:
+    """Append one landed range ({lo, hi, row_lo, rows, src_sha1}) to the
+    partial streaming record.  Never raises."""
+    try:
+        from ..runtime import dkv
+        rec = get_record(frame_key)
+        if not isinstance(rec, dict) or not rec.get("streaming"):
+            return
+        rec.setdefault("ranges", []).append(dict(rng))
+        dkv.put(lineage_key(frame_key), rec)
+    except Exception:                    # noqa: BLE001 — stamping is optional
+        pass
+
+
 # ------------------------------------------------------------- derived chains
 
 def _pack_index(index) -> Optional[bytes]:

@@ -180,7 +180,8 @@ def _pandas_safe() -> bool:
 
 def _parse_csv_native(path_or_buf, header, sep, col_names,
                       col_types: Optional[Dict[str, str]] = None,
-                      overlap_device: bool = True):
+                      overlap_device: bool = True,
+                      on_range=None):
     """Native tokenizer path — the parallel mmap'd pipeline.
 
     Paths are mmap'd (no full-file ``read()`` copy); buffers/streams get a
@@ -253,10 +254,15 @@ def _parse_csv_native(path_or_buf, header, sep, col_names,
         else None
         for nm in names]
     dev_time = [0.0]
+    consumer = on_range                   # external per-range hook, if any
 
-    def on_range(row_lo, nrows, Vt, Ft):
+    def _on_range(row_lo, nrows, Vt, Ft):
         from ..runtime import failure
         failure.maybe_inject("parse_range")
+        if consumer is not None:
+            consumer(row_lo, nrows, Vt, Ft)
+        if not overlap_device:
+            return
         t0 = time.perf_counter()
         try:
             import jax.numpy as jnp
@@ -274,9 +280,12 @@ def _parse_csv_native(path_or_buf, header, sep, col_names,
                 (row_lo, jnp.asarray(np.asarray(Vt[:, j], np.float32))))
         dev_time[0] += time.perf_counter() - t0
 
+    # the range hook is wired unconditionally: overlap_device only gates
+    # the device-chunk dispatch INSIDE it, so external consumers (the
+    # streaming ingest plane, lineage stamping) see every landed range
+    # regardless of the device-overlap setting
     out = native.parse_view(body, sepc, ncols=ncols,
-                            on_range=on_range if overlap_device else None,
-                            stats=stats)
+                            on_range=_on_range, stats=stats)
     if out is None:
         return None
     vals, flags, offs, consumed = out
@@ -309,11 +318,17 @@ def _parse_csv_native(path_or_buf, header, sep, col_names,
 def parse_csv(path_or_buf, destination_frame: Optional[str] = None,
               header: Optional[bool] = None, sep: Optional[str] = None,
               col_types: Optional[Dict[str, str]] = None,
-              col_names: Optional[List[str]] = None) -> Frame:
+              col_names: Optional[List[str]] = None,
+              on_range=None) -> Frame:
     """Parse a CSV file/buffer into a sharded Frame (ParseDataset.parse).
 
     Tokenization order: the native C++ fast path (numeric cells never
     become Python objects), then pandas' reader, then the stdlib fallback.
+
+    ``on_range(row_lo, nrows, vals, flags)`` fires per newline-aligned
+    byte range as the native tokenizer lands it (completion order, pool
+    threads) — the streaming-ingest overlap seam.  Fallback engines parse
+    whole-file and never fire it.
     """
     col_types = col_types or {}
     last_parse_stats.clear()             # fallbacks leave no stale stats
@@ -332,7 +347,7 @@ def parse_csv(path_or_buf, destination_frame: Optional[str] = None,
     names = cols = None
     try:
         parsed = _parse_csv_native(source, header, sep, col_names,
-                                   col_types=col_types)
+                                   col_types=col_types, on_range=on_range)
         if parsed is not None:
             names, cols = parsed
     except Exception:
@@ -693,28 +708,14 @@ def parse_arff(path: str, destination_frame: Optional[str] = None) -> Frame:
     return Frame(names, vecs, key=destination_frame or dkv.make_key("arff"))
 
 
-def parse_arrow(path: str, fmt: str,
-                destination_frame: Optional[str] = None) -> Frame:
-    """Columnar formats via pyarrow — the h2o-parsers/{parquet,orc} analog.
-
-    ``fmt``: parquet | orc | feather.  Arrow types map onto the Vec types:
+def arrow_table_to_vecs(table):
+    """Arrow table -> (names, vecs) under the standard type mapping:
     numerics -> T_NUM, dictionary/string -> categorical/string via the
-    standard guesser, timestamps -> T_TIME (ms since epoch).
-    """
-    from .. import persist
+    standard guesser, timestamps -> T_TIME (ms since epoch).  Shared by
+    ``parse_arrow``, the streaming row-group path, and the parquet
+    re-materialization branch in ``runtime/remat.py`` so all three land
+    bitwise-identical columns."""
     import pyarrow as pa
-    raw = persist.open_read(path)
-    if fmt == "parquet":
-        import pyarrow.parquet as pq
-        table = pq.read_table(raw)
-    elif fmt == "orc":
-        import pyarrow.orc as porc
-        table = porc.ORCFile(raw).read()
-    elif fmt == "feather":
-        import pyarrow.feather as pf
-        table = pf.read_table(raw)
-    else:
-        raise ValueError(f"unknown arrow format {fmt!r}")
     names, vecs = [], []
     for col_name in table.column_names:
         col = table.column(col_name)
@@ -736,9 +737,63 @@ def parse_arrow(path: str, fmt: str,
             arr = np.asarray(["" if v is None else str(v) for v in arr],
                              dtype=object)
             vecs.append(_column_to_vec(arr, str(col_name)))
+    return names, vecs
+
+
+def read_parquet_groups(raw, on_group=None):
+    """Ranged parquet read: one ``read_row_group`` per group instead of a
+    whole-table ``read_table``.  ``on_group(group_no, row_lo, table)``
+    fires as each group lands — the columnar streaming seam, mirroring
+    the CSV ``on_range`` hook (same ``parse_group`` fault-injection
+    point).  Returns the concatenated table, bitwise equal to a
+    whole-table read."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    pf = pq.ParquetFile(raw)
+    if pf.metadata.num_row_groups == 0:
+        return pf.read()
+    from ..runtime import failure
+    parts, row_lo = [], 0
+    for gi in range(pf.metadata.num_row_groups):
+        tbl = pf.read_row_group(gi)
+        failure.maybe_inject("parse_group")
+        if on_group is not None:
+            on_group(gi, row_lo, tbl)
+        row_lo += tbl.num_rows
+        parts.append(tbl)
+    return pa.concat_tables(parts)
+
+
+def parse_arrow(path: str, fmt: str,
+                destination_frame: Optional[str] = None,
+                on_group=None) -> Frame:
+    """Columnar formats via pyarrow — the h2o-parsers/{parquet,orc} analog.
+
+    ``fmt``: parquet | orc | feather.  Parquet reads row group by row
+    group (``read_parquet_groups``), firing ``on_group`` per landed group
+    and stamping a row-group-granularity lineage record so parquet frames
+    re-materialize partially after a host loss, exactly like CSV parses.
+    """
+    from .. import persist
+    raw = persist.open_read(path)
+    if fmt == "parquet":
+        table = read_parquet_groups(raw, on_group=on_group)
+    elif fmt == "orc":
+        import pyarrow.orc as porc
+        table = porc.ORCFile(raw).read()
+    elif fmt == "feather":
+        import pyarrow.feather as pf
+        table = pf.read_table(raw)
+    else:
+        raise ValueError(f"unknown arrow format {fmt!r}")
+    names, vecs = arrow_table_to_vecs(table)
     # register only when a destination was requested: multi-file imports
     # build unregistered shards and register just the rbind result
-    return Frame(names, vecs, key=destination_frame)
+    fr = Frame(names, vecs, key=destination_frame)
+    if fmt == "parquet" and destination_frame:
+        from . import lineage
+        lineage.record_parse_columnar(fr, path)
+    return fr
 
 
 def import_file(path, destination_frame: Optional[str] = None,

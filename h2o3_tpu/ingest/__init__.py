@@ -1,0 +1,11 @@
+"""Streaming ingest plane — train while data lands.
+
+``StreamingFrame`` admits newline-aligned byte ranges (CSV) or row
+groups (parquet) as they tokenize, exposing a landed-row watermark the
+tree drivers' ``stream=`` mode trains behind.  See ``ingest/stream.py``
+and docs/operations.md "Streaming ingest & warm-start".
+"""
+
+from .stream import StreamingFrame
+
+__all__ = ["StreamingFrame"]

@@ -1,0 +1,509 @@
+"""StreamingFrame — parse-while-train ingest (ROADMAP item 4).
+
+The batch pipeline (``frame/parse.py``) tokenizes newline-aligned byte
+ranges in parallel but only hands the caller a finished Frame, so ingest
+is dead time on the training critical path.  ``StreamingFrame`` runs the
+SAME ranged plan (``native.range_plan`` — the byte cuts ``parse_view``
+executes) on a background thread and lands each range as it tokenizes:
+
+- **watermark** — the contiguous prefix of landed rows.  Ranges land in
+  plan order, so the watermark is also the total landed count; the tree
+  drivers' ``stream=`` mode trains on ``visible_frame()`` prefixes
+  behind it and re-bins at chunk fences as it advances.
+- **per-shard readiness** — a mesh host's row block is ready once the
+  watermark passes its upper row bound (``lineage.shard_row_bounds``).
+- **backpressure** — with ``H2O3_TPU_STREAM_BUFFER_ROWS`` set, the
+  landing thread blocks while landed-but-unconsumed rows exceed the
+  bound; trainers mark consumption via :meth:`consume`.
+- **incremental lineage** — every landed range is stamped into a
+  partial ``!lineage/<key>`` record (``lineage.stream_record_range``),
+  so a host death mid-stream re-parses ONLY the missing ranges on
+  :meth:`resume` (the chaos row in tools/chaos.sh proves this by arming
+  the ``parse_range`` injection point).
+
+Parquet sources ride the same machinery at row-group granularity (the
+ranged ``parse_arrow`` path), firing the ``parse_group`` injection
+point per group.
+
+Bitwise parity with the batch parse is by construction: ranges are
+tokenized by the same native engine, text columns decode through
+``_decode_text_column`` with per-range offsets, and final Vec assembly
+goes through ``_column_to_vec`` — tests/test_stream.py pins it.
+
+Metrics: ``ingest_landed_rows``, ``ingest_watermark_lag_seconds``
+gauges; the drivers add ``stream_rebin_total`` per segment transition
+(docs/operations.md "Streaming ingest & warm-start").
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..runtime import dkv
+from ..runtime.config import config
+
+
+class StreamError(RuntimeError):
+    """The landing thread died; ``resume()`` re-parses missing ranges."""
+
+
+class StreamingFrame:
+    """A frame whose rows land while consumers already read the prefix.
+
+    Usage::
+
+        sf = StreamingFrame("big.csv")
+        sf.start()
+        model = H2OGradientBoostingEstimator(stream=True, ...).train(sf)
+        fr = sf.frame()              # the finished, registered Frame
+    """
+
+    def __init__(self, path: str, destination_frame: Optional[str] = None,
+                 header: Optional[bool] = None, sep: Optional[str] = None,
+                 col_types: Optional[Dict[str, str]] = None,
+                 col_names: Optional[List[str]] = None):
+        if not isinstance(path, str) or not os.path.isfile(path):
+            raise ValueError(f"StreamingFrame needs a local file, got "
+                             f"{path!r}")
+        self.path = os.path.abspath(path)
+        self.key = destination_frame or dkv.make_key(
+            "stream_" + os.path.basename(path))
+        self._header = header
+        self._sep = sep
+        self._col_types = dict(col_types or {})
+        self._col_names = list(col_names) if col_names else None
+        low = path.lower()
+        self.fmt = "parquet" if low.endswith((".parquet", ".pq")) else "csv"
+        self._lock = threading.Condition()
+        self._ranges: Dict[int, dict] = {}   # row_lo -> landed range
+        self._plan: Optional[list] = None    # [(lo, hi, row_lo, rows)]
+        self.total_rows: Optional[int] = None
+        self.watermark = 0                   # contiguous landed prefix rows
+        self.landed_rows = 0
+        self.complete = False
+        self.error: Optional[BaseException] = None
+        self._consumed = 0
+        self._bp_waits = 0
+        self._wm_t = time.monotonic()        # last watermark advance
+        self._t0: Optional[float] = None
+        self._thread: Optional[threading.Thread] = None
+        self._frame = None
+        self._stamp_lineage = False
+        if self.fmt == "csv":
+            self._open_csv()
+        else:
+            self._open_parquet()
+
+    # ------------------------------------------------------------- openers
+    def _open_csv(self) -> None:
+        import mmap as _mmap
+        from ..frame.parse import _guess_numeric
+        with open(self.path, "rb") as f:
+            self._mm = _mmap.mmap(f.fileno(), 0, access=_mmap.ACCESS_READ)
+        view = np.frombuffer(self._mm, np.uint8)
+        self._sepc = self._sep if self._sep is not None else ","
+        first_nl = self._mm.find(b"\n")
+        first = bytes(view[: first_nl if first_nl >= 0 else len(view)]) \
+            .decode(errors="replace")
+        head_cells = [c.strip().strip('"') for c in first.split(self._sepc)]
+        self.has_header = (not _guess_numeric(head_cells)) \
+            if self._header is None else bool(self._header)
+        self._body_off = first_nl + 1 \
+            if self.has_header and first_nl >= 0 else 0
+        self._body = view[self._body_off:]
+        from .. import native
+        self.ncols = native.ncols_of(self._body, self._sepc) \
+            if native.load() is not None else len(head_cells)
+        if self._col_names:
+            self.names = list(self._col_names)
+        elif self.has_header:
+            self.names = head_cells
+        else:
+            self.names = [f"C{i+1}" for i in range(self.ncols or 0)]
+
+    def _open_parquet(self) -> None:
+        import pyarrow.parquet as pq
+        self._pf = pq.ParquetFile(self.path)
+        self.names = [str(n) for n in self._pf.schema_arrow.names]
+        self.ncols = len(self.names)
+        self.total_rows = int(self._pf.metadata.num_rows)
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "StreamingFrame":
+        """Begin landing ranges on a background thread (idempotent)."""
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return self
+            if self.complete:
+                return self
+            self.error = None
+            self._t0 = self._t0 or time.monotonic()
+            self._thread = threading.Thread(
+                target=self._run, name=f"ingest-{self.key}", daemon=True)
+            self._thread.start()
+        return self
+
+    def resume(self) -> "StreamingFrame":
+        """Restart after a landing failure — ONLY ranges missing from the
+        landed set (equivalently: absent from the partial lineage record)
+        re-parse; everything already landed is kept."""
+        return self.start()
+
+    def _run(self) -> None:
+        try:
+            if self.fmt == "csv":
+                self._run_csv()
+            else:
+                self._run_parquet()
+            self._finalize()
+        except BaseException as e:       # noqa: BLE001 — surfaced to waiters
+            with self._lock:
+                self.error = e
+                self._lock.notify_all()
+
+    # ------------------------------------------------------------- CSV plan
+    def _csv_plan(self) -> list:
+        from .. import native
+        if self._plan is not None:
+            return self._plan
+        plan = None
+        if len(self._body) and native.load() is not None:
+            # plan granularity is a watermark/lineage concept, not a
+            # parallelism one (ranges land sequentially): cut the body
+            # into H2O3_PARSE_RANGE_MIN-sized ranges regardless of how
+            # many cores this host has
+            range_min = int(os.environ.get("H2O3_PARSE_RANGE_MIN",
+                                           1 << 22))
+            n_ranges = min(256, max(1, len(self._body) // max(range_min, 1)))
+            plan = native.range_plan(self._body, self._sepc,
+                                     threads=max(n_ranges, 2))
+        if plan is None:
+            # native fast path unavailable: the whole body is one range
+            # (landed via the strict engines in _land_whole)
+            plan = [(0, len(self._body), 0, -1)]
+        self._plan = plan
+        if plan[-1][3] >= 0:
+            self.total_rows = plan[-1][2] + plan[-1][3]
+        cfg = config()
+        self._stamp_lineage = (
+            cfg.lineage_enabled
+            and os.path.getsize(self.path) <= cfg.lineage_max_mb * 1e6)
+        if self._stamp_lineage and not self._ranges:
+            from ..frame import lineage
+            lineage.stream_record_start(
+                self.key, self.path,
+                {"header": self.has_header, "sep": self._sep,
+                 "format": "csv", "body_off": int(self._body_off)},
+                total_bytes=len(self._body))
+        return plan
+
+    def _run_csv(self) -> None:
+        from .. import native
+        from ..runtime import failure
+        plan = self._csv_plan()
+        if plan[0][3] < 0:
+            self._land_whole()
+            return
+        for (a, b, row_lo, rows) in plan:
+            with self._lock:
+                if row_lo in self._ranges:
+                    continue             # resume: already landed
+            self._backpressure_wait()
+            failure.maybe_inject("parse_range")
+            span = self._body[a:b]
+            out = native.parse_bytes(span, self._sepc, ncols=self.ncols)
+            if out is None:
+                raise StreamError(f"range [{a},{b}) of {self.path!r} "
+                                  "failed native tokenization")
+            vals, flags, offs, consumed = out
+            if consumed != len(span) or len(vals) != rows:
+                raise StreamError(
+                    f"range [{a},{b}) of {self.path!r} parsed to "
+                    f"{len(vals)} rows (planned {rows}) — blank lines or "
+                    "quoting defeat the ranged plan; use batch parse")
+            sha = hashlib.sha1(
+                np.ascontiguousarray(span).tobytes()).hexdigest() \
+                if self._stamp_lineage else None
+            self._land({"row_lo": row_lo, "rows": rows, "vals": vals,
+                        "flags": flags, "offs": offs, "span": span})
+            if self._stamp_lineage:
+                from ..frame import lineage
+                lineage.stream_record_range(self.key, {
+                    "lo": int(a + self._body_off),
+                    "hi": int(b + self._body_off),
+                    "row_lo": int(row_lo), "rows": int(rows),
+                    "src_sha1": sha})
+
+    def _land_whole(self) -> None:
+        """Strict-engine fallback: parse the whole source as one landed
+        range (no overlap, but identical semantics and results)."""
+        from ..frame.parse import parse_csv
+        fr = parse_csv(self.path, destination_frame=self.key,
+                       header=self._header, sep=self._sep,
+                       col_types=self._col_types, col_names=self._col_names)
+        with self._lock:
+            self._frame = fr
+            self.names = list(fr.names)
+            self.total_rows = fr.nrows
+            self._ranges[0] = {"row_lo": 0, "rows": fr.nrows, "whole": True}
+            self._advance(fr.nrows)
+
+    # --------------------------------------------------------- parquet plan
+    def _run_parquet(self) -> None:
+        from ..runtime import failure
+        cfg = config()
+        self._stamp_lineage = (
+            cfg.lineage_enabled
+            and os.path.getsize(self.path) <= cfg.lineage_max_mb * 1e6)
+        md = self._pf.metadata
+        g_rows = [md.row_group(i).num_rows for i in range(md.num_row_groups)]
+        self._plan = g_rows           # progress(): one "range" per group
+        if self._stamp_lineage and not self._ranges:
+            from ..frame import lineage
+            lineage.stream_record_start(
+                self.key, self.path, {"format": "parquet"},
+                total_bytes=os.path.getsize(self.path))
+        row_lo = 0
+        for gi, rows in enumerate(g_rows):
+            lo = row_lo
+            row_lo += rows
+            with self._lock:
+                if lo in self._ranges:
+                    continue             # resume: already landed
+            self._backpressure_wait()
+            failure.maybe_inject("parse_group")
+            tbl = self._pf.read_row_group(gi)
+            self._land({"row_lo": lo, "rows": rows, "table": tbl,
+                        "group": gi})
+            if self._stamp_lineage:
+                from ..frame import lineage
+                lineage.stream_record_range(self.key, {
+                    "group": gi, "row_lo": int(lo), "rows": int(rows),
+                    "src_sha1": None})
+
+    # ------------------------------------------------------------- landing
+    def _backpressure_wait(self) -> None:
+        cap = config().stream_buffer_rows
+        if cap <= 0:
+            return
+        with self._lock:
+            while self.landed_rows - self._consumed > cap \
+                    and self.error is None:
+                self._bp_waits += 1
+                self._lock.wait(0.05)
+
+    def _land(self, rec: dict) -> None:
+        with self._lock:
+            self._ranges[rec["row_lo"]] = rec
+            self._advance()
+            self._lock.notify_all()
+
+    def _advance(self, force_rows: Optional[int] = None) -> None:
+        """Recompute watermark = contiguous landed prefix (lock held)."""
+        if force_rows is not None:
+            wm = force_rows
+        else:
+            wm = 0
+            while wm in self._ranges:
+                wm += self._ranges[wm]["rows"]
+        self.landed_rows = sum(r["rows"] for r in self._ranges.values())
+        if wm > self.watermark:
+            self.watermark = wm
+            self._wm_t = time.monotonic()
+        try:
+            from ..runtime.observability import set_gauge
+            set_gauge("ingest_landed_rows", float(self.landed_rows),
+                      frame=self.key)
+            set_gauge("ingest_watermark_lag_seconds",
+                      round(time.monotonic() - self._wm_t, 3),
+                      frame=self.key)
+        except Exception:                # noqa: BLE001 — metrics optional
+            pass
+
+    # ------------------------------------------------------------ consumers
+    def consume(self, rows: int) -> None:
+        """Mark rows [0, rows) as consumed — releases backpressure."""
+        with self._lock:
+            self._consumed = max(self._consumed, int(rows))
+            self._lock.notify_all()
+
+    def wait_rows(self, rows: int, timeout: Optional[float] = None) -> int:
+        """Block until the watermark reaches ``rows`` (or the stream
+        completes / fails).  Returns the watermark."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while self.watermark < rows and not self.complete:
+                if self.error is not None:
+                    raise StreamError(
+                        f"stream {self.key} failed: "
+                        f"{self.error!r}") from self.error
+                left = None if deadline is None \
+                    else deadline - time.monotonic()
+                if left is not None and left <= 0:
+                    break
+                self._lock.wait(config().stream_poll_s
+                                if left is None
+                                else min(left, config().stream_poll_s))
+            return self.watermark
+
+    def wait_growth(self, rows: int, frac: float,
+                    timeout: Optional[float] = None) -> int:
+        """Block until the watermark exceeds ``rows`` by ``frac`` (or any
+        growth when ``frac`` rounds to zero rows), stream end included."""
+        target = rows + max(1, int(rows * frac))
+        return self.wait_rows(min(target, self.total_rows or target),
+                              timeout=timeout)
+
+    def shard_ready(self, i: int) -> bool:
+        """True when mesh host ``i``'s row block has fully landed."""
+        from ..frame import lineage
+        from ..runtime.cluster import cluster
+        if self.total_rows is None:
+            return False
+        bounds = lineage.shard_row_bounds(self.total_rows,
+                                          cluster().n_hosts)
+        if i >= len(bounds):
+            return False
+        return self.complete or self.watermark >= bounds[i][1]
+
+    def progress(self) -> dict:
+        """Live status dict — surfaced in ``GET /3/Jobs`` via
+        ``Job.stream`` while a streaming train runs."""
+        with self._lock:
+            from ..runtime.cluster import cluster
+            n_hosts = cluster().n_hosts
+            return {
+                "frame": self.key, "source": self.path, "format": self.fmt,
+                "landed_rows": self.landed_rows,
+                "watermark": self.watermark,
+                "total_rows": self.total_rows,
+                "complete": self.complete,
+                "ranges_landed": len(self._ranges),
+                "ranges_total": len(self._plan) if self._plan else None,
+                "consumed": self._consumed,
+                "backpressure_waits": self._bp_waits,
+                "shards_ready": [self.shard_ready(i)
+                                 for i in range(n_hosts)],
+                "watermark_lag_s": round(time.monotonic() - self._wm_t, 3),
+            }
+
+    # ------------------------------------------------------------- assembly
+    def _landed_prefix(self) -> list:
+        """Landed ranges under the watermark, in row order (lock held)."""
+        out, wm = [], 0
+        while wm in self._ranges:
+            out.append(self._ranges[wm])
+            wm += self._ranges[wm]["rows"]
+        return out
+
+    def _assemble_csv(self, ranges: list, limit: Optional[int] = None):
+        from ..frame.parse import _column_to_vec, _decode_text_column
+        names, vecs = list(self.names), []
+        for j, name in enumerate(names):
+            text = any(r["flags"][:, j].any() for r in ranges)
+            if text:
+                col = np.concatenate([
+                    _decode_text_column(r["span"], r["offs"], j)
+                    for r in ranges]) if ranges else np.zeros(0, object)
+            else:
+                col = np.concatenate([r["vals"][:, j] for r in ranges]) \
+                    if ranges else np.zeros(0, np.float64)
+            if limit is not None:
+                col = col[:limit]
+            vecs.append(_column_to_vec(col, name,
+                                       self._col_types.get(name)))
+        return names, vecs
+
+    def _assemble_parquet(self, ranges: list, limit: Optional[int] = None):
+        import pyarrow as pa
+        from ..frame.parse import arrow_table_to_vecs
+        tables = [r["table"] for r in ranges]
+        table = pa.concat_tables(tables) if tables \
+            else self._pf.schema_arrow.empty_table()
+        if limit is not None:
+            table = table.slice(0, limit)
+        return arrow_table_to_vecs(table)
+
+    def visible_frame(self, limit: Optional[int] = None):
+        """An UNREGISTERED Frame of the rows behind the watermark — what
+        the streaming tree drivers train each segment on.  ``limit``
+        truncates to the first N visible rows (the stream driver uses it
+        to quantize segment shapes for jit-cache reuse).  Column types
+        are guessed from the visible prefix; the final registered frame
+        re-guesses over all rows."""
+        from ..frame.frame import Frame
+        with self._lock:
+            if self._frame is not None and self.complete and limit is None:
+                return self._frame
+            ranges = self._landed_prefix()
+        if self.fmt == "csv":
+            names, vecs = self._assemble_csv(ranges, limit)
+        else:
+            names, vecs = self._assemble_parquet(ranges, limit)
+        fr = Frame(names, vecs)
+        fr.source_uri = self.path
+        return fr
+
+    def _finalize(self) -> None:
+        from ..frame.frame import Frame
+        with self._lock:
+            if self._frame is not None:      # _land_whole already built it
+                self.complete = True
+                self._lock.notify_all()
+                return
+            ranges = self._landed_prefix()
+            landed = sum(r["rows"] for r in ranges)
+            if landed != self.landed_rows:
+                raise StreamError(
+                    f"stream {self.key}: landed ranges are not contiguous "
+                    f"({landed} prefix rows of {self.landed_rows} landed)")
+        if self.fmt == "csv":
+            names, vecs = self._assemble_csv(ranges)
+        else:
+            names, vecs = self._assemble_parquet(ranges)
+        fr = Frame(names, vecs, key=self.key)
+        fr.source_uri = self.path
+        from ..frame import lineage
+        if self.fmt == "csv":
+            lineage.record_parse(fr, self.path, header=self._header,
+                                 sep=self._sep, col_types=self._col_types,
+                                 col_names=self._col_names)
+        else:
+            lineage.record_parse_columnar(fr, self.path)
+        with self._lock:
+            self._frame = fr
+            self.total_rows = fr.nrows
+            self.complete = True
+            self._advance(fr.nrows)
+            self._lock.notify_all()
+
+    def frame(self, timeout: Optional[float] = None):
+        """Join the stream and return the finished, registered Frame."""
+        self.start()
+        # joining means every row will be taken: release backpressure so
+        # the landing thread can run the stream out
+        self.consume(1 << 62)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while not self.complete:
+                if self.error is not None:
+                    raise StreamError(
+                        f"stream {self.key} failed: "
+                        f"{self.error!r}") from self.error
+                left = None if deadline is None \
+                    else deadline - time.monotonic()
+                if left is not None and left <= 0:
+                    raise TimeoutError(f"stream {self.key} incomplete "
+                                       f"after {timeout}s")
+                self._lock.wait(0.05 if left is None else min(left, 0.05))
+            return self._frame
+
+    def __repr__(self):
+        return (f"<StreamingFrame {self.key} {self.fmt} "
+                f"{self.watermark}/{self.total_rows} rows>")

@@ -82,6 +82,15 @@ class Parameters:
     priority: Optional[int] = None
     device_budget: Optional[float] = None
     retry_budget: int = 0
+    # streaming ingest (ingest/stream.py): train on already-landed rows
+    # behind the StreamingFrame watermark, re-binning at chunk fences as
+    # more data lands; per-segment row coverage is recorded into
+    # model.output["stream_coverage"].  Only tree builders support it.
+    stream: bool = False
+    # warm start: continue boosting from a prior model — a Model, a DKV
+    # key, or a saved-model path.  Public face of the checkpoint
+    # machinery; bit-identical to passing checkpoint=<key>.
+    warm_start: Optional[Any] = None
 
     def effective_seed(self) -> int:
         return np.random.default_rng().integers(2**31) if self.seed in (-1, None) \
@@ -324,8 +333,38 @@ class ModelBuilder:
             "_balance_weights_",
             Vec.from_numpy(np.asarray(uv, np.float64), T_NUM))
 
-    def train(self, frame: Frame, valid: Optional[Frame] = None) -> Model:
-        """Blocking train — the trainModel/Driver.computeImpl path."""
+    #: set True by builders whose _fit honors params.checkpoint (the tree
+    #: family) — gates warm_start= and StreamingFrame training, which are
+    #: both built on checkpoint continuation
+    _supports_checkpoint = False
+
+    def train(self, frame: Frame, valid: Optional[Frame] = None,
+              warm_start: Optional[Any] = None) -> Model:
+        """Blocking train — the trainModel/Driver.computeImpl path.
+
+        ``warm_start`` (also available as a parameter) continues boosting
+        from a prior model — a Model, a DKV key, or a saved-model path —
+        and is bit-identical to checkpoint continuation.  A
+        ``StreamingFrame`` trains in stream mode: boosting starts on the
+        rows already landed behind the watermark and re-bins at chunk
+        fences as more data arrives.
+        """
+        ws = warm_start if warm_start is not None else self.params.warm_start
+        if ws is not None:
+            if not self._supports_checkpoint:
+                raise ValueError(
+                    f"{self.algo} does not support warm_start (no "
+                    "checkpoint continuation)")
+            orig = self.params
+            try:
+                self.params = dataclasses.replace(
+                    orig, warm_start=None,
+                    checkpoint=self._resolve_warm_start(ws))
+                return self.train(frame, valid)
+            finally:
+                self.params = orig
+        if not isinstance(frame, Frame) and hasattr(frame, "watermark"):
+            return self._train_stream(frame, valid)
         self._validate(frame)
         frame, bal = self._apply_balance(frame)
         orig = self.params
@@ -336,11 +375,147 @@ class ModelBuilder:
             di = self._make_datainfo(frame)
             self.job = Job(f"{self.algo} train",
                            dest_key=dkv.make_key(self.algo))
+            if getattr(self, "_stream_ctx", None) is not None:
+                self.job.stream = self._stream_ctx.progress()
             return self.job.run(self._make_driver(
                 frame, di, valid,
                 orig_params=orig if bal is not None else None))
         finally:
             self.params = orig
+
+    def _resolve_warm_start(self, ws) -> str:
+        """Normalize a warm_start (Model | DKV key | saved path) to the
+        DKV key checkpoint continuation expects."""
+        if isinstance(ws, Model):
+            if dkv.get(ws.key) is None:
+                dkv.put(ws.key, ws)
+            return ws.key
+        if isinstance(ws, str):
+            if dkv.get(ws) is not None:
+                return ws
+            import os
+            if os.path.exists(ws):
+                return Model.load(ws).key
+            raise ValueError(
+                f"warm_start {ws!r} is neither a DKV model key nor a "
+                "saved model file")
+        raise ValueError(f"warm_start must be a Model, key, or path, "
+                         f"got {type(ws).__name__}")
+
+    def _train_stream(self, sf, valid: Optional[Frame] = None) -> Model:
+        """Train while a StreamingFrame lands: boost on the visible
+        prefix, cut at a chunk fence when enough new rows arrive (or the
+        landed-fraction tree budget is spent), re-bin the grown prefix
+        with the prior's edges, and continue as a checkpoint segment.
+        Bit-identity with batch training holds for the degenerate
+        single-segment case; multi-segment runs record their per-segment
+        row coverage in ``model.output["stream_coverage"]``.
+        """
+        import math
+
+        from ..runtime.config import config
+        from ..runtime.observability import inc
+
+        if not self._supports_checkpoint:
+            raise ValueError(
+                f"{self.algo} cannot train on a StreamingFrame (no "
+                "checkpoint continuation to re-bin against)")
+        cfg = config()
+        sf.start()
+        sf.wait_rows(max(cfg.stream_min_rows, 1))
+        p0 = self.params
+        ntrees = getattr(p0, "ntrees", None)
+        if ntrees is None:
+            raise ValueError(f"{self.algo} has no ntrees — stream mode "
+                             "is for the tree family")
+        model, prior_key, prior_nt = None, p0.checkpoint, 0
+        if prior_key is not None:
+            prior = dkv.get(prior_key) if isinstance(prior_key, str) \
+                else prior_key
+            prior_nt = prior.output["ntrees_trained"]
+        coverage: List[dict] = []
+        self._stream_ctx = sf
+        last_rows = 0
+        try:
+            while True:
+                wm = sf.watermark
+                total = sf.total_rows
+                full = sf.complete and (total is None or wm >= total)
+                r = cfg.stream_round_rows
+                rows_vis = wm if (full or r <= 0) \
+                    else ((wm // r) * r or wm)
+                if not full and rows_vis <= last_rows:
+                    # quantization floored us back onto the last segment:
+                    # wait for more rows before cutting a new one
+                    sf.wait_growth(max(last_rows, 1),
+                                   cfg.stream_grow_min_frac)
+                    continue
+                if full:
+                    # the landing thread's finalize assembles the
+                    # registered frame anyway — wait for it instead of
+                    # assembling a duplicate
+                    vis = sf.frame()
+                else:
+                    vis = sf.visible_frame(
+                        limit=rows_vis if rows_vis < wm else None)
+                rows0 = vis.nrows
+                grow = max(1, int(rows0 * cfg.stream_grow_min_frac))
+                seg_prior_nt = prior_nt
+                cut = {"hit": False}
+
+                def fence(t_rel: int, _rows0=rows0, _grow=grow,
+                          _pnt=seg_prior_nt, _cut=cut) -> bool:
+                    if self.job is not None:
+                        self.job.stream = sf.progress()
+                    wm_now = sf.watermark
+                    if sf.complete:
+                        # grab the tail as soon as the stream runs out
+                        # (or keep going: this segment IS the full data)
+                        _cut["hit"] = wm_now > _rows0
+                        return _cut["hit"]
+                    tot = sf.total_rows
+                    if not tot:
+                        # size unknown: fall back to growth-based cuts
+                        _cut["hit"] = wm_now >= _rows0 + _grow
+                        return _cut["hit"]
+                    # pace trees to the landed fraction; the budget rises
+                    # as rows land mid-segment, so a fast stream defers
+                    # the cut and a stalled one forces it (the outer
+                    # loop then blocks in wait_growth — that's the pause)
+                    budget = max(_pnt + 1, math.ceil(
+                        ntrees * min(1.0, wm_now / tot)))
+                    _cut["hit"] = _pnt + t_rel >= budget
+                    return _cut["hit"]
+
+                self._stream_fence = fence
+                self.params = dataclasses.replace(
+                    p0, checkpoint=prior_key, stream=False)
+                try:
+                    model = self.train(vis, valid)
+                finally:
+                    self._stream_fence = None
+                    self.params = p0
+                prior_key = model.key
+                prior_nt = model.output["ntrees_trained"]
+                coverage.append({"trees": int(prior_nt),
+                                 "rows": int(rows0)})
+                if len(coverage) > 1:
+                    inc("stream_rebin_total", algo=self.algo)
+                sf.consume(rows0)
+                last_rows = rows0
+                if prior_nt >= ntrees:
+                    break
+                if full and not cut["hit"]:
+                    break                # early stop on the full data
+                sf.wait_growth(rows0, cfg.stream_grow_min_frac)
+        finally:
+            self._stream_ctx = None
+            self.params = p0
+        model.output["stream_coverage"] = coverage
+        model.output["stream_segments"] = len(coverage)
+        if self.job is not None:
+            self.job.stream = sf.progress()
+        return model
 
     def _make_driver(self, frame: Frame, di: DataInfo,
                      valid: Optional[Frame], orig_params=None):

@@ -259,7 +259,8 @@ class DRF(SharedTree):
                 chunks[k].append(prior_stacked(prior, k if K > 1 else None))
         from ...runtime import failure
         for chunk_no, (c, t_new, score_now) in enumerate(chunk_schedule(
-                p.ntrees - prior_nt, p.score_tree_interval)):
+                p.ntrees - prior_nt, p.score_tree_interval,
+                fence=getattr(self, "_stream_fence", None))):
             t_done = prior_nt + t_new
             if sparse_deep:
                 # kill/resume while node-sparse deep levels are live

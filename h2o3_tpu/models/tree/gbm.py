@@ -362,7 +362,8 @@ class GBM(SharedTree):
                         else [] for k in range(K)]
             from ...runtime import failure
             for chunk_no, (c, t_new, score_now) in enumerate(chunk_schedule(
-                    p.ntrees - prior_nt, p.score_tree_interval)):
+                    p.ntrees - prior_nt, p.score_tree_interval,
+                    fence=getattr(self, "_stream_fence", None))):
                 t_done = prior_nt + t_new
                 # chaos matrix: kill/resume mid-multinomial-round — each
                 # chunk is a batch of K-tree rounds on the fused path
@@ -428,7 +429,8 @@ class GBM(SharedTree):
             chunks = [prior_stacked(prior)] if prior is not None else []
             from ...runtime import failure
             for chunk_no, (c, t_new, score_now) in enumerate(chunk_schedule(
-                    p.ntrees - prior_nt, p.score_tree_interval)):
+                    p.ntrees - prior_nt, p.score_tree_interval,
+                    fence=getattr(self, "_stream_fence", None))):
                 t_done = prior_nt + t_new
                 if sparse_deep:
                     # kill/resume while node-sparse deep levels are live

@@ -1735,12 +1735,18 @@ def tree_snapshot_state_multi(chunks_k, init_host, edges) -> dict:
 
 
 def chunk_schedule(ntrees: int, score_tree_interval: int,
-                   chunk_cap: int = 10):
+                   chunk_cap: int = 10, fence=None):
     """Yield (chunk_len, trees_done, score_now) for the scan driver loop.
 
     Chunks have a fixed length (``chunk_cap``) so every chunk reuses one
     compiled scan program; chunk boundaries land exactly on scoring
     intervals so early-stopping semantics match the per-tree loop.
+
+    ``fence(trees_done) -> bool`` is the streaming-ingest rendezvous: it
+    runs after the consumer has processed each yielded chunk, and a True
+    return ends the schedule early so the driver can finalize on the
+    trees built so far (the stream driver then re-bins the grown frame
+    and continues via a checkpoint segment).
     """
     from ...runtime import failure, scheduler
     interval = max(1, min(score_tree_interval, ntrees))
@@ -1755,6 +1761,8 @@ def chunk_schedule(ntrees: int, score_tree_interval: int,
         c = min(cap, ntrees - t, interval - (t % interval))
         t += c
         yield c, t, (t % interval == 0 or t >= ntrees)
+        if fence is not None and t < ntrees and fence(t):
+            return
 
 
 def build_tree(codes, g, h, w, edges, nbins: int, max_depth: int,
@@ -1995,6 +2003,10 @@ def prior_stacked(prior, k: Optional[int] = None) -> "StackedTrees":
 
 class SharedTree(ModelBuilder):
     """Common driver: binning, main loop, scoring, early stopping."""
+
+    # the tree family honors params.checkpoint, which also unlocks
+    # train(warm_start=...) and StreamingFrame stream training
+    _supports_checkpoint = True
 
     def _validate(self, frame) -> None:
         super()._validate(frame)

@@ -22,6 +22,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional
 
+from ...frame.frame import Frame
 from ..base import ModelBuilder
 from .gbm import GBM, GBMModel, GBMParameters
 from .shared import SharedTreeParameters
@@ -115,16 +116,20 @@ class XGBoost(GBM):
         resolve_hist_layout(params)      # ... and on a bad hist_layout
         ModelBuilder.__init__(self, params)
 
-    def train(self, frame, valid=None):
+    def train(self, frame, valid=None, warm_start=None):
         p: XGBoostParameters = self.params
+        # scale_pos_weight needs materialized response codes — a
+        # StreamingFrame defers to the per-segment trains on its
+        # visible prefixes (each a real Frame re-entering here)
         scaled = self._apply_scale_pos_weight(frame) \
-            if p.scale_pos_weight != 1.0 else None
+            if p.scale_pos_weight != 1.0 and isinstance(frame, Frame) \
+            else None
         if scaled is None:
-            return super().train(frame, valid)
+            return super().train(frame, valid, warm_start=warm_start)
         frame2, params2 = scaled
         self.params = params2
         try:
-            return super().train(frame2, valid)
+            return super().train(frame2, valid, warm_start=warm_start)
         finally:
             self.params = p          # builder stays reusable
 

@@ -147,6 +147,74 @@ def ncols_of(view, sep: str = ",") -> Optional[int]:
                                  sep.encode()[0:1]))
 
 
+def _range_bounds(lib, addr, n: int, threads: int, quoted: bool) -> list:
+    """Newline-aligned byte cut points (per-process span logic from
+    dparse._byte_assignments, applied intra-host: even byte cuts, each
+    aligned forward to the next line start).  When the buffer holds
+    quotes, a cut whose quote-count prefix parity is ODD sits inside a
+    quoted field (the "" escape preserves parity) — merge it into the
+    previous range.  Benign quoting (no embedded newlines) keeps every
+    cut, so writer-quoted files still tokenize in parallel."""
+    bounds = [0]
+    for t in range(1, threads):
+        pos = int(lib.fastcsv_find_newline(addr, n * t // threads, n))
+        pos = n if pos < 0 else pos + 1
+        if pos > bounds[-1]:
+            bounds.append(pos)
+    bounds.append(n)
+    if quoted and len(bounds) > 2:
+        safe = [0]
+        parity = 0
+        for k in range(1, len(bounds) - 1):
+            parity += int(lib.fastcsv_count_quotes(
+                addr, bounds[k - 1], bounds[k]))
+            if parity % 2 == 0:
+                safe.append(bounds[k])
+        safe.append(n)
+        bounds = safe
+    return bounds
+
+
+def range_plan(view, sep: str = ",", threads: Optional[int] = None):
+    """The ranged-parse plan for a CSV body WITHOUT tokenizing it:
+    ``[(byte_lo, byte_hi, row_lo, rows)]`` newline-aligned, quote-parity
+    safe ranges with cumulative row bases.  The streaming ingest plane
+    plans landings and lineage stamps from this before any range parses
+    (``parse_view`` executes the same plan).  ``rows`` counts lines —
+    an upper bound when blank lines are present; consumers must check
+    it against the tokenizer's actual row count.  None when the native
+    library is unavailable or the buffer doesn't fit its fast path."""
+    lib = load()
+    if lib is None:
+        return None
+    view = _as_view(view)
+    n = len(view)
+    if n == 0 or n > (1 << 31) - 16:
+        return None
+    addr = view.ctypes.data
+    has_quotes = ctypes.c_int(0)
+    lib.fastcsv_count_lines(addr, 0, n, ctypes.byref(has_quotes))
+    if threads is None:
+        threads = int(os.environ.get("H2O3_PARSE_THREADS", 0)) \
+            or min(16, os.cpu_count() or 1)
+    range_min = int(os.environ.get("H2O3_PARSE_RANGE_MIN", 1 << 22))
+    if threads <= 1 or n < range_min:
+        bounds = [0, n]
+    else:
+        bounds = _range_bounds(lib, addr, n, threads,
+                               bool(has_quotes.value))
+    ranges = [(bounds[i], bounds[i + 1]) for i in range(len(bounds) - 1)
+              if bounds[i + 1] > bounds[i]]
+    counts = [int(lib.fastcsv_count_lines(addr, a, b, None))
+              for a, b in ranges]
+    counts[-1] += 0 if view[-1] == 0x0A else 1
+    plan, base = [], 0
+    for (a, b), c in zip(ranges, counts):
+        plan.append((a, b, base, c))
+        base += c
+    return plan
+
+
 def parse_view(view, sep: str = ",", ncols: Optional[int] = None,
                threads: Optional[int] = None,
                on_range: Optional[Callable] = None,
@@ -222,31 +290,8 @@ def parse_view(view, sep: str = ",", ncols: Optional[int] = None,
         if on_range is not None and rows > 0:
             on_range(0, rows, V.T[:rows], F.T[:rows])
     else:
-        # newline-aligned byte ranges (per-process span logic from
-        # dparse._byte_assignments, applied intra-host: even byte cuts,
-        # each aligned forward to the next line start)
-        bounds = [0]
-        for t in range(1, threads):
-            pos = int(lib.fastcsv_find_newline(addr, n * t // threads, n))
-            pos = n if pos < 0 else pos + 1
-            if pos > bounds[-1]:
-                bounds.append(pos)
-        bounds.append(n)
-        if has_quotes.value and len(bounds) > 2:
-            # quoted cells may hide newlines: a cut whose quote-count
-            # prefix parity is ODD sits inside a quoted field (the ""
-            # escape preserves parity) — merge it into the previous
-            # range.  Benign quoting (no embedded newlines) keeps every
-            # cut, so writer-quoted files still tokenize in parallel.
-            safe = [0]
-            parity = 0
-            for k in range(1, len(bounds) - 1):
-                parity += int(lib.fastcsv_count_quotes(
-                    addr, bounds[k - 1], bounds[k]))
-                if parity % 2 == 0:
-                    safe.append(bounds[k])
-            safe.append(n)
-            bounds = safe
+        bounds = _range_bounds(lib, addr, n, threads,
+                               bool(has_quotes.value))
         ranges = [(bounds[i], bounds[i + 1])
                   for i in range(len(bounds) - 1)
                   if bounds[i + 1] > bounds[i]]

@@ -130,6 +130,22 @@ class Config:
     lineage_max_mb: float = 512.0
     lineage_hash_below_mb: float = 32.0
     replicate_below_mb: float = 0.0
+    # streaming ingest plane (ingest/stream.py + the tree drivers'
+    # stream= mode): rows that must land before the first training
+    # segment starts (0 = one full planned range), the backpressure
+    # bound on landed-but-unconsumed rows (0 = unbounded: training is
+    # the only consumer and reads in place), the minimum watermark
+    # growth — as a fraction of rows already trained on — before a
+    # chunk fence cuts a new segment (bounds re-bin/recompile churn),
+    # and the watermark poll cadence while training waits for data
+    stream_min_rows: int = 0
+    stream_buffer_rows: int = 0
+    stream_grow_min_frac: float = 0.25
+    stream_poll_s: float = 0.05
+    # quantize segment row counts down to a multiple of this (0 = off):
+    # repeated runs then hit the same padded shapes, so the per-segment
+    # scan programs come back from the jit cache instead of recompiling
+    stream_round_rows: int = 0
 
     @staticmethod
     def from_env() -> "Config":
@@ -198,6 +214,12 @@ class Config:
                 e("H2O3_TPU_LINEAGE_HASH_BELOW_MB", 32.0)),
             replicate_below_mb=float(
                 e("H2O3_TPU_REPLICATE_BELOW_MB", 0.0)),
+            stream_min_rows=int(e("H2O3_TPU_STREAM_MIN_ROWS", 0)),
+            stream_buffer_rows=int(e("H2O3_TPU_STREAM_BUFFER_ROWS", 0)),
+            stream_grow_min_frac=float(
+                e("H2O3_TPU_STREAM_GROW_MIN_FRAC", 0.25)),
+            stream_poll_s=float(e("H2O3_TPU_STREAM_POLL", 0.05)),
+            stream_round_rows=int(e("H2O3_TPU_STREAM_ROUND_ROWS", 0)),
         )
 
     def describe(self) -> dict:

@@ -61,6 +61,10 @@ class Job:
         self.retry_budget: int = 0
         self.user: Optional[str] = None
         self.retries = 0
+        # streaming-ingest progress (ingest/stream.py): the tree drivers'
+        # stream= mode keeps this updated at every chunk fence so
+        # GET /3/Jobs shows watermark/landed/backpressure live
+        self.stream: Optional[dict] = None
         # run-token: each (re)run holds a fresh token; epilogues only
         # apply when the token still matches, so a worker thread wedged
         # in a dead collective cannot clobber a requeued job's state
@@ -220,7 +224,7 @@ class Job:
         return (self.end_time or time.time()) - self.start_time
 
     def describe(self) -> dict:
-        return {
+        d = {
             "key": self.key, "description": self.description,
             "status": self.status, "progress": self.progress,
             "msg": self.progress_msg, "dest": self.dest_key,
@@ -230,6 +234,9 @@ class Job:
             "retry_budget": self.retry_budget, "user": self.user,
             "retries": self.retries,
         }
+        if self.stream is not None:
+            d["stream"] = self.stream
+        return d
 
 
 def list_jobs() -> list:

@@ -291,6 +291,8 @@ def _reparse_span(rec, shard: dict, cols: List[np.ndarray],
             f"source {path!r} bytes [{lo_b},{hi_b}) no longer match their "
             "lineage hash — file changed since parse; use full re-import")
     maybe_inject("parse_range")
+    if (rec.get("parse") or {}).get("format") == "parquet":
+        return _reparse_groups(rec, shard, cols, types, schema)
     sepc = rec["parse"].get("sep") or ","
     parsed = _tokenize_span(span, sepc, len(types))
     if parsed is None:
@@ -303,6 +305,72 @@ def _reparse_span(rec, shard: dict, cols: List[np.ndarray],
     for j, t in enumerate(types):
         cols[j][row_lo:row_lo + n] = _typed_column(
             t, vals, flags, text, j, schema, j_name=schema["names"][j])
+
+
+def _reparse_groups(rec, shard: dict, cols: List[np.ndarray],
+                    types: Sequence[str], schema) -> None:
+    """Columnar peer of the CSV span re-parse: the shard's column-chunk
+    byte span already passed its sha1 check, so re-read ONLY its row
+    groups and write rows [row_lo, row_lo+rows) in canonical form typed
+    by the SCHEMA (never re-guessed)."""
+    import pyarrow.parquet as pq
+    path = rec["source"]
+    row_lo, n = int(shard["row_lo"]), int(shard["rows"])
+    g_lo, g_hi = int(shard["group_lo"]), int(shard["group_hi"])
+    table = pq.ParquetFile(path).read_row_groups(list(range(g_lo, g_hi)))
+    off = row_lo - int(shard.get("group_row_lo", row_lo))
+    if off < 0 or off + n > table.num_rows:
+        raise RematError(
+            f"row groups [{g_lo},{g_hi}) of {path!r} hold "
+            f"{table.num_rows} rows, lineage wants [{off},{off + n})")
+    table = table.slice(off, n)
+    for j, (t, name) in enumerate(zip(types, schema["names"])):
+        cols[j][row_lo:row_lo + n] = _parquet_canonical(
+            t, table.column(name), name, schema)
+
+
+def _parquet_canonical(t: str, col, name: str, schema) -> np.ndarray:
+    """One arrow column in canonical form under the lineage schema —
+    mirrors the ``parse_arrow`` type mapping cell for cell so rebuilt
+    shards pass their bitwise value hash."""
+    import pyarrow as pa
+    from ..frame.parse import _NA
+    pa_type = col.type
+    if t == T_NUM and (pa.types.is_floating(pa_type)
+                       or pa.types.is_integer(pa_type)
+                       or pa.types.is_boolean(pa_type)):
+        return col.cast(pa.float64()).to_numpy(
+            zero_copy_only=False).astype(np.float32)
+    if t == T_TIME and (pa.types.is_timestamp(pa_type)
+                        or pa.types.is_date(pa_type)):
+        ms = col.cast(pa.timestamp("ms")).to_numpy(
+            zero_copy_only=False).astype("datetime64[ms]") \
+            .astype("int64").astype(np.float64)
+        ms[col.is_null().to_numpy(zero_copy_only=False)] = np.nan
+        return ms
+    sv = np.asarray(["" if v is None else str(v) for v in col.to_pylist()],
+                    dtype=object).astype(str)
+    na = np.isin(sv, list(_NA))
+    if t == T_NUM:
+        out = np.full(len(sv), np.nan, np.float64)
+        ok = ~na
+        out[ok] = sv[ok].astype(np.float64)
+        return out.astype(np.float32)
+    if t == T_CAT:
+        dom = (schema.get("domains") or {}).get(name) or []
+        return encode_domain(sv, dom, na_mask=na)
+    if t == T_TIME:
+        import pandas as pd
+        with np.errstate(all="ignore"):
+            dt = pd.to_datetime(pd.Series(sv.astype(object)),
+                                errors="coerce", format="mixed")
+        ms = dt.to_numpy().astype("datetime64[ms]").astype("int64") \
+            .astype(np.float64)
+        ms[dt.isna().to_numpy() | na] = np.nan
+        return ms
+    out = sv.astype(object)
+    out[na] = None
+    return out
 
 
 def _tokenize_span(span: bytes, sepc: str, ncols: int):

@@ -1,0 +1,193 @@
+"""Streaming ingest plane (tier-1): StreamingFrame parity + stream= training.
+
+The contract under test: a frame assembled from ranges landing
+incrementally is BITWISE identical to the batch ``parse_csv`` /
+``parse_parquet`` result (numeric, NA, categorical and string columns,
+mid-row range cuts included), the watermark/backpressure surface behaves
+as documented, and a ``stream=True`` tree build over a fully-landed
+stream produces the very same model as the batch path.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu import StreamingFrame, stream_file
+from h2o3_tpu.frame import lineage
+from h2o3_tpu.frame.parse import parse_csv
+from h2o3_tpu.ingest.stream import StreamError
+from h2o3_tpu.models import GBM
+from h2o3_tpu.runtime import failure
+from h2o3_tpu.runtime.config import reload as config_reload
+
+_STREAM_ENV = ("H2O3_PARSE_RANGE_MIN", "H2O3_TPU_FAULT_INJECT",
+               "H2O3_TPU_STREAM_MIN_ROWS", "H2O3_TPU_STREAM_BUFFER_ROWS",
+               "H2O3_TPU_STREAM_GROW_MIN_FRAC", "H2O3_TPU_STREAM_ROUND_ROWS")
+
+
+@pytest.fixture(autouse=True)
+def _clean(cl):
+    failure.reset()
+    yield
+    failure.reset()
+    for k in _STREAM_ENV:
+        os.environ.pop(k, None)
+    config_reload()
+
+
+def _write_csv(tmp_path, name="stream.csv", n=1200):
+    """Mixed-type CSV: numeric, numeric-with-NA, categorical (with NA),
+    high-cardinality string — every row a different width so tiny range
+    plans cut mid-file at awkward (but newline-aligned) offsets."""
+    lines = ["num,gappy,cat,tag,y"]
+    for i in range(n):
+        gap = "NA" if i % 11 == 0 else f"{i * 0.25}"
+        cat = ["red", "green", "blue"][i % 3] if i % 13 else "NA"
+        lines.append(f"{i},{gap},{cat},tag_{i:05d},{(i * 7) % 5}")
+    path = tmp_path / name
+    path.write_text("\n".join(lines) + "\n")
+    return str(path)
+
+
+def _assert_frames_equal(a, b, what=""):
+    assert a.names == b.names and a.nrows == b.nrows, what
+    ca, cb = lineage.canonical_cols(a), lineage.canonical_cols(b)
+    for name, x, y in zip(a.names, ca, cb):
+        if x.dtype == object:
+            assert list(x) == list(y), f"{what}: column {name}"
+        else:
+            assert x.dtype == y.dtype, f"{what}: column {name} dtype"
+            np.testing.assert_array_equal(x, y, err_msg=f"{what}: {name}")
+    for name in a.names:
+        assert a.vec(name).type == b.vec(name).type, f"{what}: {name} type"
+        assert a.vec(name).domain == b.vec(name).domain, f"{what}: {name}"
+
+
+# ------------------------------------------------------------- frame parity
+
+def test_csv_streamed_bitwise_equals_batch(cl, tmp_path):
+    path = _write_csv(tmp_path)
+    batch = parse_csv(path, destination_frame="stream_batch_ref")
+    # force many newline-aligned ranges (mid-row byte cuts snapped by the
+    # range planner) so assembly genuinely spans range boundaries
+    os.environ["H2O3_PARSE_RANGE_MIN"] = "2048"
+    sf = stream_file(path, destination_frame="stream_csv_parity")
+    fr = sf.frame(timeout=60)
+    prog = sf.progress()
+    assert prog["complete"] and prog["ranges_total"] > 1, prog
+    assert prog["watermark"] == batch.nrows
+    _assert_frames_equal(batch, fr, "csv streamed vs batch")
+    # streamed parse publishes the same replayable lineage record shape
+    rec = lineage.get_record(fr.key)
+    assert rec is not None and rec["kind"] == "parse"
+
+
+def test_parquet_streamed_bitwise_equals_batch(cl, tmp_path):
+    pa = pytest.importorskip("pyarrow")
+    pq = pytest.importorskip("pyarrow.parquet")
+    from h2o3_tpu.frame.parse import parse_arrow
+    n = 900
+    rng = np.random.default_rng(5)
+    tab = pa.table({
+        "num": rng.random(n),
+        "gappy": pa.array([None if i % 9 == 0 else float(i)
+                           for i in range(n)], pa.float64()),
+        "cat": pa.array([["a", "b", "c"][i % 3] for i in range(n)]
+                        ).dictionary_encode(),
+        "tag": [f"t{i:04d}" for i in range(n)],
+    })
+    path = str(tmp_path / "stream.parquet")
+    pq.write_table(tab, path, row_group_size=128)  # 8 row groups
+    batch = parse_arrow(path, "parquet", destination_frame="pq_batch_ref")
+    sf = stream_file(path, destination_frame="pq_stream_parity")
+    fr = sf.frame(timeout=60)
+    assert sf.progress()["ranges_total"] > 1
+    _assert_frames_equal(batch, fr, "parquet streamed vs batch")
+
+
+# ------------------------------------------------- watermark / backpressure
+
+def test_watermark_backpressure_and_consume(cl, tmp_path):
+    path = _write_csv(tmp_path, n=800)
+    os.environ["H2O3_PARSE_RANGE_MIN"] = "1024"
+    os.environ["H2O3_TPU_STREAM_BUFFER_ROWS"] = "200"
+    config_reload()
+    sf = StreamingFrame(path, destination_frame="stream_bp").start()
+    wm = sf.wait_rows(100, timeout=30)
+    assert wm >= 100
+    # worker must stall once landed-but-unconsumed exceeds the buffer cap
+    # (one in-flight range of slack): it cannot land the whole file
+    deadline = sf.wait_rows(800, timeout=1.0)
+    assert deadline < 800 and not sf.complete
+    assert sf.progress()["backpressure_waits"] > 0
+    # frame() drains the buffer and unblocks the worker
+    fr = sf.frame(timeout=60)
+    assert fr.nrows == 800 and sf.complete
+
+
+def test_stream_error_surfaces_and_wait_raises(cl, tmp_path):
+    path = _write_csv(tmp_path, n=600)
+    os.environ["H2O3_PARSE_RANGE_MIN"] = "1024"
+    os.environ["H2O3_TPU_FAULT_INJECT"] = "parse_range:0:2:raise"
+    config_reload()
+    sf = StreamingFrame(path, destination_frame="stream_err").start()
+    with pytest.raises(StreamError):
+        sf.wait_rows(600, timeout=30)
+    assert sf.error is not None and not sf.complete
+
+
+# --------------------------------------------------------- stream= training
+
+def _train_kw():
+    return dict(response_column="y", ntrees=6, max_depth=3, nbins=32,
+                min_rows=10, seed=7, score_tree_interval=3)
+
+
+def test_stream_train_fully_landed_equals_batch(cl, tmp_path):
+    """Degenerate stream (everything landed before boosting starts) must
+    reproduce the batch model bitwise — one segment, no re-bin."""
+    path = _write_csv(tmp_path, n=1000)
+    batch_fr = parse_csv(path, destination_frame="stream_tr_batch")
+    m_batch = GBM(**_train_kw()).train(batch_fr)
+
+    os.environ["H2O3_TPU_STREAM_MIN_ROWS"] = "1000"
+    config_reload()
+    sf = stream_file(path, destination_frame="stream_tr_stream")
+    m_stream = GBM(**_train_kw(), stream=True).train(sf)
+    cov = m_stream.output["stream_coverage"]
+    assert cov[-1]["rows"] == 1000 and cov[-1]["trees"] == 6
+    assert m_stream.output["stream_segments"] == 1
+
+    pb = m_batch.predict(batch_fr).vec("predict").to_numpy()
+    ps = m_stream.predict(batch_fr).vec("predict").to_numpy()
+    np.testing.assert_array_equal(pb, ps)
+
+
+def test_stream_train_multisegment_coverage(cl, tmp_path):
+    """Throttled landing forces boosting to start behind the watermark:
+    multiple segments, monotone row coverage, full data in the last."""
+    from h2o3_tpu.runtime.observability import counter
+    path = _write_csv(tmp_path, n=1000)
+    os.environ["H2O3_PARSE_RANGE_MIN"] = "2048"
+    os.environ["H2O3_TPU_STREAM_MIN_ROWS"] = "150"
+    os.environ["H2O3_TPU_STREAM_GROW_MIN_FRAC"] = "0.2"
+    # deterministic throttle: every range delayed so chunk fences observe
+    # a moving watermark
+    os.environ["H2O3_TPU_FAULT_INJECT"] = "parse_range:0:0:delay:40:999"
+    config_reload()
+    rebin0 = counter("stream_rebin_total", algo="gbm").value
+    sf = stream_file(path, destination_frame="stream_tr_multi")
+    builder = GBM(**_train_kw(), stream=True)
+    m = builder.train(sf)
+    cov = m.output["stream_coverage"]
+    assert len(cov) >= 2, cov
+    rows = [c["rows"] for c in cov]
+    trees = [c["trees"] for c in cov]
+    assert rows == sorted(rows) and rows[-1] == 1000
+    assert trees == sorted(trees) and trees[-1] == 6
+    assert counter("stream_rebin_total", algo="gbm").value > rebin0
+    # every landed row was consumed by the trainer; job carries progress
+    assert sf.progress()["consumed"] >= 1000
+    assert builder.job.stream["complete"] is True

@@ -1,0 +1,101 @@
+"""Mid-stream host death (chaos half of the streaming plane).
+
+Contract: a parse worker killed partway through landing leaves a
+``streaming`` lineage record stamped with exactly the ranges that
+landed; ``resume()`` re-parses ONLY the missing ranges (proved by
+counting ``native.parse_bytes`` calls) and the recovered frame is
+bitwise identical to the batch parse.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu import StreamingFrame
+from h2o3_tpu.frame import lineage
+from h2o3_tpu.frame.parse import parse_csv
+from h2o3_tpu.ingest.stream import StreamError
+from h2o3_tpu import native
+from h2o3_tpu.runtime import failure
+from h2o3_tpu.runtime.config import reload as config_reload
+
+
+@pytest.fixture(autouse=True)
+def _clean(cl):
+    failure.reset()
+    yield
+    failure.reset()
+    for k in ("H2O3_PARSE_RANGE_MIN", "H2O3_TPU_FAULT_INJECT",
+              "H2O3_TPU_STREAM_BUFFER_ROWS"):
+        os.environ.pop(k, None)
+    config_reload()
+
+
+def _write_csv(tmp_path, n=1500):
+    lines = ["num,gappy,cat,tag"]
+    for i in range(n):
+        gap = "NA" if i % 7 == 0 else f"{i * 0.5}"
+        cat = ["ok", "warn", "crit"][i % 3]
+        lines.append(f"{i},{gap},{cat},tag_{i:05d}")
+    path = tmp_path / "chaos.csv"
+    path.write_text("\n".join(lines) + "\n")
+    return str(path)
+
+
+def test_mid_stream_death_resumes_missing_ranges_only(cl, tmp_path,
+                                                      monkeypatch):
+    path = _write_csv(tmp_path)
+    batch = parse_csv(path, destination_frame="chaos_batch_ref")
+
+    # many small ranges, then kill the worker (in-process analog of a
+    # host death: the injection raises inside the landing loop) on its
+    # fourth range
+    os.environ["H2O3_PARSE_RANGE_MIN"] = "1024"
+    os.environ["H2O3_TPU_FAULT_INJECT"] = "parse_range:0:4:raise"
+    config_reload()
+    sf = StreamingFrame(path, destination_frame="chaos_stream").start()
+    with pytest.raises(StreamError):
+        sf.wait_rows(batch.nrows, timeout=30)
+    assert sf.error is not None
+
+    prog = sf.progress()
+    n_total = prog["ranges_total"]
+    n_landed = prog["ranges_landed"]
+    assert n_total > 4 and 0 < n_landed < n_total, prog
+
+    # the partial lineage record carries exactly the landed ranges,
+    # each stamped with source bytes + sha1 for replay verification
+    rec = lineage.get_record(sf.key)
+    assert rec is not None and rec.get("streaming") \
+        and rec["complete"] is False
+    assert len(rec["ranges"]) == n_landed
+    for rng in rec["ranges"]:
+        assert rng["hi"] > rng["lo"] and rng["src_sha1"]
+
+    # resume with the fault disarmed: ONLY the missing ranges re-parse
+    os.environ.pop("H2O3_TPU_FAULT_INJECT")
+    failure.reset()
+    calls = {"n": 0}
+    real = native.parse_bytes
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return real(*a, **kw)
+
+    monkeypatch.setattr(native, "parse_bytes", counting)
+    fr = sf.resume().frame(timeout=60)
+    assert calls["n"] == n_total - n_landed
+
+    # recovered frame is bitwise identical to the batch parse
+    assert fr.names == batch.names and fr.nrows == batch.nrows
+    for x, y in zip(lineage.canonical_cols(batch),
+                    lineage.canonical_cols(fr)):
+        if x.dtype == object:
+            assert list(x) == list(y)
+        else:
+            np.testing.assert_array_equal(x, y)
+    # and the lineage record was promoted to a complete parse record
+    final = lineage.get_record(fr.key)
+    assert final is not None and final["kind"] == "parse"

@@ -59,6 +59,10 @@ TOLERANCE_OVERRIDES_PCT = {
 # acceptance bar is >= 0.97 regardless of history.
 ABSOLUTE_FLOORS = {
     "autotune_vs_best": 0.97,
+    # streamed end-to-end (ingest overlapped with stream= training)
+    # must stay at or under 0.85x of parse-then-train wall-clock:
+    # batch/streamed >= 1/0.85
+    "stream_overlap_vs_baseline": 1.176,
 }
 # echoes of configuration / sizes / diagnostics: reported, never gated
 INFORMATIONAL = ("platform", "rows", "trees", "parse_csv_mb",

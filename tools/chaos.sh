@@ -40,7 +40,12 @@
 #     recovery re-parses ONLY the dead host's byte ranges (proved by the
 #     parse_range injection counter), derived frames replay from
 #     lineage, a failed re-mat degrades to full re-import — never wrong
-#     data (tests/test_remat.py).
+#     data (tests/test_remat.py),
+#   - stream-ingest:      a parse worker dies mid-stream; the partial
+#     streaming lineage record holds exactly the landed ranges, resume()
+#     re-parses ONLY the missing ones (parse_bytes call count), and the
+#     recovered frame is bitwise equal to the batch parse
+#     (tests/test_stream_chaos.py).
 #
 # Exits nonzero if ANY row fails (every row still runs).
 set -o pipefail
@@ -83,6 +88,7 @@ run_row dkv-retry tests/test_dkv_retry.py
 run_row snapshot-recovery tests/test_snapshot_recovery.py
 run_row failure-watchdog tests/test_failure.py
 run_row remat-partial tests/test_remat.py
+run_row stream-ingest tests/test_stream_chaos.py
 
 echo "---- chaos rows ($ROWS_FILE) ----"
 cat "$ROWS_FILE"

@@ -8,8 +8,12 @@
 # PR 2's subtraction-parity tests grew it to ~830 s (budget 1200), PR 3's
 # chaos matrix (kill-resume-verify subprocesses) added ~200 s (budget
 # 1500), and PR 5's fused-split parity suite + mid-multinomial-round
-# chaos row add ~150 s, so the budget is 1700 s — same ~1.4x headroom
-# over a clean run.  Keep the ratio when tier-1 grows again.
+# chaos row add ~150 s, so the budget became 1700 s.  By PR 14 a clean
+# run had crept to ~1560 s (headroom ratio down to ~1.1x) and PR 15's
+# streaming-ingest suite (test_stream/test_warm_start/test_stream_chaos,
+# ~40 s) pushed a noisy run past the cliff at 97%, so the budget is
+# 2200 s — back to ~1.4x over the ~1600 s clean run.  Keep the ratio
+# when tier-1 grows again.
 # PR 11's online-serving suite (tests/test_serving.py: pack parity,
 # packed-vs-ref check mode across the four tree algos, micro-batcher
 # demux, REST realtime round-trip) rides inside `tests/` and adds ~70 s,
@@ -35,7 +39,7 @@ rm -f /tmp/_t1.log
 # this path — the compile-time analog of the durations artifact.
 compile_stats_file=${H2O3_TIER1_COMPILE_STATS:-/tmp/tier1_compile_stats.txt}
 export H2O3_TIER1_COMPILE_STATS="$compile_stats_file"
-timeout -k 10 1700 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+timeout -k 10 2200 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow and not heavy' --continue-on-collection-errors \
     --durations=25 --durations-min=1.0 \
     -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
