@@ -506,6 +506,29 @@ def worker_main():
                 st["stream_overlap_vs_baseline"], 3)
         except Exception as e:
             extra["stream_error"] = repr(e)[:200]
+        try:
+            # whole-tree scan fusion: dispatch-count pin (launches per
+            # tree O(1) in depth vs one-per-level) and the deep-tree
+            # retrain-latency speedup (bench_pieces treescan); the
+            # launch counts gate lower-better, the speedup higher
+            from bench_pieces import treescan_piece
+            ts = treescan_piece()
+            extra["treescan_launches_per_tree_scan"] = \
+                ts["treescan_launches_per_tree_scan"]
+            extra["treescan_launches_per_tree_level"] = \
+                ts["treescan_launches_per_tree_level"]
+            extra["treescan_cold_level_s"] = round(
+                ts["treescan_cold_level_s"], 3)
+            extra["treescan_cold_scan_s"] = round(
+                ts["treescan_cold_scan_s"], 3)
+            extra["treescan_trees_per_sec_level"] = round(
+                ts["treescan_trees_per_sec_level"], 2)
+            extra["treescan_trees_per_sec_scan"] = round(
+                ts["treescan_trees_per_sec_scan"], 2)
+            extra["treescan_scan_vs_level_speedup"] = round(
+                ts["treescan_scan_vs_level_speedup"], 3)
+        except Exception as e:
+            extra["treescan_error"] = repr(e)[:200]
     compiles, compile_s = _ledger_totals()
     if compiles:
         extra["compiles_total"] = compiles

@@ -1436,6 +1436,144 @@ def stream_piece():
             "stream_segments": m.output.get("stream_segments")}
 
 
+
+
+def treescan_piece():
+    """Whole-tree scan-fusion bench: tree_program="scan" vs "level" on
+    the deep-tree shape (max_depth 10, small N — the regime where
+    per-level dispatch and the unrolled 2*depth-kernel program dominate
+    a tree's cost).
+
+    Two proofs land:
+      * dispatch pin — ``count_kernel_launches`` (runtime/xprof.py)
+        counts kernel dispatch SITES in the traced build program.  The
+        level program carries one histogram launch per level (grows
+        with depth); the scan program is pinned O(1) regardless of
+        depth (one scan-carried hist body + one level-0 seed).  Both
+        counts are emitted at depth 6 and 10; the gate holds the scan
+        count lower-better from this round on.
+      * trees/s — the same deep GBM trained under both programs.
+        ``treescan_cold_*`` includes compile (the scan program is one
+        small scan body instead of 2*depth unrolled kernels — this is
+        the serving-adjacent retrain-latency win);
+        ``treescan_trees_per_sec_*`` is steady-state post-warmup.
+
+    ``treescan_scan_vs_level_speedup`` (cold scan / cold level, higher
+    is better) is the headline gate metric.
+
+    Usage (chip): python bench_pieces.py treescan
+    CPU smoke:    JAX_PLATFORMS=cpu H2O3_PIECES_ROWS=30000 \\
+                  python bench_pieces.py treescan
+    """
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    import h2o3_tpu
+    from h2o3_tpu import Frame
+    from h2o3_tpu.models.tree.gbm import GBM
+    from h2o3_tpu.models.tree.shared import make_build_tree_fn
+    from h2o3_tpu.runtime.xprof import count_kernel_launches
+
+    h2o3_tpu.init()
+    platform = jax.devices()[0].platform
+    rows = min(N_ROWS, 30_000)
+    trees = int(os.environ.get("H2O3_TREESCAN_TREES", 16))
+    cold_trees = int(os.environ.get("H2O3_TREESCAN_COLD_TREES", 4))
+    depth = int(os.environ.get("H2O3_TREESCAN_DEPTH", 10))
+    nbins = 64
+    Fs = 8
+
+    # ---- dispatch pin: launches per tree from the traced jaxpr
+    rng = np.random.default_rng(9)
+    Nb = 4096
+    codes = jnp.asarray(rng.integers(0, nbins, (Fs, Nb)), jnp.int32)
+    g = jnp.asarray(rng.normal(size=Nb), jnp.float32)
+    hh = jnp.ones(Nb, jnp.float32)
+    ww = jnp.ones(Nb, jnp.float32)
+    edges = jnp.sort(jnp.asarray(rng.normal(size=(Fs, nbins)),
+                                 jnp.float32), axis=1)
+    args = (codes, g, hh, ww, edges, jax.random.PRNGKey(1), 0.0, 1.0,
+            1e-5, 0.1, 1.0, jnp.ones(Fs, bool), 0.0, 0.0, 0.0)
+    launches = {}
+    for md in (6, depth):
+        for prog in ("level", "scan"):
+            fn = make_build_tree_fn(md, nbins, Fs, Nb, "f32",
+                                    tree_program=prog)
+            launches[f"{prog}_d{md}"] = count_kernel_launches(fn, *args)
+
+    # ---- trees/s on the deep shape, both programs
+    X = rng.normal(size=(rows, Fs)).astype(np.float64)
+    y = (np.sin(3 * X[:, 0]) + X[:, 1] * X[:, 2]
+         + 0.1 * rng.normal(size=rows))
+    fr = Frame.from_numpy(
+        {**{f"x{i}": X[:, i] for i in range(Fs)}, "y": y})
+    # dense layout pinned on both sides: the scan program composes with
+    # dense uniform kernels only (node-sparse slot maps reshape per
+    # level), and an apples-to-apples comparison needs one layout
+    kw = dict(response_column="y", ntrees=trees, max_depth=depth,
+              nbins=nbins, min_rows=5, seed=3, hist_layout="dense",
+              score_tree_interval=trees)
+
+    def cold(prog):
+        """Fresh-program retrain: compile + a short boost (the
+        serving-adjacent retrain-latency shape — compile cost is the
+        point, so the tree count stays small)."""
+        jax.clear_caches()
+        from h2o3_tpu.models.tree import hist as _h, shared as _s
+        for f in (_h.make_hist_fn, _h.make_subtract_level_fn,
+                  _h.make_batched_level_fn, _h.make_scan_level_fn,
+                  _h.make_batched_scan_level_fn, _s.make_build_tree_fn,
+                  _s.make_tree_scan_fn):
+            f.cache_clear()
+        t0 = _time.perf_counter()
+        GBM(**{**kw, "ntrees": cold_trees,
+               "score_tree_interval": cold_trees},
+            tree_program=prog).train(fr)
+        return _time.perf_counter() - t0
+
+    def steady(prog):
+        GBM(**kw, tree_program=prog).train(fr)      # warm the caches
+        best = float("inf")
+        for _ in range(3):
+            t0 = _time.perf_counter()
+            GBM(**kw, tree_program=prog).train(fr)
+            best = min(best, _time.perf_counter() - t0)
+        return best
+
+    cold_level = cold("level")
+    cold_scan = cold("scan")
+    steady_level = steady("level")
+    steady_scan = steady("scan")
+    speedup = cold_level / cold_scan if cold_scan else float("inf")
+
+    rec = {
+        "piece": "treescan", "platform": platform, "rows": rows,
+        "trees": trees, "depth": depth,
+        "treescan_launches_per_tree_scan": launches[f"scan_d{depth}"],
+        "treescan_launches_per_tree_level": launches[f"level_d{depth}"],
+        "treescan_launches_scan_d6": launches["scan_d6"],
+        "treescan_launches_level_d6": launches["level_d6"],
+        "cold_trees": cold_trees,
+        "treescan_cold_level_s": round(cold_level, 3),
+        "treescan_cold_scan_s": round(cold_scan, 3),
+        "treescan_trees_per_sec_level": round(trees / steady_level, 2),
+        "treescan_trees_per_sec_scan": round(trees / steady_scan, 2),
+        "treescan_scan_vs_level_speedup": round(speedup, 3),
+        "launches_depth_independent": bool(
+            launches[f"scan_d{depth}"] == launches["scan_d6"]),
+        "note": "dispatch pin: scan launches O(1) in depth vs "
+                "one-per-level; speedup = fresh-program retrain "
+                "(compile + short boost) level/scan wall",
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "parse":
         parse_piece()
@@ -1461,5 +1599,7 @@ if __name__ == "__main__":
         autotune_piece()
     elif len(sys.argv) > 1 and sys.argv[1] == "stream":
         stream_piece()
+    elif len(sys.argv) > 1 and sys.argv[1] == "treescan":
+        treescan_piece()
     else:
         main()

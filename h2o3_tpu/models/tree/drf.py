@@ -31,7 +31,8 @@ from .shared import (SharedTree, SharedTreeModel, SharedTreeParameters,
                      StackedTrees, TreeList, chunk_schedule, dense_mem_cap,
                      make_multinomial_scan_fn, make_tree_scan_fn,
                      run_hist_crosscheck,
-                     run_layout_crosscheck, run_split_crosscheck,
+                     run_layout_crosscheck, run_program_crosscheck,
+                     run_split_crosscheck,
                      traverse_jit, use_hier_split_search)
 from ...metrics.core import make_metrics
 
@@ -107,6 +108,7 @@ class DRF(SharedTree):
         autotune.activate(knobs)
         hist_mode, split_mode, hist_layout = (
             knobs.hist_mode, knobs.split_mode, knobs.hist_layout)
+        tree_program = knobs.tree_program
         if knobs.sparse_depth_threshold != p.sparse_depth_threshold:
             p = dataclasses.replace(
                 p, sparse_depth_threshold=knobs.sparse_depth_threshold)
@@ -231,6 +233,28 @@ class DRF(SharedTree):
                 min_child_weight=p.min_child_weight)
             hist_layout = "sparse"
             model.output["hist_layout"] = hist_layout
+        # tree_program="check" — the whole-tree scan program vs the
+        # per-level dispatch loop on the real mean-fit gradients, then
+        # training rides the scan-fused path (resolve_tree_program
+        # already downgraded "check" where the scan cannot grow)
+        if tree_program == "check":
+            gK = jnp.stack([-t * w for t in targets])
+            hK = jnp.broadcast_to(w, gK.shape)
+            kchk = jnp.stack([jax.random.fold_in(rng, k)
+                              for k in range(K)]) if K > 1 else rng
+            run_program_crosscheck(
+                wcodes, gK if K > 1 else gK[0],
+                hK if K > 1 else hK[0], w, edges_mat, kchk,
+                max_depth=p.max_depth, nbins=p.nbins, F=Fw, n_padded=N,
+                hist_precision=p.effective_hist_precision,
+                hist_mode=hist_mode, split_mode=split_mode,
+                reg_lambda=p.reg_lambda, min_rows=p.min_rows,
+                min_split_improvement=p.min_split_improvement,
+                learn_rate=1.0, col_sample_rate=col_rate,
+                reg_alpha=p.reg_alpha, gamma=p.gamma,
+                min_child_weight=p.min_child_weight)
+            tree_program = "scan"
+        model.output["tree_program"] = tree_program
         # batched multiclass: one K-tree build per round (one hist + one
         # split launch per level for all K class trees) instead of K
         # sequential scans — identical keys (same fold_in structure), so
@@ -242,7 +266,8 @@ class DRF(SharedTree):
                 p.effective_hist_precision, p.sample_rate, 1.0,
                 bin_counts=wbin_counts, hist_mode=hist_mode,
                 split_mode="fused", mode="drf", hist_layout=hist_layout,
-                sparse_depth_threshold=p.sparse_depth_threshold)
+                sparse_depth_threshold=p.sparse_depth_threshold,
+                tree_program=tree_program)
         else:
             scan_fn = make_tree_scan_fn(
                 "drf", 0.0, 0.0, 0.0, p.max_depth, p.nbins, Fw, N,
@@ -250,7 +275,8 @@ class DRF(SharedTree):
                 hier=use_hier_split_search(p, N),
                 bin_counts=wbin_counts, plan=plan, hist_mode=hist_mode,
                 split_mode=split_mode, hist_layout=hist_layout,
-                sparse_depth_threshold=p.sparse_depth_threshold)
+                sparse_depth_threshold=p.sparse_depth_threshold,
+                tree_program=tree_program)
         scalars = (p.reg_lambda, p.min_rows, p.min_split_improvement, 1.0,
                    col_rate, p.reg_alpha, p.gamma, p.min_child_weight)
         chunks = [[] for _ in range(K)]
@@ -306,7 +332,8 @@ class DRF(SharedTree):
                                  tree_snapshot_state_multi)
             init0 = np.zeros(K) if K > 1 else 0.0
             snapshot.maybe_snapshot(
-                job, model, {"trees_done": t_done},
+                job, model,
+                {"trees_done": t_done, "granularity": "tree_chunk"},
                 (lambda c=[list(ch) for ch in chunks]:
                     tree_snapshot_state_multi(c, init0, binned.edges))
                 if K > 1 else

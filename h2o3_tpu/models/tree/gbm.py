@@ -36,6 +36,7 @@ from .shared import (SharedTree, SharedTreeModel, SharedTreeParameters,
                      chunk_schedule, dense_mem_cap, make_build_tree_fn,
                      make_tree_scan_fn,
                      run_hist_crosscheck, run_layout_crosscheck,
+                     run_program_crosscheck,
                      run_split_crosscheck, stack_trees,
                      traverse_jit, use_hier_split_search)
 from ...metrics.core import make_metrics
@@ -157,6 +158,7 @@ class GBM(SharedTree):
         autotune.activate(knobs)
         hist_mode, split_mode, hist_layout = (
             knobs.hist_mode, knobs.split_mode, knobs.hist_layout)
+        tree_program = knobs.tree_program
         if knobs.sparse_depth_threshold != p.sparse_depth_threshold:
             # the tuned threshold must flow to EVERY consumer (effective
             # depth, scan factories, checkpoint validation, the params
@@ -344,6 +346,35 @@ class GBM(SharedTree):
             hist_layout = "sparse"
             model.output["hist_layout"] = hist_layout
 
+        # tree_program="check" — the whole-tree scan program vs the
+        # per-level dispatch loop on the REAL first-round gradients
+        # (shared.run_program_crosscheck), then training rides the
+        # scan-fused path.  resolve_tree_program already downgraded
+        # "check" to "level" for shapes the scan cannot grow (mono/plan/
+        # hier, engaged sparse layout, effective depth < 2, varbin).
+        if tree_program == "check":
+            if multinomial:
+                g0, h0 = grads_multi(Y1, F)
+                gc_, hc_ = (g0 * w[:, None]).T, (h0 * w[:, None]).T
+                kchk = jnp.stack([jax.random.fold_in(rng, k)
+                                  for k in range(K)])
+            else:
+                g0, h0 = grads_single(y, F)
+                gc_, hc_ = g0 * w, h0 * w
+                kchk = rng
+            run_program_crosscheck(
+                wcodes, gc_, hc_, w, edges_mat, kchk,
+                max_depth=p.max_depth, nbins=p.nbins, F=Fw, n_padded=N,
+                hist_precision=p.effective_hist_precision,
+                hist_mode=hist_mode, split_mode=split_mode,
+                reg_lambda=p.reg_lambda, min_rows=p.min_rows,
+                min_split_improvement=p.min_split_improvement,
+                learn_rate=p.learn_rate, col_sample_rate=p.col_sample_rate,
+                reg_alpha=p.reg_alpha, gamma=p.gamma,
+                min_child_weight=p.min_child_weight)
+            tree_program = "scan"
+        model.output["tree_program"] = tree_program
+
         if fused_multi:
             # multinomial fast path: K class trees per round, a whole
             # scoring interval of rounds per dispatch
@@ -354,7 +385,8 @@ class GBM(SharedTree):
                 hier=use_hier_split_search(p, N),
                 bin_counts=wbin_counts, plan=plan, hist_mode=hist_mode,
                 split_mode=split_mode, hist_layout=hist_layout,
-                sparse_depth_threshold=p.sparse_depth_threshold)
+                sparse_depth_threshold=p.sparse_depth_threshold,
+                tree_program=tree_program)
             scalars = (p.reg_lambda, p.min_rows, p.min_split_improvement,
                        p.learn_rate, p.col_sample_rate, p.reg_alpha, p.gamma,
                        p.min_child_weight)
@@ -393,7 +425,8 @@ class GBM(SharedTree):
                 from ...runtime import snapshot
                 from .shared import tree_snapshot_state_multi
                 snapshot.maybe_snapshot(
-                    job, model, {"trees_done": t_done},
+                    job, model,
+                    {"trees_done": t_done, "granularity": "tree_chunk"},
                     lambda c=[list(ch) for ch in chunks_k]:
                         tree_snapshot_state_multi(c, init_host,
                                                   binned.edges))
@@ -422,7 +455,8 @@ class GBM(SharedTree):
                 custom_fn=getattr(p, "custom_distribution_func", None),
                 hist_mode=hist_mode, split_mode=split_mode,
                 hist_layout=hist_layout,
-                sparse_depth_threshold=p.sparse_depth_threshold)
+                sparse_depth_threshold=p.sparse_depth_threshold,
+                tree_program=tree_program)
             scalars = (p.reg_lambda, p.min_rows, p.min_split_improvement,
                        p.learn_rate, p.col_sample_rate, p.reg_alpha, p.gamma,
                        p.min_child_weight)
@@ -451,7 +485,8 @@ class GBM(SharedTree):
                 from ...runtime import snapshot
                 from .shared import tree_snapshot_state
                 snapshot.maybe_snapshot(
-                    job, model, {"trees_done": t_done},
+                    job, model,
+                    {"trees_done": t_done, "granularity": "tree_chunk"},
                     lambda c=list(chunks): tree_snapshot_state(
                         c, init_host, binned.edges))
                 if valid is not None:
@@ -527,7 +562,8 @@ class GBM(SharedTree):
                         p.max_depth, p.nbins, binned.nfeatures, N,
                         p.effective_hist_precision, hist_mode=hist_mode,
                         nk=K, split_mode="fused", hist_layout=hist_layout,
-                        sparse_depth_threshold=p.sparse_depth_threshold)
+                        sparse_depth_threshold=p.sparse_depth_threshold,
+                        tree_program=tree_program)
                     tmK = jnp.broadcast_to(
                         jnp.asarray(tree_mask, bool) if tree_mask
                         is not None else jnp.ones(binned.nfeatures, bool),
@@ -565,7 +601,8 @@ class GBM(SharedTree):
                             hier=use_hier_split_search(p, N),
                             hist_mode=hist_mode, split_mode=split_mode,
                             hist_layout=hist_layout,
-                            sparse_depth_threshold=p.sparse_depth_threshold)
+                            sparse_depth_threshold=p.sparse_depth_threshold,
+                            tree_program=tree_program)
                         if dart:
                             tree.values = tree.values * b_scale
                         ktrees.append(tree)
@@ -594,7 +631,8 @@ class GBM(SharedTree):
                     hier=use_hier_split_search(p, N) and mono is None,
                     hist_mode=hist_mode, split_mode=split_mode,
                     hist_layout=hist_layout,
-                    sparse_depth_threshold=p.sparse_depth_threshold)
+                    sparse_depth_threshold=p.sparse_depth_threshold,
+                    tree_program=tree_program)
                 tree.values = tree.values * b_scale
                 trees.append(tree)
                 from .hist import table_lookup

@@ -734,6 +734,175 @@ def _make_batched_level_fn(d: int, K: int, F: int, B: int, n_padded: int,
 make_batched_level_fn = _reduce_mode_dispatch(_make_batched_level_fn)
 
 
+@functools.lru_cache(maxsize=None)
+def _make_scan_level_fn(W: int, F: int, B: int, n_padded: int,
+                        force_impl: str = "", precision: str = "bf16",
+                        reduce_mode: str = "hier"):
+    """Depth-generic subtract-level histogram for the scan-fused build.
+
+    The per-level factory (make_subtract_level_fn) closes over the level
+    index ``d`` — one compiled program per depth, one dispatch per level.
+    The whole-tree ``lax.scan`` needs ONE program whose shapes do not
+    change across iterations, so this variant runs the identical
+    smaller-sibling compaction at a FIXED child width ``W`` (the deepest
+    scanned level's 2^d) with parent width ``W // 2``.  Shallower levels
+    simply leave their padding slots empty: a slot with zero local rows
+    has ``cnt == 0`` on both children, contributes an all-False chosen
+    mask (exact +0.0 histogram), and reconstructs to exact +0.0 on the
+    large side (``0 - 0`` clamped) — so padded slots are bitwise inert
+    and the live prefix matches the per-level program (see the blocking
+    caveat in shared.resolve_tree_program).
+
+    ``dead`` is the scan-carried early-exit predicate (no alive leaf
+    anywhere): the compaction + kernel launch is skipped under a
+    ``lax.cond`` and the level degenerates to the pure parent
+    passthrough — which IS what the live branch computes when every row
+    sits on an even child (sibling side empty -> Hs = +0.0, large side
+    = clamp(Hp)), so taking the branch never changes a bit.
+
+    Returns ``(H_global [3, W, F, B], carry [n_shards, 3, W//2, F, B])``
+    — the carry keeps only the first W//2 child slots, which covers
+    every live slot of any non-final level (2^d <= W/2 below the last
+    iteration; the final carry is discarded).
+    """
+    if W < 2 or W & (W - 1):
+        raise ValueError(f"scan level width must be a power of two >= 2, "
+                         f"got {W}")
+    cl = cluster()
+    n_local = n_padded // cl.n_row_shards
+    Wp = W // 2
+    cap = n_local // 2
+    inner = _local_hist_impl(Wp, F, B, cap, force_impl=force_impl,
+                             precision=precision)
+    specs_row = (P(None, ROW_AXIS), P(ROW_AXIS), P(ROW_AXIS), P(ROW_AXIS),
+                 P(ROW_AXIS))
+
+    def _live(codes, leaf, g, h, w, Hp):
+        # make_subtract_level_fn's locald body at the (W, Wp) geometry
+        cidx = jax.lax.broadcasted_iota(jnp.int32, (W, 1), 0)
+        cnt = jnp.sum(cidx == leaf[None, :], axis=1, dtype=jnp.int32)
+        small_is_left = cnt[0::2] <= cnt[1::2]                 # [Wp]
+        chosen_child = jnp.stack(
+            [small_is_left, ~small_is_left], axis=1).reshape(-1)   # [W]
+        chosen = table_lookup(
+            chosen_child.astype(jnp.float32)[None], leaf, W)[0] > 0.5
+        target = jnp.where(chosen,
+                           jnp.cumsum(chosen.astype(jnp.int32)) - 1, cap)
+        ccodes = jnp.zeros((F, cap), codes.dtype) \
+            .at[:, target].set(codes, mode="drop", unique_indices=True)
+        pleaf = jnp.zeros((cap,), jnp.int32) \
+            .at[target].set((leaf >> 1).astype(jnp.int32), mode="drop",
+                            unique_indices=True)
+        st = jnp.zeros((3, cap), jnp.float32) \
+            .at[:, target].set(
+                jnp.stack([g, h, w]).astype(jnp.float32), mode="drop",
+                unique_indices=True)
+        Hs = inner(ccodes, pleaf, st[0], st[1], st[2])     # [3, Wp, F, B]
+        Ho = Hp - Hs
+        Ho = Ho.at[1:].max(0.0)
+        sl = small_is_left[None, :, None, None]
+        Hl_ = jnp.where(sl, Hs, Ho)
+        Hr_ = jnp.where(sl, Ho, Hs)
+        return jnp.stack([Hl_, Hr_], axis=2).reshape(3, W, F, B)
+
+    def _skip(codes, leaf, g, h, w, Hp):
+        # all rows on even children: the live branch reduces to exactly
+        # this (Hs = +0.0, clamped parent on the left, zeros right)
+        Hoc = Hp.at[1:].max(0.0)
+        return jnp.stack([Hoc, jnp.zeros_like(Hp)],
+                         axis=2).reshape(3, W, F, B)
+
+    def locald(codes, leaf, g, h, w, carry, dead):
+        Hp = carry[0]                              # this shard's [3,Wp,F,B]
+        Hloc = jax.lax.cond(dead, _skip, _live, codes, leaf, g, h, w, Hp)
+        return psum_shards(Hloc, reduce_mode), Hloc[:, :Wp][None]
+
+    f = shard_map(locald, mesh=cl.mesh,
+                  in_specs=specs_row + (P(ROW_AXIS), P()),
+                  out_specs=(P(), P(ROW_AXIS)), check_vma=False)
+    return _ledger("hist_scan", jax.jit(f), orig=f)
+
+
+make_scan_level_fn = _reduce_mode_dispatch(_make_scan_level_fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_batched_scan_level_fn(W: int, K: int, F: int, B: int,
+                                n_padded: int, force_impl: str = "",
+                                precision: str = "bf16",
+                                reduce_mode: str = "hier"):
+    """K-tree batched variant of ``make_scan_level_fn`` — one launch per
+    scan iteration regardless of K (the vmap batching rule keeps the
+    shared ``codes`` operand unbatched, mirroring make_batched_level_fn).
+    ``dead`` is all-trees-dead; an individually finished tree inside a
+    live level already produces the bitwise parent passthrough on its
+    own (its rows all sit on even children), so no per-tree predicate is
+    needed.  Shapes: leaf/g/h/w [K, N]; carry [n_shards, K, 3, W//2, F,
+    B]; returns H [K, 3, W, F, B] plus the next carry."""
+    if W < 2 or W & (W - 1):
+        raise ValueError(f"scan level width must be a power of two >= 2, "
+                         f"got {W}")
+    cl = cluster()
+    n_local = n_padded // cl.n_row_shards
+    Wp = W // 2
+    cap = n_local // 2
+    inner = _local_hist_impl(Wp, F, B, cap, force_impl=force_impl,
+                             precision=precision)
+    specs_k = (P(None, ROW_AXIS),) * 5
+
+    def locald(codes, leafK, gK, hK, wK, carry, dead):
+        HpK = carry[0]                             # [K, 3, Wp, F, B]
+
+        def one(leaf, g, h, w, Hp):
+            cidx = jax.lax.broadcasted_iota(jnp.int32, (W, 1), 0)
+            cnt = jnp.sum(cidx == leaf[None, :], axis=1, dtype=jnp.int32)
+            small_is_left = cnt[0::2] <= cnt[1::2]
+            chosen_child = jnp.stack(
+                [small_is_left, ~small_is_left], axis=1).reshape(-1)
+            chosen = table_lookup(
+                chosen_child.astype(jnp.float32)[None], leaf, W)[0] > 0.5
+            target = jnp.where(
+                chosen, jnp.cumsum(chosen.astype(jnp.int32)) - 1, cap)
+            ccodes = jnp.zeros((F, cap), codes.dtype) \
+                .at[:, target].set(codes, mode="drop", unique_indices=True)
+            pleaf = jnp.zeros((cap,), jnp.int32) \
+                .at[target].set((leaf >> 1).astype(jnp.int32), mode="drop",
+                                unique_indices=True)
+            st = jnp.zeros((3, cap), jnp.float32) \
+                .at[:, target].set(
+                    jnp.stack([g, h, w]).astype(jnp.float32), mode="drop",
+                    unique_indices=True)
+            Hs = inner(ccodes, pleaf, st[0], st[1], st[2])
+            Ho = Hp - Hs
+            Ho = Ho.at[1:].max(0.0)
+            sl = small_is_left[None, :, None, None]
+            Hl_ = jnp.where(sl, Hs, Ho)
+            Hr_ = jnp.where(sl, Ho, Hs)
+            return jnp.stack([Hl_, Hr_], axis=2).reshape(3, W, F, B)
+
+        def _live(codes, leafK, gK, hK, wK, HpK):
+            return jax.vmap(one)(leafK, gK, hK, wK, HpK)
+
+        def _skip(codes, leafK, gK, hK, wK, HpK):
+            def pas(Hp):
+                Hoc = Hp.at[1:].max(0.0)
+                return jnp.stack([Hoc, jnp.zeros_like(Hp)],
+                                 axis=2).reshape(3, W, F, B)
+            return jax.vmap(pas)(HpK)
+
+        HlocK = jax.lax.cond(dead, _skip, _live,
+                             codes, leafK, gK, hK, wK, HpK)
+        return psum_shards(HlocK, reduce_mode), HlocK[:, :, :Wp][None]
+
+    f = shard_map(locald, mesh=cl.mesh,
+                  in_specs=specs_k + (P(ROW_AXIS), P()),
+                  out_specs=(P(), P(ROW_AXIS)), check_vma=False)
+    return _ledger("hist_scan_batched", jax.jit(f), orig=f)
+
+
+make_batched_scan_level_fn = _reduce_mode_dispatch(_make_batched_scan_level_fn)
+
+
 def sparse_slot_budget(F: int, B: int,
                        cap_bytes: int = 64 * 1024 * 1024) -> int:
     """Static slot capacity for node-sparse deep levels.

@@ -37,6 +37,7 @@ from .binning import BinnedFrame, fit_bins, encode_bins
 from .hist import (_ledger, make_hist_fn, make_fine_hist_fn,
                    make_varbin_hist_fn,
                    make_subtract_level_fn, make_batched_level_fn,
+                   make_scan_level_fn, make_batched_scan_level_fn,
                    make_sparse_level_fn, make_batched_sparse_level_fn,
                    sparse_slot_budget, sparse_slot_maps,
                    offset_codes, best_splits, best_splits_hier,
@@ -138,6 +139,26 @@ class SharedTreeParameters(Parameters):
     # d >= threshold histograms in slot space.  Clamped per frame to the
     # dense memory cap so the dense levels above it always fit the budget.
     sparse_depth_threshold: int = 8
+    # whole-tree program STRUCTURE (mirrors hist_mode/split_mode):
+    #   "level" — the level loop is unrolled at TRACE time inside one jit:
+    #     the compiled program holds one hist + one split kernel per level
+    #     (2*depth compiled launches per tree) — the pre-scan pipeline,
+    #     kept whole as the oracle;
+    #   "scan"  — the level loop becomes a lax.scan over levels inside the
+    #     same jitted program: fixed-width padded levels with alive-slot
+    #     masking, the early-exit fence a scan-carried on-device
+    #     predicate, O(1) compiled kernel programs per tree regardless of
+    #     depth (and a far smaller program to compile for deep trees);
+    #   "check" — driver assert mode: grow the first tree/round both ways
+    #     on the real data and raise on divergence (run_program_crosscheck),
+    #     then train with "scan";
+    #   "auto"  (default) — autotuner-decided, as with hist_mode ("level"
+    #     with the tuner off — bit-identical to the pre-scan pipeline).
+    # Monotone constraints, EFB bundling, the hierarchical search,
+    # node-sparse deep levels, the variable-bin kernel and depth-1 trees
+    # stay on the level path ("auto"/"check" downgrade automatically;
+    # uplift always grows level-wise).
+    tree_program: str = "auto"
     # probability calibration (hex/tree CalibrationHelper)
     calibrate_model: bool = False
     calibration_frame: Optional[object] = None
@@ -477,7 +498,8 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
                        plan=None, hist_mode: str = "subtract",
                        nk: int = 1, split_mode: str = "separate",
                        hist_layout: str = "dense",
-                       sparse_depth_threshold: int = 8):
+                       sparse_depth_threshold: int = 8,
+                       tree_program: str = "level"):
     """One compiled program that grows a whole tree on device.
 
     The level loop (SharedTree.buildLayer) is unrolled inside a single jit:
@@ -532,6 +554,20 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
     parity with "dense" is: valid/leaf routing exact, feat/thr/na_left
     exact WHERE VALID, leaf values to f32 tolerance
     (run_layout_crosscheck).
+
+    ``tree_program="scan"`` replaces the trace-time level unroll with a
+    ``lax.scan`` over levels inside the same jit (one fixed-width level
+    program compiled ONCE instead of one program pair per level):
+    level 0 runs outside the scan on the existing depth-0 machinery and
+    seeds the carries, levels 1..max_depth-1 run at the padded width
+    2^(max_depth-1) with alive-slot masking, and the early-exit fence is
+    a scan-carried on-device ``dead`` predicate (hist.make_scan_level_fn
+    skips the histogram kernel and the builder skips partition on dead
+    levels — both skips are bitwise the live computation).  Composes
+    with hist_mode subtract/full, split_mode separate/fused and the
+    batched K-tree build; NOT with mono/EFB/hier/sparse layout (raises)
+    or the variable-bin kernel (silently uses the uniform kernels —
+    "auto" keeps per-level programs where varbin wins).
     """
     B = nbins + 1
     if hist_layout not in ("dense", "sparse"):
@@ -574,6 +610,16 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
         raise ValueError(
             f"hist_mode={hist_mode!r}: use 'subtract' or 'full' here "
             "('check' is a driver mode — see run_hist_crosscheck)")
+    if tree_program not in ("level", "scan"):
+        raise ValueError(
+            f"tree_program={tree_program!r}: use 'level' or 'scan' here "
+            "('auto'/'check' are driver modes — see resolve_tree_program)")
+    if tree_program == "scan" and (hier or mono is not None
+                                   or plan is not None):
+        raise ValueError(
+            "tree_program='scan' does not compose with monotone "
+            "constraints, EFB bundling or the hierarchical split search; "
+            "tree_program='auto' downgrades to 'level' automatically")
     max_depth = effective_max_depth(max_depth, nbins, F, n_padded,
                                     hist_layout, sparse_depth_threshold)
     # first node-sparse level: the threshold clamps to the dense memory
@@ -582,6 +628,20 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
     t0 = max(1, min(sparse_depth_threshold, dense_mem_cap(nbins, F)))
     sparse_from = t0 if (hist_layout == "sparse" and max_depth > t0) \
         else max_depth
+    if tree_program == "scan":
+        if sparse_from < max_depth:
+            raise ValueError(
+                "tree_program='scan' requires the dense layout at every "
+                "level (the scan body is ONE fixed-width program; node-"
+                "sparse slot maps reshape per level); use "
+                "hist_layout='dense' or tree_program='auto'")
+        if max_depth < 2:
+            raise ValueError(
+                "tree_program='scan' needs effective max_depth >= 2 (a "
+                "depth-1 tree is the root level only — nothing to scan); "
+                "tree_program='auto' downgrades to 'level' automatically")
+        return _make_scan_build(max_depth, nbins, F, n_padded,
+                                hist_precision, hist_mode, nk, split_mode)
     A_cap = sparse_slot_budget(F, B)
     # slot capacity per sparse level, and the PREVIOUS level's slot space
     # (the carry/compaction geometry) — at the boundary that is the dense
@@ -598,11 +658,7 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
     # H2O3_TPU_HIST_IMPL=varbin forces the varbin path off-TPU (interpret
     # Pallas) so the multichip dryrun exercises the bench kernel code path.
     on_tpu = cluster().mesh.devices.flat[0].platform == "tpu"
-    impl_override = os.environ.get("H2O3_TPU_HIST_IMPL", "")
-    use_varbin = (bin_counts is not None
-                  and (on_tpu or impl_override == "varbin")
-                  and sum(min(b, nbins) + 9 for b in bin_counts)
-                  < F * (nbins + 1))
+    use_varbin = varbin_kernel_engages(bin_counts, nbins, F)
     # Per-LEVEL kernel choice: the varbin Pallas kernel has no einsum
     # fallback, its minimum row block must keep [R, 3L] A-build
     # intermediates inside scoped VMEM (3L <= 1024), and its whole-
@@ -1097,6 +1153,282 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
     return _ledger("tree_build", jax.jit(build), orig=build)
 
 
+def _make_scan_build(max_depth: int, nbins: int, F: int, n_padded: int,
+                     hist_precision: str, hist_mode: str, nk: int,
+                     split_mode: str):
+    """The ``tree_program="scan"`` build: one lax.scan over levels.
+
+    Level 0 runs OUTSIDE the scan on the existing depth-0 machinery (the
+    root histogram has no parent carry and no sibling to compact) and
+    seeds the carries; levels 1..max_depth-1 are iterations of ONE
+    fixed-width program at W = 2^(max_depth-1), the deepest level's
+    child count.  Shallower levels leave slots >= 2^d empty; empty
+    slots are bitwise inert end to end — they histogram exact zeros
+    (no rows route there), the split search marks them invalid (then
+    ``valid &= alive`` kills any padded-slot artifact), and the dead
+    collapse writes the zero totals back — so each level's records and
+    routing match the level-path build bit for bit on the live prefix.
+
+    Per-level column-sample masks are drawn OUTSIDE the scan at their
+    TRUE [2^d, F] shapes (threefry output depends on the draw shape, and
+    bit-parity with the level path requires identical draws), padded to
+    [W, F] with False and fed as scan xs.  The early-exit fence becomes
+    the scan-carried ``dead = ~any(alive)`` predicate: a dead iteration
+    skips the histogram kernel (hist.make_scan_level_fn's internal cond
+    — the skip branch is provably the live branch's output when no rows
+    moved) and the partition pass (all-invalid records route every row
+    left, i.e. ``leaf -> 2*leaf`` exactly); the level path has no early
+    exit, so the skips elide only provably-identical work and parity
+    holds level by level.
+
+    Bitwise caveat (documented in operations.md): the einsum histogram's
+    row-block size depends on the slot width, so at padded width W vs
+    the level path's true 2^d the row accumulation can associate
+    differently once N is large enough to split blocks — structure stays
+    exact, leaf values agree to f32 tolerance (run_program_crosscheck's
+    contract).  The variable-bin kernel is never used here (uniform
+    kernels only); resolve_tree_program keeps "auto" on the level path
+    when varbin would engage.
+    """
+    B = nbins + 1
+    W = 2 ** (max_depth - 1)
+    Wp = W // 2
+    if nk > 1:
+        lev0 = make_batched_level_fn(0, nk, F, B, n_padded,
+                                     precision=hist_precision,
+                                     subtract=(hist_mode == "subtract"))
+        if hist_mode == "subtract":
+            scan_lev = make_batched_scan_level_fn(W, nk, F, B, n_padded,
+                                                  precision=hist_precision)
+        else:
+            scan_lev = make_batched_level_fn(max_depth - 1, nk, F, B,
+                                             n_padded,
+                                             precision=hist_precision,
+                                             subtract=False)
+    else:
+        if hist_mode == "subtract":
+            lev0 = make_subtract_level_fn(0, F, B, n_padded,
+                                          precision=hist_precision)
+            scan_lev = make_scan_level_fn(W, F, B, n_padded,
+                                          precision=hist_precision)
+        else:
+            lev0 = make_hist_fn(1, F, B, n_padded,
+                                precision=hist_precision)
+            scan_lev = make_hist_fn(W, F, B, n_padded,
+                                    precision=hist_precision)
+
+    def _collapse(valid, ch):
+        # the level path's dead-slot stat collapse (axis=-1 indexing
+        # covers both the [W, 6] and the batched [K, W, 6] shapes)
+        gl, hl, cl2 = ch[..., 0], ch[..., 1], ch[..., 2]
+        gr, hr, cr2 = ch[..., 3], ch[..., 4], ch[..., 5]
+        return jnp.stack(
+            [jnp.where(valid, gl, gl + gr),
+             jnp.where(valid, hl, hl + hr),
+             jnp.where(valid, cl2, cl2 + cr2),
+             jnp.where(valid, gr, 0.0),
+             jnp.where(valid, hr, 0.0),
+             jnp.where(valid, cr2, 0.0)], axis=-1)
+
+    def build(codes, g, h, w, edges_mat, rng_key, reg_lambda, min_rows,
+              min_split_improvement, learn_rate, col_sample_rate, tree_mask,
+              reg_alpha, gamma, min_child_weight):
+        N = codes.shape[1]
+        leaf = jnp.zeros(N, jnp.int32)
+        keys = jax.random.split(rng_key, max_depth)
+
+        def draw_mask(d):
+            L = 2 ** d
+            ps = jax.random.uniform(keys[d], (L, F)) < col_sample_rate
+            ps = ps.at[:, 0].set((ps.any(axis=1) & ps[:, 0])
+                                 | ~ps.any(axis=1))
+            return ps & tree_mask[None, :]
+
+        def _split(H, mask):
+            if split_mode == "fused":
+                return fused_best_splits(H, nbins, reg_lambda, min_rows,
+                                         min_split_improvement, mask,
+                                         reg_alpha, gamma, min_child_weight)
+            return best_splits(H, nbins, reg_lambda, min_rows,
+                               min_split_improvement, mask, reg_alpha,
+                               gamma, min_child_weight)
+
+        # ---- level 0 outside the scan (root: no carry, no sibling)
+        if hist_mode == "subtract":
+            H, Hc = lev0(codes, leaf, g, h, w)
+            H_carry = jnp.pad(Hc, ((0, 0), (0, 0), (0, Wp - 1), (0, 0),
+                                   (0, 0)))
+        else:
+            H = lev0(codes, leaf, g, h, w)
+        feat, bin_, na_left, gain, valid, children = _split(H, draw_mask(0))
+        thr = edges_mat[feat, jnp.clip(bin_, 0, nbins - 1)]
+        leaf = partition(codes, leaf, feat, bin_, na_left, valid,
+                         jnp.int32(nbins))
+        lv0 = (feat, thr, na_left, valid)
+        alive = jnp.pad(jnp.stack([valid, valid], axis=1).reshape(-1),
+                        (0, W - 2))
+        children = jnp.pad(children, ((0, W - 1), (0, 0)))
+        masks = jnp.stack([
+            jnp.pad(draw_mask(d), ((0, W - 2 ** d), (0, 0)))
+            for d in range(1, max_depth)])
+
+        def body(carry, mask):
+            if hist_mode == "subtract":
+                leaf, alive, children, H_carry = carry
+            else:
+                leaf, alive, children = carry
+            dead = ~jnp.any(alive)
+            if hist_mode == "subtract":
+                H, H_carry = scan_lev(codes, leaf, g, h, w, H_carry, dead)
+            else:
+                H = scan_lev(codes, leaf, g, h, w)
+            feat, bin_, na_left, gain, valid, ch = _split(H, mask)
+            valid = valid & alive
+            children = _collapse(valid, ch)
+            # the next iteration reads only its first 2^(d+1) <= W slots:
+            # the interleave of the first Wp parents covers them all
+            alive = jnp.stack([valid[:Wp], valid[:Wp]], axis=1).reshape(-1)
+            thr = edges_mat[feat, jnp.clip(bin_, 0, nbins - 1)]
+            leaf = jax.lax.cond(
+                dead,
+                lambda c, l, f, b, na, v: 2 * l,
+                lambda c, l, f, b, na, v: partition(c, l, f, b, na, v,
+                                                    jnp.int32(nbins)),
+                codes, leaf, feat, bin_, na_left, valid)
+            out = (leaf, alive, children, H_carry) \
+                if hist_mode == "subtract" else (leaf, alive, children)
+            return out, (feat, thr, na_left, valid)
+
+        carry0 = (leaf, alive, children, H_carry) \
+            if hist_mode == "subtract" else (leaf, alive, children)
+        carry, ys = jax.lax.scan(body, carry0, masks)
+        leaf, children = carry[0], carry[2]
+        # per-level records back to their true widths — static slicing
+        # inside the jit, so the level contract is shape-identical to the
+        # level path's
+        levels = [lv0] + [
+            tuple(y[i][: 2 ** (i + 1)] for y in ys)
+            for i in range(max_depth - 1)]
+        gl, hl, cl = children[:, 0], children[:, 1], children[:, 2]
+        gr, hr, cr = children[:, 3], children[:, 4], children[:, 5]
+
+        from .hist import newton_value
+
+        def newton(gc, hc, cc):
+            return jnp.where(cc > 0,
+                             newton_value(gc, hc, reg_lambda, reg_alpha),
+                             0.0)
+        vals = jnp.stack([newton(gl, hl, cl), newton(gr, hr, cr)],
+                         axis=1).reshape(-1)
+        vals = (vals * learn_rate).astype(jnp.float32)
+        cover = jnp.stack([cl, cr], axis=1).reshape(-1).astype(jnp.float32)
+        return levels, vals, cover, leaf
+
+    def buildK(codes, g, h, w, edges_mat, rng_keys, reg_lambda,
+               min_rows, min_split_improvement, learn_rate,
+               col_sample_rate, tree_mask, reg_alpha, gamma,
+               min_child_weight):
+        N = codes.shape[1]
+        wK = jnp.broadcast_to(w, g.shape)
+        leaf = jnp.zeros((nk, N), jnp.int32)
+        keysK = jax.vmap(
+            lambda kk: jax.random.split(kk, max_depth))(rng_keys)
+
+        def draw_maskK(d):
+            L = 2 ** d
+            ps = jax.vmap(
+                lambda kd: jax.random.uniform(kd, (L, F)))(
+                    keysK[:, d]) < col_sample_rate
+            ps = ps.at[:, :, 0].set(
+                (ps.any(axis=2) & ps[:, :, 0]) | ~ps.any(axis=2))
+            return ps & tree_mask[:, None, :]
+
+        if hist_mode == "subtract":
+            H, Hc = lev0(codes, leaf, g, h, wK)
+            H_carry = jnp.pad(Hc, ((0, 0), (0, 0), (0, 0), (0, Wp - 1),
+                                   (0, 0), (0, 0)))
+        else:
+            H = lev0(codes, leaf, g, h, wK)
+        feat, bin_, na_left, gain, valid, children = \
+            fused_best_splits_batched(
+                H, nbins, reg_lambda, min_rows, min_split_improvement,
+                draw_maskK(0), reg_alpha, gamma, min_child_weight)
+        thr = edges_mat[feat, jnp.clip(bin_, 0, nbins - 1)]
+        leaf = jax.vmap(partition, in_axes=(None, 0, 0, 0, 0, 0, None))(
+            codes, leaf, feat, bin_, na_left, valid, jnp.int32(nbins))
+        lv0 = (feat, thr, na_left, valid)
+        alive = jnp.pad(jnp.stack([valid, valid], axis=2).reshape(nk, -1),
+                        ((0, 0), (0, W - 2)))
+        children = jnp.pad(children, ((0, 0), (0, W - 1), (0, 0)))
+        masks = jnp.stack([
+            jnp.pad(draw_maskK(d), ((0, 0), (0, W - 2 ** d), (0, 0)))
+            for d in range(1, max_depth)])
+
+        def body(carry, mask):
+            if hist_mode == "subtract":
+                leaf, alive, children, H_carry = carry
+            else:
+                leaf, alive, children = carry
+            # all K trees dead (an individually finished tree inside a
+            # live iteration already produces the parent passthrough
+            # bitwise on its own — every slot is invalid, so collapse
+            # and routing are the identity for it)
+            dead = ~jnp.any(alive)
+            if hist_mode == "subtract":
+                H, H_carry = scan_lev(codes, leaf, g, h, wK, H_carry,
+                                      dead)
+            else:
+                H = scan_lev(codes, leaf, g, h, wK)
+            feat, bin_, na_left, gain, valid, ch = \
+                fused_best_splits_batched(
+                    H, nbins, reg_lambda, min_rows,
+                    min_split_improvement, mask, reg_alpha, gamma,
+                    min_child_weight)
+            valid = valid & alive
+            children = _collapse(valid, ch)
+            alive = jnp.stack([valid[:, :Wp], valid[:, :Wp]],
+                              axis=2).reshape(nk, -1)
+            thr = edges_mat[feat, jnp.clip(bin_, 0, nbins - 1)]
+            leaf = jax.lax.cond(
+                dead,
+                lambda c, l, f, b, na, v: 2 * l,
+                lambda c, l, f, b, na, v: jax.vmap(
+                    partition, in_axes=(None, 0, 0, 0, 0, 0, None))(
+                    c, l, f, b, na, v, jnp.int32(nbins)),
+                codes, leaf, feat, bin_, na_left, valid)
+            out = (leaf, alive, children, H_carry) \
+                if hist_mode == "subtract" else (leaf, alive, children)
+            return out, (feat, thr, na_left, valid)
+
+        carry0 = (leaf, alive, children, H_carry) \
+            if hist_mode == "subtract" else (leaf, alive, children)
+        carry, ys = jax.lax.scan(body, carry0, masks)
+        leaf, children = carry[0], carry[2]
+        levels = [lv0] + [
+            tuple(y[i][:, : 2 ** (i + 1)] for y in ys)
+            for i in range(max_depth - 1)]
+        gl, hl, cl = children[..., 0], children[..., 1], children[..., 2]
+        gr, hr, cr = children[..., 3], children[..., 4], children[..., 5]
+
+        from .hist import newton_value
+
+        def newton(gc, hc, cc):
+            return jnp.where(cc > 0,
+                             newton_value(gc, hc, reg_lambda, reg_alpha),
+                             0.0)
+        vals = jnp.stack([newton(gl, hl, cl), newton(gr, hr, cr)],
+                         axis=2).reshape(nk, -1)
+        vals = (vals * learn_rate).astype(jnp.float32)
+        cover = jnp.stack([cl, cr], axis=2).reshape(nk, -1) \
+            .astype(jnp.float32)
+        return levels, vals, cover, leaf
+
+    if nk > 1:
+        return _ledger("tree_build_scan_batched", jax.jit(buildK),
+                       orig=buildK)
+    return _ledger("tree_build_scan", jax.jit(build), orig=build)
+
+
 def resolve_mono(params, di) -> Optional[tuple]:
     """monotone_constraints dict -> per-feature tuple in di.specs order."""
     mc = getattr(params, "monotone_constraints", None)
@@ -1239,6 +1571,85 @@ def resolve_hist_layout(params, *, hist_mode=None, mono=None, plan=None,
                 "to downgrade automatically")
         return "dense"
     return "check" if layout == "check" else "sparse"
+
+
+def varbin_kernel_engages(bin_counts, nbins: int, F: int) -> bool:
+    """Whether the variable-bin packed kernel would carry this frame's
+    histogram levels — make_build_tree_fn's gate, factored out so
+    resolve_tree_program shares it: the scan build composes with the
+    uniform kernels only, so tree_program="auto" keeps per-level
+    programs where varbin wins (the autotuner arbitrates the rest)."""
+    if bin_counts is None:
+        return False
+    from ...runtime.cluster import cluster
+    on_tpu = cluster().mesh.devices.flat[0].platform == "tpu"
+    if not (on_tpu or os.environ.get("H2O3_TPU_HIST_IMPL", "") == "varbin"):
+        return False
+    return sum(min(b, nbins) + 9 for b in bin_counts) < F * (nbins + 1)
+
+
+def resolve_tree_program(params, *, hist_layout: str = "dense", mono=None,
+                         plan=None, hier: bool = False, bin_counts=None,
+                         F: Optional[int] = None,
+                         n_padded: Optional[int] = None) -> str:
+    """Validate + normalize the ``tree_program`` knob (mirrors
+    resolve_hist_layout; drivers call this once, and ``"check"`` is
+    resolved to ``"scan"`` AFTER run_program_crosscheck).  Returns the
+    BUILDER value — "level" or "scan" — or "check" for the driver to act
+    on first.
+
+    ``"auto"`` resolves to the fixed default ("level") here — drivers
+    that route through ``autotune.resolve_tree_knobs`` get the tuned
+    choice instead, so with ``H2O3_TPU_AUTOTUNE=off`` the pipeline stays
+    bit-identical to the pre-scan per-level path.  The scan composes
+    with the dense layout, uniform kernels and the plain (non-mono /
+    non-EFB / non-hier) split search at effective depth >= 2;
+    "auto"/"check" downgrade silently to "level" outside that envelope,
+    while an EXPLICIT "scan" raises for missing features (mono / EFB /
+    hier / engaged sparse levels / depth < 2) but is allowed to forfeit
+    the variable-bin kernel (the one-launch program vs the packed
+    per-feature kernel is a cost tradeoff, not a correctness one)."""
+    prog = str(getattr(params, "tree_program", "auto")).lower()
+    if prog not in ("level", "scan", "auto", "check"):
+        raise ValueError(
+            f"tree_program={prog!r}: use auto | level | scan | check")
+    if prog == "level":
+        return "level"
+    blocked = mono is not None or plan is not None or hier
+    md = int(getattr(params, "max_depth", 5))
+    nb = int(getattr(params, "nbins", 64))
+    thr = int(getattr(params, "sparse_depth_threshold", 8))
+    if F is not None and n_padded is not None:
+        md = effective_max_depth(md, nb, F, n_padded, hist_layout, thr)
+    t0 = max(1, min(thr, dense_mem_cap(nb, F)) if F is not None else thr)
+    sparse = hist_layout in ("sparse", "check") and md > t0
+    if prog == "scan":
+        if blocked:
+            raise ValueError(
+                "tree_program='scan' does not compose with monotone "
+                "constraints, EFB bundling or the hierarchical split "
+                "search; use tree_program='auto' to downgrade "
+                "automatically")
+        if sparse:
+            raise ValueError(
+                "tree_program='scan' requires the dense layout at every "
+                "level (the scan body is ONE fixed-width program; node-"
+                "sparse slot maps reshape per level); use "
+                "hist_layout='dense' or tree_program='auto'")
+        if md < 2:
+            raise ValueError(
+                "tree_program='scan' needs effective max_depth >= 2 (a "
+                "depth-1 tree is the root level only — nothing to scan); "
+                "use tree_program='auto' to downgrade automatically")
+        return "scan"
+    if prog == "auto":
+        return "level"
+    # "check": compare only where the scan can actually engage —
+    # otherwise both builds would BE the level build (nothing to check)
+    if blocked or sparse or md < 2 \
+            or varbin_kernel_engages(bin_counts, nb, F or 0):
+        return "level"
+    return "check"
 
 
 def run_hist_crosscheck(codes, g, h, w, edges_mat, rng_key, *, max_depth,
@@ -1505,6 +1916,99 @@ def run_layout_crosscheck(codes, g, h, w, edges_mat, rng_keys, *,
                 ")")
 
 
+def run_program_crosscheck(codes, g, h, w, edges_mat, rng_keys, *,
+                           max_depth, nbins, F, n_padded,
+                           hist_precision="f32", hist_mode="subtract",
+                           split_mode="fused", tree_masks=None,
+                           reg_lambda=0.0, min_rows=1.0,
+                           min_split_improvement=1e-5, learn_rate=0.1,
+                           col_sample_rate=1.0, reg_alpha=0.0, gamma=0.0,
+                           min_child_weight=0.0, atol=1e-4):
+    """The tree_program="check" driver assert: grow ONE tree (or one
+    batched-K round — g/h/rng_keys with leading [K]) with the scan-fused
+    program and one with the per-level program on identical real inputs,
+    and raise AssertionError on divergence.
+
+    The scan runs every level at the padded width 2^(max_depth-1), so
+    the einsum histogram's row blocking can associate f32 row sums
+    differently than the level path's true-width programs once N splits
+    blocks: structure (valid flags, feat/na_left where valid, row
+    routing) is compared EXACTLY, thresholds and leaf values to f32
+    tolerance — the same contract run_layout_crosscheck enforces for the
+    node-sparse layout.  Dead-slot candidate records are masked out of
+    the compare (nothing reads them; partition routes by valid)."""
+    g, h = jnp.asarray(g), jnp.asarray(h)
+    if g.ndim == 1:
+        g, h = g[None], h[None]
+    K = g.shape[0]
+    rng_keys = jnp.asarray(rng_keys)
+    if rng_keys.ndim == 1:
+        rng_keys = rng_keys[None]
+    tm = jnp.asarray(tree_masks, bool) if tree_masks is not None \
+        else jnp.ones((K, F), bool)
+    if tm.ndim == 1:
+        tm = tm[None]
+    wK = jnp.broadcast_to(jnp.asarray(w), g.shape)
+    hm = hist_mode if hist_mode in ("subtract", "full") else "subtract"
+    sm = split_mode if split_mode in ("fused", "separate") else "fused"
+    outs = {}
+    for prog in ("level", "scan"):
+        fn = make_build_tree_fn(
+            max_depth, nbins, F, n_padded, hist_precision,
+            hist_mode=hm, nk=K if K > 1 else 1,
+            split_mode="fused" if K > 1 else sm,
+            tree_program=prog)
+        if K > 1:
+            levels, vals, cover, leaf = fn(
+                codes, g, h, wK, edges_mat, rng_keys, reg_lambda,
+                min_rows, min_split_improvement, learn_rate,
+                col_sample_rate, tm, reg_alpha, gamma, min_child_weight)
+        else:
+            levels, vals, cover, leaf = fn(
+                codes, g[0], h[0], wK[0], edges_mat, rng_keys[0],
+                reg_lambda, min_rows, min_split_improvement, learn_rate,
+                col_sample_rate, tm[0], reg_alpha, gamma,
+                min_child_weight)
+            levels = [tuple(x[None] for x in lv) for lv in levels]
+            vals, leaf = vals[None], leaf[None]
+        outs[prog] = jax.device_get(
+            [[list(lv) for lv in levels], vals, leaf])
+    lv_l, v_l, leaf_l = outs["level"]
+    lv_s, v_s, leaf_s = outs["scan"]
+    for k in range(K):
+        for d in range(len(lv_l)):
+            valid_d = np.asarray(lv_l[d][3][k], bool)
+            if not np.array_equal(valid_d,
+                                  np.asarray(lv_s[d][3][k], bool)):
+                raise AssertionError(
+                    f"tree_program='check': scan and level builds "
+                    f"disagree on valid at tree {k} level {d}")
+            for name, i in (("feat", 0), ("na_left", 2)):
+                a = np.asarray(lv_l[d][i][k])
+                b = np.asarray(lv_s[d][i][k])
+                if not np.array_equal(a[valid_d], b[valid_d]):
+                    raise AssertionError(
+                        f"tree_program='check': {name} diverges at tree "
+                        f"{k} level {d}")
+            a = np.asarray(lv_l[d][1][k])
+            b = np.asarray(lv_s[d][1][k])
+            if not np.allclose(a[valid_d], b[valid_d], atol=atol,
+                               rtol=1e-5):
+                raise AssertionError(
+                    f"tree_program='check': split thresholds diverge at "
+                    f"tree {k} level {d}")
+        if not np.array_equal(leaf_l[k], leaf_s[k]):
+            raise AssertionError(
+                "tree_program='check': final leaf routing differs "
+                f"between the scan and level builds for tree {k}")
+        if not np.allclose(v_l[k], v_s[k], atol=atol, rtol=1e-4):
+            raise AssertionError(
+                f"tree_program='check': leaf values diverge for tree {k} "
+                f"(max abs diff "
+                f"{np.max(np.abs(np.asarray(v_l[k]) - np.asarray(v_s[k])))}"
+                ")")
+
+
 @functools.lru_cache(maxsize=None)
 def make_tree_scan_fn(mode: str, tweedie_power: float, quantile_alpha: float,
                       huber_alpha: float, max_depth: int, nbins: int, F: int,
@@ -1514,7 +2018,8 @@ def make_tree_scan_fn(mode: str, tweedie_power: float, quantile_alpha: float,
                       hist_mode: str = "subtract",
                       split_mode: str = "fused",
                       hist_layout: str = "dense",
-                      sparse_depth_threshold: int = 8):
+                      sparse_depth_threshold: int = 8,
+                      tree_program: str = "level"):
     """Scan a CHUNK of boosting/bagging rounds in ONE device dispatch.
 
     The per-tree driver loop (gradients -> row/column sample -> grow ->
@@ -1536,12 +2041,14 @@ def make_tree_scan_fn(mode: str, tweedie_power: float, quantile_alpha: float,
     if mono is not None or plan is not None or hier:
         split_mode = "separate"          # no fused path for these builds
         hist_layout = "dense"            # nor a sparse one (resolve_*)
+        tree_program = "level"           # nor a scan-fused one
     bt_fn = make_build_tree_fn(max_depth, nbins, F, n_padded, hist_precision,
                                hier=hier, bin_counts=bin_counts, mono=mono,
                                plan=plan, hist_mode=hist_mode,
                                split_mode=split_mode,
                                hist_layout=hist_layout,
-                               sparse_depth_threshold=sparse_depth_threshold)
+                               sparse_depth_threshold=sparse_depth_threshold,
+                               tree_program=tree_program)
 
     def scan_fn(codes, y, w, F0, edges_mat, rng0, chunk_no, nchunk,
                 reg_lambda, min_rows, min_split_improvement, learn_rate,
@@ -1595,7 +2102,8 @@ def make_multinomial_scan_fn(K: int, max_depth: int, nbins: int, F: int,
                              split_mode: str = "fused",
                              mode: str = "multinomial",
                              hist_layout: str = "dense",
-                             sparse_depth_threshold: int = 8):
+                             sparse_depth_threshold: int = 8,
+                             tree_program: str = "level"):
     """Scan a chunk of K-tree rounds in ONE dispatch.
 
     Each round grows K one-vs-rest trees — on softmax gradients for
@@ -1621,6 +2129,7 @@ def make_multinomial_scan_fn(K: int, max_depth: int, nbins: int, F: int,
     if hier or plan is not None:
         split_mode = "separate"          # no fused path for these builds
         hist_layout = "dense"            # nor a sparse one (resolve_*)
+        tree_program = "level"           # nor a scan-fused one
     # the builder clamps internally; the level-stacking loop below must
     # iterate the SAME effective count — layout-aware, like the builder
     max_depth = effective_max_depth(max_depth, nbins, F, n_padded,
@@ -1633,7 +2142,8 @@ def make_multinomial_scan_fn(K: int, max_depth: int, nbins: int, F: int,
                                nk=K if batched else 1,
                                split_mode=split_mode,
                                hist_layout=hist_layout,
-                               sparse_depth_threshold=sparse_depth_threshold)
+                               sparse_depth_threshold=sparse_depth_threshold,
+                               tree_program=tree_program)
 
     def scan_fn(codes, Y1, w, F0, edges_mat, rng0, chunk_no, nchunk,
                 reg_lambda, min_rows, min_split_improvement, learn_rate,
@@ -1773,7 +2283,8 @@ def build_tree(codes, g, h, w, edges, nbins: int, max_depth: int,
                min_child_weight: float = 0.0, hist_precision: str = "bf16",
                hier: bool = False, mono=None, hist_mode: str = "subtract",
                split_mode: str = "fused", hist_layout: str = "dense",
-               sparse_depth_threshold: int = 8):
+               sparse_depth_threshold: int = 8,
+               tree_program: str = "level"):
     """Grow one tree — convenience wrapper around make_build_tree_fn.
 
     ``edges`` may be the per-feature edge list (converted to the dense
@@ -1791,10 +2302,12 @@ def build_tree(codes, g, h, w, edges, nbins: int, max_depth: int,
     if mono is not None or hier:
         split_mode = "separate"          # no fused path for these builds
         hist_layout = "dense"            # nor a sparse one (resolve_*)
+        tree_program = "level"           # nor a scan-fused one
     fn = make_build_tree_fn(max_depth, nbins, F, N, hist_precision,
                             hier=hier, mono=mono, hist_mode=hist_mode,
                             split_mode=split_mode, hist_layout=hist_layout,
-                            sparse_depth_threshold=sparse_depth_threshold)
+                            sparse_depth_threshold=sparse_depth_threshold,
+                            tree_program=tree_program)
     from ...runtime import observability as obs
     with obs.span("tree_build", depth=max_depth, rows=int(N)):
         levels, vals, cover, leaf = fn(codes, g, h, w, edges_mat, rng_key,

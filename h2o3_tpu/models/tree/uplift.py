@@ -227,6 +227,12 @@ class UpliftDRF(SharedTree):
         # [2^d, F, B] grid (both arms share one slot map — the leaf
         # assignment is shared).  "check" grows the first tree both ways.
         hist_layout = knobs.hist_layout
+        # tree_program: uplift's bespoke two-arm grow_tree loop has no
+        # scan-fused build (its divergence split search interleaves both
+        # treatment arms between levels), so any scan request silently
+        # rides the per-level program.  The tuner never tunes the knob
+        # for kind="uplift"; this covers an explicit tree_program="scan".
+        tree_program = "level"
         if hist_layout == "check" and (hist_mode == "check"
                                        or split_mode == "check"):
             raise ValueError(
@@ -512,6 +518,7 @@ class UpliftDRF(SharedTree):
         model.output["init_score"] = 0.0
         model.output["nclass_trees"] = 1
         model.output["hist_layout"] = hist_layout
+        model.output["tree_program"] = tree_program
 
         from ...metrics.uplift import uplift_metrics
         X = model._design(frame)

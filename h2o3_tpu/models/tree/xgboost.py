@@ -110,10 +110,11 @@ class XGBoost(GBM):
                 f"booster={params.booster!r} not supported (gbtree, dart); "
                 "gblinear maps to GLM in this framework")
         from .shared import (resolve_hist_layout, resolve_hist_mode,
-                             resolve_split_mode)
+                             resolve_split_mode, resolve_tree_program)
         resolve_hist_mode(params)        # fail fast on a bad hist_mode
         resolve_split_mode(params)       # ... and on a bad split_mode
         resolve_hist_layout(params)      # ... and on a bad hist_layout
+        resolve_tree_program(params)     # ... and on a bad tree_program
         ModelBuilder.__init__(self, params)
 
     def train(self, frame, valid=None, warm_start=None):

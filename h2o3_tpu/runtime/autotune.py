@@ -1,8 +1,9 @@
 """Cost-model-driven autotuner: the performance knobs choose themselves.
 
-The tree pipeline carries six interacting performance knobs (``hist_mode``,
-``hist_layout``, ``split_mode``, ``sparse_depth_threshold``,
-``reduce_mode``, the serving traversal ``impl``) whose best setting flips
+The tree pipeline carries seven interacting performance knobs
+(``hist_mode``, ``hist_layout``, ``split_mode``,
+``sparse_depth_threshold``, ``tree_program``, ``reduce_mode``, the
+serving traversal ``impl``) whose best setting flips
 with (shape, depth, K, mesh geometry) — the GPU tree-boosting literature
 shows the histogram/split strategy genuinely inverts with bin count and
 depth.  PR 10's compile ledger already publishes the signals a tuner
@@ -90,6 +91,15 @@ _PEAKS = {
     "gpu": (1.0e14, 1.0e12),
     "cpu": (5.0e10, 5.0e10),
 }
+
+# per-dispatch overhead for the tree_program dimension: each kernel
+# program the build launches separately costs roughly this much in
+# driver/dispatch latency (a tunnelled-backend round trip is ~50 ms —
+# PROFILE.md round 4 — but even local dispatch is O(100 us)).  The
+# level-unrolled build pays it 2*depth times per tree (hist + split
+# records per level), the scan-fused build O(1) times — this term is
+# what makes the padded-width scan win on deep trees at modest N.
+_DISPATCH_OVERHEAD_S = 5e-4
 
 # thread-local measurement scope: the decision entry whose chosen config
 # is currently executing on this thread (drivers activate it at resolve)
@@ -180,13 +190,20 @@ def _ledger_calibration() -> float:
 
 def _predict_tree_cost(F: int, N: int, K: int, max_depth: int, nbins: int,
                        *, hist_mode: str, split_mode: str,
-                       hist_layout: str, threshold: int) -> float:
+                       hist_layout: str, threshold: int,
+                       tree_program: str = "level") -> float:
     """Roofline seconds for one K-tree build under one candidate config.
 
     Per-level byte/flop counts come from ``hist.hist_level_bytes`` /
     ``hist.split_search_passes`` so the estimate lives next to the
     kernels it models; infeasible configs (dense grid over the histogram
-    budget) price at +inf and can never win."""
+    budget) price at +inf and can never win.
+
+    ``tree_program="scan"`` runs every level past the root at the padded
+    width 2^(max_depth-1) (one fixed-width program) but dispatches O(1)
+    kernel programs instead of 2*depth — the ``_DISPATCH_OVERHEAD_S``
+    term carries that tradeoff, so deep trees at modest N pick the scan
+    and wide shallow frames keep per-level programs."""
     from ..models.tree.hist import hist_level_bytes, split_search_passes
     peak_f, peak_b = _peaks()
     B = nbins + 1
@@ -195,7 +212,9 @@ def _predict_tree_cost(F: int, N: int, K: int, max_depth: int, nbins: int,
     for d in range(max_depth):
         layout_d = ("sparse" if hist_layout == "sparse" and d >= threshold
                     else "dense")
-        b = hist_level_bytes(N, F, B, 2 ** d, K,
+        width = 2 ** (max_depth - 1) if (tree_program == "scan" and d > 0) \
+            else 2 ** d
+        b = hist_level_bytes(N, F, B, width, K,
                              layout=layout_d, hist_mode=hist_mode)
         if b is None:
             return float("inf")
@@ -203,8 +222,10 @@ def _predict_tree_cost(F: int, N: int, K: int, max_depth: int, nbins: int,
         # one multiply-add per (row, feature, class) scatter contribution
         rows = N if (hist_mode == "full" or d == 0) else N // 2
         total_flops += 2.0 * rows * F * K
-    return max(total_flops / peak_f,
-               total_bytes / peak_b) * _ledger_calibration()
+    launches = 2 if tree_program == "scan" else 2 * max_depth
+    return (max(total_flops / peak_f, total_bytes / peak_b)
+            * _ledger_calibration()
+            + launches * _DISPATCH_OVERHEAD_S)
 
 
 def _tree_candidates(F: int, N: int, K: int, max_depth: int, nbins: int,
@@ -221,6 +242,16 @@ def _tree_candidates(F: int, N: int, K: int, max_depth: int, nbins: int,
                    else (tuned.get("_split_mode_pin", "fused"),))
     if mono is not None or plan is not None or hier:
         split_modes = ("separate",)
+    # the scan-fused program composes with dense uniform kernels only,
+    # and needs >= 2 effective levels.  The depth gate is conservative
+    # w.r.t. the builder (row cap from N <= n_padded), so a tuner-picked
+    # "scan" can never hit the builder's fail-fast validation.
+    row_cap = max(1, int(math.ceil(math.log2(max(N, 2)))) + 1)
+    from ..models.tree.shared import dense_mem_cap as _dmc
+    scan_ok = (mono is None and plan is None and not hier
+               and min(max_depth, row_cap, _dmc(nbins, F)) >= 2)
+    progs = (("level", "scan") if tuned.get("tree_program")
+             else (tuned.get("_tree_program_pin", "level"),))
     out = []
     for hm in hist_modes:
         layouts: Tuple[Tuple[str, int], ...]
@@ -250,9 +281,14 @@ def _tree_candidates(F: int, N: int, K: int, max_depth: int, nbins: int,
             for layout, thr in dict.fromkeys(layouts):
                 if layout == "sparse" and not sparse_ok:
                     continue
-                out.append({"hist_mode": hm, "split_mode": sm,
-                            "hist_layout": layout,
-                            "sparse_depth_threshold": int(thr)})
+                for tp in progs:
+                    if tp == "scan" and (layout == "sparse"
+                                         or not scan_ok):
+                        continue
+                    out.append({"hist_mode": hm, "split_mode": sm,
+                                "hist_layout": layout,
+                                "sparse_depth_threshold": int(thr),
+                                "tree_program": tp})
     # dedupe while keeping model-preferred ordering stable
     seen, uniq = set(), []
     for c in out:
@@ -264,8 +300,11 @@ def _tree_candidates(F: int, N: int, K: int, max_depth: int, nbins: int,
 
 
 def _cand_key(c: dict) -> str:
+    # stale cached choices keyed without the |p segment fall through
+    # _decide's candidate-membership re-pick — no migration needed
     return (f"{c['hist_mode']}|{c['split_mode']}|{c['hist_layout']}"
-            f"|t{c['sparse_depth_threshold']}")
+            f"|t{c['sparse_depth_threshold']}"
+            f"|p{c.get('tree_program', 'level')}")
 
 
 def _predict_costs(F: int, N: int, K: int, max_depth: int, nbins: int,
@@ -276,7 +315,8 @@ def _predict_costs(F: int, N: int, K: int, max_depth: int, nbins: int,
         _cand_key(c): _predict_tree_cost(
             F, N, K, max_depth, nbins, hist_mode=c["hist_mode"],
             split_mode=c["split_mode"], hist_layout=c["hist_layout"],
-            threshold=c["sparse_depth_threshold"])
+            threshold=c["sparse_depth_threshold"],
+            tree_program=c.get("tree_program", "level"))
         for c in candidates
     }
 
@@ -405,6 +445,7 @@ class TreeKnobs:
     split_mode: str
     hist_layout: str                     # dense | sparse | check
     sparse_depth_threshold: int
+    tree_program: str                    # level | scan | check
     sources: dict                        # knob -> user|default|model|...
     sig: Optional[str] = None            # signature when the tuner engaged
     run_key: Optional[str] = None        # config key actually running
@@ -424,10 +465,12 @@ def resolve_tree_knobs(params, *, kind: str, F: int, N: int, K: int = 1,
     keep the depth ledger they were validated against."""
     from ..models.tree.shared import (resolve_hist_layout,
                                       resolve_hist_mode,
-                                      resolve_split_mode)
+                                      resolve_split_mode,
+                                      resolve_tree_program)
     hm_raw = str(getattr(params, "hist_mode", "auto")).lower()
     sm_raw = str(getattr(params, "split_mode", "auto")).lower()
     hl_raw = str(getattr(params, "hist_layout", "auto")).lower()
+    tp_raw = str(getattr(params, "tree_program", "auto")).lower()
     thr_raw = int(getattr(params, "sparse_depth_threshold",
                           DEFAULT_SPARSE_THRESHOLD))
     max_depth = int(getattr(params, "max_depth", 5))
@@ -439,12 +482,16 @@ def resolve_tree_knobs(params, *, kind: str, F: int, N: int, K: int = 1,
     split_mode = resolve_split_mode(params, mono=mono, plan=plan, hier=hier)
     hist_layout = resolve_hist_layout(params, hist_mode=hist_mode,
                                       mono=mono, plan=plan, hier=hier)
+    tree_program = resolve_tree_program(params, hist_layout=hist_layout,
+                                        mono=mono, plan=plan, hier=hier,
+                                        F=F)
     sources = {
         "hist_mode": "default" if hm_raw == "auto" else "user",
         "split_mode": "default" if sm_raw == "auto" else "user",
         "hist_layout": "default" if hl_raw == "auto" else "user",
         "sparse_depth_threshold":
             "default" if thr_raw == DEFAULT_SPARSE_THRESHOLD else "user",
+        "tree_program": "default" if tp_raw == "auto" else "user",
     }
     tuned = {
         "hist_mode": hm_raw == "auto",
@@ -453,19 +500,25 @@ def resolve_tree_knobs(params, *, kind: str, F: int, N: int, K: int = 1,
         "sparse_depth_threshold":
             thr_raw == DEFAULT_SPARSE_THRESHOLD and not checkpoint
             and hist_layout in ("sparse", "auto"),
+        # uplift's bespoke two-arm grow loop has no scan-fused build, so
+        # its signature never tunes tree_program (the pin stays "level")
+        "tree_program": tp_raw == "auto" and kind != "uplift",
         "_hist_mode_pin": hist_mode,
         "_split_mode_pin": split_mode,
         "_hist_layout_pin": hist_layout,
         "_threshold_pin": thr_raw,
+        "_tree_program_pin": tree_program,
     }
     mode = autotune_mode()
     # checks bypass tuning (the oracle decides), off bypasses everything
-    if (mode == "off" or "check" in (hist_mode, split_mode, hist_layout)
+    if (mode == "off" or "check" in (hist_mode, split_mode, hist_layout,
+                                     tree_program)
             or not any(tuned[k] for k in ("hist_mode", "split_mode",
                                           "hist_layout",
-                                          "sparse_depth_threshold"))):
+                                          "sparse_depth_threshold",
+                                          "tree_program"))):
         return TreeKnobs(hist_mode, split_mode, hist_layout, thr_raw,
-                         sources)
+                         tree_program, sources)
 
     sig = _signature(kind, F, N, K, max_depth, nbins)
     with _lock:
@@ -473,13 +526,13 @@ def resolve_tree_knobs(params, *, kind: str, F: int, N: int, K: int = 1,
                                       plan=plan, hier=hier, tuned=tuned)
         if not candidates:
             return TreeKnobs(hist_mode, split_mode, hist_layout, thr_raw,
-                             sources)
+                             tree_program, sources)
         predicted = _predict_costs(F, N, K, max_depth, nbins, candidates)
         picked = _decide(sig, candidates, predicted, mode)
         ent, run = picked["entry"], picked["run"]
         knobs_out = {}
         for knob in ("hist_mode", "split_mode", "hist_layout",
-                     "sparse_depth_threshold"):
+                     "sparse_depth_threshold", "tree_program"):
             if tuned[knob]:
                 knobs_out[knob] = run[knob]
                 sources[knob] = ("explore" if picked["run_key"] ==
@@ -491,6 +544,7 @@ def resolve_tree_knobs(params, *, kind: str, F: int, N: int, K: int = 1,
         knobs_out.get("split_mode", split_mode),
         knobs_out.get("hist_layout", hist_layout),
         int(knobs_out.get("sparse_depth_threshold", thr_raw)),
+        knobs_out.get("tree_program", tree_program),
         sources, sig=sig, run_key=picked["run_key"])
 
 

@@ -250,10 +250,10 @@ def test_forced_wrong_model_self_corrects(tuner_on, monkeypatch):
     # best is fast (fed through the public measurement sink, as
     # xprof.maybe_device_sync would)
     tuner_on.activate(tuner_on.TreeKnobs(
-        "subtract", "fused", "dense", 8, {}, sig=k.sig, run_key=wrong))
+        "subtract", "fused", "dense", 8, "level", {}, sig=k.sig, run_key=wrong))
     tuner_on.on_device_sample("tree_scan", 2.0)
     tuner_on.activate(tuner_on.TreeKnobs(
-        "subtract", "fused", "dense", 8, {}, sig=k.sig, run_key=right))
+        "subtract", "fused", "dense", 8, "level", {}, sig=k.sig, run_key=right))
     tuner_on.on_device_sample("tree_scan", 0.1)
 
     row = tuner_on.decision_table()["decisions"][0]
@@ -313,10 +313,11 @@ def test_tuned_auto_matches_pinned_choice_bitwise(cl, rng, tuner_on):
     rows = [d for d in t["decisions"]
             if d["signature"].startswith("gbm:")]
     assert rows, "training under the tuner must record a decision"
-    hm, sm, layout, thr = rows[0]["choice"].split("|")
+    hm, sm, layout, thr, prog = rows[0]["choice"].split("|")
     tuner_on.reset()
     m_pin = GBM(**kw, hist_mode=hm, split_mode=sm, hist_layout=layout,
-                sparse_depth_threshold=int(thr[1:])).train(fr)
+                sparse_depth_threshold=int(thr[1:]),
+                tree_program=prog[1:]).train(fr)
     a = np.asarray(m_auto.predict(fr).vec("predict").to_numpy())
     b = np.asarray(m_pin.predict(fr).vec("predict").to_numpy())
     np.testing.assert_array_equal(a, b)

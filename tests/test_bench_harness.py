@@ -222,6 +222,38 @@ def test_gate_direction_classifier():
     assert bench_gate.classify("serve_latency_seconds") == "lower"
     assert bench_gate.classify("warmup_seconds") == "lower"
     assert bench_gate.classify("serve_qps") == "higher"
+    # count-style metrics: dispatch/launch/recompile counts gate
+    # lower-better from their first recorded round
+    assert bench_gate.classify("treescan_launches_per_tree_scan") == "lower"
+    assert bench_gate.classify("treescan_launches_per_tree_level") == "lower"
+    assert bench_gate.classify("hist_dispatch_total") == "lower"
+    assert bench_gate.classify("recompile_count") == "lower"
+    # ... but the ledger echo compiles_total stays informational
+    assert bench_gate.classify("compiles_total") == "info"
+    # speedup ratios are higher-better
+    assert bench_gate.classify("treescan_scan_vs_level_speedup") == "higher"
+    assert bench_gate.classify("serve_packed_speedup_vs_numpy") == "higher"
+
+
+def test_gate_count_metric_regression(tmp_path):
+    """A launch-count blow-up (the treescan dispatch pin) regresses; a
+    count that shrinks or holds passes."""
+    rec = {"metric": "serve_qps", "value": 2000.0,
+           "extra": {"treescan_launches_per_tree_scan": 2,
+                     "serve_qps": 2000.0}}
+    base = _write(tmp_path, "BENCH_r01.json", rec)
+    worse = {"metric": "serve_qps", "value": 2000.0,
+             "extra": {"treescan_launches_per_tree_scan": 20,
+                       "serve_qps": 2000.0}}
+    cand = _write(tmp_path, "cand.json", worse)
+    rc, report = _gate(tmp_path, cand, [base])
+    assert rc == 1 and "treescan_launches_per_tree_scan" in report
+    same = {"metric": "serve_qps", "value": 2000.0,
+            "extra": {"treescan_launches_per_tree_scan": 2,
+                      "serve_qps": 2000.0}}
+    cand2 = _write(tmp_path, "cand2.json", same)
+    rc, _ = _gate(tmp_path, cand2, [base])
+    assert rc == 0
 
 
 def test_gate_serving_latency_regression(tmp_path):

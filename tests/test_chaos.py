@@ -220,6 +220,74 @@ def test_kill_resume_mid_deep_tree(cl, tmp_path):
                                rtol=1e-4, atol=1e-4)
 
 
+_TRAIN_SCAN = textwrap.dedent("""
+    import sys
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import h2o3_tpu
+    h2o3_tpu.init()
+    from h2o3_tpu.frame.parse import import_file
+    from h2o3_tpu.models import GBM
+    fr = import_file(sys.argv[1], destination_frame="chaos_fr")
+    m = GBM(response_column="y", ntrees={nt}, max_depth=5, learn_rate=0.2,
+            seed=7, score_tree_interval=2,
+            tree_program="scan").train(fr)
+    assert m.output["tree_program"] == "scan"
+    np.save(sys.argv[2], m.predict(fr).to_numpy()[:, 0])
+    print("TRAINED", m.output["ntrees_trained"])
+""").format(nt=NTREES)
+
+
+def test_kill_resume_mid_scan_program(cl, tmp_path):
+    """Chaos row for the scan-fused tree program: under
+    ``tree_program="scan"`` the per-level host loop is gone, so the
+    tree-chunk fence is the only interruption point and snapshots carry
+    the coarser per-tree-chunk granularity tag.  The kill lands at a
+    chunk fence mid-scan-training; resume must restart from the
+    per-tree snapshot (cursor proves which one, and that it is
+    chunk-granular), rebuild the scan program in a fresh process, and
+    reproduce the uninterrupted run's predictions — the snapshot
+    granularity change loses no recoverability."""
+    csv = _write_csv(tmp_path / "chaos_scan.csv")
+    base_dir = tmp_path / "base_scan"
+    base_dir.mkdir()
+
+    base_npy = str(tmp_path / "base_scan.npy")
+    out = _run(_TRAIN_SCAN, _chaos_env(base_dir), csv, base_npy)
+    assert f"TRAINED {NTREES}" in out.stdout
+
+    kill_dir = tmp_path / "kill_scan"
+    kill_dir.mkdir()
+    kill_npy = str(tmp_path / "kill_scan.npy")
+    _run(_TRAIN_SCAN,
+         _chaos_env(kill_dir,
+                    {"H2O3_TPU_FAULT_INJECT":
+                     f"tree_chunk:0:{KILL_AT_CHUNK}"}),
+         csv, kill_npy, expect_rc=137)
+    assert not os.path.exists(kill_npy)          # it really died mid-train
+    (entry_path,) = kill_dir.glob("job_*.json")
+    entry = json.loads(entry_path.read_text())
+    assert entry["status"] == "running"
+    assert entry["snapshot_uri"]
+    cursor = entry["snapshot_cursor"]
+    assert cursor["trees_done"] == 2 * (KILL_AT_CHUNK - 1)
+    assert cursor["granularity"] == "tree_chunk"
+
+    res_npy = str(tmp_path / "resumed_scan.npy")
+    out = _run(_RESUME, _chaos_env(kill_dir), csv, res_npy)
+    info = json.loads(
+        next(line for line in out.stdout.splitlines()
+             if line.startswith("RESUME_INFO ")).split(" ", 1)[1])
+    assert info["ntrees"] == NTREES
+    assert info["cursor"]["trees_done"] == 2 * (KILL_AT_CHUNK - 1)
+    assert info["log_proof"] >= 1
+    assert not list(kill_dir.glob("job_*.json"))
+
+    np.testing.assert_allclose(np.load(res_npy), np.load(base_npy),
+                               rtol=1e-4, atol=1e-4)
+
+
 _MULTI_CSV_ROWS = 600
 
 _TRAIN_MULTI = textwrap.dedent("""

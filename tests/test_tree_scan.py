@@ -1,0 +1,270 @@
+"""tree_program="scan": the whole-tree lax.scan program vs the per-level
+dispatch loop.
+
+The scan-fused build must be BITWISE identical to the per-level program
+on every knob combination it supports (padding slots are inert, masks
+are pre-drawn with the level path's exact key sequence), and must
+compile to O(1) kernel launches per tree regardless of depth — that is
+the whole point of the fusion.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu import Frame
+from h2o3_tpu.models import DRF, GBM
+from h2o3_tpu.models.tree.gbm import GBMParameters
+from h2o3_tpu.models.tree.shared import (make_build_tree_fn,
+                                         resolve_tree_program,
+                                         run_program_crosscheck)
+from h2o3_tpu.runtime.xprof import count_kernel_launches
+
+
+# ---------------------------------------------------------- build level
+
+def _problem(rng, F=5, N=256, nbins=16):
+    codes = jnp.asarray(rng.integers(0, nbins, (F, N)), jnp.int32)
+    g = jnp.asarray(rng.normal(size=N), jnp.float32)
+    h = jnp.ones(N, jnp.float32)
+    w = jnp.ones(N, jnp.float32)
+    edges = jnp.sort(jnp.asarray(rng.normal(size=(F, nbins)), jnp.float32),
+                     axis=1)
+    return codes, g, h, w, edges
+
+
+def _args(rng, key=7, min_rows=1.0, col_rate=0.8, F=5):
+    codes, g, h, w, edges = _problem(rng, F=F)
+    tm = jnp.ones(F, bool)
+    return (codes, g, h, w, edges, jax.random.PRNGKey(key), 0.0, min_rows,
+            1e-5, 0.1, col_rate, tm, 0.0, 0.0, 0.0)
+
+
+def _assert_trees_equal(a, b):
+    la, va, ca, fa = a
+    lb, vb, cb, fb = b
+    for d, (x, y) in enumerate(zip(la, lb)):
+        for i, nm in enumerate(("feat", "thr", "na_left", "valid")):
+            np.testing.assert_array_equal(
+                np.asarray(x[i]), np.asarray(y[i]),
+                err_msg=f"level {d} {nm}")
+    np.testing.assert_array_equal(np.asarray(va), np.asarray(vb),
+                                  err_msg="values")
+    np.testing.assert_array_equal(np.asarray(ca), np.asarray(cb),
+                                  err_msg="cover")
+    np.testing.assert_array_equal(np.asarray(fa), np.asarray(fb),
+                                  err_msg="leaf")
+
+
+@pytest.mark.parametrize("hm", ["subtract", "full"])
+@pytest.mark.parametrize("sm", ["separate", "fused"])
+def test_scan_matches_level_bitwise(cl, rng, hm, sm):
+    F, N, nbins, md = 5, 256, 16, 4
+    args = _args(rng)
+    lv = make_build_tree_fn(md, nbins, F, N, "f32", hist_mode=hm,
+                            split_mode=sm)
+    sc = make_build_tree_fn(md, nbins, F, N, "f32", hist_mode=hm,
+                            split_mode=sm, tree_program="scan")
+    _assert_trees_equal(lv(*args), sc(*args))
+
+
+def test_scan_matches_level_early_exit(cl, rng):
+    """min_rows so large nothing past the root splits: the scan's dead
+    predicate must reproduce the level loop's early-terminated tree
+    (inert iterations emit the exact parent-passthrough leaves)."""
+    F, N, nbins, md = 5, 256, 16, 5
+    args = _args(rng, min_rows=200.0, col_rate=1.0)
+    lv = make_build_tree_fn(md, nbins, F, N, "f32")
+    sc = make_build_tree_fn(md, nbins, F, N, "f32", tree_program="scan")
+    _assert_trees_equal(lv(*args), sc(*args))
+
+
+@pytest.mark.parametrize("hm", ["subtract", "full"])
+def test_scan_matches_level_batched(cl, rng, hm):
+    """K-batched build (the multinomial / batched-DRF axis)."""
+    F, N, nbins, md, K = 5, 256, 16, 4, 3
+    codes, _, _, w, edges = _problem(rng, F=F)
+    gK = jnp.asarray(rng.normal(size=(K, N)), jnp.float32)
+    hK = jnp.ones((K, N), jnp.float32)
+    keysK = jax.random.split(jax.random.PRNGKey(11), K)
+    tmK = jnp.ones((K, F), bool)
+    args = (codes, gK, hK, w, edges, keysK, 0.0, 1.0, 1e-5, 0.1, 0.8,
+            tmK, 0.0, 0.0, 0.0)
+    lv = make_build_tree_fn(md, nbins, F, N, "f32", hist_mode=hm, nk=K,
+                            split_mode="fused")
+    sc = make_build_tree_fn(md, nbins, F, N, "f32", hist_mode=hm, nk=K,
+                            split_mode="fused", tree_program="scan")
+    lo, so = lv(*args), sc(*args)
+    for i in range(4):
+        for d, (x, y) in enumerate(zip(lo[0], so[0])):
+            np.testing.assert_array_equal(np.asarray(x[i]),
+                                          np.asarray(y[i]),
+                                          err_msg=f"level {d} field {i}")
+    for i in (1, 2, 3):
+        np.testing.assert_array_equal(np.asarray(lo[i]), np.asarray(so[i]))
+
+
+def test_program_crosscheck_runs_clean(cl, rng):
+    """The tree_program="check" oracle itself (drivers call this on the
+    real first-round gradients)."""
+    codes, g, h, w, edges = _problem(rng)
+    run_program_crosscheck(
+        codes, g, h, w, edges, jax.random.PRNGKey(3),
+        max_depth=4, nbins=16, F=5, n_padded=256,
+        reg_lambda=0.0, min_rows=1.0, min_split_improvement=1e-5,
+        learn_rate=0.1, col_sample_rate=1.0)
+
+
+# --------------------------------------------------------- dispatch pin
+
+def test_launches_per_tree_is_depth_independent(cl, rng):
+    """THE acceptance pin: the scan program compiles to O(1) kernel
+    dispatch sites regardless of depth, while the level program grows
+    one hist launch per level."""
+    F, N, nbins = 5, 256, 16
+    args = _args(rng)
+    scan_counts, level_counts = [], []
+    for md in (3, 4, 6):
+        sc = make_build_tree_fn(md, nbins, F, N, "f32",
+                                tree_program="scan")
+        lv = make_build_tree_fn(md, nbins, F, N, "f32")
+        scan_counts.append(count_kernel_launches(sc, *args))
+        level_counts.append(count_kernel_launches(lv, *args))
+    assert len(set(scan_counts)) == 1, scan_counts   # depth-independent
+    assert scan_counts[0] <= 4, scan_counts          # O(1), small
+    # the level program dispatches per level: strictly increasing in depth
+    assert level_counts[0] < level_counts[1] < level_counts[2], level_counts
+    assert scan_counts[-1] < level_counts[-1]
+
+
+# ------------------------------------------------------- knob semantics
+
+def test_scan_rejects_unsupported_shapes(cl):
+    p = GBMParameters(response_column="y", tree_program="scan", max_depth=5)
+    with pytest.raises(ValueError, match="mono"):
+        resolve_tree_program(p, mono={"x0": 1})
+    with pytest.raises(ValueError, match="hier"):
+        resolve_tree_program(p, hier=True)
+    p1 = GBMParameters(response_column="y", tree_program="scan",
+                       max_depth=1)
+    with pytest.raises(ValueError, match="depth"):
+        resolve_tree_program(p1)
+    deep = GBMParameters(response_column="y", tree_program="scan",
+                         max_depth=12, sparse_depth_threshold=3)
+    with pytest.raises(ValueError, match="sparse"):
+        resolve_tree_program(deep, hist_layout="sparse")
+    with pytest.raises(ValueError, match="tree_program"):
+        resolve_tree_program(
+            GBMParameters(response_column="y", tree_program="bogus"))
+
+
+def test_check_downgrades_where_scan_cannot_grow(cl):
+    """tree_program="check" silently rides the level program on shapes
+    the scan cannot grow — never raises, never forfeits the model."""
+    deep = GBMParameters(response_column="y", tree_program="check",
+                         max_depth=12, sparse_depth_threshold=3)
+    assert resolve_tree_program(deep, hist_layout="sparse") == "level"
+    assert resolve_tree_program(
+        GBMParameters(response_column="y", tree_program="check",
+                      max_depth=5), mono={"x0": 1}) == "level"
+    assert resolve_tree_program(
+        GBMParameters(response_column="y", tree_program="check",
+                      max_depth=1)) == "level"
+    # the happy path stays "check" (the driver then runs the oracle)
+    assert resolve_tree_program(
+        GBMParameters(response_column="y", tree_program="check",
+                      max_depth=5)) == "check"
+    # "auto" under H2O3_TPU_AUTOTUNE=off is the historical level path
+    assert resolve_tree_program(
+        GBMParameters(response_column="y", max_depth=5)) == "level"
+
+
+def test_build_fn_rejects_scan_with_engaged_sparse(cl):
+    with pytest.raises(ValueError, match="sparse"):
+        make_build_tree_fn(10, 16, 5, 4096, "f32", hist_layout="sparse",
+                           sparse_depth_threshold=2, tree_program="scan")
+    with pytest.raises(ValueError, match="depth"):
+        make_build_tree_fn(1, 16, 5, 256, "f32", tree_program="scan")
+
+
+# ------------------------------------------------------------- drivers
+
+def _reg_frame(n=400, seed=0, key="scan_reg"):
+    r = np.random.default_rng(seed)
+    X = r.normal(size=(n, 5))
+    y = X[:, 0] + 0.5 * X[:, 1] ** 2 + 0.1 * r.normal(size=n)
+    cols = {f"x{j}": X[:, j] for j in range(5)}
+    cols["y"] = y
+    return Frame.from_numpy(cols, key=key)
+
+
+def _multi_frame(n=400, seed=1, key="scan_multi"):
+    r = np.random.default_rng(seed)
+    centers = np.array([[2, 0], [-2, 1], [0, -2]])
+    labels = r.integers(0, 3, n)
+    X = centers[labels] + r.normal(size=(n, 2))
+    return Frame.from_numpy(
+        {"x0": X[:, 0], "x1": X[:, 1],
+         "y": np.array(["a", "b", "c"], dtype=object)[labels]}, key=key)
+
+
+_KW = dict(response_column="y", ntrees=5, max_depth=4, nbins=16, seed=7,
+           reproducible=True)
+
+
+def _pred(m, fr):
+    return np.asarray(m.predict(fr).vec("predict").to_numpy())
+
+
+def test_gbm_scan_bitwise_and_check(cl):
+    fr = _reg_frame()
+    m_lv = GBM(**_KW, tree_program="level").train(fr)
+    m_sc = GBM(**_KW, tree_program="scan").train(fr)
+    np.testing.assert_array_equal(_pred(m_lv, fr), _pred(m_sc, fr))
+    assert m_sc.output["tree_program"] == "scan"
+    assert m_lv.output["tree_program"] == "level"
+    # "check": grow the first tree both ways on the real gradients,
+    # assert, then train on the scan path
+    m_ck = GBM(**_KW, tree_program="check").train(fr)
+    np.testing.assert_array_equal(_pred(m_lv, fr), _pred(m_ck, fr))
+    assert m_ck.output["tree_program"] == "scan"
+
+
+def test_gbm_multinomial_scan_bitwise(cl):
+    fr = _multi_frame()
+    kw = dict(response_column="y", ntrees=4, max_depth=3, nbins=16,
+              seed=3, reproducible=True)
+    m_lv = GBM(**kw, tree_program="level").train(fr)
+    m_sc = GBM(**kw, tree_program="scan").train(fr)
+    np.testing.assert_array_equal(_pred(m_lv, fr), _pred(m_sc, fr))
+
+
+def test_drf_scan_bitwise(cl):
+    fr = _reg_frame(key="scan_drf")
+    kw = dict(response_column="y", ntrees=4, max_depth=4, nbins=16,
+              seed=5, reproducible=True)
+    m_lv = DRF(**kw, tree_program="level").train(fr)
+    m_sc = DRF(**kw, tree_program="scan").train(fr)
+    np.testing.assert_array_equal(_pred(m_lv, fr), _pred(m_sc, fr))
+
+
+def test_checkpoint_continuation_across_program_switch(cl):
+    """A checkpoint grown under the level program continues bit-identically
+    under the scan program (and vice versa) — the knob changes dispatch
+    strategy, never trees, so snapshots/checkpoints are portable."""
+    fr = _reg_frame(key="scan_ckpt")
+    kw = dict(response_column="y", max_depth=3, nbins=16, min_rows=10,
+              seed=11)
+    prior = GBM(**kw, ntrees=3, tree_program="level").train(fr)
+    cont_lv = GBM(**kw, ntrees=7, checkpoint=prior.key,
+                  tree_program="level").train(fr)
+    cont_sc = GBM(**kw, ntrees=7, checkpoint=prior.key,
+                  tree_program="scan").train(fr)
+    np.testing.assert_array_equal(_pred(cont_lv, fr), _pred(cont_sc, fr))
+    # and a scan-grown prior continues under level
+    prior_sc = GBM(**kw, ntrees=3, tree_program="scan").train(fr)
+    cont_back = GBM(**kw, ntrees=7, checkpoint=prior_sc.key,
+                    tree_program="level").train(fr)
+    np.testing.assert_array_equal(_pred(cont_lv, fr), _pred(cont_back, fr))

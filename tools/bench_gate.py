@@ -15,10 +15,12 @@ on metrics whose meaning shifted.  A candidate identical to the latest
 baseline therefore always passes.
 
 Metric direction is classified by name: ``*_per_sec``, ``*_vs_baseline``,
-``trees/sec``-style rates, ``*qps`` and ``scaling_*`` are higher-better;
-``*_sec``/``*_s``/``*_ms``/``*_seconds`` wall clocks and ``*latency*``
-series are lower-better (serving latencies gate correctly from their
-first recorded round).  Sizes and configuration
+``trees/sec``-style rates, ``*qps``, ``*speedup*`` and ``scaling_*`` are
+higher-better; ``*_sec``/``*_s``/``*_ms``/``*_seconds`` wall clocks and
+``*latency*`` series are lower-better, and so are count-style metrics
+(``*launches*``, ``*_total``, ``*_count`` — a dispatch or recompile
+count that grows is a regression; serving latencies and dispatch pins
+gate correctly from their first recorded round).  Sizes and configuration
 echoes (rows, trees, platform, ``parse_csv_mb``) and the compile-split
 diagnostics (``*_compile_s``/``*_steady_s``, ``compiles_total``) are
 informational only.
@@ -70,8 +72,13 @@ INFORMATIONAL = ("platform", "rows", "trees", "parse_csv_mb",
 _INFO_SUFFIXES = ("_compile_s", "_steady_s", "_error")
 
 _HIGHER_HINTS = ("per_sec", "_vs_baseline", "_vs_best", "samples_per_sec",
-                 "trees_per_sec", "scaling", "qps")
+                 "trees_per_sec", "scaling", "qps", "speedup")
 _LOWER_SUFFIXES = ("_sec", "_s", "_ms", "_seconds")
+# count-style metrics: a launch/dispatch/recompile count that grows is a
+# regression (the treescan dispatch pin rides this).  compiles_total
+# stays informational — it is listed in INFORMATIONAL, which wins.
+_COUNT_HINTS = ("launches",)
+_COUNT_SUFFIXES = ("_total", "_count")
 
 
 def classify(name: str) -> str:
@@ -80,6 +87,9 @@ def classify(name: str) -> str:
         return "info"
     if any(h in name for h in _HIGHER_HINTS):
         return "higher"
+    if any(h in name for h in _COUNT_HINTS) \
+            or name.endswith(_COUNT_SUFFIXES):
+        return "lower"
     if name.endswith(_LOWER_SUFFIXES) or "latency" in name:
         return "lower"
     return "info"

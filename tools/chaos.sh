@@ -11,6 +11,10 @@
 #     snapshot; final predictions must match an uninterrupted run,
 #     including the deep-level sparse layout and multinomial variants
 #     and the no-snapshot resume-from-zero row (tests/test_chaos.py),
+#   - scan-kill:          the same hard-kill at a tree-chunk fence with
+#     tree_program="scan" engaged — the whole-tree scan program's
+#     coarser per-tree-chunk snapshots resume to predictions equal to
+#     the uninterrupted run (tests/test_chaos.py),
 #   - coordinator-kill:   the DKV coordinator os._exit(137)s mid-GBM,
 #     is restarted on the same port + recovery dir, the worker rides
 #     out the outage on its retry budget and fences the new epoch
@@ -74,7 +78,10 @@ run_row() {
 run_row kill-resume tests/test_chaos.py \
     --deselect tests/test_chaos.py::test_coordinator_hard_kill_midtrain_rehydrate_reattach \
     --deselect tests/test_chaos.py::test_host_kill_mid_multitenant_load \
-    --deselect tests/test_chaos.py::test_host_join_fenced_rebuild_midtrain
+    --deselect tests/test_chaos.py::test_host_join_fenced_rebuild_midtrain \
+    --deselect tests/test_chaos.py::test_kill_resume_mid_scan_program
+run_row scan-kill \
+    tests/test_chaos.py::test_kill_resume_mid_scan_program
 run_row coordinator-kill \
     tests/test_chaos.py::test_coordinator_hard_kill_midtrain_rehydrate_reattach
 run_row multitenant-kill \
