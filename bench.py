@@ -529,6 +529,23 @@ def worker_main():
                 ts["treescan_scan_vs_level_speedup"], 3)
         except Exception as e:
             extra["treescan_error"] = repr(e)[:200]
+        try:
+            # batched grid sweeps: dispatch-count pin (one cohort
+            # program serves G members per chunk at a single member's
+            # launch count) + bitwise batched-vs-wave parity
+            # (bench_pieces grid); grid_batched_vs_sequential holds an
+            # absolute 4.0 floor in the gate
+            from bench_pieces import grid_piece
+            gp = grid_piece()
+            extra["grid_launches_batched"] = gp["grid_launches_batched"]
+            extra["grid_batched_vs_sequential"] = round(
+                gp["grid_batched_vs_sequential"], 3)
+            extra["grid_batched_wall_s"] = round(
+                gp["grid_batched_wall_s"], 3)
+            extra["grid_sequential_wall_s"] = round(
+                gp["grid_sequential_wall_s"], 3)
+        except Exception as e:
+            extra["grid_error"] = repr(e)[:200]
     compiles, compile_s = _ledger_totals()
     if compiles:
         extra["compiles_total"] = compiles

@@ -1574,6 +1574,142 @@ def treescan_piece():
     return rec
 
 
+def grid_piece():
+    """Batched grid sweep bench: G same-shape members as ONE program.
+
+    Two proofs land:
+      * dispatch pin — ``count_kernel_launches`` over the traced chunk
+        programs.  The batched G-member cohort program carries the SAME
+        dispatch-site count as ONE sequential member's program (the
+        model axis rides the kernels' ``nk`` batch dim, it adds no
+        launches), so a sequential G-member sweep pays G× the dispatches
+        per chunk while the cohort pays 1×.
+        ``grid_batched_vs_sequential`` = G·L_seq / L_batched is that
+        dispatch ratio — the platform-independent quantity the ~4 ms/
+        launch tunnel turns into wall-clock on chip ("G configs for the
+        price of ~1 dispatch").  Also pinned: the batched count is
+        G-INDEPENDENT (G=2 and G=8 trace to identical counts).
+      * wall clocks + bitwise parity — the same G-member sweep trained
+        batched (grid_batch="on") vs the sequential wave path ("off"),
+        warm.  On the CPU host the kernels are compute-bound, so the
+        wall ratio sits near 1 (recorded as context); the parity check
+        is the real assertion — every batched member's predictions are
+        BITWISE equal to its sequential twin's.
+
+    Usage (chip): python bench_pieces.py grid
+    CPU smoke:    JAX_PLATFORMS=cpu H2O3_PIECES_ROWS=20000 \\
+                  python bench_pieces.py grid
+    """
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    import h2o3_tpu
+    from h2o3_tpu import Frame
+    from h2o3_tpu.models.grid import GridSearch
+    from h2o3_tpu.models.tree.gbm import GBM
+    from h2o3_tpu.models.tree.shared import (make_grid_scan_fn,
+                                             make_tree_scan_fn)
+    from h2o3_tpu.runtime.xprof import count_kernel_launches
+
+    h2o3_tpu.init()
+    platform = jax.devices()[0].platform
+    rows = min(N_ROWS, 20_000)
+    G = int(os.environ.get("H2O3_GRID_MEMBERS", 8))
+    trees = int(os.environ.get("H2O3_GRID_TREES", 16))
+    depth = 5
+    nbins = 64
+    Fs = 8
+
+    # ---- dispatch pin: launch sites per chunk from the traced jaxprs
+    rng = np.random.default_rng(17)
+    Nb = 4096
+    nchunk = 5
+    codes = jnp.asarray(rng.integers(0, nbins, (Fs, Nb)), jnp.int32)
+    yv = jnp.asarray(rng.normal(size=Nb), jnp.float32)
+    wv = jnp.ones(Nb, jnp.float32)
+    F0 = jnp.zeros(Nb, jnp.float32)
+    edges = jnp.sort(jnp.asarray(rng.normal(size=(Fs, nbins)),
+                                 jnp.float32), axis=1)
+    seq_fn = make_tree_scan_fn("gaussian", 1.5, 0.5, 0.9, depth, nbins,
+                               Fs, Nb, "f32", 1.0, 1.0)
+    seq_args = (codes, yv, wv, F0, edges, jax.random.PRNGKey(1), 0,
+                nchunk, 1.0, 10.0, 1e-5, 0.1, 1.0, 0.0, 0.0, 0.0, 0)
+    L_seq = count_kernel_launches(seq_fn, *seq_args,
+                                  static_argnums=(7,))
+    L_grid = {}
+    for g in (2, G):
+        gfn = make_grid_scan_fn(g, "gaussian", 1.5, 0.5, 0.9, depth,
+                                nbins, Fs, Nb, "f32")
+        arr = lambda v, n=g: jnp.full((n,), v, jnp.float32)
+        gargs = (codes, yv, wv,
+                 jnp.zeros((g, Nb), jnp.float32), edges,
+                 jnp.stack([jax.random.PRNGKey(i) for i in range(g)]),
+                 0, nchunk, arr(1.0), arr(10.0), arr(1e-5), arr(0.1),
+                 arr(1.0), arr(1.0), arr(1.0),
+                 jnp.ones((g,), bool), arr(0.0), arr(0.0), arr(0.0))
+        L_grid[g] = count_kernel_launches(gfn, *gargs,
+                                          static_argnums=(7,))
+    dispatch_ratio = G * L_seq / L_grid[G]
+
+    # ---- wall clocks + bitwise parity, batched vs the wave path
+    X = rng.normal(size=(rows, Fs)).astype(np.float64)
+    yr = (np.sin(3 * X[:, 0]) + X[:, 1] * X[:, 2]
+          + 0.1 * rng.normal(size=rows))
+    fr = Frame.from_numpy(
+        {**{f"x{i}": X[:, i] for i in range(Fs)}, "y": yr})
+    lrs = [round(0.02 + 0.03 * i, 3) for i in range(G)]
+    hp = {"learn_rate": lrs}
+    kw = dict(response_column="y", ntrees=trees, max_depth=depth,
+              nbins=nbins, seed=3, score_tree_interval=trees,
+              hist_layout="dense", reproducible=True)
+
+    def sweep(mode):
+        GridSearch(GBM, hp, grid_batch=mode, **kw).train(fr)  # warm
+        t0 = _time.perf_counter()
+        g = GridSearch(GBM, hp, grid_batch=mode, **kw).train(fr)
+        return _time.perf_counter() - t0, g
+
+    wall_b, g_on = sweep("on")
+    wall_s, g_off = sweep("off")
+    assert all(m.output.get("grid_cohort", {}).get("size") == G
+               for m in g_on.models), "cohort did not engage"
+    GBM(learn_rate=lrs[0], **kw).train(fr)                    # warm
+    t0 = _time.perf_counter()
+    GBM(learn_rate=lrs[0], **kw).train(fr)
+    wall_1 = _time.perf_counter() - t0
+
+    by_lr = lambda g: {m.params.learn_rate: m for m in g.models}
+    mo, mf = by_lr(g_on), by_lr(g_off)
+    bitwise = all(
+        np.array_equal(mo[k].predict(fr).to_numpy()[:, 0],
+                       mf[k].predict(fr).to_numpy()[:, 0]) for k in mo)
+    assert bitwise, "batched cohort diverged from the sequential path"
+
+    rec = {
+        "piece": "grid", "platform": platform, "rows": rows,
+        "trees": trees, "grid_members": G,
+        "grid_launches_batched": L_grid[G],
+        "grid_launches_sequential_member": L_seq,
+        "grid_batched_vs_sequential": round(dispatch_ratio, 3),
+        "grid_launches_g_independent": bool(L_grid[2] == L_grid[G]),
+        "grid_batched_wall_s": round(wall_b, 3),
+        "grid_sequential_wall_s": round(wall_s, 3),
+        "grid_one_member_wall_s": round(wall_1, 3),
+        "grid_bitwise_equal": bitwise,
+        "note": "dispatch pin: one batched cohort program serves G "
+                "members per chunk at a single member's launch count "
+                "(ratio = G on any platform); walls are CPU-host "
+                "context — compute-bound there, dispatch-bound on chip",
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "parse":
         parse_piece()
@@ -1601,5 +1737,7 @@ if __name__ == "__main__":
         stream_piece()
     elif len(sys.argv) > 1 and sys.argv[1] == "treescan":
         treescan_piece()
+    elif len(sys.argv) > 1 and sys.argv[1] == "grid":
+        grid_piece()
     else:
         main()

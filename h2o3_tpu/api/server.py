@@ -505,7 +505,10 @@ class Api:
                 "hyper_names": grid.hyper_names,
                 "model_ids": [{"name": m.key} for m in grid.models],
                 "sort_metric": grid.sort_metric,
-                "summary_table": grid.sorted_metric_table()}
+                "summary_table": grid.sorted_metric_table(),
+                # GridSchemaV99 failure_details analog: one entry per
+                # member that failed to build (combo params + error)
+                "failed_entries": grid.failed_entries}
 
     def grids(self) -> dict:
         from ..runtime import dkv
@@ -801,7 +804,29 @@ class Api:
                         else str(default),
                     })
             out.append({"algo": algo, "parameters": fields})
-        return {"schemas": out}
+        # grid-level parameters (GridSearch's own knobs, not per-model
+        # hyperparameters) — introspected so client codegen tracks the
+        # server, exactly like the builder schemas above
+        import inspect
+        from ..models.grid import GridSearch
+        gfields = []
+        for name, p in inspect.signature(
+                GridSearch.__init__).parameters.items():
+            if name in ("self", "builder_cls", "hyper_params",
+                        "base_params") or p.kind in (
+                    inspect.Parameter.VAR_KEYWORD,
+                    inspect.Parameter.VAR_POSITIONAL):
+                continue
+            default = (None if p.default is inspect.Parameter.empty
+                       else p.default)
+            gfields.append({
+                "name": name,
+                "type": type(default).__name__ if default is not None
+                else "object",
+                "default": default if isinstance(
+                    default, (int, float, str, bool, type(None)))
+                else str(default)})
+        return {"schemas": out, "grid": {"parameters": gfields}}
 
     # --------------------------------------------------------------- export
     def frame_summary(self, key: str) -> dict:

@@ -357,6 +357,11 @@ class RemoteGrid:
         return self._schema["summary_table"]
 
     @property
+    def failed_entries(self) -> List[dict]:
+        """Per-member build failures (combo params + error), if any."""
+        return self._schema.get("failed_entries", [])
+
+    @property
     def best_model(self) -> RemoteModel:
         return RemoteModel(self.conn,
                            self.summary_table()[0]["model_id"])

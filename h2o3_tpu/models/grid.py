@@ -54,18 +54,31 @@ def model_metric(model: Model, metric: str,
     return None
 
 
+class _FailedBuild:
+    """In-band sentinel for a wave member whose build raised — carried
+    through map_builds' ordered results so sibling models survive."""
+
+    def __init__(self, error: str):
+        self.error = error
+
+
 class Grid:
     """Trained-grid container — hex/grid/Grid.java analog."""
 
     def __init__(self, key: str, models: List[Model],
                  hyper_names: Sequence[str], entries: List[dict],
-                 sort_metric: str, decreasing: bool):
+                 sort_metric: str, decreasing: bool,
+                 failed_entries: Optional[List[dict]] = None):
         self.key = key
         self.models = models
         self.hyper_names = list(hyper_names)
         self.entries = entries
         self.sort_metric = sort_metric
         self.decreasing = decreasing
+        # per-member fault tolerance (Grid.java failure_details analog):
+        # combos whose build failed, each with its "error" repr — the
+        # grid completes on the survivors instead of dying whole
+        self.failed_entries = list(failed_entries or [])
         dkv.put(key, self)
 
     def _order(self) -> List[int]:
@@ -99,7 +112,8 @@ class Grid:
                 {"key": self.key, "n_models": len(self.models),
                  "hyper_names": self.hyper_names, "entries": self.entries,
                  "sort_metric": self.sort_metric,
-                 "decreasing": self.decreasing},
+                 "decreasing": self.decreasing,
+                 "failed_entries": self.failed_entries},
                 # hyper values are often numpy scalars (np.arange grids)
                 default=lambda o: o.item() if hasattr(o, "item") else str(o),
             ).encode())
@@ -117,7 +131,8 @@ class Grid:
                     hyper_names=meta["hyper_names"],
                     entries=meta["entries"],
                     sort_metric=meta["sort_metric"],
-                    decreasing=meta["decreasing"])
+                    decreasing=meta["decreasing"],
+                    failed_entries=meta.get("failed_entries"))
 
     def __repr__(self):
         return (f"<Grid {self.key}: {len(self.models)} models by "
@@ -138,16 +153,29 @@ class GridSearch:
     waves, so stopping semantics degrade gracefully (a wave may overshoot
     by at most parallelism-1 models, exactly like the reference's
     parallel walker).
+
+    ``grid_batch``: "auto" (cost model picks), "on", or "off".  Combos
+    that only vary scalar hyperparameters partition into shape-compatible
+    COHORTS and train as ONE batched compiled program
+    (models/tree/grid_batch.py) — G members for ~1 dispatch per level.
+    Shape-changing combos (max_depth/nbins/ntrees/...) and every
+    disqualified member fall back to the wave path with a recorded
+    reason; "off" is exactly the wave path.  ``search_criteria`` gains
+    ``successive_halving`` (bool), ``halving_eta`` (default 3) and
+    ``halving_metric`` — in-batch retirement of losing members at
+    geometric rung fences, zero recompiles.
     """
 
     def __init__(self, builder_cls, hyper_params: Dict[str, Sequence],
                  search_criteria: Optional[dict] = None,
-                 parallelism: int = 0, **base_params):
+                 parallelism: int = 0, grid_batch: str = "auto",
+                 **base_params):
         self.builder_cls = builder_cls
         self.hyper_params = {k: list(v) for k, v in hyper_params.items()}
         self.search_criteria = dict(search_criteria or
                                     {"strategy": "Cartesian"})
         self.parallelism = parallelism
+        self.grid_batch = grid_batch
         self.base_params = base_params
 
     def _combos(self) -> List[dict]:
@@ -170,52 +198,138 @@ class GridSearch:
         stop_rounds = sc.get("stopping_rounds", 0)
         stop_tol = sc.get("stopping_tolerance", 1e-3)
         t0 = time.time()
+        # cooperative max_runtime_secs: the deadline threads into every
+        # build (map_builds / the cohort trainer) and tree drivers poll
+        # it at chunk fences — an in-flight member stops within one
+        # chunk of the budget instead of overshooting by whole builds
+        deadline = (time.monotonic() + max_secs) if max_secs else None
         models, entries = [], []
+        failed_entries: List[dict] = []
         metric, decreasing = None, None
         series: List[float] = []
         combos = self._combos()
+
+        def note(combo, m):
+            nonlocal metric, decreasing
+            models.append(m)
+            entries.append(combo)
+            if metric is None:
+                if sort_metric is None:
+                    metric, lower = default_sort_metric(m)
+                else:
+                    from .scorekeeper import METRIC_MAXIMIZE
+                    metric = sort_metric
+                    lower = not METRIC_MAXIMIZE.get(sort_metric, False)
+                decreasing = not lower
+            v = model_metric(m, metric)
+            if v is not None:
+                series.append(v)
+
+        def seq_stop() -> bool:
+            # early stop over the *sequence of best-so-far* models,
+            # checked between waves/cohorts
+            return bool(stop_rounds and series and stop_early(
+                series, stop_rounds, stop_tol, maximize=decreasing))
+
+        # ---- batched cohorts: shape-compatible combos train as ONE
+        # compiled program (models/tree/grid_batch.py); every fallback
+        # (shape-changing combos, disqualified members, CohortFallback
+        # from the trainer, a cost model that prefers pipelining) is
+        # RECORDED and rides the scheduler-parallel wave path below
+        remaining = list(range(len(combos)))
+        stopped = False
+        mode = str(getattr(self, "grid_batch", "auto")).lower()
+        if mode in ("auto", "on") and len(combos) > 1:
+            from ..runtime import autotune
+            from ..runtime.observability import record
+            from .tree import grid_batch as gb
+            scope = remaining[:max_models] if max_models else remaining
+            cohorts, rest = gb.plan_cohorts(
+                self.builder_cls, self.base_params,
+                [combos[i] for i in scope])
+            for j, reason in rest:
+                record("grid_batch_fallback", combo=combos[scope[j]],
+                       reason=reason)
+            taken = set()
+            for co in cohorts:
+                idxs = [scope[j] for j in co]
+                if stopped or (max_secs and time.time() - t0 > max_secs):
+                    break
+                if mode == "auto":
+                    rep = self.builder_cls(
+                        **{**self.base_params, **combos[idxs[0]]})
+                    choice = autotune.resolve_grid_batch(
+                        kind=rep.algo, F=max(len(frame.names) - 1, 1),
+                        N=frame.nrows, G=len(idxs),
+                        max_depth=rep.params.max_depth,
+                        nbins=rep.params.nbins)
+                    if choice != "batched":
+                        record("grid_batch_fallback", members=len(idxs),
+                               reason="cost model chose "
+                                      "scheduler-parallel")
+                        continue
+                try:
+                    res = gb.train_cohort(
+                        self.builder_cls, self.base_params,
+                        [combos[i] for i in idxs], frame, valid,
+                        search_criteria=sc, deadline=deadline)
+                except gb.CohortFallback as e:
+                    record("grid_batch_fallback", members=len(idxs),
+                           reason=str(e))
+                    continue
+                for i, (m, err) in zip(idxs, res):
+                    taken.add(i)
+                    if err is not None:
+                        failed_entries.append({**combos[i], "error": err})
+                    else:
+                        note(combos[i], m)
+                stopped = seq_stop()
+            remaining = [i for i in remaining if i not in taken]
+
         from .parallel import effective_parallelism, map_builds
-        par = effective_parallelism(self.parallelism, len(combos))
+        par = effective_parallelism(self.parallelism, len(remaining))
         pos = 0
-        while pos < len(combos):
+        while pos < len(remaining) and not stopped:
             if max_models and len(models) >= max_models:
                 break
             if max_secs and time.time() - t0 > max_secs:
                 break
-            wave = combos[pos: pos + par]
+            wave = remaining[pos: pos + par]
             if max_models:
                 wave = wave[: max_models - len(models)]
             pos += len(wave)
 
-            def build(combo):
+            def build(i):
                 # each member journals (and snapshots) itself through
                 # ModelBuilder.train — the per-member resumability path
                 from ..runtime import failure
                 failure.maybe_inject("grid_member")
-                builder = self.builder_cls(**{**self.base_params, **combo})
+                builder = self.builder_cls(
+                    **{**self.base_params, **combos[i]})
                 return builder.train(frame, valid)
 
-            for combo, m in zip(wave, map_builds(
-                    [lambda c=c: build(c) for c in wave], par)):
-                models.append(m)
-                entries.append(combo)
-                if metric is None:
-                    if sort_metric is None:
-                        metric, lower = default_sort_metric(m)
-                    else:
-                        from .scorekeeper import METRIC_MAXIMIZE
-                        metric = sort_metric
-                        lower = not METRIC_MAXIMIZE.get(sort_metric, False)
-                    decreasing = not lower
-                v = model_metric(m, metric)
-                if v is not None:
-                    series.append(v)
-            # early stop over the *sequence of best-so-far* models,
-            # checked between waves
-            if stop_rounds and series and stop_early(
-                    series, stop_rounds, stop_tol, maximize=decreasing):
-                break
+            def safe_build(i):
+                # member fault tolerance: a failing combo (including a
+                # mid-build DeadlineExceeded) becomes a failed_entries
+                # row instead of killing the whole grid
+                try:
+                    return build(i)
+                except Exception as e:                  # noqa: BLE001
+                    return _FailedBuild(repr(e))
+
+            for i, m in zip(wave, map_builds(
+                    [lambda i=i: safe_build(i) for i in wave], par,
+                    deadline=deadline)):
+                if isinstance(m, _FailedBuild):
+                    failed_entries.append({**combos[i], "error": m.error})
+                    continue
+                note(combos[i], m)
+            stopped = seq_stop()
         if not models:
-            raise ValueError("grid search trained no models")
+            raise ValueError(
+                "grid search trained no models"
+                + (f"; member failures: {failed_entries}"
+                   if failed_entries else ""))
         return Grid(dkv.make_key("grid"), models, list(self.hyper_params),
-                    entries, metric, decreasing)
+                    entries, metric, decreasing,
+                    failed_entries=failed_entries)

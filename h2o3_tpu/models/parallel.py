@@ -28,7 +28,39 @@ from __future__ import annotations
 
 import concurrent.futures
 import os
-from typing import Callable, List, Sequence
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
+
+# Cooperative max_runtime_secs deadline, thread-local so concurrent grids
+# don't see each other's budgets.  ``map_builds`` (and the batched cohort
+# trainer) arm it per worker thread; ``chunk_schedule`` polls it at every
+# tree-chunk fence via ``check_deadline`` — an in-flight member therefore
+# stops within one chunk of the budget instead of finishing its build.
+_DEADLINE = threading.local()
+
+
+class DeadlineExceeded(Exception):
+    """Raised at a chunk fence once the cooperative deadline passes."""
+
+
+def set_deadline(deadline: Optional[float]) -> None:
+    """Arm (monotonic-clock timestamp) or clear (None) this thread's
+    cooperative deadline."""
+    _DEADLINE.at = deadline
+
+
+def get_deadline() -> Optional[float]:
+    return getattr(_DEADLINE, "at", None)
+
+
+def check_deadline() -> None:
+    """Raise ``DeadlineExceeded`` if this thread's deadline has passed."""
+    at = getattr(_DEADLINE, "at", None)
+    if at is not None and time.monotonic() > at:
+        raise DeadlineExceeded(
+            f"max_runtime_secs deadline passed (cooperative cancel at "
+            f"chunk fence, {time.monotonic() - at:.1f}s over)")
 
 
 def effective_parallelism(requested: int, n_tasks: int) -> int:
@@ -50,15 +82,29 @@ def effective_parallelism(requested: int, n_tasks: int) -> int:
 
 
 def map_builds(thunks: Sequence[Callable[[], object]],
-               parallelism: int) -> List[object]:
+               parallelism: int,
+               deadline: Optional[float] = None) -> List[object]:
     """Run build thunks, at most ``parallelism`` concurrently; results in
     input order.  The first raised exception propagates (after letting
     in-flight builds finish — matching reference CV semantics where a
-    failed fold cancels the CV job but not mid-build siblings)."""
+    failed fold cancels the CV job but not mid-build siblings).
+
+    ``deadline`` (monotonic timestamp) arms the cooperative
+    max_runtime_secs cancel around each thunk: tree drivers poll it at
+    chunk fences (``check_deadline``), so a slow wave stops within one
+    chunk of the budget instead of overshooting by whole builds."""
+    def run(t):
+        prev = get_deadline()
+        set_deadline(deadline)
+        try:
+            return t()
+        finally:
+            set_deadline(prev)
+
     if parallelism <= 1:
-        return [t() for t in thunks]
+        return [run(t) for t in thunks]
     with concurrent.futures.ThreadPoolExecutor(
             max_workers=parallelism,
             thread_name_prefix="parallel-build") as ex:
-        futures = [ex.submit(t) for t in thunks]
+        futures = [ex.submit(run, t) for t in thunks]
         return [f.result() for f in futures]

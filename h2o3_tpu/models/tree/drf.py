@@ -67,6 +67,10 @@ class DRFModel(SharedTreeModel):
 class DRF(SharedTree):
     algo = "drf"
     model_class = DRFModel
+    # stays on the wave path: the forest driver's mtries/OOB bookkeeping
+    # and per-class bootstrap sharing diverge from the fused GBM chunk
+    # loop the batched cohort trainer mirrors
+    _grid_batchable = False
 
     def __init__(self, params: Optional[DRFParameters] = None, **kw):
         super().__init__(params or DRFParameters(**kw))

@@ -73,6 +73,9 @@ class GBMModel(SharedTreeModel):
 class GBM(SharedTree):
     algo = "gbm"
     model_class = GBMModel
+    # grid cohorts batch through the fused single-class path below
+    # (grid_batch.py reuses _prep_targets/_interval_score/_finalize_fused)
+    _grid_batchable = True
 
     def __init__(self, params: Optional[GBMParameters] = None, **kw):
         super().__init__(params or GBMParameters(**kw))

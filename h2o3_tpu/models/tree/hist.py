@@ -1463,10 +1463,22 @@ def best_splits(Hist, nbins: int, reg_lambda: float, min_rows: float,
 _REC_PLANES = 12
 
 
+def _per_leaf(x, extra_dims: int):
+    """Broadcast a per-leaf ``[L]`` parameter against ``extra_dims``
+    trailing axes; scalars pass through untouched, so the scalar path
+    stays trace-identical to the pre-batched code."""
+    return x.reshape(x.shape + (1,) * extra_dims) \
+        if getattr(x, "ndim", 0) else x
+
+
 def _split_records_xla(Hist, reg_lambda, min_rows, reg_alpha, gamma,
                        min_child_weight):
     """Per-(leaf, feature) winner records [L, F, 12] — XLA path, bit-
-    identical gains to best_splits (same op sequence, jnp.cumsum)."""
+    identical gains to best_splits (same op sequence, jnp.cumsum).
+
+    Regularization/constraint params accept scalars or per-leaf ``[L]``
+    arrays (the batched grid plane flattens G members into the leaf axis
+    with per-member lambda/alpha/gamma/min_rows/min_child_weight)."""
     G, Hs, C = Hist[0], Hist[1], Hist[2]
     g_na, h_na, c_na = G[..., -1], Hs[..., -1], C[..., -1]
     cumG = jnp.cumsum(G[..., :-1], -1)
@@ -1475,18 +1487,22 @@ def _split_records_xla(Hist, reg_lambda, min_rows, reg_alpha, gamma,
     totG = cumG[..., -1] + g_na
     totH = cumH[..., -1] + h_na
     totC = cumC[..., -1] + c_na
-    parent = _score(totG, totH, reg_lambda, reg_alpha)
+    lam1, alpha1 = _per_leaf(reg_lambda, 1), _per_leaf(reg_alpha, 1)
+    lam2, alpha2 = _per_leaf(reg_lambda, 2), _per_leaf(reg_alpha, 2)
+    gamma2 = _per_leaf(gamma, 2)
+    rows2, mcw2 = _per_leaf(min_rows, 2), _per_leaf(min_child_weight, 2)
+    parent = _score(totG, totH, lam1, alpha1)
     GL, HL, CL = cumG[..., :-1], cumH[..., :-1], cumC[..., :-1]
     GR = totG[..., None] - GL - g_na[..., None]
     HR = totH[..., None] - HL - h_na[..., None]
     CR = totC[..., None] - CL - c_na[..., None]
 
     def gain_with_na(gl, hl, cl, gr, hr, cr):
-        g = 0.5 * (_score(gl, hl, reg_lambda, reg_alpha)
-                   + _score(gr, hr, reg_lambda, reg_alpha)
-                   - parent[..., None]) - gamma
-        ok = (cl >= min_rows) & (cr >= min_rows) & \
-            (hl >= min_child_weight) & (hr >= min_child_weight)
+        g = 0.5 * (_score(gl, hl, lam2, alpha2)
+                   + _score(gr, hr, lam2, alpha2)
+                   - parent[..., None]) - gamma2
+        ok = (cl >= rows2) & (cr >= rows2) & \
+            (hl >= mcw2) & (hr >= mcw2)
         return jnp.where(ok, g, -jnp.inf)
 
     gain_naL = gain_with_na(GL + g_na[..., None], HL + h_na[..., None],
@@ -1507,11 +1523,18 @@ def _split_records_xla(Hist, reg_lambda, min_rows, reg_alpha, gamma,
          totG, totH, totC], axis=-1)               # [L, F, 12]
 
 
-def _make_pallas_split_records(LF: int, B: int, interpret: bool = False):
+def _make_pallas_split_records(LF: int, B: int, interpret: bool = False,
+                               per_row: bool = False):
     """Split-records kernel: (G2, H2, C2 [LF, B], scal [1, 8] SMEM) ->
     rec [LF, 16].  One (leaf, feature) pair per sublane row; bins in
     lanes; grid over row blocks.  Rows must arrive padded to the block
-    multiple (padding rows emit garbage records the caller slices off)."""
+    multiple (padding rows emit garbage records the caller slices off).
+
+    ``per_row=True`` swaps the broadcast SMEM scalar block for a
+    row-aligned ``[LF, 8]`` VMEM block (lanes 0-4 = lam/alpha/gamma/
+    min_rows/mcw per record row) — per-leaf regularization for the
+    batched grid plane.  The kernel math broadcasts [RS, 1] columns
+    against [RS, B] planes, so the compute body is shared."""
     nbins = B - 1
     Bpad = (B + 127) // 128 * 128
     # ~24 live [RS, Bpad] f32 intermediates on the scoped-VMEM stack
@@ -1519,11 +1542,18 @@ def _make_pallas_split_records(LF: int, B: int, interpret: bool = False):
     nblk = (LF + RS - 1) // RS
 
     def kernel(g_ref, h_ref, c_ref, sc_ref, out_ref):
-        lam = sc_ref[0, 0]
-        alpha = sc_ref[0, 1]
-        gamma = sc_ref[0, 2]
-        min_rows = sc_ref[0, 3]
-        mcw = sc_ref[0, 4]
+        if per_row:
+            lam = sc_ref[:, 0:1]                   # [RS, 1] columns
+            alpha = sc_ref[:, 1:2]
+            gamma = sc_ref[:, 2:3]
+            min_rows = sc_ref[:, 3:4]
+            mcw = sc_ref[:, 4:5]
+        else:
+            lam = sc_ref[0, 0]
+            alpha = sc_ref[0, 1]
+            gamma = sc_ref[0, 2]
+            min_rows = sc_ref[0, 3]
+            mcw = sc_ref[0, 4]
         Gb, Hb, Cb = g_ref[:], h_ref[:], c_ref[:]
         biota = jax.lax.broadcasted_iota(jnp.int32, (RS, B), 1)
 
@@ -1589,6 +1619,9 @@ def _make_pallas_split_records(LF: int, B: int, interpret: bool = False):
             out = jnp.where(oiota == k, v, out)
         out_ref[:] = out
 
+    sc_spec = pl.BlockSpec((RS, 8), lambda i: (i, 0),
+                           memory_space=pltpu.VMEM) if per_row else \
+        pl.BlockSpec((1, 8), lambda i: (0, 0), memory_space=pltpu.SMEM)
     return pl.pallas_call(
         kernel,
         grid=(nblk,),
@@ -1596,7 +1629,7 @@ def _make_pallas_split_records(LF: int, B: int, interpret: bool = False):
             pl.BlockSpec((RS, B), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((RS, B), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((RS, B), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 8), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            sc_spec,
         ],
         out_specs=pl.BlockSpec((RS, 16), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
@@ -1610,7 +1643,9 @@ def split_records(Hist, nbins: int, reg_lambda, min_rows, reg_alpha=0.0,
     """Per-(leaf, feature) winner records [L, F, 12] from H[3, L, F, B].
 
     On TPU the Pallas kernel; elsewhere the bit-identical XLA twin.
-    ``force_impl``: "xla" | "pallas" | "pallas_interpret" pin the path."""
+    ``force_impl``: "xla" | "pallas" | "pallas_interpret" pin the path.
+    Regularization params accept scalars or per-leaf ``[L]`` arrays
+    (batched grid members flattened into the leaf axis)."""
     cl = cluster()
     platform = cl.mesh.devices.flat[0].platform
     use_kernel = force_impl in ("pallas", "pallas_interpret") or \
@@ -1620,14 +1655,29 @@ def split_records(Hist, nbins: int, reg_lambda, min_rows, reg_alpha=0.0,
                                   gamma, min_child_weight)
     interpret = force_impl == "pallas_interpret" or platform != "tpu"
     _, L, F, B = Hist.shape
-    call, RS = _make_pallas_split_records(L * F, B, interpret=interpret)
+    per_leaf = any(getattr(x, "ndim", 0) for x in
+                   (reg_lambda, min_rows, reg_alpha, gamma,
+                    min_child_weight))
+    call, RS = _make_pallas_split_records(L * F, B, interpret=interpret,
+                                          per_row=per_leaf)
     pad = (L * F + RS - 1) // RS * RS - L * F
     planes = Hist.reshape(3, L * F, B)
     if pad:
         planes = jnp.pad(planes, [(0, 0), (0, pad), (0, 0)])
-    sc = jnp.zeros((1, 8), jnp.float32).at[0, :5].set(
-        jnp.stack([reg_lambda, reg_alpha, gamma, min_rows,
-                   min_child_weight]).astype(jnp.float32))
+    if per_leaf:
+        def as_l(x):
+            return jnp.broadcast_to(jnp.asarray(x, jnp.float32), (L,))
+        cols = jnp.stack([as_l(reg_lambda), as_l(reg_alpha), as_l(gamma),
+                          as_l(min_rows), as_l(min_child_weight)],
+                         axis=1)                       # [L, 5]
+        rows = jnp.repeat(cols, F, axis=0)             # row l*F+f -> leaf l
+        if pad:
+            rows = jnp.pad(rows, [(0, pad), (0, 0)])
+        sc = jnp.zeros((L * F + pad, 8), jnp.float32).at[:, :5].set(rows)
+    else:
+        sc = jnp.zeros((1, 8), jnp.float32).at[0, :5].set(
+            jnp.stack([reg_lambda, reg_alpha, gamma, min_rows,
+                       min_child_weight]).astype(jnp.float32))
     # the H block is replicated post-psum; run the kernel replicated too
     # (pallas_call must not meet the GSPMD partitioner un-shard_mapped)
     rec = shard_map(call, mesh=cl.mesh, in_specs=(P(), P(), P(), P()),
@@ -1659,7 +1709,7 @@ def finish_splits(rec, min_rows, min_split_improvement, feat_mask=None):
     ftot, htot, ctot = pick(9), pick(10), pick(11)
     valid = jnp.isfinite(best_gain) & \
         (best_gain > min_split_improvement) & \
-        (rec[..., 11] >= 2 * min_rows).any(-1)
+        (rec[..., 11] >= _per_leaf(2 * min_rows, 1)).any(-1)
     gr0 = ftot - glx - gna
     hr0 = htot - hlx - hna
     cr0 = ctot - clx - cna
@@ -1733,7 +1783,10 @@ def fused_best_splits_batched(HistK, nbins: int, reg_lambda, min_rows,
     with leading K axes.  The K*L leaves flatten into one records-kernel
     launch (one dispatch for all K trees); ``feat_mask`` is [K, L, F] or
     [K, F].  Per-leaf reductions (argmax, valid's any(-1)) are row-local,
-    so flattening K into L is exact."""
+    so flattening K into L is exact.  Regularization params accept
+    scalars or per-member ``[K]`` arrays (batched grid sweeps); the flat
+    row order is K-major (row k*L+l), so ``repeat(x, L)`` aligns member
+    k's parameter with its leaves."""
     K, _, L, F, B = HistK.shape
     Hflat = jnp.moveaxis(HistK, 1, 0).reshape(3, K * L, F, B)
     fm = None
@@ -1741,10 +1794,15 @@ def fused_best_splits_batched(HistK, nbins: int, reg_lambda, min_rows,
         fm = feat_mask if feat_mask.ndim == 3 else \
             jnp.broadcast_to(feat_mask[:, None, :], (K, L, F))
         fm = fm.reshape(K * L, F)
+
+    def perk(x):                                   # [K] -> [K*L] (K-major)
+        return jnp.repeat(x, L) if getattr(x, "ndim", 0) else x
+
     feat, bin_, na_left, gain, valid, children = fused_best_splits(
-        Hflat, nbins, reg_lambda, min_rows, min_split_improvement,
-        feat_mask=fm, reg_alpha=reg_alpha, gamma=gamma,
-        min_child_weight=min_child_weight, force_impl=force_impl)
+        Hflat, nbins, perk(reg_lambda), perk(min_rows),
+        perk(min_split_improvement), feat_mask=fm,
+        reg_alpha=perk(reg_alpha), gamma=perk(gamma),
+        min_child_weight=perk(min_child_weight), force_impl=force_impl)
     return (feat.reshape(K, L), bin_.reshape(K, L),
             na_left.reshape(K, L), gain.reshape(K, L),
             valid.reshape(K, L), children.reshape(K, L, 6))

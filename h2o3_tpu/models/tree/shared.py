@@ -491,6 +491,14 @@ def validate_checkpoint_depth(prior, k, params, F: int, n_padded: int,
             f"max_depth to {pd}")
 
 
+def _per_k(x, extra_dims: int):
+    """Broadcast a per-member ``[K]`` parameter against ``extra_dims``
+    trailing axes; scalars pass through untouched so the scalar
+    (non-grid) trace stays byte-identical."""
+    return x.reshape(x.shape + (1,) * extra_dims) \
+        if getattr(x, "ndim", 0) else x
+
+
 @functools.lru_cache(maxsize=None)
 def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
                        hist_precision: str = "bf16", hier: bool = False,
@@ -774,8 +782,11 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
             # the K-tree analog of build() below: one level loop, every
             # array carrying a leading [K].  w may be [N] (row sample
             # shared across class trees — reference semantics) or [K, N]
-            # (uplift arms); either broadcasts to g's shape.
+            # (uplift arms); either broadcasts to g's shape.  The scalar
+            # params also accept per-member [K] arrays (batched grid
+            # sweeps) — anything that doesn't change trace shape batches.
             N = codes.shape[1]
+            csr2 = _per_k(col_sample_rate, 2)
             wK = jnp.broadcast_to(w, g.shape)
             leaf = jnp.zeros((nk, N), jnp.int32)
             levels = []
@@ -792,7 +803,7 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
                 L = 2 ** d
                 per_split = jax.vmap(
                     lambda kd: jax.random.uniform(kd, (L, F)))(
-                        keysK[:, d]) < col_sample_rate
+                        keysK[:, d]) < csr2
                 per_split = per_split.at[:, :, 0].set(
                     (per_split.any(axis=2) & per_split[:, :, 0])
                     | ~per_split.any(axis=2))
@@ -887,12 +898,12 @@ def make_build_tree_fn(max_depth: int, nbins: int, F: int, n_padded: int,
 
             def newton(gc, hc, cc):
                 return jnp.where(cc > 0,
-                                 newton_value(gc, hc, reg_lambda,
-                                              reg_alpha),
+                                 newton_value(gc, hc, _per_k(reg_lambda, 1),
+                                              _per_k(reg_alpha, 1)),
                                  0.0)
             vals = jnp.stack([newton(gl, hl, cl), newton(gr, hr, cr)],
                              axis=2).reshape(nk, -1)
-            vals = (vals * learn_rate).astype(jnp.float32)
+            vals = (vals * _per_k(learn_rate, 1)).astype(jnp.float32)
             cover = jnp.stack([cl, cr], axis=2).reshape(nk, -1) \
                 .astype(jnp.float32)
             return levels, vals, cover, leaf
@@ -1338,7 +1349,7 @@ def _make_scan_build(max_depth: int, nbins: int, F: int, n_padded: int,
             L = 2 ** d
             ps = jax.vmap(
                 lambda kd: jax.random.uniform(kd, (L, F)))(
-                    keysK[:, d]) < col_sample_rate
+                    keysK[:, d]) < _per_k(col_sample_rate, 2)
             ps = ps.at[:, :, 0].set(
                 (ps.any(axis=2) & ps[:, :, 0]) | ~ps.any(axis=2))
             return ps & tree_mask[:, None, :]
@@ -1414,11 +1425,12 @@ def _make_scan_build(max_depth: int, nbins: int, F: int, n_padded: int,
 
         def newton(gc, hc, cc):
             return jnp.where(cc > 0,
-                             newton_value(gc, hc, reg_lambda, reg_alpha),
+                             newton_value(gc, hc, _per_k(reg_lambda, 1),
+                                          _per_k(reg_alpha, 1)),
                              0.0)
         vals = jnp.stack([newton(gl, hl, cl), newton(gr, hr, cr)],
                          axis=2).reshape(nk, -1)
-        vals = (vals * learn_rate).astype(jnp.float32)
+        vals = (vals * _per_k(learn_rate, 1)).astype(jnp.float32)
         cover = jnp.stack([cl, cr], axis=2).reshape(nk, -1) \
             .astype(jnp.float32)
         return levels, vals, cover, leaf
@@ -2219,6 +2231,111 @@ def make_multinomial_scan_fn(K: int, max_depth: int, nbins: int, F: int,
                    static_argnums=(7,), orig=scan_fn)
 
 
+@functools.lru_cache(maxsize=None)
+def make_grid_scan_fn(G: int, mode: str, tweedie_power: float,
+                      quantile_alpha: float, huber_alpha: float,
+                      max_depth: int, nbins: int, F: int, n_padded: int,
+                      hist_precision: str, custom_fn=None,
+                      hist_mode: str = "subtract",
+                      tree_program: str = "level"):
+    """Scan a chunk of G-member GRID rounds in ONE dispatch.
+
+    The hyperparameter analog of ``make_multinomial_scan_fn``: the K
+    class-tree axis generalizes to G grid members of the SAME shape
+    (max_depth/nbins/ntrees/layout), each carrying its OWN scalar
+    hyperparameters as ``[G]`` operands — eta, row/column sample rates,
+    lambda/alpha/gamma, ``min_rows``/``min_child_weight``/
+    ``min_split_improvement``.  Anything that doesn't change trace shape
+    batches; the shared ``[F, N]`` codes stay unbatched.
+
+    Per-member RNG reproduces ``make_tree_scan_fn``'s sequential chains
+    bitwise: each member supplies its own root key (``rng0G [G, 2]``),
+    the chunk/tree/draw derivation (``fold_in(chunk_no)`` -> split ->
+    ks/km/kb with the salt-0 fold) is vmapped per member, and vmapped
+    threefry emits the per-key bits exactly — so a G-loop of sequential
+    ``make_tree_scan_fn`` builds is this program's bitwise oracle.
+    Row/column sampling draws ALWAYS happen here (the sequential path
+    skips them statically at rate 1.0); a rate-1.0 member's mask is
+    all-True and ``x * 1.0`` is an IEEE identity, so parity holds.
+
+    ``alive [G]`` is the successive-halving retirement mask, a TRACED
+    operand: retiring a member zeroes its row weights (all histograms
+    empty -> every split invalid -> zero leaf values -> its F column
+    freezes) without recompilation.
+
+    Unlike the single/multinomial factories the per-member params are
+    call operands, not factory constants — one compiled program serves
+    the whole cohort across rungs.  Fused splits + dense layout only
+    (grid cohorts gate hier/mono/EFB/sparse to the wave path).
+    """
+    from ..distributions import make_distribution
+    if G < 2:
+        raise ValueError("make_grid_scan_fn needs G >= 2 (a single "
+                         "member is the sequential path)")
+    dist = None
+    if mode != "drf":
+        dist = make_distribution(
+            mode, nclasses=2 if mode == "bernoulli" else 1,
+            tweedie_power=tweedie_power, quantile_alpha=quantile_alpha,
+            huber_alpha=huber_alpha, custom_distribution_func=custom_fn)
+    bt_fn = make_build_tree_fn(max_depth, nbins, F, n_padded,
+                               hist_precision, hist_mode=hist_mode,
+                               nk=G, split_mode="fused",
+                               hist_layout="dense",
+                               tree_program=tree_program)
+
+    def scan_fn(codes, y, w, F0, edges_mat, rng0G, chunk_no, nchunk,
+                reg_lambda, min_rows, min_split_improvement, learn_rate,
+                col_sample_rate, sample_rate, col_sample_rate_per_tree,
+                alive, reg_alpha, gamma, min_child_weight):
+        from .hist import table_lookup
+        N = codes.shape[1]
+        # per-member chunk keys, vmapped: [G, T, 2] -> scan xs [T, G, 2]
+        keysG = jax.vmap(
+            lambda r: jax.random.split(jax.random.fold_in(r, chunk_no),
+                                       nchunk))(rng0G)
+        keys = jnp.swapaxes(keysG, 0, 1)
+        srG = jnp.broadcast_to(jnp.asarray(sample_rate, jnp.float32), (G,))
+        csptG = jnp.broadcast_to(
+            jnp.asarray(col_sample_rate_per_tree, jnp.float32), (G,))
+
+        def body(Fc, keys_g):
+            kk = jax.vmap(lambda k: jax.random.split(k, 3))(keys_g)
+            ks, km, kb = kk[:, 0], kk[:, 1], kk[:, 2]
+            # the sequential scan applies the salt fold unconditionally
+            # (GBM salt=0, and fold_in(k, 0) != k) — replicate it
+            km = jax.vmap(lambda k: jax.random.fold_in(k, 0))(km)
+            kb = jax.vmap(lambda k: jax.random.fold_in(k, 0))(kb)
+            if mode == "drf":
+                g0 = jnp.broadcast_to(-y, Fc.shape)
+                h0 = jnp.ones_like(Fc)
+            else:
+                g0, h0 = jax.vmap(dist.grad_hess, in_axes=(None, 0))(y, Fc)
+            rs = jax.vmap(
+                lambda k2, r: jax.random.bernoulli(k2, r, (N,)))(ks, srG)
+            wv = (w[None, :] * rs) * alive[:, None]
+            m = jax.vmap(
+                lambda k2: jax.random.uniform(k2, (F,)))(km) \
+                < csptG[:, None]
+            tm = m.at[:, 0].set(m[:, 0] | ~m.any(axis=1))
+            levels, vals, cover, leafG = bt_fn(
+                codes, g0 * wv, h0 * wv, wv, edges_mat, kb, reg_lambda,
+                min_rows, min_split_improvement, learn_rate,
+                col_sample_rate, tm, reg_alpha, gamma, min_child_weight)
+            dF = jax.vmap(
+                lambda v, l: table_lookup(v[None, :], l,
+                                          v.shape[0])[0])(vals, leafG)
+            return Fc + dF, (tuple(tuple(lvl) for lvl in levels),
+                             vals, cover)
+
+        Ff, (lv, vals, covers) = jax.lax.scan(body, F0, keys)
+        return Ff, list(lv), vals, covers
+
+    return _ledger("tree_scan_grid",
+                   jax.jit(scan_fn, donate_argnums=(3,), static_argnums=(7,)),
+                   static_argnums=(7,), orig=scan_fn)
+
+
 # jitted-program caches keyed on distribution parameters (pure functions of
 # their key — custom UDF distributions bypass these)
 _PREDS_JIT_CACHE: dict = {}
@@ -2259,11 +2376,17 @@ def chunk_schedule(ntrees: int, score_tree_interval: int,
     and continues via a checkpoint segment).
     """
     from ...runtime import failure, scheduler
+    from .. import parallel
     interval = max(1, min(score_tree_interval, ntrees))
     cap = min(chunk_cap, interval)
     t = 0
     while t < ntrees:
         failure.maybe_inject("tree_chunk")
+        # cooperative max_runtime_secs cancel: a deadline set by
+        # map_builds (grid waves) or the cohort trainer fires HERE, at
+        # the chunk fence, so an in-flight member stops between chunks
+        # instead of overshooting the budget by a whole build
+        parallel.check_deadline()
         # chunk boundaries are the fence for elastic mesh rebuilds: a
         # host join armed by the membership observer applies here, and
         # the next compile re-traces against the rebuilt mesh
@@ -2520,6 +2643,11 @@ class SharedTree(ModelBuilder):
     # the tree family honors params.checkpoint, which also unlocks
     # train(warm_start=...) and StreamingFrame stream training
     _supports_checkpoint = True
+
+    #: builders whose fused driver can grow G same-shape grid members as
+    #: one batched program (models/tree/grid_batch.py); opted in per
+    #: subclass — the batched trainer mirrors GBM's fused chunk loop
+    _grid_batchable = False
 
     def _validate(self, frame) -> None:
         super()._validate(frame)

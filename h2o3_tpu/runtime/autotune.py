@@ -92,6 +92,12 @@ _PEAKS = {
     "cpu": (5.0e10, 5.0e10),
 }
 
+# device memory budget for the batched-grid resident-state gate: a
+# cohort holds G members' F vectors, gradients and level histograms at
+# once, so batching loses outright when that estimate blows the budget
+# (coarse, like _PEAKS — only the batched/parallel flip matters)
+_HBM_BUDGET = {"tpu": 3.2e10, "gpu": 1.6e10, "cpu": 8.0e9}
+
 # per-dispatch overhead for the tree_program dimension: each kernel
 # program the build launches separately costs roughly this much in
 # driver/dispatch latency (a tunnelled-backend round trip is ~50 ms —
@@ -546,6 +552,55 @@ def resolve_tree_knobs(params, *, kind: str, F: int, N: int, K: int = 1,
         int(knobs_out.get("sparse_depth_threshold", thr_raw)),
         knobs_out.get("tree_program", tree_program),
         sources, sig=sig, run_key=picked["run_key"])
+
+
+def resolve_grid_batch(*, kind: str, F: int, N: int, G: int,
+                       max_depth: int, nbins: int, K: int = 1) -> str:
+    """``grid_batch="auto"``: price ONE batched G-member cohort program
+    against G scheduler-parallel builds; returns ``"batched"`` or
+    ``"parallel"``.
+
+    The batched program does the same histogram/split compute but pays
+    the per-level dispatch overhead once instead of G times — so it wins
+    on dispatch-bound shapes — while holding G x the model state (F
+    vector, gradients, level histograms + carry) resident at once, so it
+    loses when that estimate blows the device memory budget.  The choice
+    key carries a ``|g{G}`` segment (cohort size is part of the
+    decision, like ``|p`` for tree_program).  Off-mode keeps the same
+    fixed model decision without recording: the knob is a performance
+    choice, not a correctness one, and the wave path stays the oracle."""
+    common = dict(hist_mode="subtract", split_mode="fused",
+                  hist_layout="dense",
+                  threshold=DEFAULT_SPARSE_THRESHOLD)
+    batched = _predict_tree_cost(F, N, K * G, max_depth, nbins, **common)
+    seq = G * _predict_tree_cost(F, N, K, max_depth, nbins, **common)
+    B = nbins + 1
+    W = 2 ** max(max_depth - 1, 0)
+    # resident cohort state: F/g/h/w row vectors plus the level
+    # histogram and its subtraction carry, x G members x K class trees
+    state = float(G) * K * (16.0 * N + 2 * 3.0 * W * F * B * 4.0)
+    budget = _HBM_BUDGET.get(_backend(), _HBM_BUDGET["cpu"])
+    choice = "parallel" if (state > budget
+                            or not math.isfinite(batched)
+                            or batched >= seq) else "batched"
+    if autotune_mode() == "off":
+        return choice
+    key = f"{choice}|g{G}"
+    with _lock:
+        sig = _signature(kind, F, N, K, max_depth, nbins) + ":grid"
+        ent = _DECISIONS.get(sig)
+        if ent is None:
+            _DECISIONS[sig] = ent = {
+                "sig": sig, "choice": key, "source": "model",
+                "predicted": {f"batched|g{G}": batched,
+                              f"parallel|g{G}": seq},
+                "measured": {}, "resolves": 0, "explore": None,
+                "epoch": _EPOCH, "candidates": {}}
+            _note_decision({"grid_batch": key}, "model")
+            _publish_cache_gauge()
+        ent["resolves"] += 1
+    _save_cache()
+    return choice
 
 
 # -------------------------------------------------- reduce / serve knobs

@@ -142,7 +142,7 @@ def _release_compiled_programs():
                    _h.make_batched_sparse_level_fn,
                    _h.make_scan_level_fn, _h.make_batched_scan_level_fn,
                    _s.make_build_tree_fn, _s.make_tree_scan_fn,
-                   _s.make_multinomial_scan_fn):
+                   _s.make_multinomial_scan_fn, _s.make_grid_scan_fn):
             fn.cache_clear()
     except Exception:
         pass

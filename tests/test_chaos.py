@@ -775,3 +775,99 @@ def test_kill_without_snapshot_still_resumes_from_zero(cl, tmp_path):
              if line.startswith("RESUME_INFO ")).split(" ", 1)[1])
     assert info["ntrees"] == NTREES and info["cursor"] is None
     assert not list(kill_dir.glob("job_*.json"))
+
+
+_TRAIN_GRID = textwrap.dedent("""
+    import sys
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import h2o3_tpu
+    h2o3_tpu.init()
+    from h2o3_tpu.frame.parse import import_file
+    from h2o3_tpu.models import GBM, GridSearch
+    fr = import_file(sys.argv[1], destination_frame="chaos_fr")
+    g = GridSearch(GBM, {{"learn_rate": [0.1, 0.3]}}, grid_batch="on",
+                   response_column="y", ntrees={nt}, max_depth=3,
+                   seed=7, score_tree_interval=2).train(fr)
+    assert all(m.output["grid_cohort"]["size"] == 2 for m in g.models)
+    out = {{str(m.params.learn_rate):
+           m.predict(fr).to_numpy()[:, 0] for m in g.models}}
+    np.savez(sys.argv[2], **out)
+    print("TRAINED", sorted(m.output["ntrees_trained"] for m in g.models))
+""").format(nt=NTREES)
+
+_RESUME_GRID = textwrap.dedent("""
+    import json
+    import sys
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import h2o3_tpu
+    h2o3_tpu.init()
+    from h2o3_tpu.frame.parse import import_file
+    from h2o3_tpu.runtime import dkv, recovery
+    fr = import_file(sys.argv[1], destination_frame="chaos_fr")
+    done = recovery.resume()
+    assert len(done) == 2, f"expected 2 resumed members, got {{done}}"
+    models = [dkv.get(k) for k in done]
+    print("RESUME_INFO", json.dumps({{
+        "ntrees": sorted(m.output["ntrees_trained"] for m in models),
+        "cursors": sorted(
+            m.output["resumed_from_snapshot"]["cursor"]["trees_done"]
+            for m in models)}}))
+    np.savez(sys.argv[2], **{{str(m.params.learn_rate):
+             m.predict(fr).to_numpy()[:, 0] for m in models}})
+""").format()
+
+
+def test_kill_resume_mid_grid_cohort(cl, tmp_path):
+    """Chaos row for batched grid sweeps: a 2-member cohort trains as ONE
+    compiled program, so a hard kill at a tree-chunk fence interrupts
+    BOTH members at once — and must leave one resumable journal entry
+    per member (each with its own chunk-granular snapshot).  A fresh
+    process resume()s every member independently through the sequential
+    checkpoint path; both surviving models must match the uninterrupted
+    batched run."""
+    csv = _write_csv(tmp_path / "chaos_grid.csv")
+    base_dir = tmp_path / "base_grid"
+    base_dir.mkdir()
+
+    base_npz = str(tmp_path / "base_grid.npz")
+    out = _run(_TRAIN_GRID, _chaos_env(base_dir), csv, base_npz)
+    assert f"TRAINED [{NTREES}, {NTREES}]" in out.stdout
+    assert not list(base_dir.glob("job_*.json"))
+
+    kill_dir = tmp_path / "kill_grid"
+    kill_dir.mkdir()
+    kill_npz = str(tmp_path / "kill_grid.npz")
+    _run(_TRAIN_GRID,
+         _chaos_env(kill_dir,
+                    {"H2O3_TPU_FAULT_INJECT":
+                     f"tree_chunk:0:{KILL_AT_CHUNK}"}),
+         csv, kill_npz, expect_rc=137)
+    assert not os.path.exists(kill_npz)          # it really died mid-cohort
+    entries = [json.loads(p.read_text())
+               for p in kill_dir.glob("job_*.json")]
+    assert len(entries) == 2                     # one journal PER MEMBER
+    for entry in entries:
+        assert entry["status"] == "running"
+        assert entry["snapshot_uri"]
+        cursor = entry["snapshot_cursor"]
+        assert cursor["trees_done"] == 2 * (KILL_AT_CHUNK - 1)
+        assert cursor["granularity"] == "tree_chunk"
+
+    res_npz = str(tmp_path / "resumed_grid.npz")
+    out = _run(_RESUME_GRID, _chaos_env(kill_dir), csv, res_npz)
+    info = json.loads(
+        next(line for line in out.stdout.splitlines()
+             if line.startswith("RESUME_INFO ")).split(" ", 1)[1])
+    assert info["ntrees"] == [NTREES, NTREES]
+    assert info["cursors"] == [2 * (KILL_AT_CHUNK - 1)] * 2
+    assert not list(kill_dir.glob("job_*.json"))
+
+    base, resumed = np.load(base_npz), np.load(res_npz)
+    assert sorted(base.files) == sorted(resumed.files) == ["0.1", "0.3"]
+    for lr in base.files:
+        np.testing.assert_allclose(resumed[lr], base[lr],
+                                   rtol=1e-4, atol=1e-4)

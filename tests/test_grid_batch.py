@@ -1,0 +1,338 @@
+"""Batched grid sweeps: G same-shape configs as ONE compiled program.
+
+The model axis rides the kernels' ``nk`` batch dimension (SURVEY.md: the
+reference trains grid members as separate scheduler jobs; here
+shape-compatible members vmap), so the contract is bitwise: every member
+of a batched cohort must predict exactly what its sequential wave-path
+twin predicts.  Successive halving retires losers through the traced
+alive mask — same program, zero recompiles.
+"""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu import Frame
+from h2o3_tpu.models import GBM, GridSearch
+from h2o3_tpu.models.tree import grid_batch as gb
+from h2o3_tpu.runtime import dkv, failure, recovery, snapshot
+from h2o3_tpu.runtime.config import reload as config_reload
+from h2o3_tpu.runtime.observability import timeline_events
+
+
+def _reg_frame(rng, n=300, f=5):
+    X = rng.normal(size=(n, f))
+    y = X[:, 0] + 0.5 * X[:, 1] ** 2 + rng.normal(scale=0.1, size=n)
+    return Frame.from_numpy(
+        {**{f"x{j}": X[:, j] for j in range(f)}, "y": y})
+
+
+_BASE = dict(response_column="y", ntrees=5, max_depth=3, nbins=16,
+             seed=11, reproducible=True)
+
+
+def _pred(m, fr):
+    return np.asarray(m.predict(fr).vec("predict").to_numpy())
+
+
+def _by(models, *names):
+    return {tuple(getattr(m.params, n) for n in names): m for m in models}
+
+
+# ---------------------------------------------------------------- parity
+
+@pytest.mark.parametrize("tree_program", ["level", "scan"])
+def test_cohort_parity_bitwise(cl, rng, tree_program):
+    """Batched cohort == sequential wave path, bit for bit, and the
+    cohort actually ENGAGED (grid_cohort tag) — a silent fallback would
+    make this parity vacuously true."""
+    fr = _reg_frame(rng)
+    hp = {"learn_rate": [0.05, 0.2], "reg_lambda": [0.0, 1.0]}
+    kw = dict(_BASE, tree_program=tree_program)
+    g_on = GridSearch(GBM, hp, grid_batch="on", **kw).train(fr)
+    g_off = GridSearch(GBM, hp, grid_batch="off", **kw).train(fr)
+    assert len(g_on.models) == 4 and len(g_off.models) == 4
+    for m in g_on.models:
+        assert m.output["grid_cohort"] == {
+            "size": 4, "member": m.output["grid_cohort"]["member"]}
+    for m in g_off.models:
+        assert m.output.get("grid_cohort") is None
+    mo = _by(g_on.models, "learn_rate", "reg_lambda")
+    mf = _by(g_off.models, "learn_rate", "reg_lambda")
+    assert set(mo) == set(mf)
+    for k in mo:
+        assert np.array_equal(_pred(mo[k], fr), _pred(mf[k], fr)), k
+
+
+def test_cohort_parity_sampling_params(cl, rng):
+    """Row/column sampling rates batch as [G] operands: the vmapped
+    threefry draws must match the sequential per-member streams (rate-1.0
+    members take the always-draw path whose masks are IEEE-identical to
+    the sequential static skip)."""
+    fr = _reg_frame(rng)
+    hp = {"sample_rate": [0.7, 1.0], "col_sample_rate_per_tree": [0.8, 1.0]}
+    kw = dict(_BASE, col_sample_rate=0.6)
+    g_on = GridSearch(GBM, hp, grid_batch="on", **kw).train(fr)
+    g_off = GridSearch(GBM, hp, grid_batch="off", **kw).train(fr)
+    assert all(m.output.get("grid_cohort") for m in g_on.models)
+    mo = _by(g_on.models, "sample_rate", "col_sample_rate_per_tree")
+    mf = _by(g_off.models, "sample_rate", "col_sample_rate_per_tree")
+    for k in mo:
+        assert np.array_equal(_pred(mo[k], fr), _pred(mf[k], fr)), k
+
+
+def test_mixed_shape_grid_partitions_into_cohorts(cl, rng):
+    """max_depth changes the traced program, so a [2,3]x[lr] grid splits
+    into two depth-homogeneous cohorts — both batched, both bitwise."""
+    fr = _reg_frame(rng)
+    hp = {"max_depth": [2, 3], "learn_rate": [0.1, 0.2]}
+    kw = {k: v for k, v in _BASE.items() if k != "max_depth"}
+    g_on = GridSearch(GBM, hp, grid_batch="on", **kw).train(fr)
+    g_off = GridSearch(GBM, hp, grid_batch="off", **kw).train(fr)
+    coh = [m.output.get("grid_cohort") for m in g_on.models]
+    assert all(c is not None and c["size"] == 2 for c in coh), coh
+    mo = _by(g_on.models, "max_depth", "learn_rate")
+    mf = _by(g_off.models, "max_depth", "learn_rate")
+    for k in mo:
+        assert np.array_equal(_pred(mo[k], fr), _pred(mf[k], fr)), k
+
+
+# ----------------------------------------------------- cohort planning
+
+def test_plan_cohorts_partitioning_rules(cl):
+    """Unit contract: batchable knobs group, shape knobs split, ineligible
+    and singleton members take the wave path with a reason."""
+    base = dict(_BASE)
+    combos = [
+        {"learn_rate": 0.1, "max_depth": 3},    # cohort A
+        {"learn_rate": 0.2, "max_depth": 3},    # cohort A
+        {"learn_rate": 0.1, "max_depth": 4},    # cohort B
+        {"reg_lambda": 2.0, "max_depth": 4},    # cohort B
+        {"learn_rate": 0.1, "max_depth": 5},    # singleton -> rest
+        {"learn_rate": 0.1, "max_depth": 3, "nfolds": 2},  # ineligible
+    ]
+    cohorts, rest = gb.plan_cohorts(GBM, base, combos)
+    grouped = sorted(sorted(c) for c in cohorts)
+    assert grouped == [[0, 1], [2, 3]]
+    reasons = dict(rest)
+    assert set(reasons) == {4, 5}
+    assert "singleton" in reasons[4]
+    assert "nfolds" in reasons[5]
+
+
+def test_fallback_is_recorded_and_wave_path_still_trains(cl, rng):
+    """An all-ineligible grid (nfolds) falls back wholesale: every model
+    still trains (wave path), none carries a cohort tag, and the
+    fallback reasons land on the observability timeline."""
+    fr = _reg_frame(rng)
+    hp = {"learn_rate": [0.1, 0.2]}
+    g = GridSearch(GBM, hp, grid_batch="auto", nfolds=2,
+                   **_BASE).train(fr)
+    assert len(g.models) == 2
+    assert all(m.output.get("grid_cohort") is None for m in g.models)
+    falls = [e for e in timeline_events(500)
+             if e["kind"] == "grid_batch_fallback"]
+    assert any("nfolds" in str(e.get("reason")) for e in falls)
+
+
+# ------------------------------------------------- successive halving
+
+def test_halving_survivors_match_oracle(cl, rng):
+    """In-batch successive halving: retirement happens at scoring
+    fences via the alive mask, the survivor equals the
+    train-to-completion oracle's best member, and the one compiled
+    cohort program never recompiles (ledger: no shape_change)."""
+    from h2o3_tpu.runtime import xprof
+    fr = _reg_frame(rng)
+    hp = {"learn_rate": [0.01, 0.05, 0.1, 0.3]}
+    kw = dict(_BASE, ntrees=12, score_tree_interval=3)
+    before = xprof.ledger_snapshot().get("programs", {}).get(
+        "tree_scan_grid", {})
+    g = GridSearch(
+        GBM, hp, grid_batch="on",
+        search_criteria={"successive_halving": True, "halving_eta": 2},
+        **kw).train(fr)
+    after = xprof.ledger_snapshot().get("programs", {}).get(
+        "tree_scan_grid", {})
+    # warmup costs at most 2 compiles (first trace + the one sharding
+    # settle every fused driver pays under the mesh — tree_scan shows
+    # the same); 3 retirements across 3 rungs must add ZERO, or this
+    # delta would be >= 5
+    delta = after.get("compiles", 0) - before.get("compiles", 0)
+    assert delta <= 2, dict(after.get("reasons", {}))
+
+    retired = [m for m in g.models
+               if (m.output.get("halving") or {}).get("retired_at")]
+    survivors = [m for m in g.models
+                 if not (m.output.get("halving") or {}).get("retired_at")]
+    assert len(retired) == 3 and len(survivors) == 1
+    # retired members froze at their rung's tree count
+    for m in retired:
+        assert m.output["ntrees_trained"] < 12
+    assert survivors[0].output["ntrees_trained"] == 12
+
+    full = GridSearch(GBM, hp, grid_batch="off", **kw).train(fr)
+
+    def final_dev(m):
+        return m.scoring_history[-1].get("mean_residual_deviance",
+                                         math.inf)
+
+    best = min(full.models, key=final_dev)
+    assert survivors[0].params.learn_rate == best.params.learn_rate
+
+
+def test_halving_rungs_schedule(cl):
+    assert gb._halving_rungs(8, 40, 2.0) == [(5, 4), (10, 2), (20, 1)]
+    assert gb._halving_rungs(2, 10, 3.0) == []  # R=0: nothing to retire
+    assert gb._halving_rungs(9, 27, 3.0) == [(3, 3), (9, 1)]
+    assert gb._halving_rungs(4, 8, 1.0) == []   # eta<=1 disables
+
+
+# ------------------------------------------------- fault tolerance
+
+def test_grid_member_failure_is_isolated(cl, rng, monkeypatch):
+    """A member that dies (injected at the grid_member point) becomes a
+    failed_entries row; its cohort siblings finish normally and their
+    predictions still match the sequential path bitwise."""
+    fr = _reg_frame(rng)
+    hp = {"learn_rate": [0.05, 0.1, 0.2]}
+    monkeypatch.setenv("H2O3_TPU_FAULT_INJECT", "grid_member:0:2:raise")
+    failure.reset()
+    g = GridSearch(GBM, hp, grid_batch="on", **_BASE).train(fr)
+    monkeypatch.delenv("H2O3_TPU_FAULT_INJECT")
+    failure.reset()
+    assert len(g.models) == 2
+    assert len(g.failed_entries) == 1
+    assert "InjectedFault" in g.failed_entries[0]["error"]
+    failed_lr = g.failed_entries[0]["learn_rate"]
+    g_off = GridSearch(GBM, hp, grid_batch="off", **_BASE).train(fr)
+    mo = _by(g.models, "learn_rate")
+    mf = _by(g_off.models, "learn_rate")
+    for k in mo:
+        assert k[0] != failed_lr
+        assert np.array_equal(_pred(mo[k], fr), _pred(mf[k], fr)), k
+
+
+def test_wave_member_failure_is_isolated(cl, rng, monkeypatch):
+    """Same contract on the sequential wave path (grid_batch='off')."""
+    fr = _reg_frame(rng)
+    hp = {"learn_rate": [0.05, 0.2]}
+    monkeypatch.setenv("H2O3_TPU_FAULT_INJECT", "grid_member:0:1:raise")
+    failure.reset()
+    g = GridSearch(GBM, hp, grid_batch="off", **_BASE).train(fr)
+    monkeypatch.delenv("H2O3_TPU_FAULT_INJECT")
+    failure.reset()
+    assert len(g.models) == 1
+    assert len(g.failed_entries) == 1
+    assert "InjectedFault" in g.failed_entries[0]["error"]
+
+
+def test_failed_entries_survive_grid_save_load(cl, rng, monkeypatch,
+                                               tmp_path):
+    fr = _reg_frame(rng)
+    monkeypatch.setenv("H2O3_TPU_FAULT_INJECT", "grid_member:0:1:raise")
+    failure.reset()
+    g = GridSearch(GBM, {"learn_rate": [0.05, 0.2]}, grid_batch="on",
+                   **_BASE).train(fr)
+    monkeypatch.delenv("H2O3_TPU_FAULT_INJECT")
+    failure.reset()
+    assert g.failed_entries
+    path = g.save(str(tmp_path / "grid"))
+    g2 = type(g).load(path)
+    assert g2.failed_entries == g.failed_entries
+
+
+# ------------------------------------------------- runtime budget
+
+def test_max_runtime_secs_expired_before_start(cl, rng):
+    """A deadline that has already passed trains nothing — the grid
+    raises rather than silently returning an empty Grid."""
+    fr = _reg_frame(rng)
+    with pytest.raises(ValueError, match="no models"):
+        GridSearch(GBM, {"learn_rate": [0.1, 0.2]}, grid_batch="on",
+                   search_criteria={"max_runtime_secs": 1e-9},
+                   **_BASE).train(fr)
+
+
+def test_max_runtime_secs_generous_budget_completes(cl, rng):
+    fr = _reg_frame(rng)
+    g = GridSearch(GBM, {"learn_rate": [0.1, 0.2]}, grid_batch="on",
+                   search_criteria={"max_runtime_secs": 600},
+                   **_BASE).train(fr)
+    assert len(g.models) == 2
+    assert all(m.output.get("grid_cohort") for m in g.models)
+
+
+# ------------------------------------------------- mid-cohort resume
+
+@pytest.fixture()
+def recovery_env(cl, tmp_path, monkeypatch):
+    monkeypatch.setenv("H2O3_TPU_RECOVERY_DIR", str(tmp_path))
+    monkeypatch.setenv("H2O3_TPU_SNAPSHOT_INTERVAL", "0")
+    monkeypatch.setenv("H2O3_TPU_SNAPSHOT_ASYNC", "0")
+    config_reload()
+    snapshot.reset()
+    failure.reset()
+    yield tmp_path
+    snapshot.reset()
+    failure.reset()
+    monkeypatch.delenv("H2O3_TPU_RECOVERY_DIR", raising=False)
+    monkeypatch.delenv("H2O3_TPU_SNAPSHOT_INTERVAL", raising=False)
+    monkeypatch.delenv("H2O3_TPU_SNAPSHOT_ASYNC", raising=False)
+    monkeypatch.delenv("H2O3_TPU_FAULT_INJECT", raising=False)
+    config_reload()
+
+
+def test_mid_cohort_crash_resumes_every_member(recovery_env, monkeypatch,
+                                               rng):
+    """Kill a cohort at the 2nd tree-chunk fence: every member's journal
+    stays 'running' with a per-member snapshot, and recovery.resume()
+    finishes each one through the sequential checkpoint path to the same
+    predictions (resume tolerance) as an uninterrupted run."""
+    tmp_path = recovery_env
+    n = 300
+    X = np.random.default_rng(5).random((n, 4))
+    y = 7 * np.sin(np.pi * X[:, 0]) + 3 * X[:, 1] + 0.1 * X[:, 2]
+    cols = {**{f"x{j}": X[:, j] for j in range(4)}, "y": y}
+    fr = h2o3_tpu.H2OFrame(cols, destination_frame="gridbatch_resume_fr")
+    kw = dict(response_column="y", ntrees=8, max_depth=3, nbins=16,
+              seed=7, score_tree_interval=2, reproducible=True)
+    hp = {"learn_rate": [0.1, 0.3]}
+    monkeypatch.setenv("H2O3_TPU_FAULT_INJECT", "tree_chunk:0:2:raise")
+    failure.reset()
+    failure._handled.add("ghost")       # degraded: keep journal resumable
+    with pytest.raises(failure.InjectedFault):
+        GridSearch(GBM, hp, grid_batch="on", **kw).train(fr)
+    monkeypatch.delenv("H2O3_TPU_FAULT_INJECT")
+    failure.reset()
+
+    entries = [json.loads(p.read_text())
+               for p in tmp_path.glob("job_*.json")]
+    running = [e for e in entries if e["status"] == "running"]
+    assert len(running) == 2            # one per cohort member
+    for e in running:
+        assert e["snapshot_uri"]
+        assert e["snapshot_cursor"]["trees_done"] == 2
+
+    done = recovery.resume(str(tmp_path))
+    assert len(done) == 2
+    resumed = {}
+    for key in done:
+        m = dkv.get(key)
+        assert m.output["ntrees_trained"] == 8
+        assert m.output["resumed_from_snapshot"]["cursor"][
+            "trees_done"] == 2
+        resumed[m.params.learn_rate] = m
+    assert set(resumed) == {0.1, 0.3}
+
+    ref = GridSearch(GBM, hp, grid_batch="off", **kw).train(fr)
+    for m in ref.models:
+        # same tolerance as the single-model resume contract
+        # (test_snapshot_recovery): the checkpoint continuation is
+        # allclose to uninterrupted, not bitwise
+        np.testing.assert_allclose(
+            _pred(resumed[m.params.learn_rate], fr), _pred(m, fr),
+            rtol=1e-4, atol=1e-4)

@@ -311,6 +311,40 @@ def test_remote_workflow_fast(cl, server, rng, tmp_path):
     assert zipfile.is_zipfile(mojo)
 
 
+def test_grid_batch_knob_and_failures_over_rest(cl, server, rng, tmp_path):
+    """The generated H2OGridSearch bindings class drives /99/Grid with
+    the grid_batch knob, and a member whose params fail validation
+    surfaces in the grid schema's failed_entries instead of failing the
+    whole POST (GridSchemaV99 failure_details analog)."""
+    from h2o3_tpu import client as h2oc
+    from h2o3_tpu.estimators import H2OGBMEstimator, H2OGridSearch
+    n = 150
+    X = rng.normal(size=(n, 2))
+    yv = X[:, 0] - 0.5 * X[:, 1] + 0.1 * rng.normal(size=n)
+    csv = tmp_path / "grid_rest.csv"
+    with open(csv, "w") as f:
+        f.write("a,b,y\n")
+        for i in range(n):
+            f.write(f"{X[i,0]:.5f},{X[i,1]:.5f},{yv[i]:.5f}\n")
+    conn = h2oc.connect(server.url)
+    fr = conn.import_file(str(csv), destination_frame="grid_rest")
+
+    base = H2OGBMEstimator(response_column="y", ntrees=3, max_depth=2,
+                           seed=7, reproducible=True)
+    gs = H2OGridSearch(base, {"learn_rate": [0.1, 0.3]}, grid_batch="on")
+    grid = gs.train(fr)
+    assert len(grid.model_ids) == 2
+    assert grid.failed_entries == [] and gs.failed_entries == []
+    assert grid.refresh().failed_entries == []   # GET path carries it too
+
+    bad = H2OGridSearch(base, {"distribution": ["gaussian", "bogus"]})
+    grid2 = bad.train(fr)
+    assert len(grid2.model_ids) == 1
+    assert len(grid2.failed_entries) == 1
+    assert grid2.failed_entries[0]["distribution"] == "bogus"
+    assert "error" in grid2.failed_entries[0]
+
+
 def test_model_upload_rejects_pickle_gadgets(cl, server, tmp_path):
     """POST /3/Models.upload.bin must refuse pickles that reference
     globals outside the model-artifact allowlist (RCE gadget defense)."""

@@ -11,6 +11,12 @@
 #     snapshot; final predictions must match an uninterrupted run,
 #     including the deep-level sparse layout and multinomial variants
 #     and the no-snapshot resume-from-zero row (tests/test_chaos.py),
+#   - grid-batch:         a 2-member batched grid cohort (one compiled
+#     program for both members) hard-killed at a tree-chunk fence; a
+#     fresh process finds one resumable journal entry PER MEMBER and
+#     recovery.resume() finishes each through the sequential checkpoint
+#     path to the uninterrupted batched run's predictions
+#     (tests/test_chaos.py),
 #   - scan-kill:          the same hard-kill at a tree-chunk fence with
 #     tree_program="scan" engaged — the whole-tree scan program's
 #     coarser per-tree-chunk snapshots resume to predictions equal to
@@ -79,9 +85,12 @@ run_row kill-resume tests/test_chaos.py \
     --deselect tests/test_chaos.py::test_coordinator_hard_kill_midtrain_rehydrate_reattach \
     --deselect tests/test_chaos.py::test_host_kill_mid_multitenant_load \
     --deselect tests/test_chaos.py::test_host_join_fenced_rebuild_midtrain \
-    --deselect tests/test_chaos.py::test_kill_resume_mid_scan_program
+    --deselect tests/test_chaos.py::test_kill_resume_mid_scan_program \
+    --deselect tests/test_chaos.py::test_kill_resume_mid_grid_cohort
 run_row scan-kill \
     tests/test_chaos.py::test_kill_resume_mid_scan_program
+run_row grid-batch \
+    tests/test_chaos.py::test_kill_resume_mid_grid_cohort
 run_row coordinator-kill \
     tests/test_chaos.py::test_coordinator_hard_kill_midtrain_rehydrate_reattach
 run_row multitenant-kill \
